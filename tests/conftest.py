@@ -1,0 +1,24 @@
+"""Test config: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's "multi-node without a real cluster" strategy
+(adapters/repos/db/clusterintegrationtest/ spins 10 in-process nodes):
+we spin 8 virtual XLA CPU devices so every sharding/collective path is
+exercised without TPU hardware. Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,17 @@
+"""weaviate_tpu — a TPU-native vector database framework.
+
+A from-scratch re-design of the capabilities of the reference vector database
+(Weaviate, surveyed in SURVEY.md) for TPU hardware:
+
+- Vectors live in HBM as JAX arrays, sharded over a `jax.sharding.Mesh`.
+- Distance kernels (l2-squared / dot / cosine / hamming / manhattan) are
+  batched matmul-shaped ops that map onto the MXU, with Pallas kernels for
+  the fused scan paths (reference: hand-written SIMD assembly in
+  adapters/repos/db/vector/hnsw/distancer/asm/*.s).
+- Cross-shard top-k merges ride ICI collectives inside one compiled program
+  (reference: HTTP scatter-gather in adapters/repos/db/index.go:1541).
+- The serving/control plane (schema, LSM object store, inverted index,
+  cluster membership, replication) is host-side Python/C++.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+"""TPU compute ops: distance kernels, top-k, quantization.
+
+This package replaces the reference's native hot-path code
+(adapters/repos/db/vector/hnsw/distancer/ — SIMD assembly for single-pair
+distances) with batched, MXU-friendly ops: one call scores a whole [B, d]
+query block against an [N, d] corpus block instead of one pair at a time.
+"""
+
+from weaviate_tpu.ops.distances import (
+    DISTANCE_METRICS,
+    pairwise_distance,
+    single_distance,
+    normalize,
+)
+from weaviate_tpu.ops.topk import chunked_topk, merge_topk
+
+__all__ = [
+    "DISTANCE_METRICS",
+    "pairwise_distance",
+    "single_distance",
+    "normalize",
+    "chunked_topk",
+    "merge_topk",
+]

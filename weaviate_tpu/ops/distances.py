@@ -1,0 +1,129 @@
+"""Batched distance kernels.
+
+Reference semantics (adapters/repos/db/vector/hnsw/distancer/):
+- ``l2-squared``  sum((a-b)^2)                       l2.go:16-24
+- ``dot``         -dot(a, b)  (negated so that lower = closer)
+                                                     dot_product.go:32-34
+- ``cosine``      1 - dot(a, b) with both vectors pre-normalized at insert
+                  (the provider is literally "cosine-dot")
+                                                     cosine_dist.go:28,44
+- ``hamming``     count of positions where a[i] != b[i] (float vectors)
+                                                     hamming.go:18-27
+- ``manhattan``   sum(|a-b|)                         manhattan.go:20-29
+
+The reference dispatches to per-pair SIMD assembly (AVX2/AVX512/NEON/SVE,
+distancer/asm/*.s). On TPU the idiomatic shape is the transpose of that
+design: score a whole query block against a whole corpus block in one
+matmul-shaped op so the FLOPs land on the 128x128 MXU systolic array.
+All functions here are jit-friendly: static shapes, no Python branching on
+traced values.
+
+Layout convention: queries ``q`` are [B, d], corpus ``x`` is [N, d], the
+result is [B, N] of float32 distances (lower = closer), regardless of the
+storage dtype (bf16 storage accumulates in f32 via preferred_element_type).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DISTANCE_METRICS = ("l2-squared", "dot", "cosine", "cosine-dot", "hamming", "manhattan")
+
+# Distance value used to mask out dead/unfilled corpus slots so they can
+# never win a top-k. Finite (not +inf) so sorts and NaN-propagation stay sane.
+# Plain Python float: a jnp constant here would initialize the JAX backend
+# at import time.
+MASKED_DISTANCE = float(np.float32(3.0e38))
+
+
+def normalize(v: jnp.ndarray, eps: float = 1e-30) -> jnp.ndarray:
+    """L2-normalize along the last axis (reference: distancer/normalize.go:16).
+
+    Zero vectors are passed through unchanged rather than producing NaN.
+    """
+    norm = jnp.linalg.norm(v, axis=-1, keepdims=True)
+    return v / jnp.where(norm > eps, norm, 1.0)
+
+
+def _dot_matrix(q: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """[B,d]x[N,d] -> [B,N] inner products, f32 accumulation on the MXU.
+
+    Precision: when both operands are f32 we request HIGHEST so XLA does the
+    multi-pass f32-accurate matmul — parity with the reference's exact f32
+    SIMD kernels (SURVEY §7 hard part #5: recall drift). When the store holds
+    bf16 (the fast path), the single-pass MXU matmul is used as-is.
+    """
+    f32_exact = q.dtype == jnp.float32 and x.dtype == jnp.float32
+    return jax.lax.dot_general(
+        q,
+        x,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST if f32_exact else jax.lax.Precision.DEFAULT,
+    )
+
+
+def _sq_norms(x: jnp.ndarray) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    return jnp.sum(x32 * x32, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def pairwise_distance(
+    q: jnp.ndarray,
+    x: jnp.ndarray,
+    metric: str = "l2-squared",
+    x_sq_norms: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Distances between every query in ``q`` [B,d] and every row of ``x`` [N,d].
+
+    Returns [B, N] float32; lower = closer for every metric (dot is negated,
+    matching the reference's convention so one top-k works for all metrics).
+
+    ``x_sq_norms`` is an optional precomputed [N] array of squared row norms
+    of ``x`` — the corpus-side term of the l2 expansion. The vector store
+    maintains it incrementally so a query only computes the [B]-sized query
+    norms + one matmul.
+    """
+    if metric not in DISTANCE_METRICS:
+        raise ValueError(f"unknown distance metric {metric!r}; expected one of {DISTANCE_METRICS}")
+
+    if metric == "l2-squared":
+        # ||q-x||^2 = ||q||^2 - 2 q.x + ||x||^2 : one MXU matmul + rank-1 terms,
+        # instead of the O(N*d) subtract-square-reduce the reference asm does
+        # per pair. Clamp at 0 to hide cancellation error for near-identical rows.
+        dots = _dot_matrix(q, x)
+        qn = _sq_norms(q)[:, None]
+        xn = (_sq_norms(x) if x_sq_norms is None else x_sq_norms.astype(jnp.float32))[None, :]
+        return jnp.maximum(qn - 2.0 * dots + xn, 0.0)
+
+    if metric == "dot":
+        return -_dot_matrix(q, x)
+
+    if metric in ("cosine", "cosine-dot"):
+        # Vectors are pre-normalized at insert time (reference normalizes in
+        # the store path); queries are normalized here for safety.
+        return 1.0 - _dot_matrix(normalize(q.astype(jnp.float32)), x)
+
+    if metric == "hamming":
+        # Elementwise compare + popcount-style reduce. VPU op; no MXU use.
+        neq = (q[:, None, :] != x[None, :, :]).astype(jnp.float32)
+        return jnp.sum(neq, axis=-1)
+
+    # manhattan
+    diff = jnp.abs(q[:, None, :].astype(jnp.float32) - x[None, :, :].astype(jnp.float32))
+    return jnp.sum(diff, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def single_distance(a: jnp.ndarray, b: jnp.ndarray, metric: str = "l2-squared") -> jnp.ndarray:
+    """Distance between two single vectors [d],[d] -> scalar f32.
+
+    Parity with the reference's ``SingleDist`` (distancer/provider.go) used in
+    tests and PQ training.
+    """
+    return pairwise_distance(a[None, :], b[None, :], metric=metric)[0, 0]

@@ -1,0 +1,134 @@
+"""Top-k selection over large corpora.
+
+The reference merges per-shard results with a host-side sort
+(adapters/repos/db/index.go:1644-1648) and maintains per-query binary heaps
+in the HNSW hot loop (priorityqueue/queue.go). On TPU, selection is done
+with ``jax.lax.top_k`` over distance tiles, with two composition primitives:
+
+- ``chunked_topk``: scan an [N] axis in fixed-size chunks, carrying a running
+  top-k — bounds peak memory to O(B * chunk) instead of O(B * N) so a single
+  query batch can scan an HBM-resident corpus of any size.
+- ``merge_topk``: merge candidate sets (e.g. per-device partial top-k after an
+  all_gather over ICI) into a final top-k.
+
+All shapes static; distances follow the "lower = closer" convention.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from weaviate_tpu.ops.distances import MASKED_DISTANCE, pairwise_distance
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk_smallest(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
+    """Smallest-k along the last axis. dists [B,N] f32, ids [N] or [B,N] int32.
+
+    Returns (top_dists [B,k], top_ids [B,k]) sorted ascending by distance.
+    """
+    neg_d, idx = jax.lax.top_k(-dists, k)
+    if ids.ndim == 1:
+        top_ids = ids[idx]
+    else:
+        top_ids = jnp.take_along_axis(ids, idx, axis=-1)
+    return -neg_d, top_ids
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def merge_topk(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
+    """Merge candidate sets: dists [B, M], ids [B, M] -> top-k of the union.
+
+    Used for the cross-shard reduce: every device contributes its local top-k,
+    the [n_shards*k] candidates are all-gathered over ICI, and this picks the
+    global winners (replaces the reference's host-side merge+sort+truncate,
+    index.go:1644-1648).
+    """
+    return topk_smallest(dists, ids, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk_size", "metric"))
+def chunked_topk_distances(
+    q: jnp.ndarray,
+    x: jnp.ndarray,
+    k: int,
+    chunk_size: int,
+    metric: str = "l2-squared",
+    valid: jnp.ndarray | None = None,
+    x_sq_norms: jnp.ndarray | None = None,
+    id_offset: jnp.ndarray | int = 0,
+):
+    """Brute-force top-k of ``q`` [B,d] against ``x`` [N,d], scanning in chunks.
+
+    ``valid`` is an optional [N] bool mask (live slots / filter AllowList —
+    the device-side analog of the reference's roaring-bitmap allow list,
+    helpers/allow_list.go:19); invalid slots get MASKED_DISTANCE so they never
+    surface. ``id_offset`` shifts local row indices into global id space for
+    sharded corpora. N must be a multiple of chunk_size (pad the store, not
+    the query path). Returns (dists [B,k], ids [B,k]) ascending.
+    """
+    n = x.shape[0]
+    assert n % chunk_size == 0, f"corpus rows {n} not a multiple of chunk {chunk_size}"
+    num_chunks = n // chunk_size
+    b = q.shape[0]
+
+    x_chunks = x.reshape(num_chunks, chunk_size, x.shape[1])
+    valid_chunks = None if valid is None else valid.reshape(num_chunks, chunk_size)
+    norm_chunks = (
+        None if x_sq_norms is None else x_sq_norms.reshape(num_chunks, chunk_size)
+    )
+
+    init_d = jnp.full((b, k), MASKED_DISTANCE, dtype=jnp.float32)
+    init_i = jnp.full((b, k), -1, dtype=jnp.int32)
+
+    def body(carry, inp):
+        best_d, best_i = carry
+        chunk_idx, xc, vc, nc = inp
+        d = pairwise_distance(q, xc, metric=metric, x_sq_norms=nc)
+        if vc is not None:
+            d = jnp.where(vc[None, :], d, MASKED_DISTANCE)
+        local_ids = (
+            chunk_idx * chunk_size
+            + id_offset
+            + jax.lax.broadcasted_iota(jnp.int32, (1, chunk_size), 1)
+        )
+        local_ids = jnp.broadcast_to(local_ids, (b, chunk_size))
+        cat_d = jnp.concatenate([best_d, d], axis=1)
+        cat_i = jnp.concatenate([best_i, local_ids], axis=1)
+        new_d, new_i = topk_smallest(cat_d, cat_i, k)
+        return (new_d, new_i), None
+
+    chunk_ids = jax.lax.broadcasted_iota(jnp.int32, (num_chunks, 1), 0)[:, 0]
+    xs = (chunk_ids, x_chunks, valid_chunks, norm_chunks)
+    if num_chunks == 1:
+        # Avoid scan overhead for small corpora.
+        (final_d, final_i), _ = body(
+            (init_d, init_i),
+            (
+                chunk_ids[0],
+                x_chunks[0],
+                None if valid_chunks is None else valid_chunks[0],
+                None if norm_chunks is None else norm_chunks[0],
+            ),
+        )
+    else:
+        (final_d, final_i), _ = jax.lax.scan(body, (init_d, init_i), xs)
+    return final_d, final_i
+
+
+def chunked_topk(q, x, k, chunk_size=8192, metric="l2-squared", valid=None,
+                 x_sq_norms=None, id_offset=0):
+    """Non-jit convenience wrapper (jit happens inside).
+
+    Unlike the raw kernel, this accepts any corpus size: if ``chunk_size``
+    does not divide N it falls back to a single-chunk scan.
+    """
+    n = x.shape[0]
+    if n % chunk_size != 0:
+        chunk_size = n
+    return chunked_topk_distances(
+        q, x, k, chunk_size, metric, valid, x_sq_norms, id_offset
+    )
