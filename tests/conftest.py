@@ -8,12 +8,25 @@ exercised without TPU hardware. Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force, not setdefault: the ambient environment points JAX_PLATFORMS at the
+# single real TPU chip; tests need the 8-device virtual CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Replace (not just append) any ambient device-count flag: a stray
+# `--xla_force_host_platform_device_count=1` would silently degrade every
+# sharding test to the single-device path.
+flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(flags)
+
+import jax
+
+# The TPU plugin's site hook sets jax_platforms programmatically, which beats
+# the env var — override it back so tests really run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,47 @@
+"""Concurrent add/search against donated device buffers.
+
+Regression: search used to snapshot the store arrays under the lock but
+dispatch after releasing it; a concurrent add would donate (invalidate) the
+snapshot, raising "Array has been deleted". Mirrors the reference's lock
+discipline around its vector cache (vector/common/sharded_locks.go).
+"""
+
+import threading
+
+import numpy as np
+
+from weaviate_tpu.engine.flat import FlatIndex
+
+
+def test_concurrent_add_delete_search(rng):
+    idx = FlatIndex(dim=16, capacity=128, chunk_size=64)
+    idx.add_batch(np.arange(50), rng.standard_normal((50, 16)).astype(np.float32))
+    errs = []
+
+    def writer(t):
+        try:
+            for j in range(4):
+                idx.add_batch(
+                    np.arange(8) + 1000 * (t + 1) + 10 * j,
+                    rng.standard_normal((8, 16)).astype(np.float32),
+                )
+                idx.delete(1000 * (t + 1) + 10 * j)
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    def reader():
+        try:
+            for _ in range(8):
+                idx.search_by_vector(rng.standard_normal(16).astype(np.float32), k=5)
+        except Exception as e:  # pragma: no cover - failure path
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(3)]
+    threads += [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    ids, _ = idx.search_by_vector(rng.standard_normal(16).astype(np.float32), k=10)
+    assert len(ids) == 10

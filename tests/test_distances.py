@@ -99,3 +99,11 @@ def test_bf16_storage_f32_accumulation(rng):
     assert got.dtype == jnp.float32
     want = np_reference(q, x, "dot")
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-2, atol=2e-1)
+
+
+def test_hamming_bf16_storage_self_match(rng):
+    x = rng.standard_normal((4, 32)).astype(np.float32)
+    xb = jnp.asarray(x, dtype=jnp.bfloat16)
+    d = np.asarray(pairwise_distance(jnp.asarray(x), xb, metric="hamming"))
+    # query compared in storage dtype: each row matches its own bf16 self
+    np.testing.assert_allclose(np.diag(d), 0.0)

@@ -1,0 +1,85 @@
+"""Tests for the flat index: VectorIndex-contract semantics."""
+
+import numpy as np
+
+from weaviate_tpu.engine.flat import FlatIndex
+
+
+def test_add_search_roundtrip(rng):
+    idx = FlatIndex(dim=24, capacity=64, chunk_size=64)
+    vecs = rng.standard_normal((30, 24)).astype(np.float32)
+    doc_ids = np.arange(1000, 1030)
+    idx.add_batch(doc_ids, vecs)
+    ids, dists = idx.search_by_vector(vecs[12], k=5)
+    assert ids[0] == 1012
+    assert dists[0] < 1e-3
+    assert len(idx) == 30
+
+
+def test_update_existing_id(rng):
+    idx = FlatIndex(dim=8, capacity=32, chunk_size=32)
+    v1 = rng.standard_normal(8).astype(np.float32)
+    v2 = rng.standard_normal(8).astype(np.float32)
+    idx.add(5, v1)
+    idx.add(5, v2)  # overwrite
+    assert len(idx) == 1
+    ids, dists = idx.search_by_vector(v2, k=1)
+    assert ids[0] == 5 and dists[0] < 1e-3
+
+
+def test_delete(rng):
+    idx = FlatIndex(dim=8, capacity=32, chunk_size=32)
+    vecs = rng.standard_normal((5, 8)).astype(np.float32)
+    idx.add_batch([1, 2, 3, 4, 5], vecs)
+    idx.delete(3)
+    assert not idx.contains(3)
+    ids, _ = idx.search_by_vector(vecs[2], k=5)
+    assert 3 not in ids
+
+
+def test_allow_list_by_ids(rng):
+    idx = FlatIndex(dim=8, capacity=32, chunk_size=32)
+    vecs = rng.standard_normal((10, 8)).astype(np.float32)
+    idx.add_batch(np.arange(10) * 7, vecs)  # sparse external ids
+    ids, _ = idx.search_by_vector(vecs[0], k=10, allow_list=np.asarray([14, 21]))
+    assert set(ids.tolist()).issubset({14, 21})
+
+
+def test_batch_search(rng):
+    idx = FlatIndex(dim=16, capacity=64, chunk_size=64)
+    vecs = rng.standard_normal((20, 16)).astype(np.float32)
+    idx.add_batch(np.arange(20), vecs)
+    ids, dists = idx.search_by_vector_batch(vecs[:4], k=3)
+    assert ids.shape == (4, 3)
+    assert (ids[:, 0] == np.arange(4)).all()
+
+
+def test_range_search(rng):
+    idx = FlatIndex(dim=4, capacity=32, chunk_size=32)
+    idx.add_batch([1, 2, 3], np.asarray(
+        [[0, 0, 0, 0], [0.1, 0, 0, 0], [5, 5, 5, 5]], dtype=np.float32))
+    ids, dists = idx.search_by_vector_distance(np.zeros(4, np.float32), 1.0)
+    assert set(ids.tolist()) == {1, 2}
+
+
+def test_compact_preserves_mapping(rng):
+    idx = FlatIndex(dim=8, capacity=64, chunk_size=64)
+    vecs = rng.standard_normal((16, 8)).astype(np.float32)
+    idx.add_batch(np.arange(100, 116), vecs)
+    idx.delete(*range(100, 108))
+    idx.compact()
+    assert len(idx) == 8
+    ids, dists = idx.search_by_vector(vecs[12], k=1)
+    assert ids[0] == 112 and dists[0] < 1e-3
+
+
+def test_snapshot_restore(rng):
+    idx = FlatIndex(dim=8, capacity=32, chunk_size=32)
+    vecs = rng.standard_normal((6, 8)).astype(np.float32)
+    idx.add_batch([10, 20, 30, 40, 50, 60], vecs)
+    idx.delete(30)
+    snap = idx.snapshot()
+    idx2 = FlatIndex.restore(snap)
+    assert len(idx2) == 5
+    ids, _ = idx2.search_by_vector(vecs[4], k=1)
+    assert ids[0] == 50

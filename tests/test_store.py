@@ -1,0 +1,109 @@
+"""Tests for the HBM vector store: add/delete/grow/search/compact."""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.engine.store import DeviceVectorStore
+
+
+def test_add_and_search(rng):
+    store = DeviceVectorStore(dim=32, capacity=64, chunk_size=64)
+    vecs = rng.standard_normal((20, 32)).astype(np.float32)
+    slots = store.add(vecs)
+    assert list(slots) == list(range(20))
+    q = vecs[7]
+    d, i = store.search(q, k=3)
+    assert i[0] == 7
+    assert d[0] < 1e-3
+
+
+def test_growth(rng):
+    store = DeviceVectorStore(dim=16, capacity=8, chunk_size=8)
+    vecs = rng.standard_normal((100, 16)).astype(np.float32)
+    store.add(vecs)
+    assert store.capacity >= 100
+    d, i = store.search(vecs[55], k=1)
+    assert i[0] == 55
+
+
+def test_delete_tombstones(rng):
+    store = DeviceVectorStore(dim=8, capacity=32, chunk_size=32)
+    vecs = rng.standard_normal((10, 8)).astype(np.float32)
+    store.add(vecs)
+    d, i = store.search(vecs[3], k=1)
+    assert i[0] == 3
+    store.delete([3])
+    d, i = store.search(vecs[3], k=1)
+    assert i[0] != 3
+    assert store.live_count() == 9
+
+
+def test_cosine_normalizes_on_add(rng):
+    store = DeviceVectorStore(dim=16, metric="cosine", capacity=32, chunk_size=32)
+    v = rng.standard_normal((5, 16)).astype(np.float32)
+    store.add(v * 100.0)  # scale must not matter for cosine
+    d, i = store.search(v[2], k=1)
+    assert i[0] == 2
+    assert d[0] < 1e-3  # cosine distance of parallel vectors ~ 0
+
+
+def test_allow_mask(rng):
+    store = DeviceVectorStore(dim=8, capacity=32, chunk_size=32)
+    vecs = rng.standard_normal((10, 8)).astype(np.float32)
+    store.add(vecs)
+    mask = np.zeros(32, dtype=bool)
+    mask[[1, 4]] = True
+    d, i = store.search(vecs[0], k=5, allow_mask=mask)
+    live = i[i >= 0]
+    assert set(live.tolist()).issubset({1, 4})
+
+
+def test_update_in_place(rng):
+    store = DeviceVectorStore(dim=8, capacity=32, chunk_size=32)
+    vecs = rng.standard_normal((4, 8)).astype(np.float32)
+    store.add(vecs)
+    newv = rng.standard_normal(8).astype(np.float32)
+    store.set_at([2], newv[None, :])
+    d, i = store.search(newv, k=1)
+    assert i[0] == 2 and d[0] < 1e-3
+
+
+def test_search_by_distance(rng):
+    store = DeviceVectorStore(dim=4, capacity=32, chunk_size=32)
+    base = np.zeros((1, 4), dtype=np.float32)
+    near = np.full((3, 4), 0.1, dtype=np.float32)
+    far = np.full((3, 4), 10.0, dtype=np.float32)
+    store.add(np.concatenate([base, near, far]))
+    d, i = store.search_by_distance(np.zeros(4, dtype=np.float32), max_distance=1.0)
+    assert set(i.tolist()) == {0, 1, 2, 3}
+
+
+def test_compact(rng):
+    store = DeviceVectorStore(dim=8, capacity=64, chunk_size=64)
+    vecs = rng.standard_normal((20, 8)).astype(np.float32)
+    store.add(vecs)
+    store.delete(list(range(0, 20, 2)))  # drop evens
+    mapping = store.compact()
+    assert store.live_count() == 10
+    # odd original slots survive, remapped contiguously
+    assert (mapping[1::2][:10] >= 0).all()
+    d, i = store.search(vecs[5], k=1)
+    assert i[0] == mapping[5]
+
+
+def test_snapshot_restore(rng):
+    store = DeviceVectorStore(dim=8, capacity=32, chunk_size=32)
+    vecs = rng.standard_normal((10, 8)).astype(np.float32)
+    store.add(vecs)
+    store.delete([4])
+    snap = store.snapshot()
+    restored = DeviceVectorStore.restore(snap)
+    assert restored.live_count() == 9
+    d, i = restored.search(vecs[6], k=1)
+    assert i[0] == 6
+
+
+def test_dim_mismatch_raises(rng):
+    store = DeviceVectorStore(dim=8)
+    with pytest.raises(ValueError):
+        store.add(rng.standard_normal((2, 16)).astype(np.float32))

@@ -75,3 +75,14 @@ def test_merge_topk(rng):
                       jnp.concatenate([jnp.asarray(i1), jnp.asarray(i2)], axis=1), 4)
     np.testing.assert_allclose(np.asarray(d)[0], [0.1, 0.2, 0.3, 0.5], rtol=1e-6)
     assert list(np.asarray(i)[0]) == [3, 100, 101, 7]
+
+
+def test_chunked_topk_indivisible_n(rng):
+    # regression: N not a multiple of chunk_size must pad, not collapse to one chunk
+    q = rng.standard_normal((2, 16)).astype(np.float32)
+    x = rng.standard_normal((101, 16)).astype(np.float32)
+    d, i = chunked_topk(jnp.asarray(q), jnp.asarray(x), k=5, chunk_size=32)
+    i = np.asarray(i)
+    assert (i < 101).all() and (i >= 0).all()
+    want = np.argsort(((q[:, None] - x[None]) ** 2).sum(-1), axis=1)[:, :5]
+    assert set(i[0]) == set(want[0])

@@ -1,0 +1,13 @@
+"""TPU vector engine: HBM-resident vector stores and vector indexes.
+
+The index classes implement the semantics of the reference's ``VectorIndex``
+interface (adapters/repos/db/vector_index.go:24-45): Add/AddBatch/Delete/
+SearchByVector/SearchByVectorDistance, plus compression hooks — re-designed
+around immutable device buffers, donation-based in-place updates, and
+tombstone masks applied inside the top-k scan.
+"""
+
+from weaviate_tpu.engine.store import DeviceVectorStore
+from weaviate_tpu.engine.flat import FlatIndex
+
+__all__ = ["DeviceVectorStore", "FlatIndex"]

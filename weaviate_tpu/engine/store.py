@@ -1,0 +1,356 @@
+"""HBM-resident vector store.
+
+The reference keeps vectors in a RAM cache (vector/cache/sharded_lock_cache.go)
+plus an lsmkv bucket on disk (vector/flat/index.go:164-175). On TPU the
+authoritative hot copy lives in HBM as capacity-padded JAX arrays:
+
+- ``vectors``  [C, d]  storage dtype f32 (exact) or bf16 (2x capacity)
+- ``valid``    [C]     live-slot mask (False = unfilled or tombstoned)
+- ``sq_norms`` [C]     cached squared row norms (corpus term of the l2 expansion)
+
+Mutability under XLA's immutable-buffer model (SURVEY §7 hard part #1):
+writes are scatter updates inside a jitted function whose buffers are
+*donated*, so XLA updates HBM in place — no copy, no realloc per insert.
+Deletes flip ``valid`` bits (tombstones, reference: hnsw/index.go:115); the
+mask is applied inside the top-k scan so dead slots never win. Capacity
+grows by power-of-two re-allocation (one recompile per capacity level).
+
+When a mesh is provided, all three arrays are row-sharded over the ``shard``
+axis and every update/search runs SPMD; slot→device placement is implicit
+(slot // rows_per_device), the TPU analog of the reference's murmur3
+shard ring (usecases/sharding/state.go:167-176).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.ops.distances import normalize
+from weaviate_tpu.ops.topk import chunked_topk_distances
+from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
+from weaviate_tpu.parallel.sharded_search import (
+    replicate_array,
+    shard_array,
+    sharded_topk,
+)
+
+_DEFAULT_CHUNK = 8192
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("normalize_rows",))
+def _scatter_rows(vectors, valid, sq_norms, slots, new_vecs, write_mask,
+                  normalize_rows: bool = False):
+    """Write ``new_vecs`` [m,d] into rows ``slots`` [m]; rows with
+    write_mask=False are redirected to a scratch row (capacity-1 duplicate
+    writes are benign because mode='drop' handles OOB)."""
+    new_vecs = new_vecs.astype(jnp.float32)
+    if normalize_rows:
+        new_vecs = normalize(new_vecs)
+    new_vecs = new_vecs.astype(vectors.dtype)
+    norms = jnp.sum(new_vecs.astype(jnp.float32) ** 2, axis=-1)
+    # redirect masked (padding) rows out of range; 'drop' makes them no-ops
+    tgt = jnp.where(write_mask, slots, vectors.shape[0])
+    vectors = vectors.at[tgt].set(new_vecs, mode="drop")
+    valid = valid.at[tgt].set(True, mode="drop")
+    sq_norms = sq_norms.at[tgt].set(norms, mode="drop")
+    return vectors, valid, sq_norms
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _clear_slots(valid, slots):
+    return valid.at[slots].set(False, mode="drop")
+
+
+class DeviceVectorStore:
+    """Mutable (host-managed, device-resident) vector store.
+
+    Thread-safe for interleaved add/delete/search (a single host lock guards
+    buffer swaps; reads take a snapshot reference — the analog of the
+    reference's sharded RW locks in vector/common/sharded_locks.go).
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        metric: str = "l2-squared",
+        capacity: int = _DEFAULT_CHUNK,
+        dtype=jnp.float32,
+        mesh=None,
+        chunk_size: int = _DEFAULT_CHUNK,
+        normalize_on_add: bool | None = None,
+    ):
+        self.dim = dim
+        self.metric = metric
+        self.dtype = dtype
+        self.mesh = mesh
+        self.chunk_size = chunk_size
+        self.n_shards = 1 if mesh is None else mesh.shape[SHARD_AXIS]
+        # cosine provider normalizes at insert (reference stores normalized
+        # vectors and uses the dot kernel: cosine_dist.go "cosine-dot")
+        self.normalize_on_add = (
+            metric in ("cosine", "cosine-dot")
+            if normalize_on_add is None
+            else normalize_on_add
+        )
+        self._lock = threading.RLock()
+        self._count = 0  # high-water mark of allocated slots
+        self._free: list[int] = []  # tombstoned slots reusable after compaction
+        capacity = self._align(capacity)
+        self.capacity = capacity
+        self._alloc(capacity)
+
+    # -- capacity management -------------------------------------------------
+
+    def _align(self, capacity: int) -> int:
+        capacity = max(capacity, 2 * self.n_shards)
+        capacity = _next_pow2(capacity)
+        cs = min(self.chunk_size, capacity // self.n_shards)
+        return shardable_capacity(capacity, self.n_shards, cs)
+
+    def _placed(self, arr, dim=0):
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return shard_array(jnp.asarray(arr), self.mesh, dim=dim)
+
+    def _alloc(self, capacity: int):
+        self.vectors = self._placed(jnp.zeros((capacity, self.dim), dtype=self.dtype))
+        self.valid = self._placed(jnp.zeros((capacity,), dtype=jnp.bool_))
+        self.sq_norms = self._placed(jnp.zeros((capacity,), dtype=jnp.float32))
+
+    def _grow(self, min_capacity: int):
+        new_cap = self._align(_next_pow2(min_capacity))
+        old_vectors, old_valid, old_norms = self.vectors, self.valid, self.sq_norms
+        old_cap = self.capacity
+        self.capacity = new_cap
+        pad = new_cap - old_cap
+        # Pad on host-free device path: concatenate zeros then re-place.
+        self.vectors = self._placed(
+            jnp.concatenate([old_vectors, jnp.zeros((pad, self.dim), dtype=self.dtype)])
+        )
+        self.valid = self._placed(
+            jnp.concatenate([old_valid, jnp.zeros((pad,), dtype=jnp.bool_)])
+        )
+        self.sq_norms = self._placed(
+            jnp.concatenate([old_norms, jnp.zeros((pad,), dtype=jnp.float32)])
+        )
+
+    # -- mutation ------------------------------------------------------------
+
+    def add(self, vectors: np.ndarray) -> np.ndarray:
+        """Append a batch [m,d]; returns assigned slot ids [m] (int64).
+
+        Slots are assigned sequentially from the high-water mark. Padding to
+        power-of-two batch buckets bounds the number of compiled variants.
+        """
+        vectors = np.asarray(vectors, dtype=np.float32)
+        if vectors.ndim == 1:
+            vectors = vectors[None, :]
+        m, d = vectors.shape
+        if d != self.dim:
+            raise ValueError(f"vector dim {d} != store dim {self.dim}")
+        with self._lock:
+            slots = np.arange(self._count, self._count + m, dtype=np.int64)
+            if self._count + m > self.capacity:
+                self._grow(self._count + m)
+            self._count += m
+            bucket = _next_pow2(max(m, 8))
+            pad = bucket - m
+            padded = np.zeros((bucket, self.dim), dtype=np.float32)
+            padded[:m] = vectors
+            slot_buf = np.zeros(bucket, dtype=np.int32)
+            slot_buf[:m] = slots
+            mask = np.zeros(bucket, dtype=bool)
+            mask[:m] = True
+            self.vectors, self.valid, self.sq_norms = _scatter_rows(
+                self.vectors,
+                self.valid,
+                self.sq_norms,
+                self._placed_replicated(slot_buf),
+                self._placed_replicated(padded),
+                self._placed_replicated(mask),
+                normalize_rows=self.normalize_on_add,
+            )
+            return slots
+
+    def set_at(self, slots: np.ndarray, vectors: np.ndarray):
+        """Overwrite specific slots (update path)."""
+        vectors = np.asarray(vectors, dtype=np.float32)
+        slots = np.asarray(slots, dtype=np.int32)
+        m = len(slots)
+        with self._lock:
+            if m and int(slots.max()) >= self.capacity:
+                self._grow(int(slots.max()) + 1)
+            self._count = max(self._count, int(slots.max()) + 1 if m else 0)
+            bucket = _next_pow2(max(m, 8))
+            padded = np.zeros((bucket, self.dim), dtype=np.float32)
+            padded[:m] = vectors
+            slot_buf = np.zeros(bucket, dtype=np.int32)
+            slot_buf[:m] = slots
+            mask = np.zeros(bucket, dtype=bool)
+            mask[:m] = True
+            self.vectors, self.valid, self.sq_norms = _scatter_rows(
+                self.vectors, self.valid, self.sq_norms,
+                self._placed_replicated(slot_buf),
+                self._placed_replicated(padded),
+                self._placed_replicated(mask),
+                normalize_rows=self.normalize_on_add,
+            )
+
+    def delete(self, slots) -> None:
+        """Tombstone slots (reference: delete = tombstone + later cleanup,
+        hnsw/delete.go). Slots stay allocated until compaction."""
+        slots = np.atleast_1d(np.asarray(slots, dtype=np.int32))
+        m = len(slots)
+        if m == 0:
+            return
+        with self._lock:
+            bucket = _next_pow2(max(m, 8))
+            buf = np.full(bucket, self.capacity + 1, dtype=np.int32)  # OOB no-op
+            buf[:m] = slots
+            self.valid = _clear_slots(self.valid, self._placed_replicated(buf))
+            self._free.extend(int(s) for s in slots)
+
+    def _placed_replicated(self, arr):
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return replicate_array(jnp.asarray(arr), self.mesh)
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        """Allocated slots (including tombstones)."""
+        return self._count
+
+    def live_count(self) -> int:
+        with self._lock:
+            total = jnp.sum(self.valid)
+        return int(total)
+
+    def get(self, slots) -> np.ndarray:
+        """Fetch vectors by slot (host copy) — object-resolution path."""
+        slots = np.atleast_1d(np.asarray(slots, dtype=np.int32))
+        with self._lock:
+            rows = self.vectors[jnp.asarray(slots)]
+        return np.asarray(rows, dtype=np.float32)
+
+    def search(self, queries: np.ndarray, k: int, allow_mask: np.ndarray | None = None):
+        """Brute-force top-k. queries [B,d] (or [d]); returns (dists [B,k],
+        slots [B,k]) as numpy, ascending by distance; dead slots never appear.
+
+        ``allow_mask`` is a [capacity] or [count] bool mask — the device-side
+        AllowList (reference: helpers/allow_list.go consumed at
+        hnsw/search.go / flat/index.go:319).
+        """
+        queries = np.asarray(queries, dtype=np.float32)
+        squeeze = queries.ndim == 1
+        if squeeze:
+            queries = queries[None, :]
+        # Dispatch happens under the lock: writers *donate* the store buffers,
+        # which invalidates any handle a concurrent reader grabbed but hasn't
+        # dispatched against yet. Execution is async, so the lock only covers
+        # the (cheap) dispatch — materialization waits outside.
+        with self._lock:
+            vectors, valid, norms = self.vectors, self.valid, self.sq_norms
+            capacity = self.capacity
+            if allow_mask is not None:
+                full = np.zeros(capacity, dtype=bool)
+                full[: len(allow_mask)] = allow_mask
+                valid = jnp.logical_and(valid, self._placed(full))
+            k_eff = min(k, capacity)
+            # cosine runs as "cosine" against rows normalized at insert
+            # (the query side is normalized inside the kernel)
+            metric = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
+            cs = min(self.chunk_size, capacity // self.n_shards)
+            if self.mesh is None:
+                d, i = chunked_topk_distances(
+                    jnp.asarray(queries), vectors, k=k_eff, chunk_size=cs,
+                    metric=metric, valid=valid, x_sq_norms=norms,
+                )
+            else:
+                d, i = sharded_topk(
+                    jnp.asarray(queries), vectors, valid, norms,
+                    k=k_eff, chunk_size=cs, metric=metric, mesh=self.mesh,
+                )
+        d_np, i_np = np.asarray(d), np.asarray(i)
+        if squeeze:
+            return d_np[0], i_np[0]
+        return d_np, i_np
+
+    def search_by_distance(self, query: np.ndarray, max_distance: float,
+                           allow_mask: np.ndarray | None = None,
+                           batch: int = 4096):
+        """All slots within ``max_distance`` (reference:
+        SearchByVectorDistance, vector_index.go:31). Iteratively widens k
+        until the worst returned hit exceeds the threshold."""
+        k = min(64, self.capacity)
+        while True:
+            d, i = self.search(query, k, allow_mask)
+            within = d <= max_distance
+            # done if some slot beyond threshold surfaced or we've pulled everything
+            if (~within).any() or k >= self.capacity or within.sum() >= self.live_count():
+                return d[within], i[within]
+            k = min(k * 4, self.capacity)
+
+    # -- maintenance ---------------------------------------------------------
+
+    def compact(self) -> np.ndarray:
+        """Defragment: drop tombstoned rows, repack live rows contiguously.
+        Returns old_slot -> new_slot mapping (-1 for dropped). The HBM analog
+        of the reference's tombstone-cleanup cycle (hnsw tombstone cleanup /
+        lsmkv compaction)."""
+        with self._lock:
+            valid_np = np.asarray(self.valid)
+            live = np.nonzero(valid_np)[0]
+            mapping = np.full(self.capacity, -1, dtype=np.int64)
+            mapping[live] = np.arange(len(live))
+            vec_np = np.asarray(self.vectors)[live]
+            self._count = len(live)
+            self._free.clear()
+            new_cap = self._align(max(len(live), 2))
+            self.capacity = new_cap
+            self._alloc(new_cap)
+            if len(live):
+                self.set_at(np.arange(len(live)), vec_np)
+            return mapping
+
+    # -- persistence hooks ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Host-side snapshot for checkpointing (driver: storage layer WAL +
+        snapshot gives restart durability, reference hnsw/startup.go:57)."""
+        with self._lock:
+            return {
+                "vectors": np.asarray(self.vectors, dtype=np.float32),
+                "valid": np.asarray(self.valid),
+                "count": self._count,
+                "dim": self.dim,
+                "metric": self.metric,
+            }
+
+    @classmethod
+    def restore(cls, snap: dict, **kwargs) -> "DeviceVectorStore":
+        store = cls(dim=snap["dim"], metric=snap["metric"],
+                    capacity=max(len(snap["valid"]), 2), **kwargs)
+        live = np.nonzero(snap["valid"])[0]
+        store._count = snap["count"]
+        if len(live):
+            # vectors were already normalized at original insert; don't re-normalize
+            orig = store.normalize_on_add
+            store.normalize_on_add = False
+            store.set_at(live, snap["vectors"][live])
+            store.normalize_on_add = orig
+        store._count = snap["count"]
+        return store

@@ -111,7 +111,9 @@ def pairwise_distance(
 
     if metric == "hamming":
         # Elementwise compare + popcount-style reduce. VPU op; no MXU use.
-        neq = (q[:, None, :] != x[None, :, :]).astype(jnp.float32)
+        # Compare in the *storage* dtype: with a bf16 store, an f32 query
+        # would never equal its own bf16-rounded row after promotion.
+        neq = (q.astype(x.dtype)[:, None, :] != x[None, :, :]).astype(jnp.float32)
         return jnp.sum(neq, axis=-1)
 
     # manhattan

@@ -101,7 +101,7 @@ def chunked_topk_distances(
         new_d, new_i = topk_smallest(cat_d, cat_i, k)
         return (new_d, new_i), None
 
-    chunk_ids = jax.lax.broadcasted_iota(jnp.int32, (num_chunks, 1), 0)[:, 0]
+    chunk_ids = jnp.arange(num_chunks, dtype=jnp.int32)
     xs = (chunk_ids, x_chunks, valid_chunks, norm_chunks)
     if num_chunks == 1:
         # Avoid scan overhead for small corpora.
@@ -123,12 +123,25 @@ def chunked_topk(q, x, k, chunk_size=8192, metric="l2-squared", valid=None,
                  x_sq_norms=None, id_offset=0):
     """Non-jit convenience wrapper (jit happens inside).
 
-    Unlike the raw kernel, this accepts any corpus size: if ``chunk_size``
-    does not divide N it falls back to a single-chunk scan.
+    Unlike the raw kernel, this accepts any corpus size: when ``chunk_size``
+    does not divide N the corpus is padded with dead (masked) rows up to the
+    next multiple, preserving the O(B*chunk) memory bound. The store path
+    keeps capacity chunk-aligned and never pays this copy.
     """
     n = x.shape[0]
-    if n % chunk_size != 0:
-        chunk_size = n
+    chunk_size = min(chunk_size, n) or 1
+    rem = n % chunk_size
+    if rem:
+        pad = chunk_size - rem
+        x = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), dtype=x.dtype)])
+        if valid is None:
+            valid = jnp.arange(n + pad) < n
+        else:
+            valid = jnp.concatenate([valid, jnp.zeros(pad, dtype=valid.dtype)])
+        if x_sq_norms is not None:
+            x_sq_norms = jnp.concatenate(
+                [x_sq_norms, jnp.zeros(pad, dtype=x_sq_norms.dtype)]
+            )
     return chunked_topk_distances(
         q, x, k, chunk_size, metric, valid, x_sq_norms, id_offset
     )

@@ -1,0 +1,24 @@
+"""Device-mesh parallelism: sharded HBM corpora and ICI-collective search.
+
+Replaces the reference's distributed data plane for vector search
+(HTTP scatter-gather across shards, adapters/repos/db/index.go:1541-1663)
+with a single compiled program: each device scans its row-shard of the
+corpus, computes a local top-k, and the partial results are combined with
+an all_gather over ICI — no host round-trips inside a query.
+"""
+
+from weaviate_tpu.parallel.mesh import (
+    default_mesh,
+    device_count,
+    make_mesh,
+    shardable_capacity,
+)
+from weaviate_tpu.parallel.sharded_search import sharded_topk
+
+__all__ = [
+    "default_mesh",
+    "device_count",
+    "make_mesh",
+    "shardable_capacity",
+    "sharded_topk",
+]

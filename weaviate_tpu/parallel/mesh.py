@@ -1,0 +1,42 @@
+"""Mesh construction helpers.
+
+The framework uses a 1-D ``shard`` axis for corpus row-sharding (the analog
+of the reference's physical shards, usecases/sharding/state.go:28). On a
+multi-host pod the same axis spans DCN automatically via jax.devices().
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = SHARD_AXIS) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def default_mesh() -> Mesh | None:
+    """Mesh over all devices, or None when there is a single device
+    (single-chip path skips shard_map entirely)."""
+    if device_count() <= 1:
+        return None
+    return make_mesh()
+
+
+def shardable_capacity(capacity: int, n_shards: int, chunk_size: int) -> int:
+    """Round ``capacity`` up so each device gets an equal, chunk-aligned
+    number of rows."""
+    per_device = -(-capacity // n_shards)
+    per_device = -(-per_device // chunk_size) * chunk_size
+    return per_device * n_shards
