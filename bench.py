@@ -1,0 +1,145 @@
+"""Benchmark: flat brute-force kNN on TPU vs host-CPU BLAS baseline.
+
+North-star config #1 (BASELINE.md): flat index, l2-squared, SIFT1M-shaped
+synthetic corpus (1M x 128), k=10. The reference's flat index is also an
+exact scan (CPU, lsmkv cursor + SIMD distance), so CPU exact scan is the
+apples-to-apples baseline; numpy/BLAS is a *generous* stand-in for it.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": QPS, "unit": "qps", "vs_baseline": x}
+plus recall/latency detail on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _watchdog(seconds: float):
+    """Hard-exit with a sentinel line if the TPU tunnel wedges (jax init can
+    hang indefinitely when the device claim is stuck)."""
+    def fire():
+        print(json.dumps({
+            "metric": "flat_knn_qps_synth1M_128d_k10",
+            "value": 0.0,
+            "unit": "qps",
+            "vs_baseline": 0.0,
+            "error": f"watchdog: no result within {seconds}s",
+        }), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def main():
+    wd = _watchdog(float(os.environ.get("BENCH_WATCHDOG_S", "900")))
+    import numpy as np
+
+    n, dim, k = 1_000_000, 128, 10
+    batch = 256
+    n_query_batches = 8
+    log = lambda *a: print(*a, file=sys.stderr, flush=True)
+
+    rng = np.random.default_rng(0)
+    corpus = rng.standard_normal((n, dim)).astype(np.float32)
+    queries = rng.standard_normal((n_query_batches, batch, dim)).astype(np.float32)
+    log(f"corpus {corpus.nbytes/1e9:.2f} GB, {n_query_batches}x{batch} queries")
+
+    # --- CPU BLAS exact-scan baseline (chunked, same algorithm) -------------
+    def cpu_scan(qb):
+        best_d = np.full((batch, k), np.inf, np.float32)
+        best_i = np.zeros((batch, k), np.int64)
+        cn = (corpus ** 2).sum(-1)
+        qn = (qb ** 2).sum(-1)[:, None]
+        step = 131072
+        for s in range(0, n, step):
+            c = corpus[s:s + step]
+            d = qn - 2.0 * qb @ c.T + cn[None, s:s + step]
+            idx = np.argpartition(d, k, axis=1)[:, :k]
+            dd = np.take_along_axis(d, idx, axis=1)
+            cat_d = np.concatenate([best_d, dd], 1)
+            cat_i = np.concatenate([best_i, idx + s], 1)
+            sel = np.argpartition(cat_d, k, axis=1)[:, :k]
+            best_d = np.take_along_axis(cat_d, sel, 1)
+            best_i = np.take_along_axis(cat_i, sel, 1)
+        order = np.argsort(best_d, 1)
+        return np.take_along_axis(best_d, order, 1), np.take_along_axis(best_i, order, 1)
+
+    t0 = time.perf_counter()
+    gt_d, gt_i = cpu_scan(queries[0])
+    cpu_s = time.perf_counter() - t0
+    cpu_qps = batch / cpu_s
+    log(f"CPU BLAS exact scan: {cpu_s*1e3:.1f} ms/batch -> {cpu_qps:.1f} QPS")
+
+    # --- TPU path -----------------------------------------------------------
+    import jax
+    import jax.numpy as jnp
+
+    from weaviate_tpu.ops.topk import chunked_topk_distances
+
+    dev = jax.devices()[0]
+    log(f"device: {dev}, platform: {dev.platform}")
+    store_dtype = jnp.bfloat16 if os.environ.get("BENCH_DTYPE", "bf16") == "bf16" else jnp.float32
+    chunk = 65536
+    n_pad = -(-n // chunk) * chunk  # pad corpus to a chunk multiple once
+    padded = np.zeros((n_pad, dim), dtype=np.float32)
+    padded[:n] = corpus
+    x = jax.device_put(jnp.asarray(padded, dtype=store_dtype), dev)
+    norms = jnp.sum(jnp.asarray(x, dtype=jnp.float32) ** 2, axis=-1)
+    valid = jnp.asarray(np.arange(n_pad) < n)
+
+    def step(qb):
+        return chunked_topk_distances(
+            qb, x, k=k, chunk_size=chunk, metric="l2-squared",
+            valid=valid, x_sq_norms=norms,
+        )
+
+    q0 = jax.device_put(jnp.asarray(queries[0]), dev)
+    t0 = time.perf_counter()
+    d, i = step(q0)
+    jax.block_until_ready((d, i))
+    log(f"first call (incl compile): {time.perf_counter()-t0:.1f}s")
+
+    # recall@10 vs CPU exact ground truth (bf16 storage drifts slightly)
+    ids = np.asarray(i)
+    recall = np.mean([
+        len(set(ids[r]) & set(gt_i[r])) / k for r in range(batch)
+    ])
+    log(f"recall@{k} vs exact f32: {recall:.4f}")
+
+    # timed runs
+    times = []
+    for rep in range(3):
+        for bi in range(n_query_batches):
+            qb = jax.device_put(jnp.asarray(queries[bi]), dev)
+            t0 = time.perf_counter()
+            d, i = step(qb)
+            jax.block_until_ready((d, i))
+            times.append(time.perf_counter() - t0)
+    times = np.asarray(times[1:])  # drop first timed (cache effects)
+    per_batch = float(np.median(times))
+    qps = batch / per_batch
+    log(f"median {per_batch*1e3:.2f} ms/batch of {batch} -> {qps:.0f} QPS; "
+        f"p95 {np.percentile(times,95)*1e3:.2f} ms")
+
+    wd.cancel()
+    print(json.dumps({
+        "metric": "flat_knn_qps_synth1M_128d_k10",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / cpu_qps, 2),
+        "recall_at_10": round(float(recall), 4),
+        "p50_batch_ms": round(per_batch * 1e3, 2),
+        "baseline_cpu_qps": round(cpu_qps, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

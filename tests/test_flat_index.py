@@ -83,3 +83,26 @@ def test_snapshot_restore(rng):
     assert len(idx2) == 5
     ids, _ = idx2.search_by_vector(vecs[4], k=1)
     assert ids[0] == 50
+
+
+def test_duplicate_ids_in_one_batch(rng):
+    idx = FlatIndex(dim=8, capacity=32, chunk_size=32)
+    v = rng.standard_normal((2, 8)).astype(np.float32)
+    idx.add_batch([7, 7], v)  # last occurrence wins, one slot
+    assert len(idx) == 1
+    assert idx.store.live_count() == 1
+    ids, d = idx.search_by_vector(v[1], k=2)
+    assert ids[0] == 7 and d[0] < 1e-3
+    idx.delete(7)
+    ids, _ = idx.search_by_vector(v[0], k=2)
+    assert 7 not in ids and idx.store.live_count() == 0
+
+
+def test_snapshot_preserves_storage_dtype(rng):
+    import jax.numpy as jnp
+    from weaviate_tpu.engine.store import DeviceVectorStore
+    store = DeviceVectorStore(dim=8, capacity=32, chunk_size=16, dtype=jnp.bfloat16)
+    store.add(rng.standard_normal((4, 8)).astype(np.float32))
+    restored = DeviceVectorStore.restore(store.snapshot())
+    assert restored.dtype == jnp.bfloat16
+    assert restored.chunk_size == 16

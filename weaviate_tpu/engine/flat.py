@@ -58,6 +58,12 @@ class FlatIndex:
         vectors = np.asarray(vectors, dtype=np.float32)
         if len(doc_ids) != len(vectors):
             raise ValueError(f"{len(doc_ids)} ids != {len(vectors)} vectors")
+        # dedupe within the batch, last occurrence wins — otherwise one id
+        # would allocate two live slots and corrupt the id<->slot mapping
+        if len(doc_ids) != len(set(doc_ids.tolist())):
+            last = {int(i): idx for idx, i in enumerate(doc_ids.tolist())}
+            keep = sorted(last.values())
+            doc_ids, vectors = doc_ids[keep], vectors[keep]
         with self._lock:
             existing = np.array([i in self._id_to_slot for i in doc_ids.tolist()])
             if existing.any():
@@ -103,28 +109,33 @@ class FlatIndex:
         ids (the reference's roaring-bitmap AllowList). Returns
         (doc_ids [<=k] int64, dists [<=k] f32), ascending.
         """
-        allow_mask = self._allow_mask(allow_list)
-        d, slots = self.store.search(np.asarray(query), k, allow_mask)
-        return self._resolve(d, slots, k)
+        # The index lock spans search + id resolution so a concurrent
+        # compact() can't remap slots between the scan and _resolve.
+        with self._lock:
+            allow_mask = self._allow_mask(allow_list)
+            d, slots = self.store.search(np.asarray(query), k, allow_mask)
+            return self._resolve(d, slots, k)
 
     def search_by_vector_batch(self, queries: np.ndarray, k: int,
                                allow_list: np.ndarray | None = None):
         """Batched query path — amortizes one matmul across B queries.
 
         Returns (doc_ids [B,k] int64 with -1 padding, dists [B,k])."""
-        allow_mask = self._allow_mask(allow_list)
-        d, slots = self.store.search(np.asarray(queries), k, allow_mask)
-        ids = np.where(slots >= 0, self._slot_to_id_safe(slots), -1)
-        return ids, d
+        with self._lock:
+            allow_mask = self._allow_mask(allow_list)
+            d, slots = self.store.search(np.asarray(queries), k, allow_mask)
+            ids = np.where(slots >= 0, self._slot_to_id_safe(slots), -1)
+            return ids, d
 
     def search_by_vector_distance(self, query: np.ndarray, max_distance: float,
                                   allow_list: np.ndarray | None = None):
         """Range search (reference SearchByVectorDistance,
         vector_index.go:31)."""
-        allow_mask = self._allow_mask(allow_list)
-        d, slots = self.store.search_by_distance(np.asarray(query), max_distance,
-                                                 allow_mask)
-        return self._resolve(d, slots, len(slots))
+        with self._lock:
+            allow_mask = self._allow_mask(allow_list)
+            d, slots = self.store.search_by_distance(np.asarray(query), max_distance,
+                                                     allow_mask)
+            return self._resolve(d, slots, len(slots))
 
     # -- helpers --------------------------------------------------------------
 
@@ -132,19 +143,14 @@ class FlatIndex:
         if allow_list is None:
             return None
         allow_list = np.asarray(allow_list)
+        if allow_list.dtype == np.bool_:
+            allow_list = np.nonzero(allow_list)[0]
         with self._lock:
-            mask = np.zeros(self.store.capacity, dtype=bool)
-            if allow_list.dtype == np.bool_:
-                for doc_id in np.nonzero(allow_list)[0]:
-                    s = self._id_to_slot.get(int(doc_id))
-                    if s is not None:
-                        mask[s] = True
-            else:
-                for doc_id in allow_list.tolist():
-                    s = self._id_to_slot.get(int(doc_id))
-                    if s is not None:
-                        mask[s] = True
-            return mask
+            # vectorized doc-id -> slot translation via the inverse table;
+            # a Python-loop of dict lookups here would dominate filtered
+            # queries with large allow lists
+            table = self._slot_to_id[: self.store.capacity]
+            return (table >= 0) & np.isin(table, allow_list)
 
     def _slot_to_id_safe(self, slots):
         clipped = np.clip(slots, 0, len(self._slot_to_id) - 1)

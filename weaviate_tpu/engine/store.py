@@ -106,7 +106,6 @@ class DeviceVectorStore:
         )
         self._lock = threading.RLock()
         self._count = 0  # high-water mark of allocated slots
-        self._free: list[int] = []  # tombstoned slots reusable after compaction
         capacity = self._align(capacity)
         self.capacity = capacity
         self._alloc(capacity)
@@ -220,7 +219,6 @@ class DeviceVectorStore:
             buf = np.full(bucket, self.capacity + 1, dtype=np.int32)  # OOB no-op
             buf[:m] = slots
             self.valid = _clear_slots(self.valid, self._placed_replicated(buf))
-            self._free.extend(int(s) for s in slots)
 
     def _placed_replicated(self, arr):
         if self.mesh is None:
@@ -318,7 +316,6 @@ class DeviceVectorStore:
             mapping[live] = np.arange(len(live))
             vec_np = np.asarray(self.vectors)[live]
             self._count = len(live)
-            self._free.clear()
             new_cap = self._align(max(len(live), 2))
             self.capacity = new_cap
             self._alloc(new_cap)
@@ -338,10 +335,16 @@ class DeviceVectorStore:
                 "count": self._count,
                 "dim": self.dim,
                 "metric": self.metric,
+                "dtype": jnp.dtype(self.dtype).name,
+                "chunk_size": self.chunk_size,
             }
 
     @classmethod
     def restore(cls, snap: dict, **kwargs) -> "DeviceVectorStore":
+        # storage config survives the checkpoint round-trip unless the
+        # caller explicitly overrides it
+        kwargs.setdefault("dtype", jnp.dtype(snap.get("dtype", "float32")))
+        kwargs.setdefault("chunk_size", snap.get("chunk_size", _DEFAULT_CHUNK))
         store = cls(dim=snap["dim"], metric=snap["metric"],
                     capacity=max(len(snap["valid"]), 2), **kwargs)
         live = np.nonzero(snap["valid"])[0]
