@@ -1,0 +1,181 @@
+"""PQ/BQ conformance and recall tests.
+
+Mirrors the reference's compression tests (compressionhelpers tests +
+hnsw/compress_recall_test.go): codebook quality, encode/decode roundtrip,
+ADC-equivalence, and end-to-end recall of compressed search with rescore.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from weaviate_tpu.engine.flat import FlatIndex
+from weaviate_tpu.engine.quantized import QuantizedVectorStore
+from weaviate_tpu.ops import bq as bq_ops
+from weaviate_tpu.ops import pq as pq_ops
+
+
+def clustered_data(rng, n=2000, dim=32, n_clusters=16):
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32) * 5
+    assign = rng.integers(0, n_clusters, n)
+    return (centers[assign] + rng.standard_normal((n, dim)).astype(np.float32) * 0.3)
+
+
+# -- PQ ops ------------------------------------------------------------------
+
+def test_pq_fit_encode_roundtrip(rng):
+    x = clustered_data(rng)
+    cb = pq_ops.pq_fit(x, m=8, k=16, iters=6)
+    assert cb.centroids.shape == (8, 16, 4)
+    codes = pq_ops.pq_encode(cb, x)
+    assert codes.shape == (2000, 8) and codes.dtype == np.uint8
+    # reconstruction error must be far below data scale
+    x_hat = np.asarray(pq_ops.pq_reconstruct(jnp.asarray(codes), cb.centroids, 8))
+    rel_err = np.linalg.norm(x_hat - x) / np.linalg.norm(x)
+    assert rel_err < 0.5
+
+
+def test_pq_topk_matches_adc_lut(rng):
+    """reconstruct-matmul distances == classic per-query LUT ADC distances."""
+    x = clustered_data(rng, n=256, dim=16)
+    q = rng.standard_normal((2, 16)).astype(np.float32)
+    cb = pq_ops.pq_fit(x, m=4, k=8, iters=4)
+    codes = pq_ops.pq_encode(cb, x)
+    d, i = pq_ops.pq_topk(jnp.asarray(q), jnp.asarray(codes), cb.centroids,
+                          k=5, chunk_size=256)
+    # numpy LUT-ADC reference (reference product_quantization.go:440)
+    cents = np.asarray(cb.centroids)  # [m, k, ds]
+    qs = q.reshape(2, 4, 4)
+    lut = ((qs[:, :, None, :] - cents[None]) ** 2).sum(-1)  # [B, m, k]
+    adc = np.zeros((2, 256), np.float32)
+    for b in range(2):
+        for n in range(256):
+            adc[b, n] = sum(lut[b, m, codes[n, m]] for m in range(4))
+    want = np.sort(adc, axis=1)[:, :5]
+    np.testing.assert_allclose(np.asarray(d), want, rtol=1e-3, atol=1e-3)
+
+
+def test_pq_recall_on_clustered_data(rng):
+    # wider within-cluster spread + finer segmentation: the un-rescored
+    # compressed scan must still rank mostly-correct neighbors (end-to-end
+    # recall with rescore is asserted in test_flat_index_compress_runtime)
+    centers = rng.standard_normal((16, 64)).astype(np.float32) * 5
+    x = (centers[rng.integers(0, 16, 4000)]
+         + rng.standard_normal((4000, 64)).astype(np.float32) * 1.5)
+    q = x[rng.choice(4000, 20, replace=False)] \
+        + rng.standard_normal((20, 64)).astype(np.float32) * 0.3
+    cb = pq_ops.pq_fit(x, m=32, k=64, iters=10)
+    codes = pq_ops.pq_encode(cb, x)
+    d, i = pq_ops.pq_topk(jnp.asarray(q), jnp.asarray(codes), cb.centroids,
+                          k=10, chunk_size=500)
+    gt = np.argsort(((q[:, None] - x[None]) ** 2).sum(-1), axis=1)[:, :10]
+    recall = np.mean([len(set(np.asarray(i)[r]) & set(gt[r])) / 10 for r in range(20)])
+    assert recall > 0.45, recall  # un-rescored compressed recall
+
+
+# -- BQ ops ------------------------------------------------------------------
+
+def test_bq_encode_matches_numpy(rng):
+    x = rng.standard_normal((16, 70)).astype(np.float32)  # 70 -> 3 words padded
+    words = np.asarray(bq_ops.bq_encode(jnp.asarray(x)))
+    assert words.shape == (16, 3)
+    want_bits = (x >= 0)
+    for r in range(16):
+        for j in range(70):
+            w, b = divmod(j, 32)
+            assert bool((words[r, w] >> b) & 1) == want_bits[r, j]
+
+
+def test_bq_topk_is_hamming(rng):
+    x = rng.standard_normal((128, 64)).astype(np.float32)
+    q = rng.standard_normal((3, 64)).astype(np.float32)
+    xw = bq_ops.bq_encode(jnp.asarray(x))
+    qw = bq_ops.bq_encode(jnp.asarray(q))
+    d, i = bq_ops.bq_topk(qw, xw, k=5, chunk_size=128)
+    ham = bq_ops.bq_hamming_np(np.asarray(qw), np.asarray(xw))
+    want = np.sort(ham, axis=1)[:, :5]
+    np.testing.assert_array_equal(np.asarray(d), want.astype(np.float32))
+
+
+# -- quantized store / index -------------------------------------------------
+
+def test_bq_store_search_with_rescore(rng):
+    store = QuantizedVectorStore(dim=64, quantization="bq", capacity=512,
+                                 chunk_size=512, rescore_limit=8)
+    x = rng.standard_normal((300, 64)).astype(np.float32)
+    store.add(x)
+    d, i = store.search(x[17], k=5)
+    assert i[0] == 17 and d[0] < 1e-3  # rescore restores exact self-match
+    store.delete([17])
+    d, i = store.search(x[17], k=5)
+    assert i[0] != 17
+
+
+def test_pq_store_lifecycle(rng):
+    x = clustered_data(rng, n=1000, dim=32)
+    store = QuantizedVectorStore(dim=32, quantization="pq", capacity=1024,
+                                 chunk_size=1024, pq_segments=8,
+                                 pq_centroids=32, rescore_limit=8)
+    store.train(x)
+    store.add(x)
+    d, i = store.search(x[3], k=5)
+    assert i[0] == 3 and d[0] < 1e-3
+
+
+def test_untrained_pq_store_raises_on_search(rng):
+    store = QuantizedVectorStore(dim=16, quantization="pq", pq_centroids=8)
+    # adds are allowed before training (vectors accumulate on host)...
+    store.add(rng.standard_normal((40, 16)).astype(np.float32))
+    # ...but searching without a codebook must fail loudly
+    with pytest.raises(RuntimeError):
+        store.search(rng.standard_normal(16).astype(np.float32), k=3)
+    # train() on current contents unlocks search and encodes the backlog
+    store.train()
+    d, i = store.search(store.get([7])[0], k=1)
+    assert i[0] == 7
+
+
+def test_flat_index_compress_runtime(rng):
+    """Reference compress.go semantics: build uncompressed, compress at
+    runtime, mapping and recall preserved."""
+    x = clustered_data(rng, n=1200, dim=32)
+    idx = FlatIndex(dim=32, capacity=2048, chunk_size=2048)
+    ids = np.arange(1200) + 10_000
+    idx.add_batch(ids, x)
+    idx.delete(ids[7])
+    assert not idx.compressed
+    idx.compress("pq", pq_segments=8, pq_centroids=64, rescore_limit=8)
+    assert idx.compressed
+    got, d = idx.search_by_vector(x[100], k=5)
+    assert got[0] == ids[100] and d[0] < 1e-3
+    got, _ = idx.search_by_vector(x[7], k=5)
+    assert ids[7] not in got  # tombstone survived compression
+    # recall@10 with rescore must be high
+    q = clustered_data(rng, n=10, dim=32)
+    gt = np.argsort(((q[:, None] - x[None]) ** 2).sum(-1), axis=1)[:, :10]
+    hits = 0
+    for r in range(10):
+        got, _ = idx.search_by_vector(q[r], k=10)
+        hits += len(set((got - 10_000).tolist()) & set(gt[r].tolist()))
+    assert hits / 100 > 0.85, hits / 100
+
+
+def test_quantized_snapshot_restore(rng):
+    x = clustered_data(rng, n=600, dim=32)
+    idx = FlatIndex(dim=32, capacity=1024, chunk_size=1024, quantization="bq",
+                    rescore_limit=8)
+    idx.add_batch(np.arange(600), x)
+    snap = idx.snapshot()
+    idx2 = FlatIndex.restore(snap)
+    assert idx2.compressed
+    got, d = idx2.search_by_vector(x[42], k=3)
+    assert got[0] == 42 and d[0] < 1e-3
+
+
+def test_compress_twice_raises(rng):
+    x = clustered_data(rng, n=300, dim=16)
+    idx = FlatIndex(dim=16, capacity=512, chunk_size=512)
+    idx.add_batch(np.arange(300), x)
+    idx.compress("bq")
+    with pytest.raises(RuntimeError):
+        idx.compress("bq")

@@ -28,19 +28,37 @@ class FlatIndex:
     index_type = "flat"
 
     def __init__(self, dim: int, metric: str = "l2-squared", mesh=None,
-                 dtype=None, capacity: int = 8192, chunk_size: int = 8192):
+                 dtype=None, capacity: int = 8192, chunk_size: int = 8192,
+                 quantization: str | None = None, **quant_kwargs):
         import jax.numpy as jnp
 
         self.dim = dim
         self.metric = metric
-        self.store = DeviceVectorStore(
-            dim=dim,
-            metric=metric,
-            capacity=capacity,
-            dtype=dtype or jnp.float32,
-            mesh=mesh,
-            chunk_size=chunk_size,
-        )
+        if quantization:
+            from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+            if mesh is not None:
+                raise NotImplementedError(
+                    "quantized stores are single-replica; mesh sharding of "
+                    "codes is not supported yet"
+                )
+            self.store = QuantizedVectorStore(
+                dim=dim, metric=metric, quantization=quantization,
+                capacity=capacity, chunk_size=chunk_size, **quant_kwargs,
+            )
+        else:
+            if quant_kwargs:
+                raise TypeError(
+                    f"unexpected kwargs without quantization: {sorted(quant_kwargs)}"
+                )
+            self.store = DeviceVectorStore(
+                dim=dim,
+                metric=metric,
+                capacity=capacity,
+                dtype=dtype or jnp.float32,
+                mesh=mesh,
+                chunk_size=chunk_size,
+            )
         self._lock = threading.RLock()
         self._id_to_slot: dict[int, int] = {}
         self._slot_to_id: np.ndarray = np.full(self.store.capacity, -1, dtype=np.int64)
@@ -161,6 +179,50 @@ class FlatIndex:
         ids = self._slot_to_id_safe(slots)[live]
         return ids[:k], d[live][:k]
 
+    # -- compression ----------------------------------------------------------
+
+    def compress(self, quantization: str = "pq", **quant_kwargs) -> None:
+        """Runtime compression: train a quantizer on current contents and swap
+        the store (reference: hnsw/compress.go:38, enabled via a config
+        update once enough data exists). Slot layout is preserved, so the
+        id<->slot mapping carries over untouched."""
+        from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+        with self._lock:
+            old = self.store
+            if isinstance(old, QuantizedVectorStore):
+                raise RuntimeError("index is already compressed")
+            if old.mesh is not None:
+                raise NotImplementedError(
+                    "compressing a mesh-sharded index is not supported yet"
+                )
+            snap = old.snapshot()
+            new = QuantizedVectorStore(
+                dim=self.dim, metric=self.metric, quantization=quantization,
+                capacity=old.capacity, chunk_size=old.chunk_size, **quant_kwargs,
+            )
+            live = np.nonzero(snap["valid"])[0]
+            live_vecs = snap["vectors"][live]
+            if quantization == "pq" and new.codebook is None:
+                if len(live) < new.pq_centroids:
+                    raise RuntimeError(
+                        f"need >= {new.pq_centroids} live vectors to train PQ, "
+                        f"have {len(live)}"
+                    )
+                new.train(live_vecs)
+            if len(live):
+                # vectors were already normalized at original insert
+                new.set_at_prenormalized(live, live_vecs)
+            new._count = snap["count"]
+            self.store = new
+
+    @property
+    def compressed(self) -> bool:
+        """Reference Compressed() (vector_index.go:37)."""
+        from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+        return isinstance(self.store, QuantizedVectorStore)
+
     # -- maintenance / persistence -------------------------------------------
 
     def compact(self):
@@ -186,7 +248,12 @@ class FlatIndex:
         idx = cls.__new__(cls)
         idx.dim = snap["dim"]
         idx.metric = snap["metric"]
-        idx.store = DeviceVectorStore.restore(snap, mesh=mesh, **kwargs)
+        if snap.get("quantization"):
+            from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+            idx.store = QuantizedVectorStore.restore(snap, **kwargs)
+        else:
+            idx.store = DeviceVectorStore.restore(snap, mesh=mesh, **kwargs)
         idx._lock = threading.RLock()
         slot_to_id = snap["slot_to_id"]
         idx._slot_to_id = np.full(idx.store.capacity, -1, dtype=np.int64)

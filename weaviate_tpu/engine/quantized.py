@@ -1,0 +1,339 @@
+"""Quantized (PQ/BQ) vector store: compressed codes in HBM, rescore on host
+full-precision vectors.
+
+Reference parity:
+- flat BQ path with rescore: vector/flat/index.go:347 (searchByVectorBQ)
+- HNSW runtime compression hook: vector/hnsw/compress.go:38 (train on
+  current contents, swap cache for a compressed one)
+- compressor plumbing: compressionhelpers/compression.go:37
+
+Memory layout: HBM holds only the codes ([C, m] uint8 for PQ — 16-64x
+smaller than f32; [C, w] uint32 sign-bits for BQ — 32x smaller) plus the
+valid mask. Full-precision vectors stay in host RAM for (a) quantizer
+(re)training, (b) exact rescore of the oversampled candidate set — the
+candidate gather is tiny (k * rescore_factor rows) so the host round-trip
+costs microseconds, not the HBM scan.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.ops import bq as bq_ops
+from weaviate_tpu.ops import pq as pq_ops
+from weaviate_tpu.ops.distances import normalize, pairwise_distance
+from weaviate_tpu.ops.topk import topk_smallest
+
+_DEFAULT_CHUNK = 8192
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class QuantizedVectorStore:
+    """PQ- or BQ-compressed store with the DeviceVectorStore method surface.
+
+    Single-replica (unsharded) in this round; codes are small enough that a
+    100M x 96-byte corpus fits one chip.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        metric: str = "l2-squared",
+        quantization: str = "pq",
+        capacity: int = _DEFAULT_CHUNK,
+        chunk_size: int = _DEFAULT_CHUNK,
+        pq_segments: int | None = None,
+        pq_centroids: int = 256,
+        # oversampling multiplier: the compressed scan returns
+        # rescore_limit*k candidates for exact rescore (reference keeps an
+        # absolute rescoreLimit, flat/index.go:301; 16x measures ~0.99
+        # candidate-recall@10 on clustered 96-dim data)
+        rescore_limit: int = 16,
+        normalize_on_add: bool | None = None,
+        codebook: pq_ops.PQCodebook | None = None,
+    ):
+        if quantization not in ("pq", "bq"):
+            raise ValueError(f"unknown quantization {quantization!r}")
+        self.dim = dim
+        self.metric = metric
+        self.quantization = quantization
+        self.chunk_size = chunk_size
+        self.rescore_limit = rescore_limit
+        self.pq_segments = pq_segments or max(1, dim // 8)
+        self.pq_centroids = pq_centroids
+        self.codebook = codebook
+        self.normalize_on_add = (
+            metric in ("cosine", "cosine-dot")
+            if normalize_on_add is None
+            else normalize_on_add
+        )
+        self.mesh = None
+        self.n_shards = 1
+        self._lock = threading.RLock()
+        self._count = 0
+        self.capacity = max(_next_pow2(capacity), chunk_size)
+        self._host_vectors = np.zeros((self.capacity, dim), dtype=np.float32)
+        self._valid_np = np.zeros(self.capacity, dtype=bool)
+        self._alloc_codes()
+
+    # -- internals -----------------------------------------------------------
+
+    def _code_width(self) -> int:
+        if self.quantization == "pq":
+            return self.pq_segments
+        return bq_ops.bq_words(self.dim)
+
+    def _alloc_codes(self):
+        w = self._code_width()
+        dtype = jnp.uint8 if self.quantization == "pq" else jnp.uint32
+        self.codes = jnp.zeros((self.capacity, w), dtype=dtype)
+        self.valid = jnp.asarray(self._valid_np)
+
+    def _encode(self, vectors: np.ndarray) -> np.ndarray:
+        if self.quantization == "pq":
+            if self.codebook is None:
+                raise RuntimeError("PQ store not trained; call train() first")
+            return pq_ops.pq_encode(self.codebook, vectors)
+        return np.asarray(bq_ops.bq_encode(jnp.asarray(vectors)))
+
+    def _maybe_norm(self, vectors: np.ndarray) -> np.ndarray:
+        if self.normalize_on_add:
+            return np.asarray(normalize(jnp.asarray(vectors)))
+        return vectors
+
+    # -- training ------------------------------------------------------------
+
+    @property
+    def trained(self) -> bool:
+        return self.quantization == "bq" or self.codebook is not None
+
+    def train(self, vectors: np.ndarray | None = None, iters: int = 8, seed: int = 0):
+        """Fit the PQ codebook (on given vectors or current live contents)
+        and (re-)encode everything stored so far."""
+        if self.quantization == "bq":
+            return
+        with self._lock:
+            if vectors is None:
+                vectors = self._host_vectors[self._valid_np]
+            vectors = self._maybe_norm(np.asarray(vectors, dtype=np.float32))
+            self.codebook = pq_ops.pq_fit(
+                vectors, m=self.pq_segments, k=self.pq_centroids,
+                iters=iters, seed=seed,
+            )
+            self._reencode_all()
+
+    def _reencode_all(self):
+        live = np.nonzero(self._valid_np)[0]
+        if len(live):
+            codes = self._encode(self._host_vectors[live])
+            self.codes = self.codes.at[jnp.asarray(live)].set(jnp.asarray(codes))
+
+    # -- mutation ------------------------------------------------------------
+
+    def add(self, vectors: np.ndarray) -> np.ndarray:
+        vectors = np.asarray(vectors, dtype=np.float32)
+        if vectors.ndim == 1:
+            vectors = vectors[None, :]
+        m = len(vectors)
+        with self._lock:
+            slots = np.arange(self._count, self._count + m, dtype=np.int64)
+            self._count += m
+            if self._count > self.capacity:
+                self._grow(self._count)
+            self._write(slots, vectors)
+            return slots
+
+    def set_at(self, slots, vectors: np.ndarray):
+        slots = np.atleast_1d(np.asarray(slots, dtype=np.int64))
+        vectors = np.asarray(vectors, dtype=np.float32)
+        with self._lock:
+            if len(slots) and int(slots.max()) >= self.capacity:
+                self._grow(int(slots.max()) + 1)
+            self._count = max(self._count, int(slots.max()) + 1 if len(slots) else 0)
+            self._write(slots, vectors)
+
+    def _write(self, slots: np.ndarray, vectors: np.ndarray):
+        vectors = self._maybe_norm(vectors)
+        self._host_vectors[slots] = vectors
+        self._valid_np[slots] = True
+        codes = self._encode(vectors) if self.trained else None
+        if codes is not None:
+            self.codes = self.codes.at[jnp.asarray(slots)].set(jnp.asarray(codes))
+        self.valid = jnp.asarray(self._valid_np)
+
+    def _grow(self, min_capacity: int):
+        new_cap = max(_next_pow2(min_capacity), self.chunk_size)
+        grown_v = np.zeros((new_cap, self.dim), dtype=np.float32)
+        grown_v[: self.capacity] = self._host_vectors
+        grown_m = np.zeros(new_cap, dtype=bool)
+        grown_m[: self.capacity] = self._valid_np
+        self._host_vectors, self._valid_np = grown_v, grown_m
+        old_codes = self.codes
+        self.capacity = new_cap
+        self._alloc_codes()
+        self.codes = self.codes.at[: old_codes.shape[0]].set(old_codes)
+
+    def set_at_prenormalized(self, slots, vectors: np.ndarray):
+        """set_at for vectors already normalized at their original insert
+        (restore/compact/compress paths) — skips re-normalization."""
+        orig = self.normalize_on_add
+        self.normalize_on_add = False
+        try:
+            self.set_at(slots, vectors)
+        finally:
+            self.normalize_on_add = orig
+
+    def delete(self, slots) -> None:
+        slots = np.atleast_1d(np.asarray(slots, dtype=np.int64))
+        if len(slots) == 0:
+            return
+        with self._lock:
+            self._valid_np[slots] = False
+            self.valid = jnp.asarray(self._valid_np)
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def live_count(self) -> int:
+        return int(self._valid_np.sum())
+
+    def get(self, slots) -> np.ndarray:
+        slots = np.atleast_1d(np.asarray(slots, dtype=np.int64))
+        return self._host_vectors[slots].copy()
+
+    def search(self, queries: np.ndarray, k: int, allow_mask: np.ndarray | None = None):
+        """Two-stage: compressed scan (oversampled) -> exact f32 rescore.
+
+        Reference BQ rescore: flat/index.go:347; oversampling factor =
+        ``rescore_limit`` (*k candidates pulled from the compressed scan).
+        """
+        queries = np.asarray(queries, dtype=np.float32)
+        squeeze = queries.ndim == 1
+        if squeeze:
+            queries = queries[None, :]
+        queries = self._maybe_norm(queries)
+        with self._lock:
+            codes, valid = self.codes, self.valid
+            capacity = self.capacity
+            if allow_mask is not None:
+                full = np.zeros(capacity, dtype=bool)
+                full[: len(allow_mask)] = allow_mask[:capacity]
+                valid = jnp.logical_and(valid, jnp.asarray(full))
+            if not self.trained:
+                raise RuntimeError("PQ store not trained; call train() first")
+            k_cand = min(max(k * self.rescore_limit, k), capacity)
+            cs = min(self.chunk_size, capacity)
+            metric = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
+            if self.quantization == "pq":
+                d, i = pq_ops.pq_topk(
+                    jnp.asarray(queries), codes, self.codebook.centroids,
+                    k=k_cand, chunk_size=cs, metric=metric, valid=valid,
+                )
+            else:
+                q_words = bq_ops.bq_encode(jnp.asarray(queries))
+                d, i = bq_ops.bq_topk(
+                    q_words, codes, k=k_cand, chunk_size=cs, valid=valid,
+                )
+        cand_ids = np.asarray(i)  # [B, k_cand]
+        # exact rescore on host vectors (gather candidates, tiny matmul)
+        b = len(queries)
+        safe = np.clip(cand_ids, 0, capacity - 1)
+        cand_vecs = self._host_vectors[safe]  # [B, k_cand, d]
+        metric_exact = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
+        out_d = np.empty((b, min(k, cand_ids.shape[1])), dtype=np.float32)
+        out_i = np.empty_like(out_d, dtype=np.int64)
+        for bi in range(b):
+            dd = np.array(
+                pairwise_distance(
+                    jnp.asarray(queries[bi : bi + 1]),
+                    jnp.asarray(cand_vecs[bi]),
+                    metric=metric_exact,
+                )
+            )[0]
+            dead = cand_ids[bi] < 0
+            dd[dead] = np.float32(3.0e38)
+            order = np.argsort(dd, kind="stable")[: out_d.shape[1]]
+            out_d[bi] = dd[order]
+            out_i[bi] = np.where(dead[order], -1, cand_ids[bi][order])
+        if squeeze:
+            return out_d[0], out_i[0]
+        return out_d, out_i
+
+    def search_by_distance(self, query: np.ndarray, max_distance: float,
+                           allow_mask: np.ndarray | None = None):
+        k = min(64, self.capacity)
+        while True:
+            d, i = self.search(query, k, allow_mask)
+            within = d <= max_distance
+            if (~within).any() or k >= self.capacity or within.sum() >= self.live_count():
+                return d[within], i[within]
+            k = min(k * 4, self.capacity)
+
+    # -- maintenance / persistence -------------------------------------------
+
+    def compact(self) -> np.ndarray:
+        with self._lock:
+            live = np.nonzero(self._valid_np)[0]
+            mapping = np.full(self.capacity, -1, dtype=np.int64)
+            mapping[live] = np.arange(len(live))
+            vecs = self._host_vectors[live]
+            self._count = 0
+            self.capacity = max(_next_pow2(max(len(live), 1)), self.chunk_size)
+            self._host_vectors = np.zeros((self.capacity, self.dim), dtype=np.float32)
+            self._valid_np = np.zeros(self.capacity, dtype=bool)
+            self._alloc_codes()
+            if len(live):
+                self.set_at_prenormalized(np.arange(len(live)), vecs)
+            return mapping
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "vectors": self._host_vectors.copy(),
+                "valid": self._valid_np.copy(),
+                "count": self._count,
+                "dim": self.dim,
+                "metric": self.metric,
+                "quantization": self.quantization,
+                "pq_segments": self.pq_segments,
+                "pq_centroids": self.pq_centroids,
+                "rescore_limit": self.rescore_limit,
+                "chunk_size": self.chunk_size,
+                "codebook": (
+                    None if self.codebook is None
+                    else np.asarray(self.codebook.centroids)
+                ),
+            }
+
+    @classmethod
+    def restore(cls, snap: dict, **kwargs) -> "QuantizedVectorStore":
+        store = cls(
+            dim=snap["dim"],
+            metric=snap["metric"],
+            quantization=snap["quantization"],
+            capacity=max(len(snap["valid"]), 2),
+            chunk_size=snap["chunk_size"],
+            pq_segments=snap["pq_segments"],
+            pq_centroids=snap["pq_centroids"],
+            rescore_limit=snap["rescore_limit"],
+            **kwargs,
+        )
+        if snap.get("codebook") is not None:
+            store.codebook = pq_ops.PQCodebook(jnp.asarray(snap["codebook"]))
+        live = np.nonzero(snap["valid"])[0]
+        if len(live):
+            store.set_at_prenormalized(live, snap["vectors"][live])
+        store._count = snap["count"]
+        return store

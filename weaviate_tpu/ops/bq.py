@@ -1,0 +1,113 @@
+"""Binary quantization (BQ) on TPU.
+
+Reference: adapters/repos/db/vector/compressionhelpers/binary_quantization.go
+(:22 — sign bit per dimension packed into uint64 words, hamming distance via
+XOR + popcount, with full-precision rescore in the flat index,
+vector/flat/index.go:347).
+
+TPU re-design: bits pack into uint32 words (int64 lanes are wasteful on
+TPU); hamming runs as `population_count(xor(q, x))` on the VPU over [N, w]
+word arrays — one vectorized pass instead of per-pair scalar loops. 32x
+HBM compression; candidates are rescored against full-precision vectors.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+WORD_BITS = 32
+
+
+def bq_words(dim: int) -> int:
+    return -(-dim // WORD_BITS)
+
+
+@jax.jit
+def bq_encode(vectors: jnp.ndarray) -> jnp.ndarray:
+    """Pack sign bits: [N, d] float -> [N, ceil(d/32)] uint32.
+
+    Bit j of word w is set iff vectors[:, w*32+j] >= 0 (reference uses the
+    sign bit the same way, binary_quantization.go:30).
+    """
+    n, d = vectors.shape
+    w = bq_words(d)
+    pad = w * WORD_BITS - d
+    bits = (vectors >= 0).astype(jnp.uint32)
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros((n, pad), dtype=jnp.uint32)], axis=1)
+    bits = bits.reshape(n, w, WORD_BITS)
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)[None, None, :]
+    return jnp.sum(bits << shifts, axis=-1, dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk_size"))
+def bq_topk(
+    q_words: jnp.ndarray,
+    x_words: jnp.ndarray,
+    k: int,
+    chunk_size: int,
+    valid: jnp.ndarray | None = None,
+    id_offset: jnp.ndarray | int = 0,
+):
+    """Hamming top-k over packed words: q [B, w] uint32, x [N, w] uint32.
+
+    XOR + popcount + reduce on the VPU, chunk-scanned like the float path.
+    """
+    from weaviate_tpu.ops.distances import MASKED_DISTANCE
+    from weaviate_tpu.ops.topk import topk_smallest
+
+    n, w = x_words.shape
+    assert n % chunk_size == 0, f"{n} rows not a multiple of {chunk_size}"
+    num_chunks = n // chunk_size
+    b = q_words.shape[0]
+
+    x_chunks = x_words.reshape(num_chunks, chunk_size, w)
+    valid_chunks = None if valid is None else valid.reshape(num_chunks, chunk_size)
+
+    init_d = jnp.full((b, k), MASKED_DISTANCE, dtype=jnp.float32)
+    init_i = jnp.full((b, k), -1, dtype=jnp.int32)
+
+    def body(carry, inp):
+        best_d, best_i = carry
+        chunk_idx, xc, vc = inp
+        x_or = jax.lax.bitwise_xor(q_words[:, None, :], xc[None, :, :])
+        d = jnp.sum(
+            jax.lax.population_count(x_or), axis=-1, dtype=jnp.int32
+        ).astype(jnp.float32)
+        if vc is not None:
+            d = jnp.where(vc[None, :], d, MASKED_DISTANCE)
+        ids = (
+            chunk_idx * chunk_size
+            + id_offset
+            + jax.lax.broadcasted_iota(jnp.int32, (1, chunk_size), 1)
+        )
+        ids = jnp.broadcast_to(ids, (b, chunk_size))
+        new_d, new_i = topk_smallest(
+            jnp.concatenate([best_d, d], axis=1),
+            jnp.concatenate([best_i, ids], axis=1),
+            k,
+        )
+        return (new_d, new_i), None
+
+    chunk_ids = jnp.arange(num_chunks, dtype=jnp.int32)
+    if num_chunks == 1:
+        (fd, fi), _ = body(
+            (init_d, init_i),
+            (chunk_ids[0], x_chunks[0],
+             None if valid_chunks is None else valid_chunks[0]),
+        )
+    else:
+        (fd, fi), _ = jax.lax.scan(
+            body, (init_d, init_i), (chunk_ids, x_chunks, valid_chunks)
+        )
+    return fd, fi
+
+
+def bq_hamming_np(a_words: np.ndarray, b_words: np.ndarray) -> np.ndarray:
+    """Host reference: hamming between packed rows [A, w] x [B, w] -> [A, B]."""
+    x = np.bitwise_xor(a_words[:, None, :], b_words[None, :, :])
+    return np.unpackbits(x.view(np.uint8), axis=-1).sum(-1)
