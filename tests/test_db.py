@@ -1,0 +1,269 @@
+"""End-to-end DB tests: schema -> import -> search -> delete -> restart.
+
+The "minimum end-to-end slice" milestone (SURVEY §7 step 3): one collection,
+sharded, nearVector search through the full stack (schema, object store,
+doc-id mapping, HBM index, scatter-gather merge).
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.db import Database
+from weaviate_tpu.schema import (
+    CollectionConfig,
+    MultiTenancyConfig,
+    Property,
+    ShardingConfig,
+    VectorConfig,
+    VectorIndexConfig,
+)
+
+
+def make_db(tmp_path, **kwargs):
+    return Database(data_dir=str(tmp_path / "data"), **kwargs)
+
+
+def articles_config(shards=1, **kwargs):
+    return CollectionConfig(
+        name="Article",
+        properties=[
+            Property(name="title"),
+            Property(name="wordCount", data_type="int"),
+        ],
+        vectors=[VectorConfig(index=VectorIndexConfig(metric="l2-squared"))],
+        sharding=ShardingConfig(desired_count=shards),
+        **kwargs,
+    )
+
+
+def test_create_and_list_collections(tmp_path):
+    db = make_db(tmp_path)
+    db.create_collection(articles_config())
+    assert db.list_collections() == ["Article"]
+    with pytest.raises(ValueError):
+        db.create_collection(articles_config())
+    assert "Article" in db.schema_dict()
+
+
+def test_put_get_delete_object(tmp_path, rng):
+    db = make_db(tmp_path)
+    col = db.create_collection(articles_config())
+    uid = col.put_object({"title": "hello", "wordCount": 10},
+                         vector=rng.standard_normal(8).astype(np.float32))
+    obj = col.get_object(uid)
+    assert obj.properties["title"] == "hello"
+    assert col.object_count() == 1
+    assert col.delete_object(uid)
+    assert col.get_object(uid) is None
+    assert not col.delete_object(uid)
+
+
+def test_near_vector_end_to_end(tmp_path, rng):
+    db = make_db(tmp_path)
+    col = db.create_collection(articles_config())
+    vecs = rng.standard_normal((50, 16)).astype(np.float32)
+    res = col.batch_put([
+        {"properties": {"title": f"doc-{i}", "wordCount": i}, "vector": vecs[i]}
+        for i in range(50)
+    ])
+    assert all(r["status"] == "SUCCESS" for r in res)
+    hits = col.near_vector(vecs[17], k=3)
+    assert hits[0].object.properties["title"] == "doc-17"
+    assert hits[0].distance < 1e-3
+    assert len(hits) == 3
+
+
+def test_multi_shard_scatter_gather(tmp_path, rng):
+    db = make_db(tmp_path)
+    col = db.create_collection(articles_config(shards=4))
+    vecs = rng.standard_normal((80, 16)).astype(np.float32)
+    col.batch_put([
+        {"properties": {"title": f"d{i}"}, "vector": vecs[i]} for i in range(80)
+    ])
+    # objects spread over shards
+    counts = [s.object_count() for s in col.shards.values()]
+    assert len(counts) == 4 and sum(counts) == 80 and max(counts) < 80
+    hits = col.near_vector(vecs[33], k=5)
+    assert hits[0].object.properties["title"] == "d33"
+    # merged results are globally sorted
+    dists = [h.distance for h in hits]
+    assert dists == sorted(dists)
+
+
+def test_update_object_same_uuid(tmp_path, rng):
+    db = make_db(tmp_path)
+    col = db.create_collection(articles_config())
+    v1, v2 = rng.standard_normal((2, 8)).astype(np.float32)
+    uid = col.put_object({"title": "v1"}, vector=v1)
+    col.put_object({"title": "v2"}, vector=v2, uuid=uid)
+    assert col.object_count() == 1
+    hits = col.near_vector(v2, k=1)
+    assert hits[0].uuid == uid
+    assert hits[0].object.properties["title"] == "v2"
+    # old vector no longer findable
+    hits = col.near_vector(v1, k=1)
+    assert hits[0].distance > 1e-3 or hits[0].uuid == uid
+
+
+def test_restart_restores_everything(tmp_path, rng):
+    db = make_db(tmp_path)
+    col = db.create_collection(articles_config(shards=2))
+    vecs = rng.standard_normal((30, 12)).astype(np.float32)
+    col.batch_put([
+        {"properties": {"title": f"d{i}"}, "vector": vecs[i]} for i in range(30)
+    ])
+    col.flush()
+    db.close()
+
+    db2 = make_db(tmp_path)
+    assert db2.list_collections() == ["Article"]
+    col2 = db2.get_collection("Article")
+    assert col2.object_count() == 30
+    hits = col2.near_vector(vecs[21], k=1)
+    assert hits[0].object.properties["title"] == "d21"
+    assert hits[0].distance < 1e-3
+
+
+def test_multi_tenancy(tmp_path, rng):
+    db = make_db(tmp_path)
+    cfg = articles_config(multi_tenancy=MultiTenancyConfig(enabled=True))
+    col = db.create_collection(cfg)
+    db.add_tenants("Article", ["alice", "bob"])
+    va = rng.standard_normal(8).astype(np.float32)
+    vb = rng.standard_normal(8).astype(np.float32)
+    ua = col.put_object({"title": "alice-doc"}, vector=va, tenant="alice")
+    col.put_object({"title": "bob-doc"}, vector=vb, tenant="bob")
+    # tenant isolation: alice search never sees bob's docs
+    hits = col.near_vector(vb, k=5, tenant="alice")
+    assert all(h.object.properties["title"] == "alice-doc" for h in hits)
+    assert col.get_object(ua, tenant="alice") is not None
+    with pytest.raises(KeyError):
+        col.near_vector(va, k=1, tenant="carol")
+    with pytest.raises(ValueError):
+        col.near_vector(va, k=1)  # tenant required
+    db.remove_tenants("Article", ["bob"])
+    assert col.tenants() == ["alice"]
+
+
+def test_named_vectors(tmp_path, rng):
+    db = make_db(tmp_path)
+    cfg = CollectionConfig(
+        name="Product",
+        properties=[Property(name="name")],
+        vectors=[
+            VectorConfig(name="text", index=VectorIndexConfig(metric="cosine")),
+            VectorConfig(name="image", index=VectorIndexConfig(metric="l2-squared")),
+        ],
+    )
+    col = db.create_collection(cfg)
+    tv = rng.standard_normal((5, 16)).astype(np.float32)
+    iv = rng.standard_normal((5, 32)).astype(np.float32)
+    for i in range(5):
+        col.put_object({"name": f"p{i}"}, vectors={"text": tv[i], "image": iv[i]})
+    hits = col.near_vector(tv[2], k=1, vec_name="text")
+    assert hits[0].object.properties["name"] == "p2"
+    hits = col.near_vector(iv[4], k=1, vec_name="image")
+    assert hits[0].object.properties["name"] == "p4"
+
+
+def test_add_property_schema_evolution(tmp_path):
+    db = make_db(tmp_path)
+    db.create_collection(articles_config())
+    db.add_property("Article", Property(name="author"))
+    assert db.get_collection("Article").config.property("author") is not None
+    with pytest.raises(ValueError):
+        db.add_property("Article", Property(name="author"))
+
+
+def test_delete_collection(tmp_path):
+    db = make_db(tmp_path)
+    db.create_collection(articles_config())
+    assert db.delete_collection("Article")
+    assert db.list_collections() == []
+    assert not db.delete_collection("Article")
+    # recreate works after delete
+    db.create_collection(articles_config())
+
+
+def test_invalid_schema_rejected(tmp_path):
+    db = make_db(tmp_path)
+    with pytest.raises(ValueError):
+        db.create_collection(CollectionConfig(name="lowercase"))
+    with pytest.raises(ValueError):
+        db.create_collection(CollectionConfig(
+            name="Bad", properties=[Property(name="x", data_type="nope")]))
+
+
+def test_dim_mismatch_rejected_before_persist(tmp_path, rng):
+    """Regression: a rejected write must not leave a poisoned object behind
+    that breaks restart replay."""
+    db = make_db(tmp_path)
+    col = db.create_collection(articles_config())
+    col.put_object({"title": "ok"}, vector=rng.standard_normal(16).astype(np.float32))
+    with pytest.raises(ValueError):
+        col.put_object({"title": "bad"}, vector=np.ones(8, np.float32))
+    assert col.object_count() == 1  # bad object not persisted
+    db.flush()
+    db.close()
+    db2 = make_db(tmp_path)  # restart must not crash
+    assert db2.get_collection("Article").object_count() == 1
+
+
+def test_auto_tenant_creation_persists(tmp_path, rng):
+    """Regression: auto-created tenants must survive restart."""
+    db = make_db(tmp_path)
+    db.create_collection(articles_config(
+        multi_tenancy=MultiTenancyConfig(enabled=True, auto_tenant_creation=True)))
+    col = db.get_collection("Article")
+    col.put_object({"title": "x"}, vector=rng.standard_normal(8).astype(np.float32),
+                   tenant="auto-t")
+    db.flush(); db.close()
+    db2 = make_db(tmp_path)
+    col2 = db2.get_collection("Article")
+    assert "auto-t" in col2.tenants()
+    assert col2.object_count(tenant="auto-t") == 1
+
+
+def test_case_variant_collections_isolated(tmp_path, rng):
+    db = make_db(tmp_path)
+    db.create_collection(CollectionConfig(name="MyClass"))
+    db.create_collection(CollectionConfig(name="Myclass"))
+    a = db.get_collection("MyClass")
+    b = db.get_collection("Myclass")
+    a.put_object({"x": 1}, vector=np.ones(4, np.float32))
+    assert b.object_count() == 0
+    db.delete_collection("Myclass")
+    assert a.object_count() == 1
+
+
+def test_rejected_config_update_leaves_live_config(tmp_path):
+    db = make_db(tmp_path)
+    db.create_collection(articles_config())
+    def bad(cfg):
+        cfg.vectors[0].index.metric = "bogus"
+    with pytest.raises(ValueError):
+        db.update_collection_config("Article", bad)
+    assert db.get_collection("Article").config.vectors[0].index.metric == "l2-squared"
+
+
+def test_duplicate_uuid_in_batch_no_ghost(tmp_path, rng):
+    db = make_db(tmp_path)
+    col = db.create_collection(articles_config())
+    v = rng.standard_normal((2, 8)).astype(np.float32)
+    uid = "11111111-2222-3333-4444-555555555555"
+    col.batch_put([
+        {"uuid": uid, "properties": {"title": "first"}, "vector": v[0]},
+        {"uuid": uid, "properties": {"title": "second"}, "vector": v[1]},
+    ])
+    assert col.object_count() == 1
+    hits = col.near_vector(v[0], k=2)
+    # no ghost row: every hit resolves to the single live object
+    assert all(h.uuid == uid for h in hits)
+    assert col.get_object(uid).properties["title"] == "second"
+
+
+def test_add_property_case_variant_rejected(tmp_path):
+    db = make_db(tmp_path)
+    db.create_collection(articles_config())
+    with pytest.raises(ValueError):
+        db.add_property("Article", Property(name="Title"))  # 'title' exists

@@ -1,0 +1,13 @@
+"""The node-local database: shards, collections, and the DB facade.
+
+Layer map (SURVEY §1): Shard (layer 3) owns an object KV store + vector
+index(es) + inverted index; Collection (layer 4, the reference's Index)
+routes objects to shards and scatter-gathers queries; Database (layer 5,
+the reference's DB repo) holds collections + the schema manager.
+"""
+
+from weaviate_tpu.db.database import Database
+from weaviate_tpu.db.collection import Collection
+from weaviate_tpu.db.shard import Shard
+
+__all__ = ["Database", "Collection", "Shard"]

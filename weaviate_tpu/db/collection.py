@@ -1,0 +1,250 @@
+"""Collection: shard routing + scatter-gather queries.
+
+Reference: adapters/repos/db/index.go (Index struct :156) — putObject routes
+by sharding state (:637), objectVectorSearch scatter-gathers across shards
+and merges by distance (:1541-1663). Multi-tenant collections address one
+shard per tenant.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid as uuid_mod
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from weaviate_tpu.db.shard import Shard
+from weaviate_tpu.db.sharding import ShardingState
+from weaviate_tpu.schema.config import CollectionConfig
+from weaviate_tpu.storage.objects import StorageObject
+
+
+class SearchResult:
+    __slots__ = ("uuid", "distance", "score", "object", "shard")
+
+    def __init__(self, uuid, distance=None, score=None, object=None, shard=None):
+        self.uuid = uuid
+        self.distance = distance
+        self.score = score
+        self.object = object
+        self.shard = shard
+
+    def __repr__(self):
+        return f"SearchResult({self.uuid}, dist={self.distance}, score={self.score})"
+
+
+class Collection:
+    def __init__(self, data_dir: str, config: CollectionConfig,
+                 sharding_state: ShardingState | None = None, mesh=None,
+                 local_node: str = "node-0", on_sharding_change=None):
+        config.validate()
+        self.config = config
+        self.data_dir = data_dir
+        self.mesh = mesh
+        self.local_node = local_node
+        self._lock = threading.RLock()
+        if sharding_state is None:
+            if config.multi_tenancy.enabled:
+                sharding_state = ShardingState.create_partitioned()
+            else:
+                sharding_state = ShardingState.create(
+                    config.sharding.desired_count,
+                    replication_factor=config.replication.factor,
+                )
+        self.sharding = sharding_state
+        # persistence hook: auto-created tenants must reach the schema store
+        # or they vanish from sharding state on restart
+        self._on_sharding_change = on_sharding_change or (lambda col: None)
+        self.shards: dict[str, Shard] = {}
+        for name in self.sharding.shard_names:
+            if self.local_node in self.sharding.nodes_for(name):
+                self._load_shard(name)
+        self._pool = ThreadPoolExecutor(max_workers=8,
+                                        thread_name_prefix=f"{config.name}-search")
+
+    # -- shard management ----------------------------------------------------
+
+    def _load_shard(self, name: str) -> Shard:
+        # check-then-insert under the lock: two concurrent writers must not
+        # construct two Shard objects (two WALs, two doc counters) for the
+        # same on-disk shard
+        with self._lock:
+            if name not in self.shards:
+                self.shards[name] = Shard(self.data_dir, self.config, name,
+                                          mesh=self.mesh)
+            return self.shards[name]
+
+    def _shard_for_write(self, uuid: str, tenant: str | None) -> Shard:
+        with self._lock:
+            name = self.sharding.shard_for(uuid, tenant)
+            if name not in self.shards:
+                if self.config.multi_tenancy.enabled:
+                    if tenant not in self.sharding.shard_names:
+                        if not self.config.multi_tenancy.auto_tenant_creation:
+                            raise KeyError(f"tenant {tenant!r} does not exist")
+                        self.sharding.add_tenant(tenant)
+                        self._on_sharding_change(self)
+                self._load_shard(name)
+            return self.shards[name]
+
+    def _target_shards(self, tenant: str | None) -> list[Shard]:
+        if self.config.multi_tenancy.enabled:
+            if not tenant:
+                raise ValueError("multi-tenant collection requires a tenant")
+            if tenant not in self.sharding.shard_names:
+                raise KeyError(f"tenant {tenant!r} does not exist")
+            return [self._load_shard(tenant)]
+        return [self._load_shard(n) for n in self.sharding.shard_names]
+
+    # -- tenants -------------------------------------------------------------
+
+    def add_tenant(self, tenant: str):
+        with self._lock:
+            self.sharding.add_tenant(tenant)
+            self._load_shard(tenant)
+            self._on_sharding_change(self)
+
+    def remove_tenant(self, tenant: str):
+        with self._lock:
+            shard = self.shards.pop(tenant, None)
+            if shard is not None:
+                shard.close()
+            self.sharding.remove_tenant(tenant)
+
+    def tenants(self) -> list[str]:
+        return list(self.sharding.shard_names) if self.config.multi_tenancy.enabled else []
+
+    # -- object CRUD ---------------------------------------------------------
+
+    def put_object(self, properties: dict, vector=None, vectors: dict | None = None,
+                   uuid: str | None = None, tenant: str | None = None) -> str:
+        uuid = uuid or str(uuid_mod.uuid4())
+        obj = StorageObject(uuid=uuid, properties=properties)
+        if vector is not None:
+            obj.vector = np.asarray(vector, dtype=np.float32)
+        for name, vec in (vectors or {}).items():
+            obj.vectors[name] = np.asarray(vec, dtype=np.float32)
+        shard = self._shard_for_write(uuid, tenant)
+        shard.put_object(obj)
+        return uuid
+
+    def batch_put(self, objects: list[dict], tenant: str | None = None) -> list[dict]:
+        """Batch import; per-object error reporting, not transactional
+        (reference: usecases/objects/batch_add.go)."""
+        results = []
+        by_shard: dict[str, list[StorageObject]] = {}
+        metas: dict[str, list[int]] = {}
+        for i, spec in enumerate(objects):
+            try:
+                uid = spec.get("uuid") or str(uuid_mod.uuid4())
+                obj = StorageObject(uuid=uid,
+                                    properties=spec.get("properties", {}))
+                if spec.get("vector") is not None:
+                    obj.vector = np.asarray(spec["vector"], dtype=np.float32)
+                for name, vec in (spec.get("vectors") or {}).items():
+                    obj.vectors[name] = np.asarray(vec, dtype=np.float32)
+                shard_name = self.sharding.shard_for(uid, tenant)
+                by_shard.setdefault(shard_name, []).append(obj)
+                metas.setdefault(shard_name, []).append(i)
+                results.append({"uuid": uid, "status": "SUCCESS"})
+            except Exception as e:  # per-object failure, keep going
+                results.append({"uuid": spec.get("uuid"), "status": "FAILED",
+                                "error": str(e)})
+        for shard_name, objs in by_shard.items():
+            try:
+                with self._lock:
+                    if (self.config.multi_tenancy.enabled
+                            and shard_name not in self.sharding.shard_names):
+                        if self.config.multi_tenancy.auto_tenant_creation:
+                            self.sharding.add_tenant(shard_name)
+                            self._on_sharding_change(self)
+                        else:
+                            raise KeyError(f"tenant {shard_name!r} does not exist")
+                    shard = self._load_shard(shard_name)
+                shard.put_object_batch(objs)
+            except Exception as e:
+                for i in metas[shard_name]:
+                    results[i] = {"uuid": results[i]["uuid"], "status": "FAILED",
+                                  "error": str(e)}
+        return results
+
+    def get_object(self, uuid: str, tenant: str | None = None) -> StorageObject | None:
+        if self.config.multi_tenancy.enabled:
+            shard = self._target_shards(tenant)[0]
+            return shard.get_object(uuid)
+        name = self.sharding.shard_for(uuid, tenant)
+        if name not in self.shards:
+            return None
+        return self.shards[name].get_object(uuid)
+
+    def delete_object(self, uuid: str, tenant: str | None = None) -> bool:
+        if self.config.multi_tenancy.enabled:
+            return self._target_shards(tenant)[0].delete_object(uuid)
+        name = self.sharding.shard_for(uuid, tenant)
+        if name not in self.shards:
+            return False
+        return self.shards[name].delete_object(uuid)
+
+    def object_count(self, tenant: str | None = None) -> int:
+        shards = self._target_shards(tenant) if (tenant or not
+                  self.config.multi_tenancy.enabled) else []
+        return sum(s.object_count() for s in shards)
+
+    def iter_objects(self, tenant: str | None = None):
+        for shard in self._target_shards(tenant):
+            for key, raw in shard.objects.iter_items():
+                yield StorageObject.from_bytes(raw)
+
+    # -- search --------------------------------------------------------------
+
+    def near_vector(self, query, k: int = 10, vec_name: str = "",
+                    tenant: str | None = None, include_objects: bool = True,
+                    allow_list_by_shard: dict | None = None,
+                    max_distance: float | None = None) -> list[SearchResult]:
+        """Scatter-gather nearVector (reference: index.go:1541
+        objectVectorSearch -> per-shard parallel search -> merge+truncate)."""
+        query = np.asarray(query, dtype=np.float32)
+        shards = self._target_shards(tenant)
+
+        def one(shard: Shard):
+            allow = None if allow_list_by_shard is None else \
+                allow_list_by_shard.get(shard.name)
+            ids, dists = shard.vector_search(query, k, vec_name, allow)
+            return shard, ids, dists
+
+        if len(shards) == 1:
+            gathered = [one(shards[0])]
+        else:
+            gathered = list(self._pool.map(one, shards))
+
+        merged: list[tuple[float, int, Shard]] = []
+        for shard, ids, dists in gathered:
+            for doc_id, dist in zip(ids.tolist(), dists.tolist()):
+                merged.append((dist, doc_id, shard))
+        merged.sort(key=lambda t: t[0])
+        merged = merged[:k]
+        if max_distance is not None:
+            merged = [m for m in merged if m[0] <= max_distance]
+
+        out = []
+        for dist, doc_id, shard in merged:
+            uuid = shard._doc_to_uuid.get(doc_id)
+            if uuid is None:
+                continue
+            res = SearchResult(uuid=uuid, distance=dist, shard=shard.name)
+            if include_objects:
+                res.object = shard.get_object(uuid)
+            out.append(res)
+        return out
+
+    # -- maintenance ---------------------------------------------------------
+
+    def flush(self):
+        for s in self.shards.values():
+            s.flush()
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+        for s in self.shards.values():
+            s.close()

@@ -1,0 +1,148 @@
+"""Database facade: schema manager + collections.
+
+Reference: adapters/repos/db/repo.go (DB struct :41) + usecases/schema
+(handler.go:102 validation, manager). Schema is persisted in its own KV
+bucket; on a cluster this layer sits behind the Raft FSM (cluster/store.go)
+— single-node mode applies changes directly through the same interface the
+Raft executor uses.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from weaviate_tpu.db.collection import Collection
+from weaviate_tpu.db.sharding import ShardingState
+from weaviate_tpu.schema.config import CollectionConfig, Property
+from weaviate_tpu.storage.kv import KVStore
+
+
+class Database:
+    def __init__(self, data_dir: str = "./data", mesh=None,
+                 local_node: str = "node-0"):
+        self.data_dir = data_dir
+        self.mesh = mesh
+        self.local_node = local_node
+        os.makedirs(data_dir, exist_ok=True)
+        self._lock = threading.RLock()
+        self._schema_store = KVStore(os.path.join(data_dir, "_schema"))
+        self._schema = self._schema_store.bucket("classes", "replace")
+        self.collections: dict[str, Collection] = {}
+        self._load_existing()
+
+    def _load_existing(self):
+        for key in self._schema.keys():
+            d = self._schema.get(key)
+            cfg = CollectionConfig.from_dict(d["config"])
+            state = ShardingState.from_dict(d["sharding"])
+            self.collections[cfg.name] = Collection(
+                self.data_dir, cfg, sharding_state=state, mesh=self.mesh,
+                local_node=self.local_node, on_sharding_change=self._persist,
+            )
+
+    # -- schema ops (the Raft FSM op set, cluster/store_apply.go:133-160) ----
+
+    def create_collection(self, config: CollectionConfig) -> Collection:
+        config.validate()
+        with self._lock:
+            if config.name in self.collections:
+                raise ValueError(f"collection {config.name!r} already exists")
+            col = Collection(self.data_dir, config, mesh=self.mesh,
+                             local_node=self.local_node,
+                             on_sharding_change=self._persist)
+            self.collections[config.name] = col
+            self._persist(col)
+            return col
+
+    def delete_collection(self, name: str) -> bool:
+        with self._lock:
+            col = self.collections.pop(name, None)
+            if col is None:
+                return False
+            col.close()
+            self._schema.delete(name.encode())
+            import shutil
+
+            # exact-case path (matches Shard dir layout): names differing
+            # only in case are distinct collections
+            shutil.rmtree(os.path.join(self.data_dir, name),
+                          ignore_errors=True)
+            return True
+
+    def add_property(self, collection: str, prop: Property):
+        """Schema evolution (reference: ADD_PROPERTY FSM op; auto-schema
+        uses this too)."""
+        with self._lock:
+            col = self.get_collection(collection)
+            prop.validate()
+            # case-insensitive duplicate check, matching
+            # CollectionConfig.validate() — a case-variant duplicate would
+            # persist fine but make the schema unloadable on restart
+            if any(p.name.lower() == prop.name.lower()
+                   for p in col.config.properties):
+                raise ValueError(f"property {prop.name!r} already exists")
+            col.config.properties.append(prop)
+            self._persist(col)
+
+    def update_collection_config(self, name: str, mutate) -> None:
+        """Runtime-mutable config path (reference: UpdateUserConfig,
+        vector_index.go:33). ``mutate(config)`` edits in place; validation
+        runs on a copy so a rejected update leaves the live config intact."""
+        import copy
+
+        with self._lock:
+            col = self.get_collection(name)
+            candidate = copy.deepcopy(col.config)
+            mutate(candidate)
+            candidate.validate()
+            mutate(col.config)
+            self._persist(col)
+
+    def _persist(self, col: Collection):
+        self._schema.put(
+            col.config.name.encode(),
+            {"config": col.config.to_dict(), "sharding": col.sharding.to_dict()},
+        )
+
+    def get_collection(self, name: str) -> Collection:
+        col = self.collections.get(name)
+        if col is None:
+            raise KeyError(f"collection {name!r} does not exist")
+        return col
+
+    def list_collections(self) -> list[str]:
+        return sorted(self.collections)
+
+    def schema_dict(self) -> dict:
+        return {name: col.config.to_dict()
+                for name, col in sorted(self.collections.items())}
+
+    # -- tenants -------------------------------------------------------------
+
+    def add_tenants(self, collection: str, tenants: list[str]):
+        col = self.get_collection(collection)
+        for t in tenants:
+            col.add_tenant(t)
+        with self._lock:
+            self._persist(col)
+
+    def remove_tenants(self, collection: str, tenants: list[str]):
+        col = self.get_collection(collection)
+        for t in tenants:
+            col.remove_tenant(t)
+        with self._lock:
+            self._persist(col)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush(self):
+        for col in self.collections.values():
+            col.flush()
+
+    def close(self):
+        with self._lock:
+            for col in self.collections.values():
+                col.close()
+            self.collections.clear()
+            self._schema_store.close()

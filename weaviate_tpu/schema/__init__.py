@@ -1,0 +1,29 @@
+"""Schema: collection configs, properties, vector index configs.
+
+Maps the reference's entities/schema + entities/vectorindex config surface
+and the usecases/schema handler validation (schema/handler.go:102).
+"""
+
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    InvertedIndexConfig,
+    Property,
+    ShardingConfig,
+    MultiTenancyConfig,
+    ReplicationConfig,
+    VectorConfig,
+    VectorIndexConfig,
+    DataType,
+)
+
+__all__ = [
+    "CollectionConfig",
+    "InvertedIndexConfig",
+    "Property",
+    "ShardingConfig",
+    "MultiTenancyConfig",
+    "ReplicationConfig",
+    "VectorConfig",
+    "VectorIndexConfig",
+    "DataType",
+]

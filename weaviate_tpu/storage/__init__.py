@@ -1,0 +1,15 @@
+"""Host-side persistence: object codec, WAL, LSM-style KV store.
+
+Maps the reference's storage engine (adapters/repos/db/lsmkv — memtable +
+WAL + mmap'd sorted segments with bloom filters and strategy-specific
+compaction) and the binary object codec (entities/storobj). The TPU engine
+holds the hot vector copy in HBM; this layer is the durable source of truth
+that rebuilds device state on restart (reference contract: hnsw commit log
+replay, startup.go:57).
+"""
+
+from weaviate_tpu.storage.objects import StorageObject
+from weaviate_tpu.storage.wal import WriteAheadLog
+from weaviate_tpu.storage.kv import KVStore, Bucket
+
+__all__ = ["StorageObject", "WriteAheadLog", "KVStore", "Bucket"]

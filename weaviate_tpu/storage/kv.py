@@ -1,0 +1,450 @@
+"""LSM-style KV store with strategy-typed buckets.
+
+Reference: adapters/repos/db/lsmkv — a ``Store`` is a directory of named
+``Bucket``s (store.go:36, bucket.go:45), each with an active memtable, a
+WAL, and a stack of immutable sorted segments, compacted in the background.
+Four value strategies (strategies.go:21-25):
+
+- ``replace``     last write wins (object storage)
+- ``set``         unordered value collection with per-value deletes
+- ``map``         key -> {mapKey: mapValue} with per-mapKey deletes
+- ``roaringset``  key -> bitmap of doc ids (additions/removals sets)
+
+This implementation keeps the same shapes — memtable + WAL + sorted
+segment files + strategy-aware merge/compaction — with a Python core:
+segments store a sorted key index in a footer (loaded at open) and values
+read on demand, standing in for the reference's mmap'd segments with
+bloom filters. doc-id bitmaps are sorted numpy uint64 arrays, the dense
+analog of the reference's roaring bitmaps (sroar).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Iterator
+
+import msgpack
+import numpy as np
+
+from weaviate_tpu.storage.wal import WriteAheadLog
+
+STRATEGIES = ("replace", "set", "map", "roaringset")
+_TOMBSTONE = "__tomb__"
+
+
+def _merge_values(strategy: str, older, newer):
+    """Merge two strategy values, newer taking precedence."""
+    if strategy == "replace":
+        return newer
+    if strategy == "set":
+        # value: {"add": set, "del": set}
+        add = (older["add"] - newer["del"]) | newer["add"]
+        dele = (older["del"] | newer["del"]) - newer["add"]
+        return {"add": add, "del": dele}
+    if strategy == "map":
+        # value: {"set": {k: v}, "del": set}
+        out = dict(older.get("set", {}))
+        for k in newer.get("del", set()):
+            out.pop(k, None)
+        out.update(newer.get("set", {}))
+        dele = (older.get("del", set()) | newer.get("del", set())) - set(
+            newer.get("set", {})
+        )
+        return {"set": out, "del": dele}
+    # roaringset: value {"add": np.uint64[], "del": np.uint64[]}
+    add = np.union1d(
+        np.setdiff1d(older["add"], newer["del"], assume_unique=False), newer["add"]
+    )
+    dele = np.setdiff1d(
+        np.union1d(older["del"], newer["del"]), newer["add"], assume_unique=False
+    )
+    return {"add": add, "del": dele}
+
+
+def _empty_value(strategy: str):
+    if strategy == "replace":
+        return None
+    if strategy == "set":
+        return {"add": set(), "del": set()}
+    if strategy == "map":
+        return {"set": {}, "del": set()}
+    return {"add": np.empty(0, np.uint64), "del": np.empty(0, np.uint64)}
+
+
+def _pack_value(strategy: str, value) -> bytes:
+    if strategy == "replace":
+        return msgpack.packb({"v": value}, use_bin_type=True)
+    if strategy == "set":
+        return msgpack.packb(
+            {"add": sorted(value["add"]), "del": sorted(value["del"])},
+            use_bin_type=True,
+        )
+    if strategy == "map":
+        return msgpack.packb(
+            {"set": value["set"], "del": sorted(value["del"])}, use_bin_type=True
+        )
+    return msgpack.packb(
+        {
+            "add": np.asarray(value["add"], np.uint64).tobytes(),
+            "del": np.asarray(value["del"], np.uint64).tobytes(),
+        },
+        use_bin_type=True,
+    )
+
+
+def _unpack_value(strategy: str, raw: bytes):
+    obj = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+    if strategy == "replace":
+        return obj["v"]
+    if strategy == "set":
+        return {"add": set(obj["add"]), "del": set(obj["del"])}
+    if strategy == "map":
+        return {"set": obj["set"], "del": set(obj["del"])}
+    return {
+        "add": np.frombuffer(obj["add"], np.uint64).copy(),
+        "del": np.frombuffer(obj["del"], np.uint64).copy(),
+    }
+
+
+class _Segment:
+    """Immutable sorted segment file.
+
+    Layout: [records...][footer msgpack][u64 footer_off]
+    footer = {"keys": [...], "offs": [...], "lens": [...]}
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            f.seek(-8, os.SEEK_END)
+            (foot_off,) = struct.unpack("<Q", f.read(8))
+            f.seek(foot_off)
+            size = f.seek(0, os.SEEK_END)
+            f.seek(foot_off)
+            footer = msgpack.unpackb(f.read(size - 8 - foot_off), raw=False)
+        self.keys: list[bytes] = footer["keys"]
+        self.offs: list[int] = footer["offs"]
+        self.lens: list[int] = footer["lens"]
+
+    @classmethod
+    def write(cls, path: str, items: list[tuple[bytes, bytes]]) -> "_Segment":
+        tmp = path + ".tmp"
+        keys, offs, lens = [], [], []
+        with open(tmp, "wb") as f:
+            for k, v in items:  # items must be key-sorted
+                keys.append(k)
+                offs.append(f.tell())
+                lens.append(len(v))
+                f.write(v)
+            foot_off = f.tell()
+            f.write(msgpack.packb({"keys": keys, "offs": offs, "lens": lens},
+                                  use_bin_type=True))
+            f.write(struct.pack("<Q", foot_off))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return cls(path)
+
+    def get(self, key: bytes) -> bytes | None:
+        import bisect
+
+        i = bisect.bisect_left(self.keys, key)
+        if i < len(self.keys) and self.keys[i] == key:
+            with open(self.path, "rb") as f:
+                f.seek(self.offs[i])
+                return f.read(self.lens[i])
+        return None
+
+    def iter_items(self) -> Iterator[tuple[bytes, bytes]]:
+        with open(self.path, "rb") as f:
+            for k, off, ln in zip(self.keys, self.offs, self.lens):
+                f.seek(off)
+                yield k, f.read(ln)
+
+
+class Bucket:
+    """Named bucket: memtable + WAL + segment stack (reference bucket.go:45)."""
+
+    def __init__(self, dir_path: str, name: str, strategy: str = "replace",
+                 memtable_limit: int = 4 * 1024 * 1024, sync_wal: bool = False):
+        if strategy not in STRATEGIES:
+            raise ValueError(f"unknown strategy {strategy!r}")
+        self.name = name
+        self.strategy = strategy
+        self.dir = os.path.join(dir_path, name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.memtable_limit = memtable_limit
+        self._lock = threading.RLock()
+        self._mem: dict[bytes, object] = {}
+        self._mem_bytes = 0
+        self._segments: list[_Segment] = []  # oldest -> newest
+        self._load_segments()
+        self._wal = WriteAheadLog(os.path.join(self.dir, "wal.bin"), sync=sync_wal)
+        self._replay_wal()
+
+    # -- startup -------------------------------------------------------------
+
+    def _load_segments(self):
+        segs = sorted(
+            f for f in os.listdir(self.dir)
+            if f.startswith("segment-") and f.endswith(".db")
+        )
+        self._segments = [_Segment(os.path.join(self.dir, s)) for s in segs]
+        # monotonic segment sequence — never reuse or go below an existing
+        # number, or newest-wins ordering breaks after compaction
+        self._next_seq = (
+            max((int(s.split("-")[1].split(".")[0]) for s in segs), default=-1) + 1
+        )
+
+    def _replay_wal(self):
+        for payload in WriteAheadLog.replay(self._wal.path):
+            rec = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+            self._apply_mem(rec["k"], _unpack_value(self.strategy, rec["v"])
+                            if rec["v"] is not None else _TOMBSTONE)
+
+    # -- write path ----------------------------------------------------------
+
+    def _log_and_apply(self, key: bytes, value) -> None:
+        packed = None if value is _TOMBSTONE else _pack_value(self.strategy, value)
+        self._wal.append(msgpack.packb({"k": key, "v": packed}, use_bin_type=True))
+        self._apply_mem(key, value)
+        if self._mem_bytes >= self.memtable_limit:
+            self.flush()
+
+    def _apply_mem(self, key: bytes, value) -> None:
+        cur = self._mem.get(key)
+        if value is _TOMBSTONE or cur is _TOMBSTONE or cur is None:
+            self._mem[key] = value
+        else:
+            self._mem[key] = _merge_values(self.strategy, cur, value)
+        self._mem_bytes += len(key) + 64
+
+    def put(self, key: bytes, value) -> None:
+        """replace strategy: store value (any msgpack-able object)."""
+        assert self.strategy == "replace"
+        with self._lock:
+            self._log_and_apply(key, value)
+
+    def delete(self, key: bytes) -> None:
+        assert self.strategy == "replace"
+        with self._lock:
+            self._log_and_apply(key, _TOMBSTONE)
+
+    def set_add(self, key: bytes, values) -> None:
+        assert self.strategy == "set"
+        with self._lock:
+            self._log_and_apply(key, {"add": set(values), "del": set()})
+
+    def set_remove(self, key: bytes, values) -> None:
+        assert self.strategy == "set"
+        with self._lock:
+            self._log_and_apply(key, {"add": set(), "del": set(values)})
+
+    def map_set(self, key: bytes, mapping: dict) -> None:
+        assert self.strategy == "map"
+        with self._lock:
+            self._log_and_apply(key, {"set": dict(mapping), "del": set()})
+
+    def map_delete(self, key: bytes, map_keys) -> None:
+        assert self.strategy == "map"
+        with self._lock:
+            self._log_and_apply(key, {"set": {}, "del": set(map_keys)})
+
+    def bitmap_add(self, key: bytes, ids) -> None:
+        assert self.strategy == "roaringset"
+        with self._lock:
+            self._log_and_apply(
+                key,
+                {"add": np.asarray(sorted(ids), np.uint64),
+                 "del": np.empty(0, np.uint64)},
+            )
+
+    def bitmap_remove(self, key: bytes, ids) -> None:
+        assert self.strategy == "roaringset"
+        with self._lock:
+            self._log_and_apply(
+                key,
+                {"add": np.empty(0, np.uint64),
+                 "del": np.asarray(sorted(ids), np.uint64)},
+            )
+
+    # -- read path -----------------------------------------------------------
+
+    @staticmethod
+    def _is_tomb_record(raw: bytes) -> bool:
+        obj = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+        return isinstance(obj, dict) and obj.get("__tomb__") is True
+
+    def get(self, key: bytes):
+        """Merged view across memtable + segments (newest wins)."""
+        with self._lock:
+            layers = []
+            for seg in self._segments:
+                raw = seg.get(key)
+                if raw is not None:
+                    if self._is_tomb_record(raw):
+                        layers.append(_TOMBSTONE)
+                    else:
+                        layers.append(_unpack_value(self.strategy, raw))
+            mem = self._mem.get(key)
+            if mem is not None:
+                layers.append(mem)
+            if not layers:
+                return None
+            if self.strategy == "replace":
+                last = layers[-1]
+                return None if last is _TOMBSTONE else last
+            out = _empty_value(self.strategy)
+            seen_any = False
+            for layer in layers:
+                if layer is _TOMBSTONE:
+                    out = _empty_value(self.strategy)  # wipes prior layers
+                    seen_any = False
+                else:
+                    out = _merge_values(self.strategy, out, layer)
+                    seen_any = True
+            return out if seen_any else None
+
+    def get_set(self, key: bytes) -> set:
+        v = self.get(key)
+        return set() if v is None else set(v["add"])
+
+    def get_map(self, key: bytes) -> dict:
+        v = self.get(key)
+        return {} if v is None else dict(v["set"])
+
+    def get_bitmap(self, key: bytes) -> np.ndarray:
+        v = self.get(key)
+        if v is None:
+            return np.empty(0, np.uint64)
+        return np.setdiff1d(v["add"], v["del"])
+
+    def keys(self) -> list[bytes]:
+        with self._lock:
+            out = set()
+            for seg in self._segments:
+                out.update(seg.keys)
+            for k, v in self._mem.items():
+                out.add(k)
+            live = []
+            for k in sorted(out):
+                val = self.get(k)
+                if self.strategy == "replace":
+                    if val is not None:
+                        live.append(k)
+                else:
+                    live.append(k)
+            return live
+
+    def iter_items(self) -> Iterator[tuple[bytes, object]]:
+        """Cursor over merged live items in key order (reference: segment
+        cursors used by the flat index full scan)."""
+        for k in self.keys():
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+    # -- flush / compaction --------------------------------------------------
+
+    def flush(self) -> None:
+        """Memtable -> new segment; WAL truncates (reference: flush cycle,
+        store_cyclecallbacks.go)."""
+        with self._lock:
+            if not self._mem:
+                return
+            items = []
+            for k in sorted(self._mem):
+                v = self._mem[k]
+                if v is _TOMBSTONE:
+                    packed = msgpack.packb({"__tomb__": True}, use_bin_type=True)
+                else:
+                    packed = _pack_value(self.strategy, v)
+                items.append((k, packed))
+            path = os.path.join(self.dir, f"segment-{self._next_seq:06d}.db")
+            self._next_seq += 1
+            self._segments.append(_Segment.write(path, items))
+            self._mem.clear()
+            self._mem_bytes = 0
+            self._wal.reset()
+
+    def compact(self) -> None:
+        """Full compaction: merge all segments strategy-aware, drop
+        tombstones (reference: segment_group_compaction.go +
+        compactor_{replace,set,map}.go)."""
+        with self._lock:
+            self.flush()
+            if len(self._segments) <= 1:
+                return
+            merged: dict[bytes, object] = {}
+            for seg in self._segments:  # oldest -> newest
+                for k, raw in seg.iter_items():
+                    obj = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+                    if isinstance(obj, dict) and obj.get("__tomb__"):
+                        merged[k] = _TOMBSTONE
+                        continue
+                    val = _unpack_value(self.strategy, raw)
+                    cur = merged.get(k)
+                    if cur is None or cur is _TOMBSTONE:
+                        merged[k] = val
+                    else:
+                        merged[k] = _merge_values(self.strategy, cur, val)
+            items = []
+            for k in sorted(merged):
+                v = merged[k]
+                if v is _TOMBSTONE:
+                    continue  # tombstones die in full compaction
+                items.append((k, _pack_value(self.strategy, v)))
+            # Crash safety: write the merged segment as a NEW higher-seq
+            # segment first, then delete the old ones. A crash in between
+            # leaves old + merged coexisting, which replays consistently
+            # (merge is idempotent; replace takes the newest layer).
+            old_segments = self._segments
+            if items:
+                path = os.path.join(self.dir, f"segment-{self._next_seq:06d}.db")
+                self._next_seq += 1
+                merged_seg = _Segment.write(path, items)
+                self._segments = [merged_seg]
+            else:
+                self._segments = []
+            for seg in old_segments:
+                os.remove(seg.path)
+
+    def close(self) -> None:
+        with self._lock:
+            self.flush()
+            self._wal.close()
+
+
+class KVStore:
+    """Directory of named buckets (reference Store, lsmkv/store.go:36)."""
+
+    def __init__(self, dir_path: str, sync_wal: bool = False):
+        self.dir = dir_path
+        self.sync_wal = sync_wal
+        os.makedirs(dir_path, exist_ok=True)
+        self._buckets: dict[str, Bucket] = {}
+        self._lock = threading.Lock()
+
+    def bucket(self, name: str, strategy: str = "replace", **kwargs) -> Bucket:
+        with self._lock:
+            if name not in self._buckets:
+                self._buckets[name] = Bucket(
+                    self.dir, name, strategy, sync_wal=self.sync_wal, **kwargs
+                )
+            b = self._buckets[name]
+            if b.strategy != strategy:
+                raise ValueError(
+                    f"bucket {name!r} exists with strategy {b.strategy!r}"
+                )
+            return b
+
+    def close(self) -> None:
+        with self._lock:
+            for b in self._buckets.values():
+                b.close()
+            self._buckets.clear()

@@ -1,0 +1,81 @@
+"""Write-ahead log: CRC-framed append-only records with replay.
+
+Reference: adapters/repos/db/lsmkv/commitlogger.go (memtable WAL) and
+bucket_recover_from_wal.go (replay on open). Frame layout:
+
+    u32 crc32(payload)   u32 len(payload)   payload
+
+Torn tails (partial final record after a crash) are truncated on replay,
+matching the reference's recovery behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Iterator
+
+_FRAME = struct.Struct("<II")
+
+
+class WriteAheadLog:
+    def __init__(self, path: str, sync: bool = False):
+        self.path = path
+        self.sync = sync
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+
+    def append(self, payload: bytes) -> None:
+        frame = _FRAME.pack(zlib.crc32(payload) & 0xFFFFFFFF, len(payload)) + payload
+        with self._lock:
+            self._f.write(frame)
+            self._f.flush()
+            if self.sync:
+                os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    def size(self) -> int:
+        with self._lock:
+            return self._f.tell() if not self._f.closed else os.path.getsize(self.path)
+
+    def reset(self) -> None:
+        """Truncate after a successful flush (reference: WAL switch on
+        memtable flush)."""
+        with self._lock:
+            self._f.close()
+            self._f = open(self.path, "wb")
+            self._f.flush()
+            if self.sync:
+                os.fsync(self._f.fileno())
+
+    @classmethod
+    def replay(cls, path: str) -> Iterator[bytes]:
+        """Yield intact payloads; stop (and truncate) at the first torn or
+        corrupt frame."""
+        if not os.path.exists(path):
+            return
+        good_end = 0
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + _FRAME.size <= len(data):
+            crc, ln = _FRAME.unpack_from(data, off)
+            start = off + _FRAME.size
+            if start + ln > len(data):
+                break  # torn tail
+            payload = data[start : start + ln]
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                break  # corrupt frame — stop replay here
+            yield payload
+            off = start + ln
+            good_end = off
+        if good_end < len(data):
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
