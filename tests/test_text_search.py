@@ -1,0 +1,327 @@
+"""Inverted index, BM25F, filters, hybrid fusion.
+
+Mirrors reference test semantics: inverted/analyzer tokenization tests,
+bm25_searcher scoring order, searcher filter set algebra, hybrid fusion
+(usecases/traverser/hybrid/hybrid_fusion_test.go).
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.db.database import Database
+from weaviate_tpu.filters import Filter, Operator
+from weaviate_tpu.schema.config import (
+    CollectionConfig, DataType, Property, VectorConfig,
+)
+from weaviate_tpu.text.tokenizer import tokenize
+
+
+# -- tokenizer ----------------------------------------------------------------
+
+def test_tokenize_word():
+    assert tokenize("Hello, World! x2", "word") == ["hello", "world", "x2"]
+
+
+def test_tokenize_lowercase():
+    assert tokenize("Hello, World!", "lowercase") == ["hello,", "world!"]
+
+
+def test_tokenize_whitespace():
+    assert tokenize("Hello the World", "whitespace") == ["Hello", "the", "World"]
+
+
+def test_tokenize_field():
+    assert tokenize("  Hello World  ", "field") == ["Hello World"]
+
+
+def test_tokenize_array():
+    assert tokenize(["a b", "c"], "word") == ["a", "b", "c"]
+
+
+# -- fixtures -----------------------------------------------------------------
+
+@pytest.fixture
+def articles(tmp_path):
+    db = Database(str(tmp_path))
+    cfg = CollectionConfig(
+        name="Article",
+        properties=[
+            Property(name="title", data_type=DataType.TEXT),
+            Property(name="body", data_type=DataType.TEXT),
+            Property(name="views", data_type=DataType.INT),
+            Property(name="score", data_type=DataType.NUMBER),
+            Property(name="published", data_type=DataType.BOOL),
+            Property(name="tags", data_type=DataType.TEXT_ARRAY),
+            Property(name="location", data_type=DataType.GEO),
+        ],
+        vectors=[VectorConfig()],
+    )
+    col = db.create_collection(cfg)
+    rng = np.random.default_rng(7)
+    docs = [
+        ("Python on TPU", "fast vector search with python and jax", 100, 4.5,
+         True, ["ml", "tpu"], (48.2, 16.37)),
+        ("Go databases", "weaviate is a vector database written in go", 50,
+         3.0, True, ["db"], (52.52, 13.40)),
+        ("Cooking pasta", "boil water add salt cook the pasta", 10, 2.0,
+         False, ["food"], (41.9, 12.49)),
+        ("Vector search", "vector vector vector search search engines", 500,
+         5.0, True, ["ml", "search"], (37.77, -122.41)),
+        ("Gardening", "plant tomatoes in spring water them daily", 5, 1.0,
+         False, ["garden"], (51.5, -0.12)),
+    ]
+    for title, body, views, score, pub, tags, (lat, lon) in docs:
+        col.put_object(
+            {"title": title, "body": body, "views": views, "score": score,
+             "published": pub, "tags": tags,
+             "location": {"latitude": lat, "longitude": lon}},
+            vector=rng.standard_normal(8),
+        )
+    yield db, col
+    db.close()
+
+
+# -- BM25 ---------------------------------------------------------------------
+
+def test_bm25_basic_ranking(articles):
+    _, col = articles
+    res = col.bm25("vector search", k=5)
+    assert res, "expected hits"
+    # the doc stuffed with 'vector vector vector search search' must rank first
+    assert res[0].object.properties["title"] == "Vector search"
+    scores = [r.score for r in res]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_bm25_no_hits(articles):
+    _, col = articles
+    assert col.bm25("zzzqqq nonexistent", k=5) == []
+
+
+def test_bm25_property_scoping(articles):
+    _, col = articles
+    res = col.bm25("pasta", k=5, properties=["title"])
+    assert len(res) == 1
+    assert res[0].object.properties["title"] == "Cooking pasta"
+
+
+def test_bm25_property_boost(articles):
+    _, col = articles
+    # boosting body term should outrank title-only match
+    res = col.bm25("go databases", k=5, properties=["title^3", "body"])
+    assert res[0].object.properties["title"] == "Go databases"
+
+
+def test_bm25_stopwords_ignored(articles):
+    _, col = articles
+    # 'the' is a stopword; query of only stopwords yields nothing
+    assert col.bm25("the", k=5) == []
+
+
+def test_bm25_survives_restart(articles, tmp_path):
+    db, col = articles
+    db.flush()
+    db.close()
+    db2 = Database(str(tmp_path))
+    col2 = db2.get_collection("Article")
+    res = col2.bm25("tomatoes", k=3)
+    assert len(res) == 1
+    assert res[0].object.properties["title"] == "Gardening"
+    db2.close()
+
+
+def test_bm25_after_delete(articles):
+    _, col = articles
+    res = col.bm25("pasta", k=5)
+    assert len(res) == 1
+    col.delete_object(res[0].uuid)
+    assert col.bm25("pasta", k=5) == []
+
+
+def test_bm25_after_update(articles):
+    _, col = articles
+    res = col.bm25("gardening", k=5, properties=["title"])
+    uuid = res[0].uuid
+    col.put_object({"title": "Quantum computing", "body": "qubits"},
+                   vector=np.zeros(8), uuid=uuid)
+    assert col.bm25("gardening", k=5) == []
+    res2 = col.bm25("quantum qubits", k=5)
+    assert len(res2) == 1 and res2[0].uuid == uuid
+
+
+# -- filters ------------------------------------------------------------------
+
+def test_filter_equal_text(articles):
+    _, col = articles
+    res = col.bm25("vector", k=10,
+                   where=Filter.where("tags", Operator.EQUAL, "ml"))
+    titles = {r.object.properties["title"] for r in res}
+    assert titles == {"Python on TPU", "Vector search"}
+
+
+def test_filter_range_int(articles):
+    _, col = articles
+    f = Filter.where("views", Operator.GREATER_THAN_EQUAL, 100)
+    res = col.bm25("vector search python go pasta plant", k=10, where=f)
+    titles = {r.object.properties["title"] for r in res}
+    assert titles == {"Python on TPU", "Vector search"}
+
+
+def test_filter_bool_and_range(articles):
+    _, col = articles
+    f = Filter.and_(
+        Filter.where("published", Operator.EQUAL, True),
+        Filter.where("views", Operator.LESS_THAN, 100),
+    )
+    res = col.bm25("go database", k=10, where=f)
+    assert len(res) == 1
+    assert res[0].object.properties["title"] == "Go databases"
+
+
+def test_filter_or_not(articles):
+    _, col = articles
+    f = Filter.or_(
+        Filter.where("tags", Operator.EQUAL, "food"),
+        Filter.where("tags", Operator.EQUAL, "garden"),
+    )
+    res = col.bm25("pasta tomatoes water", k=10, where=f)
+    titles = {r.object.properties["title"] for r in res}
+    assert titles == {"Cooking pasta", "Gardening"}
+
+    f_not = Filter.not_(Filter.where("published", Operator.EQUAL, True))
+    res = col.bm25("pasta tomatoes water plant", k=10, where=f_not)
+    titles = {r.object.properties["title"] for r in res}
+    assert titles == {"Cooking pasta", "Gardening"}
+
+
+def test_filter_like(articles):
+    _, col = articles
+    f = Filter.where("body", Operator.LIKE, "tomato*")
+    res = col.bm25("plant", k=10, where=f)
+    assert len(res) == 1
+    assert res[0].object.properties["title"] == "Gardening"
+
+
+def test_filter_contains_any_all(articles):
+    _, col = articles
+    f_any = Filter.where("tags", Operator.CONTAINS_ANY, ["db", "food"])
+    res = col.bm25("pasta database weaviate", k=10, where=f_any)
+    assert {r.object.properties["title"] for r in res} == \
+        {"Go databases", "Cooking pasta"}
+
+    f_all = Filter.where("body", Operator.CONTAINS_ALL, ["vector", "jax"])
+    res = col.bm25("python", k=10, where=f_all)
+    assert len(res) == 1
+    assert res[0].object.properties["title"] == "Python on TPU"
+
+
+def test_filter_geo_range(articles):
+    _, col = articles
+    # within 600 km of Vienna: Vienna itself (0 km) and Berlin (~523 km);
+    # Rome is ~765 km away and must be excluded
+    f = Filter.where("location", Operator.WITHIN_GEO_RANGE, {
+        "geoCoordinates": {"latitude": 48.2, "longitude": 16.37},
+        "distance": {"max": 600_000},
+    })
+    res = col.bm25("python go pasta vector plant water", k=10, where=f)
+    titles = {r.object.properties["title"] for r in res}
+    assert titles == {"Python on TPU", "Go databases"}
+
+
+def test_filter_on_vector_search(articles):
+    _, col = articles
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal(8)
+    f = Filter.where("published", Operator.EQUAL, False)
+    res = col.near_vector(q, k=10, where=f)
+    titles = {r.object.properties["title"] for r in res}
+    assert titles == {"Cooking pasta", "Gardening"}
+
+
+def test_filter_from_dict_roundtrip():
+    f = Filter.and_(
+        Filter.where("views", Operator.GREATER_THAN, 10),
+        Filter.where("title", Operator.EQUAL, "x"),
+    )
+    d = f.to_dict()
+    f2 = Filter.from_dict(d)
+    assert f2.operator == Operator.AND
+    assert f2.operands[0].prop == "views"
+    assert f2.operands[0].value == 10
+    # weaviate REST typed-value form
+    f3 = Filter.from_dict({"operator": "Equal", "path": ["title"],
+                           "valueText": "x"})
+    assert f3.value == "x"
+
+
+# -- hybrid -------------------------------------------------------------------
+
+def test_hybrid_blends_legs(articles):
+    _, col = articles
+    # query vector aimed at the doc for 'Vector search' - find its vector
+    target = col.bm25("engines", k=1)[0]
+    vec = target.object.vectors[""]
+    res = col.hybrid("pasta", vector=vec, alpha=0.5, k=3)
+    titles = [r.object.properties["title"] for r in res]
+    # both legs' top hits must surface
+    assert "Vector search" in titles
+    assert "Cooking pasta" in titles
+
+
+def test_hybrid_alpha_extremes(articles):
+    _, col = articles
+    target = col.bm25("engines", k=1)[0]
+    vec = target.object.vectors[""]
+    dense_only = col.hybrid("pasta", vector=vec, alpha=1.0, k=1)
+    assert dense_only[0].object.properties["title"] == "Vector search"
+    sparse_only = col.hybrid("pasta", vector=vec, alpha=0.0, k=1)
+    assert sparse_only[0].object.properties["title"] == "Cooking pasta"
+
+
+def test_hybrid_ranked_fusion(articles):
+    _, col = articles
+    target = col.bm25("engines", k=1)[0]
+    vec = target.object.vectors[""]
+    res = col.hybrid("pasta", vector=vec, alpha=0.5, k=3, fusion="rankedFusion")
+    assert len(res) >= 2
+
+
+def test_hybrid_without_vector_is_sparse(articles):
+    _, col = articles
+    res = col.hybrid("pasta", vector=None, alpha=0.5, k=3)
+    assert res[0].object.properties["title"] == "Cooking pasta"
+    # even alpha=1.0 degrades to sparse when no vector is available
+    res = col.hybrid("pasta", vector=None, alpha=1.0, k=3)
+    assert res and res[0].object.properties["title"] == "Cooking pasta"
+
+
+def test_hybrid_with_where_filter(articles):
+    _, col = articles
+    target = col.bm25("engines", k=1)[0]
+    vec = target.object.vectors[""]
+    f = Filter.where("published", Operator.EQUAL, False)
+    res = col.hybrid("pasta vector", vector=vec, alpha=0.5, k=5, where=f)
+    titles = {r.object.properties["title"] for r in res}
+    assert "Vector search" not in titles
+    assert "Cooking pasta" in titles
+
+
+# -- multi-shard --------------------------------------------------------------
+
+def test_bm25_multi_shard(tmp_path):
+    db = Database(str(tmp_path))
+    cfg = CollectionConfig(
+        name="Doc",
+        properties=[Property(name="text", data_type=DataType.TEXT)],
+    )
+    cfg.sharding.desired_count = 4
+    col = db.create_collection(cfg)
+    for i in range(40):
+        col.put_object({"text": f"common token{i}"})
+    col.put_object({"text": "needle in the haystack"})
+    res = col.bm25("needle haystack", k=3)
+    assert res and res[0].object.properties["text"] == "needle in the haystack"
+    # common term spans shards
+    res = col.bm25("common", k=50)
+    assert len(res) == 40
+    db.close()

@@ -198,18 +198,43 @@ class Collection:
 
     # -- search --------------------------------------------------------------
 
+    @staticmethod
+    def _and_masks(a, b) -> np.ndarray:
+        """Intersect two allow lists (bool mask or doc-id array forms)."""
+        def to_mask(x, size):
+            x = np.asarray(x)
+            if x.dtype == np.bool_:
+                m = np.zeros(size, dtype=bool)
+                m[: len(x)] = x
+                return m
+            m = np.zeros(size, dtype=bool)
+            m[x[x < size]] = True
+            return m
+
+        a, b = np.asarray(a), np.asarray(b)
+        size = max(len(a) if a.dtype == np.bool_ else (int(a.max()) + 1 if len(a) else 0),
+                   len(b) if b.dtype == np.bool_ else (int(b.max()) + 1 if len(b) else 0))
+        return to_mask(a, size) & to_mask(b, size)
+
     def near_vector(self, query, k: int = 10, vec_name: str = "",
                     tenant: str | None = None, include_objects: bool = True,
                     allow_list_by_shard: dict | None = None,
-                    max_distance: float | None = None) -> list[SearchResult]:
+                    max_distance: float | None = None,
+                    where=None) -> list[SearchResult]:
         """Scatter-gather nearVector (reference: index.go:1541
-        objectVectorSearch -> per-shard parallel search -> merge+truncate)."""
+        objectVectorSearch -> per-shard parallel search -> merge+truncate).
+        ``where``: optional Filter tree, evaluated per shard to an AllowList
+        mask applied inside the device scan."""
         query = np.asarray(query, dtype=np.float32)
         shards = self._target_shards(tenant)
 
         def one(shard: Shard):
             allow = None if allow_list_by_shard is None else \
                 allow_list_by_shard.get(shard.name)
+            if where is not None:
+                fmask = shard.allow_mask(where)
+                allow = fmask if allow is None else \
+                    self._and_masks(allow, fmask)
             ids, dists = shard.vector_search(query, k, vec_name, allow)
             return shard, ids, dists
 
@@ -237,6 +262,118 @@ class Collection:
                 res.object = shard.get_object(uuid)
             out.append(res)
         return out
+
+    def bm25(self, query: str, k: int = 10, properties: list[str] | None = None,
+             tenant: str | None = None, include_objects: bool = True,
+             allow_list_by_shard: dict | None = None,
+             where=None) -> list[SearchResult]:
+        """Scatter-gather keyword search; merge by score descending
+        (reference: Index.objectSearch → per-shard BM25 → merge)."""
+        shards = self._target_shards(tenant)
+
+        def one(shard: Shard):
+            allow = None if allow_list_by_shard is None else \
+                allow_list_by_shard.get(shard.name)
+            if where is not None:
+                fmask = shard.allow_mask(where)
+                allow = fmask if allow is None else \
+                    self._and_masks(allow, fmask)
+            ids, scores = shard.bm25_search(query, k, properties, allow)
+            return shard, ids, scores
+
+        gathered = [one(shards[0])] if len(shards) == 1 else \
+            list(self._pool.map(one, shards))
+
+        merged: list[tuple[float, int, Shard]] = []
+        for shard, ids, scores in gathered:
+            merged.extend(zip(scores.tolist(), ids.tolist(), [shard] * len(ids)))
+        merged.sort(key=lambda t: -t[0])
+        out = []
+        for score, doc_id, shard in merged[:k]:
+            uuid = shard._doc_to_uuid.get(doc_id)
+            if uuid is None:
+                continue
+            res = SearchResult(uuid=uuid, score=score, shard=shard.name)
+            if include_objects:
+                res.object = shard.get_object(uuid)
+            out.append(res)
+        return out
+
+    def hybrid(self, query: str, vector=None, alpha: float = 0.75, k: int = 10,
+               properties: list[str] | None = None, vec_name: str = "",
+               tenant: str | None = None, fusion: str = "relativeScore",
+               where=None, include_objects: bool = True) -> list[SearchResult]:
+        """Hybrid sparse+dense search (reference: hybrid/searcher.go:74 runs
+        both legs in parallel, then fuses). ``alpha`` weighs the dense leg
+        (0 = pure BM25, 1 = pure vector). ``vector=None`` degrades to
+        sparse-only, as the reference does without a vectorizer."""
+        from weaviate_tpu.text.hybrid import fusion_ranked, fusion_relative_score
+
+        # over-fetch each leg so fusion has overlap to work with; legs run on
+        # ephemeral threads, NOT self._pool — a leg parked in a pool worker
+        # while its inner scatter-gather waits for that same pool can deadlock
+        import threading as _threading
+
+        if vector is None:
+            alpha = 0.0  # degrade to sparse-only (reference does the same
+            # when no vectorizer can produce a query vector)
+        # evaluate the filter once per shard; both legs reuse the masks
+        allow_by_shard = None
+        if where is not None:
+            allow_by_shard = {s.name: s.allow_mask(where)
+                              for s in self._target_shards(tenant)}
+
+        fetch = max(k * 10, 100)
+        legs, weights = [], []
+        results: dict[str, list] = {}
+        errors: dict[str, BaseException] = {}
+
+        def run(name, fn, *a):
+            try:
+                results[name] = fn(*a)
+            except BaseException as e:  # re-raised on the caller thread
+                errors[name] = e
+
+        # legs skip object fetch; only the fused top-k pays for it below
+        threads = []
+        if alpha < 1.0:
+            threads.append(_threading.Thread(
+                target=run, args=("sparse", self.bm25, query, fetch,
+                                  properties, tenant, False, allow_by_shard,
+                                  None)))
+        if vector is not None and alpha > 0.0:
+            threads.append(_threading.Thread(
+                target=run, args=("dense", self.near_vector, vector, fetch,
+                                  vec_name, tenant, False, allow_by_shard,
+                                  None, None)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise next(iter(errors.values()))
+        if "sparse" in results:
+            legs.append(results["sparse"])
+            weights.append(1.0 - alpha)
+        if "dense" in results:
+            dense = results["dense"]
+            # similarity score for fusion: any monotone-decreasing map of
+            # distance works (min-max normalization is affine-invariant)
+            for r in dense:
+                r.score = -r.distance
+            legs.append(dense)
+            weights.append(alpha)
+        if not legs:
+            return []
+        fuse = fusion_relative_score if fusion == "relativeScore" else fusion_ranked
+        fused = fuse(legs, weights, k)
+        if include_objects:
+            by_shard = {s.name: s for s in self._target_shards(tenant)}
+            for r in fused:
+                shard = by_shard.get(r.shard)
+                if shard is not None:
+                    r.object = shard.get_object(r.uuid)
+        return fused
 
     # -- maintenance ---------------------------------------------------------
 

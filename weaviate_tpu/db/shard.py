@@ -76,7 +76,9 @@ class Shard:
         self.mesh = mesh
         # named vector indexes, built lazily at first insert (dim inference)
         self.vector_indexes: dict[str, FlatIndex] = {}
-        self._inverted = None  # attached by the inverted package when built
+        from weaviate_tpu.text.inverted import InvertedIndex
+
+        self._inverted = InvertedIndex(collection)
         # doc_id -> uuid, rebuilt at startup; the object-resolution hot path
         # after a vector search (reference: docid bucket, adapters/repos/db/docid)
         self._doc_to_uuid: dict[int, str] = {}
@@ -92,6 +94,7 @@ class Shard:
         for key, raw in self.objects.iter_items():
             obj = StorageObject.from_bytes(raw)
             self._doc_to_uuid[obj.doc_id] = obj.uuid
+            self._inverted.index_object(obj)
             for vec_name, vec in obj.vectors.items():
                 ids, vecs = batch.setdefault(vec_name, ([], []))
                 ids.append(obj.doc_id)
@@ -190,8 +193,7 @@ class Shard:
                     ids, vecs = vec_batches.setdefault(vec_name, ([], []))
                     ids.append(obj.doc_id)
                     vecs.append(np.asarray(vec, dtype=np.float32))
-                if self._inverted is not None:
-                    self._inverted.index_object(obj)
+                self._inverted.index_object(obj)
                 doc_ids.append(obj.doc_id)
             for vec_name, (ids, vecs) in vec_batches.items():
                 idx = self._ensure_vector_index(vec_name, len(vecs[0]))
@@ -203,10 +205,9 @@ class Shard:
         for idx in self.vector_indexes.values():
             if idx is not None:
                 idx.delete(doc_id)
-        if self._inverted is not None:
-            old = self.get_object(uuid)
-            if old is not None:
-                self._inverted.unindex_object(old)
+        old = self.get_object(uuid)
+        if old is not None:
+            self._inverted.unindex_object(old)
         self._doc_to_uuid.pop(doc_id, None)
 
     def delete_object(self, uuid: str) -> bool:
@@ -250,6 +251,29 @@ class Shard:
         if idx is None:
             return np.empty(0, np.int64), np.empty(0, np.float32)
         return idx.search_by_vector(query, k, allow_list=allow_list)
+
+    def bm25_search(self, query: str, k: int = 10,
+                    properties: list[str] | None = None,
+                    allow_mask: np.ndarray | None = None):
+        """(doc_ids, scores) keyword search (reference: shard ObjectSearch →
+        inverted.BM25Searcher)."""
+        return self._inverted.bm25_search(query, k, properties, allow_mask)
+
+    @property
+    def doc_id_space(self) -> int:
+        """Upper bound (exclusive) on doc ids ever assigned — the size of
+        AllowList masks."""
+        return self._counter
+
+    def allow_mask(self, where) -> np.ndarray | None:
+        """Filter tree → bool mask over this shard's doc-id space
+        (reference: inverted.Searcher → helpers.AllowList)."""
+        if where is None:
+            return None
+        from weaviate_tpu.filters import compute_allow_mask
+
+        with self._lock:
+            return compute_allow_mask(where, self._inverted, self.doc_id_space)
 
     # -- maintenance ---------------------------------------------------------
 

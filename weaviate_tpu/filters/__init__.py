@@ -1,0 +1,10 @@
+"""Filter trees → AllowList masks.
+
+Reference: entities/filters (operator tree) evaluated by
+adapters/repos/db/inverted/searcher.go into a roaring-bitmap AllowList
+(helpers/allow_list.go:19) that the vector search consumes as a mask.
+"""
+
+from weaviate_tpu.filters.filters import Filter, Operator, compute_allow_mask
+
+__all__ = ["Filter", "Operator", "compute_allow_mask"]

@@ -1,0 +1,10 @@
+"""Text search: tokenization, inverted index, BM25F, hybrid fusion.
+
+Reference: adapters/repos/db/inverted/ (analyzer, BM25 searcher, filter
+searcher) + usecases/traverser/hybrid/ (fusion).
+"""
+
+from weaviate_tpu.text.tokenizer import tokenize
+from weaviate_tpu.text.inverted import InvertedIndex
+
+__all__ = ["tokenize", "InvertedIndex"]

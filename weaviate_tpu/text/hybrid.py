@@ -1,0 +1,56 @@
+"""Hybrid search fusion: merge sparse (BM25) and dense (vector) rankings.
+
+Reference: usecases/traverser/hybrid/hybrid_fusion.go —
+``FusionRanked`` (:22, reciprocal-rank fusion with alpha weights) and
+``FusionRelativeScore`` (:87, min-max normalized score blending); the
+orchestration (parallel sparse+dense searches) mirrors hybrid/searcher.go:74.
+"""
+
+from __future__ import annotations
+
+
+def fusion_ranked(result_sets: list[list], weights: list[float],
+                  k: int = 10) -> list:
+    """Reciprocal-rank fusion. Each result keeps its best contribution:
+    score_i = sum over sets of weight / (60 + rank). Reference:
+    hybrid_fusion.go:22 (the constant 60 is the reference's, :36)."""
+    fused: dict[str, tuple[float, object]] = {}
+    for results, weight in zip(result_sets, weights):
+        for rank, res in enumerate(results):
+            add = weight / (60.0 + rank)
+            prev = fused.get(res.uuid)
+            fused[res.uuid] = (add + (prev[0] if prev else 0.0),
+                              prev[1] if prev else res)
+    out = sorted(fused.values(), key=lambda t: -t[0])[:k]
+    results = []
+    for score, res in out:
+        res.score = score
+        results.append(res)
+    return results
+
+
+def fusion_relative_score(result_sets: list[list], weights: list[float],
+                          k: int = 10) -> list:
+    """Min-max normalize each set's scores to [0,1], blend by weight.
+    Reference: hybrid_fusion.go:87 (FusionRelativeScore). Distances from
+    the dense set must already be converted to similarity scores
+    (higher = better) by the caller."""
+    fused: dict[str, tuple[float, object]] = {}
+    for results, weight in zip(result_sets, weights):
+        if not results:
+            continue
+        scores = [r.score for r in results]
+        lo, hi = min(scores), max(scores)
+        span = (hi - lo) or 1.0
+        for res in results:
+            norm = (res.score - lo) / span if hi > lo else 1.0
+            add = weight * norm
+            prev = fused.get(res.uuid)
+            fused[res.uuid] = (add + (prev[0] if prev else 0.0),
+                              prev[1] if prev else res)
+    out = sorted(fused.values(), key=lambda t: -t[0])[:k]
+    results = []
+    for score, res in out:
+        res.score = score
+        results.append(res)
+    return results
