@@ -1,0 +1,193 @@
+"""IVF ANN index + dynamic flat→IVF upgrade.
+
+Mirrors the reference's recall-gated ANN tests (hnsw/recall_test.go asserts
+recall vs brute force) and dynamic upgrade tests (dynamic/index.go:348).
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.engine.dynamic import DynamicIndex
+from weaviate_tpu.engine.flat import FlatIndex
+from weaviate_tpu.engine.ivf import IVFIndex
+
+
+def _clustered(rng, n, dim, n_clusters=32):
+    """Clustered corpus — IVF recall on uniform noise is meaningless."""
+    centers = rng.standard_normal((n_clusters, dim)) * 5.0
+    assign = rng.integers(0, n_clusters, n)
+    return (centers[assign] + rng.standard_normal((n, dim))).astype(np.float32)
+
+
+def _recall(ann_ids, exact_ids):
+    hits = sum(len(set(a.tolist()) & set(e.tolist())) for a, e in
+               zip(ann_ids, exact_ids))
+    return hits / exact_ids.size
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(3)
+    x = _clustered(rng, 6000, 32)
+    q = _clustered(rng, 16, 32)
+    return x, q
+
+
+def test_ivf_trains_at_threshold(corpus):
+    x, _ = corpus
+    idx = IVFIndex(dim=32, train_threshold=2000, delta_threshold=512)
+    idx.add_batch(np.arange(1000), x[:1000])
+    assert not idx.trained
+    idx.add_batch(np.arange(1000, 4000), x[1000:4000])
+    assert idx.trained
+    assert len(idx) == 4000
+
+
+def test_ivf_recall_vs_exact(corpus):
+    x, q = corpus
+    n = len(x)
+    flat = FlatIndex(dim=32)
+    flat.add_batch(np.arange(n), x)
+    ivf = IVFIndex(dim=32, train_threshold=2000, delta_threshold=512,
+                   nprobe=8)
+    ivf.add_batch(np.arange(n), x)
+    assert ivf.trained
+
+    exact_ids, _ = flat.search_by_vector_batch(q, 10)
+    ann_ids, ann_d = ivf.search_by_vector_batch(q, 10)
+    r = _recall(ann_ids, exact_ids)
+    assert r >= 0.9, f"recall {r} too low"
+    # distances ascending
+    for row in ann_d:
+        assert (np.diff(row[row < 1e37]) >= -1e-4).all()
+
+
+def test_ivf_full_probe_is_exact(corpus):
+    """nprobe == nlist degenerates to exact brute force."""
+    x, q = corpus
+    n = 4000
+    ivf = IVFIndex(dim=32, train_threshold=2000, nlist=16, nprobe=16,
+                   delta_threshold=512)
+    ivf.add_batch(np.arange(n), x[:n])
+    flat = FlatIndex(dim=32)
+    flat.add_batch(np.arange(n), x[:n])
+    exact_ids, _ = flat.search_by_vector_batch(q, 5)
+    ann_ids, _ = ivf.search_by_vector_batch(q, 5)
+    assert _recall(ann_ids, exact_ids) == 1.0
+
+
+def test_ivf_delta_is_searchable_before_flush(corpus):
+    x, _ = corpus
+    ivf = IVFIndex(dim=32, train_threshold=2000, delta_threshold=100_000)
+    ivf.add_batch(np.arange(3000), x[:3000])
+    assert ivf.trained
+    # these stay in the delta buffer (threshold huge)
+    probe = x[3000] + 0.001
+    ivf.add(99_999, x[3000])
+    ids, d = ivf.search_by_vector(probe, 1)
+    assert ids[0] == 99_999
+
+
+def test_ivf_delete_and_update(corpus):
+    x, _ = corpus
+    n = 3000
+    ivf = IVFIndex(dim=32, train_threshold=1000, delta_threshold=256)
+    ivf.add_batch(np.arange(n), x[:n])
+    ivf.store.flush_delta()
+    # delete a list-resident vector: must vanish from results
+    q = x[5]
+    ids, _ = ivf.search_by_vector(q, 1)
+    assert ids[0] == 5
+    ivf.delete(5)
+    ids, _ = ivf.search_by_vector(q, 3)
+    assert 5 not in ids.tolist()
+    assert len(ivf) == n - 1
+    # update: overwrite doc 7 with a far-away vector
+    far = (x[7] + 100.0).astype(np.float32)
+    ivf.add(7, far)
+    ids, _ = ivf.search_by_vector(far + 0.001, 1)
+    assert ids[0] == 7
+
+
+def test_ivf_allow_list(corpus):
+    x, q = corpus
+    n = 3000
+    ivf = IVFIndex(dim=32, train_threshold=1000, delta_threshold=256,
+                   nprobe=16)
+    ivf.add_batch(np.arange(n), x[:n])
+    allowed = np.arange(0, n, 7)
+    ids, d = ivf.search_by_vector(q[0], 10, allow_list=allowed)
+    assert len(ids) > 0
+    assert all(i % 7 == 0 for i in ids.tolist())
+
+
+def test_ivf_cosine(corpus):
+    x, q = corpus
+    n = 3000
+    ivf = IVFIndex(dim=32, metric="cosine", train_threshold=1000,
+                   delta_threshold=256, nprobe=8)
+    ivf.add_batch(np.arange(n), x[:n])
+    flat = FlatIndex(dim=32, metric="cosine")
+    flat.add_batch(np.arange(n), x[:n])
+    exact_ids, _ = flat.search_by_vector_batch(q, 10)
+    ann_ids, _ = ivf.search_by_vector_batch(q, 10)
+    assert _recall(ann_ids, exact_ids) >= 0.85
+
+
+def test_ivf_snapshot_restore(corpus):
+    x, q = corpus
+    n = 3000
+    ivf = IVFIndex(dim=32, train_threshold=1000, delta_threshold=256)
+    ivf.add_batch(np.arange(n), x[:n])
+    ivf.delete(17)
+    snap = ivf.snapshot()
+    restored = IVFIndex.restore(snap)
+    assert restored.trained
+    assert len(restored) == n - 1
+    a, _ = ivf.search_by_vector_batch(q, 10)
+    b, _ = restored.search_by_vector_batch(q, 10)
+    assert _recall(b, a) >= 0.9
+
+
+def test_dynamic_upgrade(corpus):
+    x, q = corpus
+    dyn = DynamicIndex(dim=32, threshold=2000, nprobe=16)
+    dyn.add_batch(np.arange(1500), x[:1500])
+    assert not dyn.upgraded
+    ids, _ = dyn.search_by_vector(x[3], 1)
+    assert ids[0] == 3
+    dyn.add_batch(np.arange(1500, 4000), x[1500:4000])
+    assert dyn.upgraded
+    assert len(dyn) == 4000
+    # still finds its nearest neighbors after migration
+    ids, _ = dyn.search_by_vector(x[3] + 0.0001, 1)
+    assert ids[0] == 3
+
+
+def test_dynamic_stays_flat_below_threshold(corpus):
+    x, _ = corpus
+    dyn = DynamicIndex(dim=32, threshold=10_000)
+    dyn.add_batch(np.arange(500), x[:500])
+    assert not dyn.upgraded
+    assert dyn.index_type == "dynamic"
+
+
+def test_dynamic_in_collection(tmp_path, corpus):
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.schema.config import CollectionConfig, VectorConfig, VectorIndexConfig
+
+    x, _ = corpus
+    db = Database(str(tmp_path))
+    cfg = CollectionConfig(
+        name="Ann",
+        vectors=[VectorConfig(index=VectorIndexConfig(
+            index_type="dynamic", flat_to_ann_threshold=2000))],
+    )
+    col = db.create_collection(cfg)
+    col.batch_put([{"properties": {"i": i}, "vector": x[i]}
+                   for i in range(2500)])
+    res = col.near_vector(x[42] + 0.0001, k=1)
+    assert res[0].object.properties["i"] == 42
+    shard = next(iter(col.shards.values()))
+    assert shard.vector_indexes[""].upgraded
+    db.close()

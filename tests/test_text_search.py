@@ -306,6 +306,52 @@ def test_hybrid_with_where_filter(articles):
     assert "Cooking pasta" in titles
 
 
+# -- regression: per-property tokenization & array semantics ------------------
+
+def test_bm25_field_tokenized_property(tmp_path):
+    """Query must be analyzed with each property's own tokenization."""
+    db = Database(str(tmp_path))
+    cfg = CollectionConfig(
+        name="Item",
+        properties=[Property(name="sku", tokenization="field"),
+                    Property(name="desc")],
+    )
+    col = db.create_collection(cfg)
+    col.put_object({"sku": "AB-12 X", "desc": "a widget"})
+    col.put_object({"sku": "CD-99 Y", "desc": "a gadget"})
+    res = col.bm25("AB-12 X", k=5, properties=["sku"])
+    assert len(res) == 1
+    assert res[0].object.properties["sku"] == "AB-12 X"
+    db.close()
+
+
+def test_filter_range_any_element_array(tmp_path):
+    db = Database(str(tmp_path))
+    cfg = CollectionConfig(
+        name="Nums",
+        properties=[Property(name="vals", data_type=DataType.NUMBER_ARRAY),
+                    Property(name="tag")],
+    )
+    col = db.create_collection(cfg)
+    col.put_object({"vals": [5.0, 100.0], "tag": "both"})
+    col.put_object({"vals": [1.0, 2.0], "tag": "low"})
+    f = Filter.where("vals", Operator.GREATER_THAN, 50)
+    res = col.bm25("both low", k=5, where=f)
+    assert [r.object.properties["tag"] for r in res] == ["both"]
+    db.close()
+
+
+def test_bm25_allow_list_id_array_form(articles):
+    _, col = articles
+    # doc-id-array allow list (the form near_vector also accepts)
+    shard = next(iter(col.shards.values()))
+    all_res = col.bm25("vector", k=10)
+    some_doc = shard.docid.get(all_res[0].uuid.encode())
+    ids, scores = shard.bm25_search("vector", k=10,
+                                    allow_mask=np.asarray([int(some_doc)]))
+    assert ids.tolist() == [int(some_doc)]
+
+
 # -- multi-shard --------------------------------------------------------------
 
 def test_bm25_multi_shard(tmp_path):

@@ -37,10 +37,7 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
         capacity=8192,
         chunk_size=8192,
     )
-    if cfg.index_type in ("flat", "hnsw", "dynamic", "ivf"):
-        # graph/ivf indexes land later; flat is the TPU-native default and
-        # the stand-in until then (exact > approximate at equal speed for
-        # moderate N on TPU)
+    if cfg.index_type == "flat":
         if cfg.quantization:
             return FlatIndex(
                 quantization=cfg.quantization,
@@ -51,6 +48,36 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
             )
         return FlatIndex(
             mesh=mesh,
+            dtype=jnp.bfloat16 if cfg.storage_dtype == "bfloat16" else jnp.float32,
+            **common,
+        )
+    if cfg.index_type == "ivf":
+        from weaviate_tpu.engine.ivf import IVFIndex
+
+        # mesh forwarded so the single-replica guard fires loudly instead of
+        # silently landing a sharded corpus on one device
+        return IVFIndex(nlist=cfg.ivf_nlist, nprobe=cfg.ivf_nprobe,
+                        mesh=mesh, **common)
+    if cfg.index_type in ("hnsw", "dynamic"):
+        # "hnsw" is accepted for reference-config compatibility; the ANN
+        # regime on TPU is IVF (SURVEY §7 step 5), entered via the dynamic
+        # flat→ANN upgrade so small corpora stay exact
+        from weaviate_tpu.engine.dynamic import DynamicIndex
+
+        if cfg.quantization:
+            # quantized flat scan is already the fast path; stays flat
+            # (DynamicIndex refuses to upgrade a quantized impl)
+            return DynamicIndex(
+                threshold=cfg.flat_to_ann_threshold,
+                quantization=cfg.quantization,
+                pq_segments=cfg.pq_segments,
+                pq_centroids=cfg.pq_centroids,
+                rescore_limit=cfg.rescore_limit,
+                **common,
+            )
+        return DynamicIndex(
+            threshold=cfg.flat_to_ann_threshold, mesh=mesh,
+            nlist=cfg.ivf_nlist, nprobe=cfg.ivf_nprobe,
             dtype=jnp.bfloat16 if cfg.storage_dtype == "bfloat16" else jnp.float32,
             **common,
         )
@@ -256,7 +283,14 @@ class Shard:
                     properties: list[str] | None = None,
                     allow_mask: np.ndarray | None = None):
         """(doc_ids, scores) keyword search (reference: shard ObjectSearch →
-        inverted.BM25Searcher)."""
+        inverted.BM25Searcher). ``allow_mask`` accepts either form the
+        vector path does: bool mask or doc-id array."""
+        if allow_mask is not None:
+            allow_mask = np.asarray(allow_mask)
+            if allow_mask.dtype != np.bool_:
+                ids = allow_mask
+                allow_mask = np.zeros(self.doc_id_space, dtype=bool)
+                allow_mask[ids[ids < len(allow_mask)]] = True
         return self._inverted.bm25_search(query, k, properties, allow_mask)
 
     @property

@@ -29,12 +29,16 @@ class FlatIndex:
 
     def __init__(self, dim: int, metric: str = "l2-squared", mesh=None,
                  dtype=None, capacity: int = 8192, chunk_size: int = 8192,
-                 quantization: str | None = None, **quant_kwargs):
+                 quantization: str | None = None, store=None, **quant_kwargs):
         import jax.numpy as jnp
 
         self.dim = dim
         self.metric = metric
-        if quantization:
+        if store is not None:
+            # injected store (IVFIndex subclass passes an IVFStore; the
+            # id<->slot bookkeeping below is store-agnostic)
+            self.store = store
+        elif quantization:
             from weaviate_tpu.engine.quantized import QuantizedVectorStore
 
             if mesh is not None:

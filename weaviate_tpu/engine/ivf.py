@@ -1,0 +1,615 @@
+"""IVF (inverted-file) ANN index — the TPU-native ANN.
+
+The reference's ANN is HNSW (vector/hnsw/index.go): a pointer-chasing graph
+whose hot loop (search.go:173-341) is one-vector-at-a-time — the worst
+possible shape for a systolic array. The TPU-idiomatic replacement
+(SURVEY §7 step 5) is IVF/ScaNN-style partitioning:
+
+- **train**: coarse k-means over the corpus (ops/kmeans.py, MXU Lloyd's)
+- **layout**: posting lists as ONE dense padded tensor ``[nlist, cap, d]``
+  in HBM (+ valid mask, slot ids, cached norms) — uniform shapes so the
+  probe gather is a static-shape `take`, not ragged pointer chasing
+- **search**: query→centroid matmul → top-nprobe lists → gather probed
+  blocks → batched distance → masked top-k. Two matmuls and one gather
+  replace thousands of dependent graph hops.
+- **delta buffer**: recent inserts land in a small brute-force scanned
+  DeviceVectorStore (exact), merged into lists when it fills (the LSM
+  memtable idea applied to HBM; mirrors how the reference's async index
+  queue batches graph inserts, index_queue.go:42).
+
+Deletes tombstone rows in place (valid mask), exactly like the flat store.
+Updates re-route the slot through the delta buffer. Global slot ids are
+stable across flushes, so the FlatIndex id<->slot bookkeeping works
+unchanged — IVFIndex subclasses FlatIndex and swaps the store.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.engine.flat import FlatIndex
+from weaviate_tpu.engine.store import DeviceVectorStore, _next_pow2
+from weaviate_tpu.ops.distances import MASKED_DISTANCE, normalize, pairwise_distance
+from weaviate_tpu.ops.kmeans import kmeans_assign, kmeans_fit
+from weaviate_tpu.ops.topk import topk_smallest
+
+_SUPPORTED_METRICS = ("l2-squared", "dot", "cosine", "cosine-dot")
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _scatter_lists(list_vecs, list_valid, list_slots, list_norms,
+                   flat_idx, vecs, slots, write_mask):
+    """Scatter rows into the flattened [nlist*cap] list tensor."""
+    nlist, cap, dim = list_vecs.shape
+    fv = list_vecs.reshape(nlist * cap, dim)
+    fva = list_valid.reshape(nlist * cap)
+    fs = list_slots.reshape(nlist * cap)
+    fn = list_norms.reshape(nlist * cap)
+    tgt = jnp.where(write_mask, flat_idx, nlist * cap)  # OOB rows drop
+    vecs = vecs.astype(fv.dtype)
+    norms = jnp.sum(vecs.astype(jnp.float32) ** 2, axis=-1)
+    fv = fv.at[tgt].set(vecs, mode="drop")
+    fva = fva.at[tgt].set(True, mode="drop")
+    fs = fs.at[tgt].set(slots, mode="drop")
+    fn = fn.at[tgt].set(norms, mode="drop")
+    return (fv.reshape(nlist, cap, dim), fva.reshape(nlist, cap),
+            fs.reshape(nlist, cap), fn.reshape(nlist, cap))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _clear_list_rows(list_valid, flat_idx):
+    nlist, cap = list_valid.shape
+    flat = list_valid.reshape(nlist * cap)
+    return flat.at[flat_idx].set(False, mode="drop").reshape(nlist, cap)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "nprobe", "metric", "use_allow"))
+def _ivf_probe_topk(q, centroids, c_norms, list_vecs, list_valid, list_slots,
+                    list_norms, allow_by_slot, k: int, nprobe: int,
+                    metric: str, use_allow: bool):
+    """Probe + score + select for a query batch.
+
+    q [B,d] → centroid distances [B,nlist] (MXU matmul) → top-nprobe →
+    gather [B, nprobe, cap, …] → per-query batched distance → masked top-k.
+    Returns (dists [B,k], slots [B,k]) ascending; dead/filtered rows never
+    surface. Memory is O(B * nprobe * cap * d): callers chunk B.
+    """
+    nlist, cap, dim = list_vecs.shape
+    q32 = q.astype(jnp.float32)
+    if metric in ("cosine", "cosine-dot"):
+        q32 = normalize(q32)
+    cd = pairwise_distance(q32, centroids, metric="l2-squared",
+                           x_sq_norms=c_norms)
+    _, probes = jax.lax.top_k(-cd, nprobe)  # [B, nprobe]
+
+    vecs = list_vecs[probes].reshape(q.shape[0], nprobe * cap, dim)
+    vld = list_valid[probes].reshape(q.shape[0], nprobe * cap)
+    slots = list_slots[probes].reshape(q.shape[0], nprobe * cap)
+    nrm = list_norms[probes].reshape(q.shape[0], nprobe * cap)
+
+    dots = jnp.einsum("bd,bpd->bp", q32, vecs.astype(jnp.float32),
+                      preferred_element_type=jnp.float32)
+    if metric == "l2-squared":
+        qn = jnp.sum(q32 * q32, axis=-1)[:, None]
+        d = jnp.maximum(qn - 2.0 * dots + nrm, 0.0)
+    elif metric == "dot":
+        d = -dots
+    else:  # cosine: rows stored normalized
+        d = 1.0 - dots
+    if use_allow:
+        ok = allow_by_slot[jnp.clip(slots, 0, allow_by_slot.shape[0] - 1)]
+        vld = vld & ok & (slots >= 0) & (slots < allow_by_slot.shape[0])
+    d = jnp.where(vld, d, MASKED_DISTANCE)
+    return topk_smallest(d, slots, min(k, nprobe * cap))
+
+
+class IVFStore:
+    """DeviceVectorStore-compatible store backed by IVF posting lists plus a
+    brute-force delta buffer. Slot ids are append-order and stable."""
+
+    mesh = None  # single-replica; collection-level sharding distributes IVF
+
+    def __init__(self, dim: int, metric: str = "l2-squared",
+                 capacity: int = 8192, chunk_size: int = 8192,
+                 nlist: int = 0, nprobe: int = 0,
+                 train_threshold: int = 16_384,
+                 delta_threshold: int = 8192,
+                 query_chunk: int = 16,
+                 dtype=None):
+        if metric not in _SUPPORTED_METRICS:
+            raise ValueError(
+                f"ivf supports {_SUPPORTED_METRICS}, not {metric!r}")
+        self.dim = dim
+        self.metric = metric
+        self.chunk_size = chunk_size
+        self.dtype = dtype or jnp.float32
+        self.nlist = nlist  # 0 = auto at train time
+        self.nprobe = nprobe  # 0 = auto (nlist/8, min 8)
+        self.train_threshold = train_threshold
+        self.delta_threshold = delta_threshold
+        self.query_chunk = query_chunk
+        self.normalize_on_add = metric in ("cosine", "cosine-dot")
+        self._lock = threading.RLock()
+        self._count = 0  # global slot high-water mark
+        # delta buffer (exact scan); delta slot -> global slot
+        self.delta = DeviceVectorStore(
+            dim, metric, capacity=min(capacity, delta_threshold * 2),
+            chunk_size=chunk_size)
+        self._delta_slots: dict[int, int] = {}  # delta slot -> global
+        # slot -> ("delta", dslot) | ("list", flat_idx)
+        self._slot_loc: dict[int, tuple] = {}
+        # list tensors (allocated at train time)
+        self.centroids = None  # jnp [nlist, d]
+        self._c_norms = None
+        self.list_vecs = None  # [nlist, cap, d]
+        self.list_valid = None
+        self.list_slots = None
+        self.list_norms = None
+        self.list_cap = 0
+        self._fill: np.ndarray | None = None  # host per-list fill count
+
+    # -- properties mirrored from DeviceVectorStore ---------------------------
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def capacity(self) -> int:
+        """Global slot-space bound (exclusive upper bound on slot ids)."""
+        return max(_next_pow2(max(self._count, 1)), 8)
+
+    @property
+    def trained(self) -> bool:
+        return self.centroids is not None
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._slot_loc)
+
+    # -- mutation -------------------------------------------------------------
+
+    def add(self, vectors: np.ndarray) -> np.ndarray:
+        vectors = np.asarray(vectors, dtype=np.float32)
+        if vectors.ndim == 1:
+            vectors = vectors[None, :]
+        with self._lock:
+            slots = np.arange(self._count, self._count + len(vectors),
+                              dtype=np.int64)
+            self._count += len(vectors)
+            self._add_to_delta(slots, vectors)
+            self._maybe_reorganize()
+            return slots
+
+    def _add_to_delta(self, slots: np.ndarray, vectors: np.ndarray):
+        dslots = self.delta.add(vectors)
+        for g, d in zip(slots.tolist(), dslots.tolist()):
+            self._delta_slots[int(d)] = int(g)
+            self._slot_loc[int(g)] = ("delta", int(d))
+
+    def set_at(self, slots: np.ndarray, vectors: np.ndarray):
+        """Overwrite slots in place. List-resident slots are tombstoned there
+        and re-routed through the delta buffer (their assignment may change)."""
+        slots = np.atleast_1d(np.asarray(slots, dtype=np.int64))
+        vectors = np.asarray(vectors, dtype=np.float32)
+        if vectors.ndim == 1:
+            vectors = vectors[None, :]
+        with self._lock:
+            self._count = max(self._count, int(slots.max()) + 1 if len(slots) else 0)
+            delta_upd_d, delta_upd_v = [], []
+            fresh_s, fresh_v = [], []
+            clear_flat = []
+            for s, v in zip(slots.tolist(), vectors):
+                loc = self._slot_loc.get(int(s))
+                if loc is not None and loc[0] == "delta":
+                    delta_upd_d.append(loc[1])
+                    delta_upd_v.append(v)
+                else:
+                    if loc is not None:  # list-resident: tombstone there
+                        clear_flat.append(loc[1])
+                    fresh_s.append(int(s))
+                    fresh_v.append(v)
+            if clear_flat:
+                self.list_valid = _clear_list_rows(
+                    self.list_valid, jnp.asarray(clear_flat, dtype=jnp.int32))
+            if delta_upd_d:
+                self.delta.set_at(np.asarray(delta_upd_d),
+                                  np.stack(delta_upd_v))
+            if fresh_s:
+                self._add_to_delta(np.asarray(fresh_s), np.stack(fresh_v))
+            self._maybe_reorganize()
+
+    def delete(self, slots) -> None:
+        slots = np.atleast_1d(np.asarray(slots, dtype=np.int64))
+        with self._lock:
+            clear_flat, delta_del = [], []
+            for s in slots.tolist():
+                loc = self._slot_loc.pop(int(s), None)
+                if loc is None:
+                    continue
+                if loc[0] == "delta":
+                    delta_del.append(loc[1])
+                    self._delta_slots.pop(loc[1], None)
+                else:
+                    clear_flat.append(loc[1])
+            if delta_del:
+                self.delta.delete(np.asarray(delta_del))
+            if clear_flat:
+                self.list_valid = _clear_list_rows(
+                    self.list_valid, jnp.asarray(clear_flat, dtype=jnp.int32))
+
+    # -- training / reorganization -------------------------------------------
+
+    def _maybe_reorganize(self):
+        if not self.trained:
+            if len(self._slot_loc) >= self.train_threshold:
+                self.train()
+        elif len(self._delta_slots) >= self.delta_threshold:
+            self.flush_delta()
+
+    def _auto_nlist(self, n: int) -> int:
+        # ~2*sqrt(N) lists, pow2-rounded, clamped: large enough to prune,
+        # small enough that centroids fit one matmul
+        return int(min(8192, max(16, _next_pow2(int(2 * math.sqrt(n))))))
+
+    def train(self, force_nlist: int | None = None):
+        """Learn the coarse partition from current contents and move
+        everything into posting lists (reference analog: hnsw compress.go:38
+        trains PQ once enough data exists — same lifecycle hook)."""
+        with self._lock:
+            vecs, slots = self._all_live_host()
+            n = len(vecs)
+            if n == 0:
+                raise RuntimeError("cannot train IVF on an empty store")
+            nlist = force_nlist or self.nlist or self._auto_nlist(n)
+            nlist = min(nlist, n)
+            train_vecs = vecs
+            self.nlist = nlist
+            cents = kmeans_fit(train_vecs, nlist, iters=10)
+            if self.normalize_on_add:
+                # keep centroids on the sphere so probe distances stay comparable
+                cents = np.asarray(normalize(jnp.asarray(cents)))
+            self.centroids = jnp.asarray(cents)
+            self._c_norms = jnp.sum(self.centroids * self.centroids, axis=1)
+            self._rebuild_lists(vecs, slots)
+            # delta fully absorbed
+            self._reset_delta()
+
+    def _all_live_host(self):
+        """(vectors [L,d] f32, slots [L] int64) for every live slot."""
+        out_v, out_s = [], []
+        if self.trained and self.list_vecs is not None:
+            lv = np.asarray(self.list_vecs, dtype=np.float32).reshape(-1, self.dim)
+            lval = np.asarray(self.list_valid).reshape(-1)
+            lslot = np.asarray(self.list_slots).reshape(-1)
+            live = np.nonzero(lval)[0]
+            out_v.append(lv[live])
+            out_s.append(lslot[live].astype(np.int64))
+        dsnap = self.delta.snapshot()
+        dlive = np.nonzero(dsnap["valid"])[0]
+        if len(dlive):
+            out_v.append(dsnap["vectors"][dlive])
+            out_s.append(np.asarray(
+                [self._delta_slots[int(d)] for d in dlive], dtype=np.int64))
+        if not out_v:
+            return (np.empty((0, self.dim), np.float32),
+                    np.empty(0, np.int64))
+        return np.concatenate(out_v), np.concatenate(out_s)
+
+    def _rebuild_lists(self, vecs: np.ndarray, slots: np.ndarray):
+        """Assign + scatter everything into fresh list tensors."""
+        assign = kmeans_assign(vecs, np.asarray(self.centroids))
+        counts = np.bincount(assign, minlength=self.nlist)
+        cap = max(8, _next_pow2(int(counts.max()) if len(counts) else 8))
+        self.list_cap = cap
+        self.list_vecs = jnp.zeros((self.nlist, cap, self.dim), dtype=self.dtype)
+        self.list_valid = jnp.zeros((self.nlist, cap), dtype=jnp.bool_)
+        self.list_slots = jnp.full((self.nlist, cap), -1, dtype=jnp.int32)
+        self.list_norms = jnp.zeros((self.nlist, cap), dtype=jnp.float32)
+        self._fill = np.zeros(self.nlist, dtype=np.int64)
+        self._scatter_assigned(vecs, slots, assign)
+
+    def _scatter_assigned(self, vecs, slots, assign):
+        """Place (vec, slot) pairs at the next free position of their list."""
+        pos = np.empty(len(assign), dtype=np.int64)
+        order = np.argsort(assign, kind="stable")
+        sorted_assign = assign[order]
+        # per-list sequential positions after current fill
+        starts = {}
+        for idx, l in zip(order.tolist(), sorted_assign.tolist()):
+            p = starts.get(l)
+            if p is None:
+                p = int(self._fill[l])
+            pos[idx] = p
+            starts[l] = p + 1
+        for l, nxt in starts.items():
+            self._fill[l] = nxt
+        max_needed = int(self._fill.max()) if len(self._fill) else 0
+        while max_needed > self.list_cap:
+            self._grow_cap()
+        flat_idx = assign.astype(np.int64) * self.list_cap + pos
+        bucket = _next_pow2(max(len(vecs), 8))
+        pad = bucket - len(vecs)
+        v_buf = np.zeros((bucket, self.dim), np.float32)
+        v_buf[:len(vecs)] = vecs
+        i_buf = np.zeros(bucket, np.int32)
+        i_buf[:len(vecs)] = flat_idx
+        s_buf = np.zeros(bucket, np.int32)
+        s_buf[:len(vecs)] = slots
+        m_buf = np.zeros(bucket, bool)
+        m_buf[:len(vecs)] = True
+        (self.list_vecs, self.list_valid, self.list_slots,
+         self.list_norms) = _scatter_lists(
+            self.list_vecs, self.list_valid, self.list_slots, self.list_norms,
+            jnp.asarray(i_buf), jnp.asarray(v_buf), jnp.asarray(s_buf),
+            jnp.asarray(m_buf))
+        for s, fi in zip(slots.tolist(), flat_idx.tolist()):
+            self._slot_loc[int(s)] = ("list", int(fi))
+
+    def _grow_cap(self):
+        """Double per-list capacity (repack on host — rare, amortized)."""
+        old_cap = self.list_cap
+        new_cap = old_cap * 2
+        pad = new_cap - old_cap
+        self.list_vecs = jnp.concatenate(
+            [self.list_vecs,
+             jnp.zeros((self.nlist, pad, self.dim), dtype=self.dtype)], axis=1)
+        self.list_valid = jnp.concatenate(
+            [self.list_valid, jnp.zeros((self.nlist, pad), dtype=jnp.bool_)],
+            axis=1)
+        self.list_slots = jnp.concatenate(
+            [self.list_slots, jnp.full((self.nlist, pad), -1, dtype=jnp.int32)],
+            axis=1)
+        self.list_norms = jnp.concatenate(
+            [self.list_norms, jnp.zeros((self.nlist, pad), dtype=jnp.float32)],
+            axis=1)
+        self.list_cap = new_cap
+        # flat indices shift: old flat l*old_cap+p -> l*new_cap+p
+        for s, loc in self._slot_loc.items():
+            if loc[0] == "list":
+                l, p = divmod(loc[1], old_cap)
+                self._slot_loc[s] = ("list", l * new_cap + p)
+
+    def flush_delta(self):
+        """Merge the delta buffer into posting lists (memtable flush)."""
+        with self._lock:
+            if not self.trained:
+                return
+            dsnap = self.delta.snapshot()
+            live = np.nonzero(dsnap["valid"])[0]
+            if len(live) == 0:
+                self._reset_delta()
+                return
+            vecs = dsnap["vectors"][live]
+            slots = np.asarray([self._delta_slots[int(d)] for d in live],
+                               dtype=np.int64)
+            assign = kmeans_assign(vecs, np.asarray(self.centroids))
+            self._scatter_assigned(vecs, slots, assign)
+            self._reset_delta()
+
+    def _reset_delta(self):
+        self.delta = DeviceVectorStore(
+            self.dim, self.metric,
+            capacity=min(self.capacity, self.delta_threshold * 2),
+            chunk_size=self.chunk_size)
+        self._delta_slots = {}
+
+    # -- queries -------------------------------------------------------------
+
+    def _effective_nprobe(self) -> int:
+        if self.nprobe:
+            return min(self.nprobe, self.nlist)
+        return min(self.nlist, max(8, self.nlist // 8))
+
+    def search(self, queries: np.ndarray, k: int,
+               allow_mask: np.ndarray | None = None,
+               nprobe: int | None = None):
+        """Merged top-k over delta (exact) + probed lists (ANN)."""
+        queries = np.asarray(queries, dtype=np.float32)
+        squeeze = queries.ndim == 1
+        if squeeze:
+            queries = queries[None, :]
+        b = len(queries)
+        with self._lock:
+            # --- delta leg (exact scan over the small recent set)
+            d_d = np.full((b, 0), MASKED_DISTANCE, np.float32)
+            d_s = np.full((b, 0), -1, np.int64)
+            if self.delta.live_count() > 0:
+                delta_allow = None
+                if allow_mask is not None:
+                    delta_allow = np.zeros(self.delta.capacity, dtype=bool)
+                    for ds, g in self._delta_slots.items():
+                        if g < len(allow_mask) and allow_mask[g]:
+                            delta_allow[ds] = True
+                dd, dslots = self.delta.search(queries, min(k, self.delta.capacity),
+                                              delta_allow)
+                # delta slot -> global slot
+                gmap = np.full(self.delta.capacity + 1, -1, np.int64)
+                for ds, g in self._delta_slots.items():
+                    gmap[ds] = g
+                d_s = np.where(dslots >= 0, gmap[np.clip(dslots, 0, None)], -1)
+                d_d = np.where(d_s >= 0, dd, MASKED_DISTANCE)
+            # --- list leg
+            l_d = np.full((b, 0), MASKED_DISTANCE, np.float32)
+            l_s = np.full((b, 0), -1, np.int64)
+            if self.trained and self._fill is not None and self._fill.sum() > 0:
+                np_probe = min((nprobe or self._effective_nprobe()), self.nlist)
+                use_allow = allow_mask is not None
+                allow_dev = jnp.asarray(
+                    allow_mask if use_allow else np.ones(1, bool))
+                k_eff = min(k, np_probe * self.list_cap)
+                outs_d, outs_s = [], []
+                for s in range(0, b, self.query_chunk):
+                    qd, qs = _ivf_probe_topk(
+                        jnp.asarray(queries[s:s + self.query_chunk]),
+                        self.centroids, self._c_norms,
+                        self.list_vecs, self.list_valid, self.list_slots,
+                        self.list_norms, allow_dev, k_eff, np_probe,
+                        self.metric, use_allow)
+                    outs_d.append(np.asarray(qd))
+                    outs_s.append(np.asarray(qs, dtype=np.int64))
+                l_d = np.concatenate(outs_d)
+                l_s = np.concatenate(outs_s)
+        # --- host merge of the two legs
+        cat_d = np.concatenate([d_d, l_d], axis=1)
+        cat_s = np.concatenate([d_s, l_s], axis=1)
+        k_out = min(k, cat_d.shape[1]) if cat_d.shape[1] else 0
+        if k_out == 0:
+            empty_d = np.full((b, k), MASKED_DISTANCE, np.float32)
+            empty_s = np.full((b, k), -1, np.int64)
+            return (empty_d[0], empty_s[0]) if squeeze else (empty_d, empty_s)
+        cat_d = np.where(cat_s >= 0, cat_d, MASKED_DISTANCE)
+        order = np.argsort(cat_d, axis=1, kind="stable")[:, :k]
+        out_d = np.take_along_axis(cat_d, order, axis=1)
+        out_s = np.take_along_axis(cat_s, order, axis=1)
+        out_s = np.where(out_d >= MASKED_DISTANCE, -1, out_s)
+        if out_d.shape[1] < k:  # pad to k like the flat store contract
+            pad = k - out_d.shape[1]
+            out_d = np.pad(out_d, ((0, 0), (0, pad)),
+                           constant_values=MASKED_DISTANCE)
+            out_s = np.pad(out_s, ((0, 0), (0, pad)), constant_values=-1)
+        if squeeze:
+            return out_d[0], out_s[0]
+        return out_d, out_s
+
+    def search_by_distance(self, query: np.ndarray, max_distance: float,
+                           allow_mask: np.ndarray | None = None):
+        k = 64
+        while True:
+            d, i = self.search(query, k, allow_mask)
+            within = (d <= max_distance) & (i >= 0)
+            if (~within).any() or k >= max(self._count, 1):
+                return d[within], i[within]
+            k = min(k * 4, max(self._count, 1))
+
+    # -- maintenance ---------------------------------------------------------
+
+    def compact(self) -> np.ndarray:
+        """Drop tombstones and repack lists. Slot ids stay stable (identity
+        mapping for live slots) — the IVF layout doesn't tie slots to
+        physical rows the way the flat store does."""
+        with self._lock:
+            mapping = np.full(self.capacity, -1, dtype=np.int64)
+            for s in self._slot_loc:
+                mapping[s] = s
+            if self.trained:
+                vecs, slots = self._all_live_host()
+                # keep only live (slot_loc) entries
+                keep = np.asarray([s in self._slot_loc for s in slots.tolist()])
+                self._fill = np.zeros(self.nlist, dtype=np.int64)
+                self._rebuild_lists(vecs[keep], slots[keep])
+                self._reset_delta()
+            return mapping
+
+    # -- persistence ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            vecs, slots = self._all_live_host()
+            keep = np.asarray([s in self._slot_loc for s in slots.tolist()],
+                              dtype=bool) if len(slots) else np.empty(0, bool)
+            return {
+                "kind": "ivf",
+                "dim": self.dim,
+                "metric": self.metric,
+                "count": self._count,
+                "nlist": self.nlist if self.trained else 0,
+                "nprobe": self.nprobe,
+                "centroids": (np.asarray(self.centroids, np.float32)
+                              if self.trained else None),
+                "live_vectors": vecs[keep] if len(slots) else vecs,
+                "live_slots": slots[keep] if len(slots) else slots,
+                "chunk_size": self.chunk_size,
+                "train_threshold": self.train_threshold,
+                "delta_threshold": self.delta_threshold,
+                # FlatIndex.snapshot() compatibility
+                "valid": self._valid_over_slots(),
+                "quantization": None,
+            }
+
+    def _valid_over_slots(self) -> np.ndarray:
+        v = np.zeros(self.capacity, dtype=bool)
+        for s in self._slot_loc:
+            v[s] = True
+        return v
+
+    @classmethod
+    def restore(cls, snap: dict, **kwargs) -> "IVFStore":
+        store = cls(dim=snap["dim"], metric=snap["metric"],
+                    nlist=snap.get("nlist", 0), nprobe=snap.get("nprobe", 0),
+                    chunk_size=snap.get("chunk_size", 8192),
+                    train_threshold=snap.get("train_threshold", 16_384),
+                    delta_threshold=snap.get("delta_threshold", 8192))
+        slots = np.asarray(snap["live_slots"], dtype=np.int64)
+        vecs = np.asarray(snap["live_vectors"], dtype=np.float32)
+        store._count = snap["count"]
+        if snap.get("centroids") is not None:
+            store.nlist = snap["nlist"]
+            store.centroids = jnp.asarray(snap["centroids"])
+            store._c_norms = jnp.sum(store.centroids * store.centroids, axis=1)
+            if len(vecs):
+                store._fill = np.zeros(store.nlist, dtype=np.int64)
+                store._rebuild_lists(vecs, slots)
+        elif len(vecs):
+            # untrained: everything back into the delta buffer
+            store._add_to_delta(slots, vecs)
+        return store
+
+
+class IVFIndex(FlatIndex):
+    """VectorIndex-contract ANN index: FlatIndex id<->slot bookkeeping over
+    an IVFStore (the bookkeeping is store-agnostic). See FlatIndex for the
+    contract docs (reference: vector_index.go:24-45)."""
+
+    index_type = "ivf"
+
+    def __init__(self, dim: int, metric: str = "l2-squared",
+                 capacity: int = 8192, chunk_size: int = 8192,
+                 nlist: int = 0, nprobe: int = 0,
+                 train_threshold: int = 16_384, delta_threshold: int = 8192,
+                 mesh=None, **_ignored):
+        if mesh is not None:
+            raise NotImplementedError(
+                "ivf is single-replica; collection sharding distributes it")
+        store = IVFStore(dim=dim, metric=metric, capacity=capacity,
+                         chunk_size=chunk_size, nlist=nlist, nprobe=nprobe,
+                         train_threshold=train_threshold,
+                         delta_threshold=delta_threshold)
+        super().__init__(dim=dim, metric=metric, capacity=capacity,
+                         chunk_size=chunk_size, store=store)
+
+    def train(self, nlist: int | None = None):
+        """Force coarse training now (normally automatic at threshold)."""
+        with self._lock:
+            self.store.train(force_nlist=nlist)
+
+    def compress(self, *a, **kw):
+        raise NotImplementedError(
+            "ivf does not support runtime PQ/BQ compression yet")
+
+    @property
+    def trained(self) -> bool:
+        return self.store.trained
+
+    @classmethod
+    def restore(cls, snap: dict, **kwargs) -> "IVFIndex":
+        idx = cls.__new__(cls)
+        idx.dim = snap["dim"]
+        idx.metric = snap["metric"]
+        idx.store = IVFStore.restore(snap, **kwargs)
+        idx._lock = threading.RLock()
+        slot_to_id = snap["slot_to_id"]
+        idx._slot_to_id = np.full(idx.store.capacity, -1, dtype=np.int64)
+        idx._slot_to_id[: len(slot_to_id)] = slot_to_id
+        idx._id_to_slot = {
+            int(doc): int(slot)
+            for slot, doc in enumerate(slot_to_id)
+            if doc >= 0 and slot < len(snap["valid"]) and snap["valid"][slot]
+        }
+        return idx

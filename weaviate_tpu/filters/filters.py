@@ -191,26 +191,38 @@ def _eval(f: Filter, inv: InvertedIndex, size: int) -> np.ndarray:
         return mask
 
     if op in Operator.RANGE:
-        vals = inv.numeric.get(prop)
-        if not vals:
-            return np.zeros(size, dtype=bool)
         threshold = f.value
         if isinstance(threshold, str):
             threshold = parse_date(threshold)
         threshold = float(threshold)
-        ids = np.fromiter(vals.keys(), dtype=np.int64, count=len(vals))
-        vv = np.fromiter(vals.values(), dtype=np.float64, count=len(vals))
-        if op == Operator.GREATER_THAN:
-            hit = vv > threshold
-        elif op == Operator.GREATER_THAN_EQUAL:
-            hit = vv >= threshold
-        elif op == Operator.LESS_THAN:
-            hit = vv < threshold
-        else:
-            hit = vv <= threshold
+
+        def cmp(vv):
+            if op == Operator.GREATER_THAN:
+                return vv > threshold
+            if op == Operator.GREATER_THAN_EQUAL:
+                return vv >= threshold
+            if op == Operator.LESS_THAN:
+                return vv < threshold
+            return vv <= threshold
+
         mask = np.zeros(size, dtype=bool)
-        sel = ids[hit & (ids < size)]
-        mask[sel] = True
+        # scalar path (also covers _creationTimeUnix/_lastUpdateTimeUnix,
+        # which only live in the numeric index)
+        vals = inv.numeric.get(prop)
+        if vals:
+            ids = np.fromiter(vals.keys(), dtype=np.int64, count=len(vals))
+            vv = np.fromiter(vals.values(), dtype=np.float64, count=len(vals))
+            sel = ids[cmp(vv) & (ids < size)]
+            mask[sel] = True
+        # per-value keys: any-element semantics for numeric/date arrays
+        # (a doc is listed under every element value)
+        table = inv.filterable.get(prop)
+        if table:
+            for key, docs in table.items():
+                if isinstance(key, bool) or not isinstance(key, (int, float)):
+                    continue
+                if cmp(np.float64(key)):
+                    mask |= _from_set(docs, size)
         return mask
 
     if op == Operator.LIKE:

@@ -1,0 +1,81 @@
+"""Coarse k-means for IVF partitioning.
+
+Reference: adapters/repos/db/vector/compressionhelpers/kmeans.go trains PQ
+sub-quantizers per segment; here the same Lloyd's iteration runs over FULL
+vectors to learn the IVF coarse partition (the reference has no IVF — its
+ANN is an in-RAM graph. IVF/ScaNN-style partitioning is the TPU-idiomatic
+replacement, SURVEY §7 step 5).
+
+TPU shape: the assign step is one [chunk, k] distance matmul (MXU), the
+update step is a one-hot segment-sum einsum (also MXU). Host only loops
+over chunks and carries the running sums.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.ops.distances import pairwise_distance
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _assign_accumulate(chunk, centroids, c_norms, k: int):
+    """One chunk's Lloyd contribution: (assign [n], sums [k,d], counts [k])."""
+    d = pairwise_distance(chunk, centroids, metric="l2-squared",
+                          x_sq_norms=c_norms)
+    assign = jnp.argmin(d, axis=1)
+    one_hot = jax.nn.one_hot(assign, k, dtype=jnp.float32)  # [n, k]
+    sums = jnp.einsum("nk,nd->kd", one_hot, chunk.astype(jnp.float32),
+                      preferred_element_type=jnp.float32)
+    counts = jnp.sum(one_hot, axis=0)
+    return assign.astype(jnp.int32), sums, counts
+
+
+def kmeans_fit(vectors: np.ndarray, k: int, iters: int = 10,
+               sample: int = 262_144, batch: int = 16_384,
+               seed: int = 0) -> np.ndarray:
+    """Train ``k`` full-dim centroids; returns [k, d] f32 (host).
+
+    Trains on a random sample; chunked so HBM holds at most
+    [batch, k] distances at a time.
+    """
+    vectors = np.asarray(vectors, dtype=np.float32)
+    n, dim = vectors.shape
+    if n < k:
+        raise ValueError(f"need >= {k} vectors to train {k} centroids, have {n}")
+    rng = np.random.default_rng(seed)
+    if n > sample:
+        vectors = vectors[rng.choice(n, sample, replace=False)]
+        n = sample
+    centroids = jnp.asarray(vectors[rng.choice(n, k, replace=False)])
+    for _ in range(iters):
+        c_norms = jnp.sum(centroids * centroids, axis=1)
+        sums = jnp.zeros((k, dim), dtype=jnp.float32)
+        counts = jnp.zeros((k,), dtype=jnp.float32)
+        for s in range(0, n, batch):
+            _, cs, cc = _assign_accumulate(jnp.asarray(vectors[s:s + batch]),
+                                           centroids, c_norms, k)
+            sums = sums + cs
+            counts = counts + cc
+        fresh = sums / jnp.maximum(counts, 1.0)[:, None]
+        centroids = jnp.where((counts > 0)[:, None], fresh, centroids)
+    return np.asarray(jax.block_until_ready(centroids))
+
+
+def kmeans_assign(vectors: np.ndarray, centroids: np.ndarray,
+                  batch: int = 16_384) -> np.ndarray:
+    """Nearest-centroid id per vector, [N] int32 (host)."""
+    vectors = np.asarray(vectors, dtype=np.float32)
+    cent = jnp.asarray(centroids, dtype=jnp.float32)
+    c_norms = jnp.sum(cent * cent, axis=1)
+    k = cent.shape[0]
+    out = np.empty(len(vectors), dtype=np.int32)
+    for s in range(0, len(vectors), batch):
+        a, _, _ = _assign_accumulate(jnp.asarray(vectors[s:s + batch]),
+                                     cent, c_norms, k)
+        out[s:s + batch] = np.asarray(a)
+    return out

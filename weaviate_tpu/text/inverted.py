@@ -222,9 +222,12 @@ class InvertedIndex:
             self.numeric[name][doc] = parse_date(value)
         elif dt in (DataType.INT_ARRAY, DataType.NUMBER_ARRAY):
             if value:
-                # range filters on arrays match if ANY element matches; we
-                # keep the full set in filterable keys, plus min for sorting
-                self.numeric[name][doc] = float(value[0])
+                # scalar index keeps min (for sorting); range filters use the
+                # per-value filterable keys for any-element semantics
+                self.numeric[name][doc] = float(min(value))
+        elif dt == DataType.DATE_ARRAY:
+            if value:
+                self.numeric[name][doc] = min(parse_date(v) for v in value)
         elif dt == DataType.GEO:
             self.geo[name][doc] = (float(value["latitude"]),
                                    float(value["longitude"]))
@@ -282,18 +285,25 @@ class InvertedIndex:
                 for name, _ in props
             }
 
-            # group query terms; a term's df = docs containing it in ANY
-            # searched property (BM25F treats props as fields of one doc)
-            tokens = self.stopwords.filter(
-                sorted(set(tokenize(query, "word"))))
-            if not tokens:
+            # the query analyzes per-property with THAT property's
+            # tokenization (reference: bm25_searcher analyzes per field);
+            # a term's df = docs containing it in ANY searched property
+            # (BM25F treats props as fields of one doc)
+            term_fields: dict[str, list] = {}
+            for name, boost in props:
+                sch = self.config.property(name)
+                tok = sch.tokenization if sch is not None else "word"
+                for term in self.stopwords.filter(
+                        sorted(set(tokenize(query, tok)))):
+                    term_fields.setdefault(term, []).append((name, boost))
+            if not term_fields:
                 return np.empty(0, np.int64), np.empty(0, np.float32)
 
-            term_rows = []  # (idf, [(ids, tfs, boost, len_arr, avg_len)])
-            for term in tokens:
+            term_rows = []  # (idf, [(ids, tfs, boost, prop_name)])
+            for term, tf_props in sorted(term_fields.items()):
                 fields = []
                 df_docs: set[int] = set()
-                for name, boost in props:
+                for name, boost in tf_props:
                     p = self.searchable.get(name, {}).get(term)
                     if p is None or not len(p):
                         continue
