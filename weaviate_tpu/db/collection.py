@@ -196,6 +196,95 @@ class Collection:
             for key, raw in shard.objects.iter_items():
                 yield StorageObject.from_bytes(raw)
 
+    def fetch_objects(self, limit: int = 25, offset: int = 0,
+                      sort: list[dict] | None = None, where=None,
+                      tenant: str | None = None,
+                      after: str | None = None) -> list[StorageObject]:
+        """List objects with optional filter/sort/cursor (reference:
+        /v1/objects listing; sorter/objects_sorter.go; cursor via ?after=
+        which requires uuid order — sort and after are mutually exclusive,
+        as in the reference API)."""
+        from weaviate_tpu.query.sorter import sort_objects
+
+        if after is not None and sort:
+            raise ValueError("'after' cursor cannot be combined with sort")
+        shards = self._target_shards(tenant)
+        if sort:
+            # property sort needs the values: materialize candidates
+            objs: list[StorageObject] = []
+            for shard in shards:
+                mask = shard.allow_mask(where) if where is not None else None
+                for _key, raw in shard.objects.iter_items():
+                    obj = StorageObject.from_bytes(raw)
+                    if mask is not None and (obj.doc_id >= len(mask)
+                                             or not mask[obj.doc_id]):
+                        continue
+                    objs.append(obj)
+            return sort_objects(objs, sort)[offset: offset + limit]
+        # uuid-ordered page: select uuids from the in-RAM docid map, only
+        # deserialize the page actually returned
+        candidates: list[tuple[str, Shard]] = []
+        for shard in shards:
+            mask = shard.allow_mask(where) if where is not None else None
+            for doc_id, uid in shard._doc_to_uuid.items():
+                if mask is not None and (doc_id >= len(mask) or not mask[doc_id]):
+                    continue
+                if after is not None and uid <= after:
+                    continue
+                candidates.append((uid, shard))
+        candidates.sort(key=lambda t: t[0])
+        page = candidates[offset: offset + limit]
+        out = []
+        for uid, shard in page:
+            obj = shard.get_object(uid)
+            if obj is not None:
+                out.append(obj)
+        return out
+
+    # -- aggregation ---------------------------------------------------------
+
+    def aggregate(self, properties: list[str] | None = None,
+                  group_by: str | None = None, where=None,
+                  tenant: str | None = None,
+                  requested: dict[str, list[str]] | None = None,
+                  near_vector=None, object_limit: int | None = None,
+                  top_occurrences_limit: int = 5) -> dict:
+        """Scatter-gather aggregation (reference: aggregator/aggregator.go →
+        per-shard fold, shard_combiner.go merge). With ``near_vector`` +
+        ``object_limit``, aggregates over the top-k of a vector search
+        instead of the whole (filtered) corpus (aggregator/hybrid.go)."""
+        from weaviate_tpu.query.aggregator import (
+            aggregate_objects,
+            combine_partials,
+            finalize_aggregation,
+        )
+
+        if near_vector is not None:
+            k = object_limit or 100
+            hits = self.near_vector(near_vector, k=k, tenant=tenant,
+                                    include_objects=True, where=where)
+            partials = [aggregate_objects((r.object for r in hits if r.object),
+                                          properties, group_by)]
+        else:
+            def one(shard: Shard):
+                mask = shard.allow_mask(where) if where is not None else None
+
+                def objs():
+                    for _key, raw in shard.objects.iter_items():
+                        obj = StorageObject.from_bytes(raw)
+                        if mask is not None and (obj.doc_id >= len(mask)
+                                                 or not mask[obj.doc_id]):
+                            continue
+                        yield obj
+
+                return aggregate_objects(objs(), properties, group_by)
+
+            shards = self._target_shards(tenant)
+            partials = [one(shards[0])] if len(shards) == 1 else \
+                list(self._pool.map(one, shards))
+        return finalize_aggregation(combine_partials(partials), requested,
+                                    top_occurrences_limit)
+
     # -- search --------------------------------------------------------------
 
     @staticmethod
@@ -220,7 +309,7 @@ class Collection:
                     tenant: str | None = None, include_objects: bool = True,
                     allow_list_by_shard: dict | None = None,
                     max_distance: float | None = None,
-                    where=None) -> list[SearchResult]:
+                    where=None, autocut: int = 0) -> list[SearchResult]:
         """Scatter-gather nearVector (reference: index.go:1541
         objectVectorSearch -> per-shard parallel search -> merge+truncate).
         ``where``: optional Filter tree, evaluated per shard to an AllowList
@@ -251,6 +340,10 @@ class Collection:
         merged = merged[:k]
         if max_distance is not None:
             merged = [m for m in merged if m[0] <= max_distance]
+        if autocut > 0 and merged:
+            from weaviate_tpu.query.autocut import autocut as _autocut
+
+            merged = merged[: _autocut([m[0] for m in merged], autocut)]
 
         out = []
         for dist, doc_id, shard in merged:
@@ -266,7 +359,7 @@ class Collection:
     def bm25(self, query: str, k: int = 10, properties: list[str] | None = None,
              tenant: str | None = None, include_objects: bool = True,
              allow_list_by_shard: dict | None = None,
-             where=None) -> list[SearchResult]:
+             where=None, autocut: int = 0) -> list[SearchResult]:
         """Scatter-gather keyword search; merge by score descending
         (reference: Index.objectSearch → per-shard BM25 → merge)."""
         shards = self._target_shards(tenant)
@@ -288,8 +381,13 @@ class Collection:
         for shard, ids, scores in gathered:
             merged.extend(zip(scores.tolist(), ids.tolist(), [shard] * len(ids)))
         merged.sort(key=lambda t: -t[0])
+        merged = merged[:k]
+        if autocut > 0 and merged:
+            from weaviate_tpu.query.autocut import autocut as _autocut
+
+            merged = merged[: _autocut([-m[0] for m in merged], autocut)]
         out = []
-        for score, doc_id, shard in merged[:k]:
+        for score, doc_id, shard in merged:
             uuid = shard._doc_to_uuid.get(doc_id)
             if uuid is None:
                 continue
@@ -302,7 +400,8 @@ class Collection:
     def hybrid(self, query: str, vector=None, alpha: float = 0.75, k: int = 10,
                properties: list[str] | None = None, vec_name: str = "",
                tenant: str | None = None, fusion: str = "relativeScore",
-               where=None, include_objects: bool = True) -> list[SearchResult]:
+               where=None, include_objects: bool = True,
+               autocut: int = 0) -> list[SearchResult]:
         """Hybrid sparse+dense search (reference: hybrid/searcher.go:74 runs
         both legs in parallel, then fuses). ``alpha`` weighs the dense leg
         (0 = pure BM25, 1 = pure vector). ``vector=None`` degrades to
@@ -367,6 +466,10 @@ class Collection:
             return []
         fuse = fusion_relative_score if fusion == "relativeScore" else fusion_ranked
         fused = fuse(legs, weights, k)
+        if autocut > 0 and fused:
+            from weaviate_tpu.query.autocut import autocut_results
+
+            fused = autocut_results(fused, autocut, by="score")
         if include_objects:
             by_shard = {s.name: s for s in self._target_shards(tenant)}
             for r in fused:

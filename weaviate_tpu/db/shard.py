@@ -52,12 +52,26 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
             **common,
         )
     if cfg.index_type == "ivf":
+        if cfg.quantization:
+            # quantized scan is a flat-index capability; IVF lists hold raw
+            # vectors — honor the compression request rather than silently
+            # dropping it
+            return FlatIndex(
+                quantization=cfg.quantization,
+                pq_segments=cfg.pq_segments,
+                pq_centroids=cfg.pq_centroids,
+                rescore_limit=cfg.rescore_limit,
+                **common,
+            )
         from weaviate_tpu.engine.ivf import IVFIndex
 
         # mesh forwarded so the single-replica guard fires loudly instead of
         # silently landing a sharded corpus on one device
         return IVFIndex(nlist=cfg.ivf_nlist, nprobe=cfg.ivf_nprobe,
-                        mesh=mesh, **common)
+                        mesh=mesh,
+                        dtype=jnp.bfloat16 if cfg.storage_dtype == "bfloat16"
+                        else jnp.float32,
+                        **common)
     if cfg.index_type in ("hnsw", "dynamic"):
         # "hnsw" is accepted for reference-config compatibility; the ANN
         # regime on TPU is IVF (SURVEY §7 step 5), entered via the dynamic
@@ -288,7 +302,7 @@ class Shard:
         if allow_mask is not None:
             allow_mask = np.asarray(allow_mask)
             if allow_mask.dtype != np.bool_:
-                ids = allow_mask
+                ids = allow_mask.astype(np.int64)
                 allow_mask = np.zeros(self.doc_id_space, dtype=bool)
                 allow_mask[ids[ids < len(allow_mask)]] = True
         return self._inverted.bm25_search(query, k, properties, allow_mask)

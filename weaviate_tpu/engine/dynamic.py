@@ -72,7 +72,8 @@ class DynamicIndex:
             ivf = IVFIndex(dim=self.dim, metric=self.metric,
                            chunk_size=self._chunk_size, nlist=self._nlist,
                            nprobe=self._nprobe,
-                           train_threshold=max(self.threshold, 256))
+                           train_threshold=max(self.threshold, 256),
+                           dtype=getattr(flat.store, "dtype", None))
             if live:
                 ids = slot_to_id[live]
                 vecs = snap["vectors"][live]

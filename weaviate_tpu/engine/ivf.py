@@ -554,8 +554,19 @@ class IVFStore:
             store.centroids = jnp.asarray(snap["centroids"])
             store._c_norms = jnp.sum(store.centroids * store.centroids, axis=1)
             if len(vecs):
-                store._fill = np.zeros(store.nlist, dtype=np.int64)
                 store._rebuild_lists(vecs, slots)
+            else:
+                # trained-but-empty: allocate empty list tensors so later
+                # delta flushes have somewhere to scatter (a None _fill
+                # would crash the first _maybe_reorganize)
+                cap = 8
+                store.list_cap = cap
+                store.list_vecs = jnp.zeros((store.nlist, cap, store.dim),
+                                            dtype=store.dtype)
+                store.list_valid = jnp.zeros((store.nlist, cap), dtype=jnp.bool_)
+                store.list_slots = jnp.full((store.nlist, cap), -1, dtype=jnp.int32)
+                store.list_norms = jnp.zeros((store.nlist, cap), dtype=jnp.float32)
+                store._fill = np.zeros(store.nlist, dtype=np.int64)
         elif len(vecs):
             # untrained: everything back into the delta buffer
             store._add_to_delta(slots, vecs)
@@ -573,14 +584,14 @@ class IVFIndex(FlatIndex):
                  capacity: int = 8192, chunk_size: int = 8192,
                  nlist: int = 0, nprobe: int = 0,
                  train_threshold: int = 16_384, delta_threshold: int = 8192,
-                 mesh=None, **_ignored):
+                 mesh=None, dtype=None, **_ignored):
         if mesh is not None:
             raise NotImplementedError(
                 "ivf is single-replica; collection sharding distributes it")
         store = IVFStore(dim=dim, metric=metric, capacity=capacity,
                          chunk_size=chunk_size, nlist=nlist, nprobe=nprobe,
                          train_threshold=train_threshold,
-                         delta_threshold=delta_threshold)
+                         delta_threshold=delta_threshold, dtype=dtype)
         super().__init__(dim=dim, metric=metric, capacity=capacity,
                          chunk_size=chunk_size, store=store)
 

@@ -215,8 +215,9 @@ def _eval(f: Filter, inv: InvertedIndex, size: int) -> np.ndarray:
             sel = ids[cmp(vv) & (ids < size)]
             mask[sel] = True
         # per-value keys: any-element semantics for numeric/date arrays
-        # (a doc is listed under every element value)
-        table = inv.filterable.get(prop)
+        # (a doc is listed under every element value); scalar props are
+        # already fully answered by the numeric map above
+        table = inv.filterable.get(prop) if prop in inv.array_props else None
         if table:
             for key, docs in table.items():
                 if isinstance(key, bool) or not isinstance(key, (int, float)):

@@ -1,0 +1,17 @@
+"""Query-feature layer: aggregations, sorting, autocut, cursor listing.
+
+Reference: adapters/repos/db/aggregator/, adapters/repos/db/sorter/,
+entities/autocut/.
+"""
+
+from weaviate_tpu.query.aggregator import PropertyAggregator, aggregate_objects, combine_partials
+from weaviate_tpu.query.autocut import autocut
+from weaviate_tpu.query.sorter import sort_objects
+
+__all__ = [
+    "PropertyAggregator",
+    "aggregate_objects",
+    "combine_partials",
+    "autocut",
+    "sort_objects",
+]

@@ -1,0 +1,52 @@
+"""Object sorting by property paths / id / special keys.
+
+Reference: adapters/repos/db/sorter/ — comparators for every property
+data type with explicit null ordering (basic_comparators.go), applied to
+result sets before pagination.
+"""
+
+from __future__ import annotations
+
+from weaviate_tpu.query.aggregator import _parse_date
+
+
+def _sort_key_value(obj, path: str):
+    """Extract a comparable value; None sorts last regardless of order."""
+    if path in ("_id", "id", "uuid"):
+        return obj.uuid
+    if path == "_creationTimeUnix":
+        return getattr(obj, "creation_time_ms", 0)
+    if path == "_lastUpdateTimeUnix":
+        return getattr(obj, "last_update_time_ms", 0)
+    v = obj.properties.get(path)
+    if isinstance(v, str):
+        try:
+            return _parse_date(v)  # dates sort on the timeline
+        except ValueError:
+            return v
+    if isinstance(v, list):
+        return len(v)  # reference sorts arrays by length
+    if isinstance(v, bool):
+        return int(v)
+    return v
+
+
+def sort_objects(objects: list, sort_specs: list[dict]) -> list:
+    """Stable multi-key sort. ``sort_specs``: [{"path": "name",
+    "order": "asc"|"desc"}, ...] — applied right-to-left so the first
+    spec dominates (reference: objects_sorter.go)."""
+    out = list(objects)
+    for spec in reversed(sort_specs):
+        path = spec["path"] if isinstance(spec["path"], str) else spec["path"][0]
+        desc = spec.get("order", "asc") == "desc"
+
+        keyed = [(_sort_key_value(o, path), o) for o in out]
+        nones = [o for kv, o in keyed if kv is None]
+        present = [(kv, o) for kv, o in keyed if kv is not None]
+        # mixed-type guard: compare within the dominant type, others go last
+        try:
+            present.sort(key=lambda t: t[0], reverse=desc)
+        except TypeError:
+            present.sort(key=lambda t: (str(type(t[0])), str(t[0])), reverse=desc)
+        out = [o for _, o in present] + nones
+    return out

@@ -124,6 +124,10 @@ class InvertedIndex:
             lambda: defaultdict(set))
         # numeric/date values for range filters: prop -> doc_id -> float
         self.numeric: dict[str, dict[int, float]] = defaultdict(dict)
+        # numeric/date ARRAY props: range filters need the per-value keys
+        # for any-element semantics; scalar props are fully covered by
+        # the numeric map
+        self.array_props: set[str] = set()
         # geo coordinates: prop -> doc_id -> (lat, lon)
         self.geo: dict[str, dict[int, tuple[float, float]]] = defaultdict(dict)
         # null tracking (reference: IndexNullState)
@@ -221,11 +225,13 @@ class InvertedIndex:
         elif dt == DataType.DATE:
             self.numeric[name][doc] = parse_date(value)
         elif dt in (DataType.INT_ARRAY, DataType.NUMBER_ARRAY):
+            self.array_props.add(name)
             if value:
                 # scalar index keeps min (for sorting); range filters use the
                 # per-value filterable keys for any-element semantics
                 self.numeric[name][doc] = float(min(value))
         elif dt == DataType.DATE_ARRAY:
+            self.array_props.add(name)
             if value:
                 self.numeric[name][doc] = min(parse_date(v) for v in value)
         elif dt == DataType.GEO:
