@@ -1,0 +1,205 @@
+"""Background runtime: cyclemanager, memwatch, metrics.
+
+Reference intents: entities/cyclemanager tests (callback scheduling,
+backoff), usecases/memwatch/monitor CheckAlloc semantics, monitoring
+registry exposition.
+"""
+
+import time
+
+import pytest
+
+from weaviate_tpu.runtime import CycleManager, MemoryMonitor, MetricsRegistry
+from weaviate_tpu.runtime.memwatch import InsufficientMemoryError
+
+
+# -- cyclemanager --------------------------------------------------------------
+
+
+def test_cycle_runs_callback_repeatedly():
+    cm = CycleManager()
+    runs = []
+    cm.register("tick", lambda: runs.append(1) or True, interval=0.02)
+    cm.start()
+    try:
+        deadline = time.time() + 2.0
+        while len(runs) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        cm.stop()
+    assert len(runs) >= 3
+
+
+def test_cycle_backoff_and_reset():
+    cm = CycleManager()
+    cb = cm.register("idle", lambda: False, interval=0.1, max_interval=0.4)
+    cb.run()
+    assert cb.current_interval == pytest.approx(0.2)
+    cb.run()
+    cb.run()
+    assert cb.current_interval == pytest.approx(0.4)  # capped
+    cb.fn = lambda: True
+    cb.run()
+    assert cb.current_interval == pytest.approx(0.1)  # reset on activity
+
+
+def test_cycle_failure_does_not_kill_scheduler():
+    cm = CycleManager()
+    ok_runs = []
+
+    def boom():
+        raise RuntimeError("compaction exploded")
+
+    cm.register("boom", boom, interval=0.02)
+    cm.register("ok", lambda: ok_runs.append(1) or True, interval=0.02)
+    cm.start()
+    try:
+        deadline = time.time() + 2.0
+        while len(ok_runs) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        cm.stop()
+    assert len(ok_runs) >= 2
+    assert cm.stats()["boom"]["failures"] >= 1
+
+
+def test_cycle_trigger_and_unregister():
+    cm = CycleManager()
+    runs = []
+    cm.register("manual", lambda: runs.append(1) or True, interval=999.0)
+    cm.start()
+    try:
+        cm.trigger("manual")
+        deadline = time.time() + 2.0
+        while not runs and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        cm.stop()
+    assert runs
+    cm.unregister("manual")
+    assert "manual" not in cm.stats()
+
+
+# -- memwatch ------------------------------------------------------------------
+
+
+def test_memwatch_host_gate():
+    mon = MemoryMonitor(host_limit_bytes=1000, max_utilization=0.9)
+    mon.check_host_alloc(800)  # fits
+    mon.track_host(800)
+    with pytest.raises(InsufficientMemoryError):
+        mon.check_host_alloc(200)  # 800+200 > 900
+    mon.release_host(500)
+    mon.check_host_alloc(200)
+    assert mon.tracked_host == 300
+
+
+def test_memwatch_device_gate_with_explicit_limit(monkeypatch):
+    mon = MemoryMonitor(device_limit_bytes=10_000, max_utilization=0.5)
+    monkeypatch.setattr(MemoryMonitor, "device_in_use", lambda self: 4000)
+    mon.check_device_alloc(500)  # 4500 < 5000
+    with pytest.raises(InsufficientMemoryError):
+        mon.check_device_alloc(2000)
+
+
+def test_memwatch_no_limit_is_open():
+    mon = MemoryMonitor()
+    mon.check_host_alloc(10**12)  # no limit configured -> no gate
+
+
+# -- metrics -------------------------------------------------------------------
+
+
+def test_counter_gauge_exposition():
+    reg = MetricsRegistry()
+    c = reg.counter("ops_total", "ops", ("op",))
+    c.labels("put").inc()
+    c.labels("put").inc(2)
+    c.labels("delete").inc()
+    g = reg.gauge("live", "live objects")
+    g.set(42)
+    text = reg.expose()
+    assert 'ops_total{op="put"} 3.0' in text
+    assert 'ops_total{op="delete"} 1.0' in text
+    assert "live 42" in text
+    assert "# TYPE ops_total counter" in text
+
+
+def test_histogram_buckets_and_timer():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(100.0)
+    text = reg.expose()
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1.0"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 3' in text
+    assert "lat_count 3" in text
+    with h.time():
+        pass
+    assert "lat_count 4" in reg.expose()
+
+
+def test_registry_rejects_kind_conflict():
+    reg = MetricsRegistry()
+    reg.counter("x", "")
+    with pytest.raises(ValueError):
+        reg.gauge("x", "")
+    # same kind re-registration returns the same metric
+    assert reg.counter("x", "") is reg.counter("x", "")
+
+
+# -- integration: database maintenance cycle ----------------------------------
+
+
+def test_database_maintenance_flushes_and_compacts(tmp_path):
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.schema.config import CollectionConfig
+
+    db = Database(str(tmp_path))
+    col = db.create_collection(CollectionConfig(name="M"))
+    for i in range(20):
+        col.put_object({"i": i}, vector=[float(i), 0.0])
+    shard = next(iter(col.shards.values()))
+    assert any(b.dirty for b in shard.store.buckets())
+    did = db._maintenance_cycle()
+    assert did
+    assert not any(b.dirty for b in shard.store.buckets())
+    # repeat with no new writes: nothing to do
+    assert db._maintenance_cycle() is False
+    db.close()
+
+
+def test_memwatch_gates_batch_import(tmp_path, monkeypatch):
+    """The device-HBM gate refuses an import before any mutation
+    (reference: memwatch.CheckAlloc called from the import path)."""
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.schema.config import CollectionConfig
+
+    monkeypatch.setattr(MemoryMonitor, "device_in_use", lambda self: 0)
+    mon = MemoryMonitor(device_limit_bytes=100, max_utilization=1.0)
+    db = Database(str(tmp_path), memory_monitor=mon)
+    col = db.create_collection(CollectionConfig(name="Gate"))
+    with pytest.raises(InsufficientMemoryError):
+        col.put_object({"x": 1}, vector=[0.0] * 64)  # 256 bytes > 100
+    assert col.object_count() == 0  # nothing landed
+    col.put_object({"x": 1}, vector=[0.0, 1.0])  # 8 bytes fits
+    assert col.object_count() == 1
+    db.close()
+
+
+def test_collection_queries_record_metrics(tmp_path):
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.runtime.metrics import objects_total, query_duration
+    from weaviate_tpu.schema.config import CollectionConfig
+
+    db = Database(str(tmp_path))
+    col = db.create_collection(CollectionConfig(name="Met"))
+    col.put_object({"a": 1}, vector=[1.0, 2.0])
+    col.near_vector([1.0, 2.0], k=1)
+    put_child = objects_total.labels("Met", "put")
+    assert put_child.value >= 1
+    dur_child = query_duration.labels("Met", "vector")
+    assert dur_child.count >= 1
+    db.close()

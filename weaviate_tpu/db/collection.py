@@ -8,6 +8,7 @@ shard per tenant.
 
 from __future__ import annotations
 
+import functools
 import threading
 import uuid as uuid_mod
 from concurrent.futures import ThreadPoolExecutor
@@ -16,6 +17,7 @@ import numpy as np
 
 from weaviate_tpu.db.shard import Shard
 from weaviate_tpu.db.sharding import ShardingState
+from weaviate_tpu.runtime import metrics as monitoring
 from weaviate_tpu.schema.config import CollectionConfig
 from weaviate_tpu.storage.objects import StorageObject
 
@@ -34,15 +36,33 @@ class SearchResult:
         return f"SearchResult({self.uuid}, dist={self.distance}, score={self.score})"
 
 
+def _timed(query_type: str):
+    """Record query latency per collection (reference: monitoring
+    query-duration metric vecs, usecases/monitoring/prometheus.go)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            with monitoring.query_duration.labels(self.config.name,
+                                                  query_type).time():
+                return fn(self, *args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
 class Collection:
     def __init__(self, data_dir: str, config: CollectionConfig,
                  sharding_state: ShardingState | None = None, mesh=None,
-                 local_node: str = "node-0", on_sharding_change=None):
+                 local_node: str = "node-0", on_sharding_change=None,
+                 memwatch=None):
         config.validate()
         self.config = config
         self.data_dir = data_dir
         self.mesh = mesh
         self.local_node = local_node
+        self.memwatch = memwatch
         self._lock = threading.RLock()
         if sharding_state is None:
             if config.multi_tenancy.enabled:
@@ -72,7 +92,8 @@ class Collection:
         with self._lock:
             if name not in self.shards:
                 self.shards[name] = Shard(self.data_dir, self.config, name,
-                                          mesh=self.mesh)
+                                          mesh=self.mesh,
+                                          memwatch=self.memwatch)
             return self.shards[name]
 
     def _shard_for_write(self, uuid: str, tenant: str | None) -> Shard:
@@ -127,6 +148,7 @@ class Collection:
             obj.vectors[name] = np.asarray(vec, dtype=np.float32)
         shard = self._shard_for_write(uuid, tenant)
         shard.put_object(obj)
+        monitoring.objects_total.labels(self.config.name, "put").inc()
         return uuid
 
     def batch_put(self, objects: list[dict], tenant: str | None = None) -> list[dict]:
@@ -163,6 +185,8 @@ class Collection:
                             raise KeyError(f"tenant {shard_name!r} does not exist")
                     shard = self._load_shard(shard_name)
                 shard.put_object_batch(objs)
+                monitoring.objects_total.labels(self.config.name, "put"
+                                                ).inc(len(objs))
             except Exception as e:
                 for i in metas[shard_name]:
                     results[i] = {"uuid": results[i]["uuid"], "status": "FAILED",
@@ -180,11 +204,14 @@ class Collection:
 
     def delete_object(self, uuid: str, tenant: str | None = None) -> bool:
         if self.config.multi_tenancy.enabled:
-            return self._target_shards(tenant)[0].delete_object(uuid)
-        name = self.sharding.shard_for(uuid, tenant)
-        if name not in self.shards:
-            return False
-        return self.shards[name].delete_object(uuid)
+            ok = self._target_shards(tenant)[0].delete_object(uuid)
+        elif (name := self.sharding.shard_for(uuid, tenant)) not in self.shards:
+            ok = False
+        else:
+            ok = self.shards[name].delete_object(uuid)
+        if ok:
+            monitoring.objects_total.labels(self.config.name, "delete").inc()
+        return ok
 
     def object_count(self, tenant: str | None = None) -> int:
         shards = self._target_shards(tenant) if (tenant or not
@@ -226,7 +253,9 @@ class Collection:
         candidates: list[tuple[str, Shard]] = []
         for shard in shards:
             mask = shard.allow_mask(where) if where is not None else None
-            for doc_id, uid in shard._doc_to_uuid.items():
+            with shard._lock:  # snapshot: writers mutate _doc_to_uuid
+                items = list(shard._doc_to_uuid.items())
+            for doc_id, uid in items:
                 if mask is not None and (doc_id >= len(mask) or not mask[doc_id]):
                     continue
                 if after is not None and uid <= after:
@@ -243,6 +272,7 @@ class Collection:
 
     # -- aggregation ---------------------------------------------------------
 
+    @_timed("aggregate")
     def aggregate(self, properties: list[str] | None = None,
                   group_by: str | None = None, where=None,
                   tenant: str | None = None,
@@ -305,6 +335,7 @@ class Collection:
                    len(b) if b.dtype == np.bool_ else (int(b.max()) + 1 if len(b) else 0))
         return to_mask(a, size) & to_mask(b, size)
 
+    @_timed("vector")
     def near_vector(self, query, k: int = 10, vec_name: str = "",
                     tenant: str | None = None, include_objects: bool = True,
                     allow_list_by_shard: dict | None = None,
@@ -356,6 +387,7 @@ class Collection:
             out.append(res)
         return out
 
+    @_timed("bm25")
     def bm25(self, query: str, k: int = 10, properties: list[str] | None = None,
              tenant: str | None = None, include_objects: bool = True,
              allow_list_by_shard: dict | None = None,
@@ -397,6 +429,7 @@ class Collection:
             out.append(res)
         return out
 
+    @_timed("hybrid")
     def hybrid(self, query: str, vector=None, alpha: float = 0.75, k: int = 10,
                properties: list[str] | None = None, vec_name: str = "",
                tenant: str | None = None, fusion: str = "relativeScore",

@@ -20,7 +20,9 @@ from weaviate_tpu.storage.kv import KVStore
 
 class Database:
     def __init__(self, data_dir: str = "./data", mesh=None,
-                 local_node: str = "node-0"):
+                 local_node: str = "node-0", start_cycles: bool = False,
+                 maintenance_interval: float = 5.0,
+                 memory_monitor=None):
         self.data_dir = data_dir
         self.mesh = mesh
         self.local_node = local_node
@@ -29,7 +31,25 @@ class Database:
         self._schema_store = KVStore(os.path.join(data_dir, "_schema"))
         self._schema = self._schema_store.bucket("classes", "replace")
         self.collections: dict[str, Collection] = {}
+        from weaviate_tpu.runtime import CycleManager, MemoryMonitor
+
+        self.memwatch = memory_monitor or MemoryMonitor()
+        # background maintenance (reference: cyclemanager drives LSM
+        # flush/compaction); off by default so embedded/test use stays
+        # deterministic — the server entry point enables it
+        self.cycles = CycleManager()
+        self.cycles.register("lsm-maintenance", self._maintenance_cycle,
+                             maintenance_interval)
+        if start_cycles:
+            self.cycles.start()
         self._load_existing()
+
+    def _maintenance_cycle(self) -> bool:
+        did = False
+        for col in list(self.collections.values()):
+            for shard in list(col.shards.values()):
+                did = shard.maintenance() or did
+        return did
 
     def _load_existing(self):
         for key in self._schema.keys():
@@ -39,6 +59,7 @@ class Database:
             self.collections[cfg.name] = Collection(
                 self.data_dir, cfg, sharding_state=state, mesh=self.mesh,
                 local_node=self.local_node, on_sharding_change=self._persist,
+                memwatch=self.memwatch,
             )
 
     # -- schema ops (the Raft FSM op set, cluster/store_apply.go:133-160) ----
@@ -50,7 +71,8 @@ class Database:
                 raise ValueError(f"collection {config.name!r} already exists")
             col = Collection(self.data_dir, config, mesh=self.mesh,
                              local_node=self.local_node,
-                             on_sharding_change=self._persist)
+                             on_sharding_change=self._persist,
+                             memwatch=self.memwatch)
             self.collections[config.name] = col
             self._persist(col)
             return col
@@ -141,6 +163,7 @@ class Database:
             col.flush()
 
     def close(self):
+        self.cycles.stop()
         with self._lock:
             for col in self.collections.values():
                 col.close()

@@ -37,32 +37,24 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
         capacity=8192,
         chunk_size=8192,
     )
+    if cfg.index_type in ("flat", "ivf") and cfg.quantization:
+        # quantized scan is a flat-index capability; IVF lists hold raw
+        # vectors — honor the compression request rather than silently
+        # dropping it
+        return FlatIndex(
+            quantization=cfg.quantization,
+            pq_segments=cfg.pq_segments,
+            pq_centroids=cfg.pq_centroids,
+            rescore_limit=cfg.rescore_limit,
+            **common,
+        )
     if cfg.index_type == "flat":
-        if cfg.quantization:
-            return FlatIndex(
-                quantization=cfg.quantization,
-                pq_segments=cfg.pq_segments,
-                pq_centroids=cfg.pq_centroids,
-                rescore_limit=cfg.rescore_limit,
-                **common,
-            )
         return FlatIndex(
             mesh=mesh,
             dtype=jnp.bfloat16 if cfg.storage_dtype == "bfloat16" else jnp.float32,
             **common,
         )
     if cfg.index_type == "ivf":
-        if cfg.quantization:
-            # quantized scan is a flat-index capability; IVF lists hold raw
-            # vectors — honor the compression request rather than silently
-            # dropping it
-            return FlatIndex(
-                quantization=cfg.quantization,
-                pq_segments=cfg.pq_segments,
-                pq_centroids=cfg.pq_centroids,
-                rescore_limit=cfg.rescore_limit,
-                **common,
-            )
         from weaviate_tpu.engine.ivf import IVFIndex
 
         # mesh forwarded so the single-replica guard fires loudly instead of
@@ -100,8 +92,9 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
 
 class Shard:
     def __init__(self, data_dir: str, collection: CollectionConfig, name: str,
-                 mesh=None):
+                 mesh=None, memwatch=None):
         self.name = name
+        self.memwatch = memwatch
         self.collection_name = collection.name
         self.config = collection
         # exact-case directory: two collections differing only in case are
@@ -221,6 +214,12 @@ class Shard:
         doc_ids: list[int] = []
         with self._lock:
             self._validate_vectors(objs)
+            if self.memwatch is not None:
+                # refuse BEFORE mutating anything (reference memwatch
+                # CheckAlloc gates imports): vectors land in device HBM
+                nbytes = sum(int(np.asarray(v).nbytes)
+                             for o in objs for v in o.vectors.values())
+                self.memwatch.check_device_alloc(nbytes)
             vec_batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
             for obj in objs:
                 old_raw = self.docid.get(obj.uuid.encode())
@@ -328,6 +327,24 @@ class Shard:
     def flush(self):
         for b in (self.objects, self.docid, self.meta):
             b.flush()
+
+    def maintenance(self, compact_above: int = 4) -> bool:
+        """One background cycle: flush dirty memtables, compact segment
+        stacks past the threshold (reference: store_cyclecallbacks.go).
+        Returns True when work was done (cyclemanager backoff signal)."""
+        from weaviate_tpu.runtime.metrics import lsm_segment_count
+
+        did = False
+        for b in self.store.buckets():
+            if b.dirty:
+                b.flush()
+                did = True
+            if b.segment_count > compact_above:
+                b.compact()
+                did = True
+            lsm_segment_count.labels(f"{self.collection_name}/{self.name}/{b.name}"
+                                     ).set(b.segment_count)
+        return did
 
     def close(self):
         self.store.close()

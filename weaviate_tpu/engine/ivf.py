@@ -526,6 +526,7 @@ class IVFStore:
                 "live_vectors": vecs[keep] if len(slots) else vecs,
                 "live_slots": slots[keep] if len(slots) else slots,
                 "chunk_size": self.chunk_size,
+                "dtype": jnp.dtype(self.dtype).name,
                 "train_threshold": self.train_threshold,
                 "delta_threshold": self.delta_threshold,
                 # FlatIndex.snapshot() compatibility
@@ -541,11 +542,15 @@ class IVFStore:
 
     @classmethod
     def restore(cls, snap: dict, **kwargs) -> "IVFStore":
+        # storage dtype survives the round-trip unless explicitly overridden
+        # (same contract as DeviceVectorStore.restore)
+        dtype = kwargs.pop("dtype", None) or jnp.dtype(snap.get("dtype", "float32"))
         store = cls(dim=snap["dim"], metric=snap["metric"],
                     nlist=snap.get("nlist", 0), nprobe=snap.get("nprobe", 0),
                     chunk_size=snap.get("chunk_size", 8192),
                     train_threshold=snap.get("train_threshold", 16_384),
-                    delta_threshold=snap.get("delta_threshold", 8192))
+                    delta_threshold=snap.get("delta_threshold", 8192),
+                    dtype=dtype)
         slots = np.asarray(snap["live_slots"], dtype=np.int64)
         vecs = np.asarray(snap["live_vectors"], dtype=np.float32)
         store._count = snap["count"]

@@ -1,0 +1,21 @@
+"""Background-task runtime: cycle scheduler, memory watchdog, metrics.
+
+Reference: entities/cyclemanager/ (CycleManager, exponential tickers),
+usecases/memwatch/ (allocation gate), usecases/monitoring/ (prometheus
+registry).
+"""
+
+from weaviate_tpu.runtime.cyclemanager import CycleCallback, CycleManager
+from weaviate_tpu.runtime.memwatch import MemoryMonitor
+from weaviate_tpu.runtime.metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
+
+__all__ = [
+    "CycleCallback",
+    "CycleManager",
+    "MemoryMonitor",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+]

@@ -1,0 +1,93 @@
+"""Memory watchdog: gate allocations against host and device budgets.
+
+Reference: usecases/memwatch/monitor.go:49 — CheckAlloc(:99) compares the
+projected live heap against GOMEMLIMIT and rejects imports/cache growth
+when it would overshoot. The TPU analog adds the HBM budget: device
+arrays (vector stores, posting lists) are tracked against per-device HBM
+capacity read from jax device memory_stats when available.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class InsufficientMemoryError(MemoryError):
+    pass
+
+
+class MemoryMonitor:
+    def __init__(self, host_limit_bytes: int | None = None,
+                 device_limit_bytes: int | None = None,
+                 max_utilization: float = 0.9):
+        self.host_limit = host_limit_bytes
+        self.device_limit = device_limit_bytes
+        self.max_utilization = max_utilization
+        self._lock = threading.Lock()
+        # host-side tracked allocations (we can't read the Python live
+        # heap cheaply; callers register their big buffers)
+        self._tracked_host = 0
+
+    # -- device -----------------------------------------------------------
+
+    def device_budget(self) -> int | None:
+        """Per-device HBM budget in bytes; explicit limit wins, else read
+        from the backend (axon TPU exposes memory_stats)."""
+        if self.device_limit is not None:
+            return self.device_limit
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+            if stats and "bytes_limit" in stats:
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+        return None
+
+    def device_in_use(self) -> int:
+        try:
+            import jax
+
+            stats = jax.devices()[0].memory_stats()
+            if stats and "bytes_in_use" in stats:
+                return int(stats["bytes_in_use"])
+        except Exception:
+            pass
+        return 0
+
+    def check_device_alloc(self, nbytes: int) -> None:
+        """Raise InsufficientMemoryError if landing ``nbytes`` more on the
+        device would exceed the utilization cap (reference CheckAlloc
+        semantics: refuse BEFORE allocating, don't OOM mid-import)."""
+        budget = self.device_budget()
+        if budget is None:
+            return
+        if self.device_in_use() + nbytes > budget * self.max_utilization:
+            raise InsufficientMemoryError(
+                f"device allocation of {nbytes} bytes would exceed "
+                f"{self.max_utilization:.0%} of HBM budget {budget}")
+
+    # -- host -------------------------------------------------------------
+
+    def track_host(self, nbytes: int) -> None:
+        with self._lock:
+            self._tracked_host += nbytes
+
+    def release_host(self, nbytes: int) -> None:
+        with self._lock:
+            self._tracked_host = max(0, self._tracked_host - nbytes)
+
+    def check_host_alloc(self, nbytes: int) -> None:
+        if self.host_limit is None:
+            return
+        with self._lock:
+            projected = self._tracked_host + nbytes
+        if projected > self.host_limit * self.max_utilization:
+            raise InsufficientMemoryError(
+                f"host allocation of {nbytes} bytes would exceed "
+                f"{self.max_utilization:.0%} of limit {self.host_limit}")
+
+    @property
+    def tracked_host(self) -> int:
+        return self._tracked_host

@@ -1,0 +1,259 @@
+"""Prometheus-style metrics registry with text exposition.
+
+Reference: usecases/monitoring/prometheus.go:28 (~70 metric vecs: LSM,
+vector index, backup, queries) served on PROMETHEUS_MONITORING_PORT.
+Hand-rolled (no prometheus_client in the image): Counter/Gauge/Histogram
+with label vectors and the /metrics text format.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str = "", label_names: tuple = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}
+
+    def labels(self, *values, **kw):
+        if kw:
+            values = tuple(kw.get(n, "") for n in self.label_names)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.label_names):
+            raise ValueError(f"{self.name}: expected labels {self.label_names}")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._new_child()
+                self._children[values] = child
+            return child
+
+    def _default(self):
+        if self.label_names:
+            raise ValueError(f"{self.name} has labels; use .labels(...)")
+        return self.labels()
+
+    def _label_str(self, values: tuple) -> str:
+        if not values:
+            return ""
+        pairs = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, values))
+        return "{" + pairs + "}"
+
+
+class _CounterChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self.value += amount
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0):
+        self._default().inc(amount)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:  # labels() inserts race the scrape iteration
+            children = sorted(self._children.items())
+        for lv, child in children:
+            out.append(f"{self.name}{self._label_str(lv)} {child.value}")
+        return out
+
+
+class _GaugeChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float):
+        with self._lock:
+            self.value = v
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0):
+        self.inc(-amount)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float):
+        self._default().set(v)
+
+    def inc(self, amount: float = 1.0):
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0):
+        self._default().dec(amount)
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for lv, child in children:
+            out.append(f"{self.name}{self._label_str(lv)} {child.value}")
+        return out
+
+
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        with self._lock:
+            self.total += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+
+    def time(self):
+        return _Timer(self)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text="", label_names=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(buckets)
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float):
+        self._default().observe(v)
+
+    def time(self):
+        """Context manager observing elapsed seconds."""
+        return _Timer(self._default())
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for lv, child in children:
+            base = self._label_str(lv)[1:-1] if lv else ""
+            for b, c in zip(self.buckets, child.counts):
+                lbl = f'{{{base}{"," if base else ""}le="{b}"}}'
+                out.append(f"{self.name}_bucket{lbl} {c}")
+            lbl_inf = f'{{{base}{"," if base else ""}le="+Inf"}}'
+            out.append(f"{self.name}_bucket{lbl_inf} {child.count}")
+            suffix = "{" + base + "}" if base else ""
+            out.append(f"{self.name}_sum{suffix} {child.total}")
+            out.append(f"{self.name}_count{suffix} {child.count}")
+        return out
+
+
+class _Timer:
+    def __init__(self, child):
+        self._child = child
+
+    def __enter__(self):
+        import time
+
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        self._child.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _register(self, cls, name, help_text, label_names, **kw):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(f"metric {name} already registered as "
+                                     f"{existing.kind}")
+                return existing
+            m = cls(name, help_text, label_names, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help_text="", label_names=()) -> Counter:
+        return self._register(Counter, name, help_text, label_names)
+
+    def gauge(self, name, help_text="", label_names=()) -> Gauge:
+        return self._register(Gauge, name, help_text, label_names)
+
+    def histogram(self, name, help_text="", label_names=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help_text, label_names,
+                              buckets=buckets)
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+#: process-wide default registry (reference: one prometheus registry per node)
+registry = MetricsRegistry()
+
+# -- the standard metric set (subset of prometheus.go's ~70 vecs) -------------
+
+query_duration = registry.histogram(
+    "weaviate_tpu_query_duration_seconds",
+    "Query latency by collection and query type",
+    ("collection", "query_type"))
+objects_total = registry.counter(
+    "weaviate_tpu_objects_total",
+    "Object mutations by collection and operation",
+    ("collection", "operation"))
+vector_index_size = registry.gauge(
+    "weaviate_tpu_vector_index_size",
+    "Live vectors per collection/shard", ("collection", "shard"))
+vector_index_operations = registry.counter(
+    "weaviate_tpu_vector_index_operations_total",
+    "Vector index ops", ("collection", "operation"))
+lsm_segment_count = registry.gauge(
+    "weaviate_tpu_lsm_segment_count",
+    "Segments per bucket", ("bucket",))

@@ -351,6 +351,15 @@ class Bucket:
 
     # -- flush / compaction --------------------------------------------------
 
+    @property
+    def dirty(self) -> bool:
+        """True when the memtable holds unflushed entries."""
+        return bool(self._mem)
+
+    @property
+    def segment_count(self) -> int:
+        return len(self._segments)
+
     def flush(self) -> None:
         """Memtable -> new segment; WAL truncates (reference: flush cycle,
         store_cyclecallbacks.go)."""
@@ -442,6 +451,10 @@ class KVStore:
                     f"bucket {name!r} exists with strategy {b.strategy!r}"
                 )
             return b
+
+    def buckets(self) -> list[Bucket]:
+        with self._lock:
+            return list(self._buckets.values())
 
     def close(self) -> None:
         with self._lock:
