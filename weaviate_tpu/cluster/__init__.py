@@ -1,0 +1,23 @@
+"""Cluster layer: membership, schema consensus, remote shard data plane.
+
+Reference: usecases/cluster/ (memberlist gossip), cluster/ (raft schema
+store), adapters/handlers/rest/clusterapi/ + adapters/clients/ (internal
+HTTP data plane), usecases/sharding (remote index).
+"""
+
+from weaviate_tpu.cluster.membership import Membership, NodeInfo
+from weaviate_tpu.cluster.node import ClusterNode
+from weaviate_tpu.cluster.raft import RaftNode
+from weaviate_tpu.cluster.remote import RemoteShardClient, register_incoming
+from weaviate_tpu.cluster.transport import InternalServer, rpc
+
+__all__ = [
+    "Membership",
+    "NodeInfo",
+    "ClusterNode",
+    "RaftNode",
+    "RemoteShardClient",
+    "register_incoming",
+    "InternalServer",
+    "rpc",
+]

@@ -1,0 +1,61 @@
+"""Schema FSM: committed Raft ops applied to the node-local Database.
+
+Reference: cluster/store_apply.go:71,133-160 — the op set
+(ADD_CLASS, UPDATE_CLASS, DELETE_CLASS, ADD_PROPERTY, ADD_TENANT,
+DELETE_TENANT, ...) applied on EVERY node; the executor then creates the
+local shards (usecases/schema/executor.go). Ops are idempotent so log
+replay after restart converges.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from weaviate_tpu.db.sharding import ShardingState
+from weaviate_tpu.schema.config import CollectionConfig, Property
+
+logger = logging.getLogger(__name__)
+
+
+class SchemaFSM:
+    def __init__(self, db):
+        self.db = db
+
+    def apply(self, op: dict) -> None:
+        t = op["type"]
+        if t == "add_class":
+            cfg = CollectionConfig.from_dict(op["config"])
+            state = ShardingState.from_dict(op["sharding"])
+            if cfg.name in self.db.collections:
+                return  # replay idempotence
+            self.db.create_collection(cfg, sharding_state=state)
+        elif t == "delete_class":
+            self.db.delete_collection(op["name"])
+        elif t == "add_property":
+            p = dict(op["prop"])
+            nested = p.get("nested")
+            p["nested"] = [Property(**n) for n in nested] if nested else None
+            try:
+                self.db.add_property(op["class"], Property(**p))
+            except ValueError:
+                pass  # duplicate on replay
+        elif t == "update_class":
+            cfg = CollectionConfig.from_dict(op["config"])
+
+            def overwrite(c):
+                c.__dict__.update(cfg.__dict__)
+
+            self.db.update_collection_config(cfg.name, overwrite)
+        elif t == "add_tenants":
+            col = self.db.get_collection(op["class"])
+            for tenant in op["tenants"]:
+                if tenant["name"] not in col.sharding.shard_names:
+                    col.add_tenant(tenant["name"], nodes=tenant.get("nodes"))
+            self.db._persist(col)
+        elif t == "remove_tenants":
+            col = self.db.get_collection(op["class"])
+            for name in op["tenants"]:
+                col.remove_tenant(name)
+            self.db._persist(col)
+        else:
+            logger.warning("unknown FSM op type %r", t)

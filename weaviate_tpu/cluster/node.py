@@ -1,0 +1,112 @@
+"""ClusterNode: one process-worth of the distributed database.
+
+Composes (reference: configure_api.go MakeAppState wiring order):
+internal HTTP server (clusterapi), gossip membership (usecases/cluster),
+Raft schema store (cluster/), remote shard client (adapters/clients),
+and the node-local Database. Schema writes go through Raft; object
+reads/writes go point-to-point over the data plane.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from weaviate_tpu.cluster.fsm import SchemaFSM
+from weaviate_tpu.cluster.membership import Membership
+from weaviate_tpu.cluster.raft import RaftNode
+from weaviate_tpu.cluster.remote import RemoteShardClient, register_incoming
+from weaviate_tpu.cluster.transport import InternalServer
+from weaviate_tpu.db.database import Database
+from weaviate_tpu.db.sharding import ShardingState
+from weaviate_tpu.schema.config import CollectionConfig, Property
+
+logger = logging.getLogger(__name__)
+
+
+class ClusterNode:
+    def __init__(self, name: str, data_dir: str, raft_peers: list[str],
+                 host: str = "127.0.0.1", port: int = 0, mesh=None,
+                 gossip_interval: float = 0.3,
+                 election_timeout: tuple[float, float] = (0.3, 0.6)):
+        """``raft_peers``: the static bootstrap member set (node names,
+        incl. this one) — reference: RAFT_JOIN env (cluster/bootstrap)."""
+        self.name = name
+        self.server = InternalServer(host, port)
+        self.membership = Membership(name, self.server,
+                                     interval=gossip_interval)
+        self.remote = RemoteShardClient(self.membership.resolve)
+        self.db = Database(data_dir, mesh=mesh, local_node=name,
+                           remote=self.remote,
+                           nodes_provider=self.membership.alive_nodes)
+        register_incoming(self.server, self.db)
+        self.fsm = SchemaFSM(self.db)
+        raft_bucket = self.db._schema_store.bucket("raft", "replace")
+        self.raft = RaftNode(name, raft_peers, self.membership.resolve,
+                             self.server, self.fsm.apply,
+                             store_bucket=raft_bucket,
+                             election_timeout=election_timeout)
+        # auto tenant creation must take the Raft path in a cluster
+        self.db.set_auto_tenant_hook(self.add_tenants)
+        self.server.start()
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def start(self, seed_addrs: list[str] | None = None) -> None:
+        if seed_addrs:
+            self.membership.join(seed_addrs)
+        self.membership.start()
+        self.raft.start()
+
+    def close(self) -> None:
+        self.raft.stop()
+        self.membership.stop()
+        self.server.stop()
+        self.db.close()
+
+    # -- schema API (through Raft; reference raft_apply_endpoints.go) --------
+
+    def create_collection(self, config: CollectionConfig):
+        config.validate()
+        # placement computed ONCE here, applied identically everywhere
+        if config.multi_tenancy.enabled:
+            state = ShardingState.create_partitioned()
+        else:
+            state = ShardingState.create(
+                config.sharding.desired_count,
+                nodes=self.membership.alive_nodes(),
+                replication_factor=config.replication.factor)
+        self.raft.propose({"type": "add_class", "config": config.to_dict(),
+                           "sharding": state.to_dict()})
+        return self.db.get_collection(config.name)
+
+    def delete_collection(self, name: str) -> None:
+        self.raft.propose({"type": "delete_class", "name": name})
+
+    def add_property(self, collection: str, prop: Property) -> None:
+        self.raft.propose({"type": "add_property", "class": collection,
+                           "prop": dataclasses.asdict(prop)})
+
+    def add_tenants(self, collection: str, tenants: list[str]) -> None:
+        col = self.db.get_collection(collection)
+        nodes = self.membership.alive_nodes()
+        placed = []
+        for t in tenants:
+            # placement decided at propose time, like shards
+            probe = ShardingState.create_partitioned()
+            probe.add_tenant(t, nodes=nodes,
+                             replication_factor=col.config.replication.factor)
+            placed.append({"name": t, "nodes": probe.placement[t]})
+        self.raft.propose({"type": "add_tenants", "class": collection,
+                           "tenants": placed})
+
+    def remove_tenants(self, collection: str, tenants: list[str]) -> None:
+        self.raft.propose({"type": "remove_tenants", "class": collection,
+                           "tenants": tenants})
+
+    # -- convenience ---------------------------------------------------------
+
+    def get_collection(self, name: str):
+        return self.db.get_collection(name)

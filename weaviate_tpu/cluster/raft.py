@@ -1,0 +1,387 @@
+"""Raft consensus for cluster metadata (schema, tenants).
+
+Reference: cluster/store.go (hashicorp/raft + boltdb log store),
+store_apply.go (FSM ops ADD_CLASS...DELETE_TENANT), raft.go:26 (leader
+forwarding from followers). Scope parity: only schema/tenant METADATA
+goes through Raft — object data takes the replication data plane.
+
+This is a compact Raft: leader election with randomized timeouts,
+AppendEntries log replication with the log-matching backtrack, majority
+commit, persisted (term, votedFor, log) so a restarted node rejoins with
+its history. Schema-op volume is tiny, so the log persists as one KV
+record per entry and snapshotting is simply the applied FSM state
+(the schema store itself).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+
+from weaviate_tpu.cluster.transport import RpcError, rpc
+
+logger = logging.getLogger(__name__)
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class NotLeaderError(RuntimeError):
+    def __init__(self, leader: str | None):
+        super().__init__(f"not the leader (leader={leader})")
+        self.leader = leader
+
+
+class RaftNode:
+    def __init__(self, name: str, peers: list[str], resolver, server,
+                 apply_fn, store_bucket=None,
+                 election_timeout: tuple[float, float] = (0.3, 0.6),
+                 heartbeat_interval: float = 0.08):
+        """``peers``: all member names incl. self (static bootstrap set,
+        reference cluster/bootstrap). ``resolver(name) -> addr``.
+        ``apply_fn(op: dict)`` applies a committed entry to the FSM.
+        ``store_bucket``: KV bucket for persistence (term/vote/log)."""
+        self.name = name
+        self.peers = sorted(set(peers) | {name})
+        self.resolver = resolver
+        self.apply_fn = apply_fn
+        self._bucket = store_bucket
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+
+        self._lock = threading.RLock()
+        self._applied_cv = threading.Condition(self._lock)
+        self.role = FOLLOWER
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[dict] = []  # {"term": int, "op": dict}
+        self.commit_index = -1
+        self.last_applied = -1
+        self.leader_id: str | None = None
+        self._next_index: dict[str, int] = {}
+        self._match_index: dict[str, int] = {}
+        self._last_heard = time.monotonic()
+        self._deadline = self._new_deadline()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        self._restore()
+        server.route("/raft/vote", self._handle_vote)
+        server.route("/raft/append", self._handle_append)
+        server.route("/raft/propose", self._handle_propose)
+
+    # -- persistence ---------------------------------------------------------
+
+    def _persist_meta(self) -> None:
+        if self._bucket is not None:
+            self._bucket.put(b"meta", {"term": self.current_term,
+                                       "voted_for": self.voted_for})
+
+    def _persist_log(self, start: int = 0) -> None:
+        if self._bucket is not None:
+            for i in range(start, len(self.log)):
+                self._bucket.put(f"log-{i:012d}".encode(), self.log[i])
+            self._bucket.put(b"log_len", len(self.log))
+
+    def _truncate_log(self, new_len: int) -> None:
+        if self._bucket is not None:
+            self._bucket.put(b"log_len", new_len)
+        del self.log[new_len:]
+
+    def _restore(self) -> None:
+        if self._bucket is None:
+            return
+        meta = self._bucket.get(b"meta")
+        if meta:
+            self.current_term = meta["term"]
+            self.voted_for = meta.get("voted_for")
+        n = self._bucket.get(b"log_len") or 0
+        self.log = [self._bucket.get(f"log-{i:012d}".encode())
+                    for i in range(n)]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"raft-{self.name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)
+            self._thread = None
+
+    def _new_deadline(self) -> float:
+        return time.monotonic() + random.uniform(*self.election_timeout)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(0.01):
+            try:
+                with self._lock:
+                    role = self.role
+                if role == LEADER:
+                    self._replicate_all()
+                    time.sleep(self.heartbeat_interval)
+                elif time.monotonic() >= self._deadline:
+                    self._run_election()
+            except Exception:
+                logger.exception("raft %s loop error", self.name)
+
+    # -- election ------------------------------------------------------------
+
+    def _last_log(self) -> tuple[int, int]:
+        if not self.log:
+            return (-1, 0)
+        return (len(self.log) - 1, self.log[-1]["term"])
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self.role = CANDIDATE
+            self.current_term += 1
+            self.voted_for = self.name
+            self.leader_id = None
+            term = self.current_term
+            last_index, last_term = self._last_log()
+            self._persist_meta()
+            self._deadline = self._new_deadline()
+        votes = 1
+        for peer in self.peers:
+            if peer == self.name:
+                continue
+            try:
+                reply = rpc(self.resolver(peer), "/raft/vote",
+                            {"term": term, "candidate": self.name,
+                             "last_log_index": last_index,
+                             "last_log_term": last_term}, timeout=1.0)
+            except (RpcError, KeyError):
+                continue
+            with self._lock:
+                if reply["term"] > self.current_term:
+                    self._become_follower(reply["term"])
+                    return
+                if reply.get("granted") and self.role == CANDIDATE \
+                        and self.current_term == term:
+                    votes += 1
+        with self._lock:
+            if self.role == CANDIDATE and self.current_term == term \
+                    and votes > len(self.peers) // 2:
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        logger.info("raft %s: leader for term %d", self.name, self.current_term)
+        self.role = LEADER
+        self.leader_id = self.name
+        n = len(self.log)
+        self._next_index = {p: n for p in self.peers if p != self.name}
+        self._match_index = {p: -1 for p in self.peers if p != self.name}
+        # no-op barrier entry so the new leader can commit prior-term
+        # entries (Raft §5.4.2)
+        self.log.append({"term": self.current_term, "op": {"type": "noop"}})
+        self._persist_log(n)
+
+    def _become_follower(self, term: int) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist_meta()
+        self.role = FOLLOWER
+        self._deadline = self._new_deadline()
+
+    # -- replication (leader side) -------------------------------------------
+
+    def _replicate_all(self) -> None:
+        for peer in self.peers:
+            if peer != self.name:
+                self._replicate_one(peer)
+        self._advance_commit()
+
+    def _replicate_one(self, peer: str) -> None:
+        with self._lock:
+            if self.role != LEADER:
+                return
+            term = self.current_term
+            next_i = self._next_index.get(peer, len(self.log))
+            prev_i = next_i - 1
+            prev_t = self.log[prev_i]["term"] if prev_i >= 0 else 0
+            entries = self.log[next_i:]
+            commit = self.commit_index
+        try:
+            reply = rpc(self.resolver(peer), "/raft/append",
+                        {"term": term, "leader": self.name,
+                         "prev_index": prev_i, "prev_term": prev_t,
+                         "entries": entries, "leader_commit": commit},
+                        timeout=1.0)
+        except (RpcError, KeyError):
+            return
+        with self._lock:
+            if reply["term"] > self.current_term:
+                self._become_follower(reply["term"])
+                return
+            if self.role != LEADER or self.current_term != term:
+                return
+            if reply.get("success"):
+                self._match_index[peer] = prev_i + len(entries)
+                self._next_index[peer] = self._match_index[peer] + 1
+            else:
+                # log-matching backtrack
+                self._next_index[peer] = max(0, next_i - 1)
+
+    def _advance_commit(self) -> None:
+        with self._lock:
+            if self.role != LEADER:
+                return
+            for n in range(len(self.log) - 1, self.commit_index, -1):
+                if self.log[n]["term"] != self.current_term:
+                    break  # only current-term entries commit by counting
+                replicas = 1 + sum(1 for m in self._match_index.values()
+                                   if m >= n)
+                if replicas > len(self.peers) // 2:
+                    self.commit_index = n
+                    break
+            self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        # caller holds the lock
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            entry = self.log[self.last_applied]
+            if entry["op"].get("type") != "noop":
+                try:
+                    self.apply_fn(entry["op"])
+                except Exception:
+                    logger.exception("raft %s: FSM apply failed at %d",
+                                     self.name, self.last_applied)
+        self._applied_cv.notify_all()
+
+    # -- RPC handlers (follower side) -----------------------------------------
+
+    def _handle_vote(self, payload: dict) -> dict:
+        with self._lock:
+            term = payload["term"]
+            if term > self.current_term:
+                self._become_follower(term)
+            granted = False
+            if term == self.current_term and \
+                    self.voted_for in (None, payload["candidate"]):
+                my_index, my_term = self._last_log()
+                up_to_date = (payload["last_log_term"], payload["last_log_index"]) \
+                    >= (my_term, my_index)
+                if up_to_date:
+                    granted = True
+                    self.voted_for = payload["candidate"]
+                    self._persist_meta()
+                    self._deadline = self._new_deadline()
+            return {"term": self.current_term, "granted": granted}
+
+    def _handle_append(self, payload: dict) -> dict:
+        with self._lock:
+            term = payload["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.role != FOLLOWER:
+                self._become_follower(term)
+            self.leader_id = payload["leader"]
+            self._deadline = self._new_deadline()
+
+            prev_i = payload["prev_index"]
+            if prev_i >= 0 and (prev_i >= len(self.log)
+                                or self.log[prev_i]["term"] != payload["prev_term"]):
+                return {"term": self.current_term, "success": False}
+            entries = payload["entries"]
+            insert = prev_i + 1
+            for k, e in enumerate(entries):
+                i = insert + k
+                if i < len(self.log):
+                    if self.log[i]["term"] != e["term"]:
+                        self._truncate_log(i)
+                        self.log.extend(entries[k:])
+                        self._persist_log(i)
+                        break
+                else:
+                    self.log.extend(entries[k:])
+                    self._persist_log(i)
+                    break
+            if payload["leader_commit"] > self.commit_index:
+                self.commit_index = min(payload["leader_commit"],
+                                        len(self.log) - 1)
+                self._apply_committed()
+            return {"term": self.current_term, "success": True}
+
+    def _handle_propose(self, payload: dict) -> dict:
+        """Leader-forwarded proposal endpoint (reference raft.go:26-38:
+        followers forward schema writes to the leader over gRPC)."""
+        index = self.propose_local(payload["op"], timeout=payload.get("timeout", 10.0))
+        return {"index": index}
+
+    # -- public API ----------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role == LEADER
+
+    def propose(self, op: dict, timeout: float = 10.0) -> int:
+        """Submit an FSM op; blocks until applied locally. Followers
+        forward to the leader."""
+        deadline = time.time() + timeout
+        last_err: Exception | None = None
+        while time.time() < deadline:
+            with self._lock:
+                role, leader = self.role, self.leader_id
+            if role == LEADER:
+                return self.propose_local(op, timeout=deadline - time.time())
+            if leader is not None:
+                try:
+                    reply = rpc(self.resolver(leader), "/raft/propose",
+                                {"op": op, "timeout": max(0.1, deadline - time.time())},
+                                timeout=max(0.1, deadline - time.time()))
+                    index = reply["index"]
+                    # wait until OUR node applies it too (read-your-writes
+                    # for schema; the reference schema manager reads its
+                    # local FSM after Raft apply)
+                    with self._applied_cv:
+                        while self.last_applied < index:
+                            if time.time() >= deadline:
+                                raise TimeoutError(
+                                    f"raft entry {index} committed on the "
+                                    "leader but not yet applied locally")
+                            self._applied_cv.wait(
+                                max(0.05, deadline - time.time()))
+                    return index
+                except (RpcError, KeyError) as e:
+                    last_err = e
+            time.sleep(0.05)
+        raise TimeoutError(f"raft propose timed out: {last_err}")
+
+    def propose_local(self, op: dict, timeout: float = 10.0) -> int:
+        with self._lock:
+            if self.role != LEADER:
+                raise NotLeaderError(self.leader_id)
+            index = len(self.log)
+            self.log.append({"term": self.current_term, "op": op})
+            self._persist_log(index)
+        # replicate eagerly rather than waiting a heartbeat
+        self._replicate_all()
+        deadline = time.time() + timeout
+        with self._applied_cv:
+            while self.last_applied < index:
+                if time.time() >= deadline:
+                    raise TimeoutError("raft commit timed out")
+                self._applied_cv.wait(max(0.05, deadline - time.time()))
+        return index
+
+    def wait_for_leader(self, timeout: float = 10.0) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                if self.leader_id is not None:
+                    return self.leader_id
+            time.sleep(0.05)
+        raise TimeoutError("no raft leader elected")

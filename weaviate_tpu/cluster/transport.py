@@ -1,0 +1,181 @@
+"""Internal cluster transport: JSON-over-HTTP on a dedicated port.
+
+Reference: adapters/handlers/rest/clusterapi/serve.go — a separate HTTP
+mux on CLUSTER_DATA_BIND_PORT carries all intra-cluster traffic (shard
+ops, replicas, backups); adapters/clients/* are the matching clients.
+Raft RPCs ride the same transport here (the reference uses gRPC for
+those; same boundary, different encoding).
+
+Numpy arrays cross the wire base64-encoded inside JSON ("b64npy"
+envelopes) — compact enough for control + small data payloads while
+staying dependency-free.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import io
+import json
+import logging
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+# -- numpy-aware JSON encoding -------------------------------------------------
+
+
+def encode_array(a: np.ndarray) -> dict:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(a), allow_pickle=False)
+    return {"__b64npy__": base64.b64encode(buf.getvalue()).decode("ascii")}
+
+
+def _decode_hook(d: dict):
+    if "__b64npy__" in d:
+        return np.load(io.BytesIO(base64.b64decode(d["__b64npy__"])),
+                       allow_pickle=False)
+    if "__b64__" in d:
+        return base64.b64decode(d["__b64__"])
+    return d
+
+
+class _Encoder(json.JSONEncoder):
+    def default(self, o):
+        if isinstance(o, np.ndarray):
+            return encode_array(o)
+        if isinstance(o, (np.integer,)):
+            return int(o)
+        if isinstance(o, (np.floating,)):
+            return float(o)
+        if isinstance(o, bytes):
+            return {"__b64__": base64.b64encode(o).decode("ascii")}
+        return super().default(o)
+
+
+def dumps(payload) -> bytes:
+    return json.dumps(payload, cls=_Encoder).encode()
+
+
+def loads(raw: bytes):
+    return json.loads(raw.decode(), object_hook=_decode_hook)
+
+
+# -- server --------------------------------------------------------------------
+
+
+class InternalServer:
+    """Route table + ThreadingHTTPServer. Handlers: fn(payload) -> payload.
+
+    Routes are exact paths ("/raft/vote") or prefixes ending in "/"
+    ("/indices/" receives (subpath, payload))."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.routes: dict[str, object] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                try:
+                    payload = loads(raw) if raw else {}
+                    result = outer.dispatch(self.path, payload)
+                    body = dumps(result)
+                    code = 200
+                except KeyError as e:
+                    body = dumps({"error": f"not found: {e}"})
+                    code = 404
+                except Exception as e:
+                    logger.exception("internal handler %s failed", self.path)
+                    body = dumps({"error": str(e)})
+                    code = 500
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def route(self, path: str, handler) -> None:
+        self.routes[path] = handler
+
+    def dispatch(self, path: str, payload):
+        handler = self.routes.get(path)
+        if handler is not None:
+            return handler(payload)
+        # longest-prefix match for "/prefix/" routes
+        best = None
+        for p in self.routes:
+            if p.endswith("/") and path.startswith(p):
+                if best is None or len(p) > len(best):
+                    best = p
+        if best is None:
+            raise KeyError(path)
+        return self.routes[best](path[len(best):], payload)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name=f"internal-{self.port}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread = None
+
+
+# -- client --------------------------------------------------------------------
+
+
+class RpcError(RuntimeError):
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
+
+
+def rpc(addr: str, path: str, payload=None, timeout: float = 10.0):
+    """POST ``payload`` to http://addr/path; raises RpcError on transport
+    or handler failure."""
+    host, _, port = addr.partition(":")
+    body = dumps(payload or {})
+    try:
+        conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+        try:
+            conn.request("POST", path, body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            raw = resp.read()
+        finally:
+            conn.close()
+    except (ConnectionError, socket.timeout, OSError) as e:
+        raise RpcError(f"rpc to {addr}{path} failed: {e}") from e
+    result = loads(raw)
+    if resp.status != 200:
+        raise RpcError(
+            result.get("error", f"status {resp.status}") if isinstance(result, dict)
+            else f"status {resp.status}",
+            status=resp.status)
+    return result

@@ -36,6 +36,14 @@ class SearchResult:
         return f"SearchResult({self.uuid}, dist={self.distance}, score={self.score})"
 
 
+def _remote_result(item: dict, shard_name: str) -> "SearchResult":
+    raw = item.get("object")
+    return SearchResult(
+        uuid=item["uuid"], distance=item.get("distance"),
+        score=item.get("score"), shard=shard_name,
+        object=StorageObject.from_bytes(raw) if raw else None)
+
+
 def _timed(query_type: str):
     """Record query latency per collection (reference: monitoring
     query-duration metric vecs, usecases/monitoring/prometheus.go)."""
@@ -56,13 +64,20 @@ class Collection:
     def __init__(self, data_dir: str, config: CollectionConfig,
                  sharding_state: ShardingState | None = None, mesh=None,
                  local_node: str = "node-0", on_sharding_change=None,
-                 memwatch=None):
+                 memwatch=None, remote=None, nodes_provider=None):
         config.validate()
         self.config = config
         self.data_dir = data_dir
         self.mesh = mesh
         self.local_node = local_node
         self.memwatch = memwatch
+        # cross-node data plane (reference: Index holds a
+        # sharding.RemoteIndexClient for non-local shards, index.go:1607)
+        self.remote = remote
+        self._nodes_provider = nodes_provider or (lambda: [local_node])
+        # cluster hook fn(collection_name, [tenant]) routing auto tenant
+        # creation through Raft; None = apply locally (single node)
+        self._auto_tenant_hook = None
         self._lock = threading.RLock()
         if sharding_state is None:
             if config.multi_tenancy.enabled:
@@ -70,6 +85,7 @@ class Collection:
             else:
                 sharding_state = ShardingState.create(
                     config.sharding.desired_count,
+                    nodes=self._nodes_provider(),
                     replication_factor=config.replication.factor,
                 )
         self.sharding = sharding_state
@@ -96,34 +112,81 @@ class Collection:
                                           memwatch=self.memwatch)
             return self.shards[name]
 
-    def _shard_for_write(self, uuid: str, tenant: str | None) -> Shard:
-        with self._lock:
-            name = self.sharding.shard_for(uuid, tenant)
-            if name not in self.shards:
-                if self.config.multi_tenancy.enabled:
-                    if tenant not in self.sharding.shard_names:
-                        if not self.config.multi_tenancy.auto_tenant_creation:
-                            raise KeyError(f"tenant {tenant!r} does not exist")
-                        self.sharding.add_tenant(tenant)
-                        self._on_sharding_change(self)
-                self._load_shard(name)
-            return self.shards[name]
-
-    def _target_shards(self, tenant: str | None) -> list[Shard]:
+    def _check_tenant(self, tenant: str | None) -> None:
         if self.config.multi_tenancy.enabled:
             if not tenant:
                 raise ValueError("multi-tenant collection requires a tenant")
             if tenant not in self.sharding.shard_names:
                 raise KeyError(f"tenant {tenant!r} does not exist")
-            return [self._load_shard(tenant)]
-        return [self._load_shard(n) for n in self.sharding.shard_names]
+
+    def _ensure_tenant_shard(self, tenant: str | None) -> None:
+        if not self.config.multi_tenancy.enabled:
+            return
+        with self._lock:
+            if tenant in self.sharding.shard_names:
+                return
+            if not self.config.multi_tenancy.auto_tenant_creation:
+                raise KeyError(f"tenant {tenant!r} does not exist")
+            hook = self._auto_tenant_hook
+            if hook is None:
+                self.sharding.add_tenant(
+                    tenant, nodes=self._nodes_provider(),
+                    replication_factor=self.config.replication.factor)
+                self._on_sharding_change(self)
+                return
+        # cluster mode: tenant creation must go through Raft so every node
+        # applies the same placement — a local-only mutation would diverge
+        # from the replica that has to accept the write. Called OUTSIDE the
+        # collection lock: the FSM apply (another thread on followers)
+        # needs that lock to install the tenant.
+        hook(self.config.name, [tenant])
+        if tenant not in self.sharding.shard_names:
+            raise RuntimeError(f"auto tenant creation for {tenant!r} did "
+                               "not converge")
+
+    def _require_remote(self, shard_name: str):
+        if self.remote is None:
+            raise RuntimeError(
+                f"shard {shard_name!r} is placed on "
+                f"{self.sharding.nodes_for(shard_name)} but node "
+                f"{self.local_node!r} has no remote client configured")
+        return self.remote
+
+    def _is_local(self, shard_name: str) -> bool:
+        return self.local_node in self.sharding.nodes_for(shard_name)
+
+    def _read_node(self, shard_name: str) -> str:
+        """Preferred replica for a read: local if we own it, else the
+        first placed node (reference: Finder picks the local/first
+        replica for direct reads)."""
+        if self._is_local(shard_name):
+            return self.local_node
+        return self.sharding.nodes_for(shard_name)[0]
+
+    def _target_shard_names(self, tenant: str | None) -> list[str]:
+        if self.config.multi_tenancy.enabled:
+            if not tenant:
+                raise ValueError("multi-tenant collection requires a tenant")
+            if tenant not in self.sharding.shard_names:
+                raise KeyError(f"tenant {tenant!r} does not exist")
+            return [tenant]
+        return list(self.sharding.shard_names)
+
+    def _target_shards(self, tenant: str | None) -> list[Shard]:
+        """LOCAL shards addressed by a query (all shards on a single
+        node; the locally-placed subset in a cluster)."""
+        return [self._load_shard(n) for n in self._target_shard_names(tenant)
+                if self._is_local(n)]
 
     # -- tenants -------------------------------------------------------------
 
-    def add_tenant(self, tenant: str):
+    def add_tenant(self, tenant: str, nodes: list[str] | None = None):
         with self._lock:
-            self.sharding.add_tenant(tenant)
-            self._load_shard(tenant)
+            self.sharding.add_tenant(
+                tenant, nodes=nodes or self._nodes_provider(),
+                replication_factor=self.config.replication.factor)
+            if self._is_local(tenant):
+                self._load_shard(tenant)
             self._on_sharding_change(self)
 
     def remove_tenant(self, tenant: str):
@@ -138,6 +201,26 @@ class Collection:
 
     # -- object CRUD ---------------------------------------------------------
 
+    def _write_to_shard(self, shard_name: str, objs: list[StorageObject]) -> None:
+        """Write a batch to every replica of one shard (reference: with
+        replication off, index.go:922 writes local or remote; the
+        replica.Replicator 2PC path refines this)."""
+        wrote = 0
+        for node in self.sharding.nodes_for(shard_name):
+            if node == self.local_node:
+                self._load_shard(shard_name).put_object_batch(objs)
+                wrote += 1
+            elif self.remote is not None:
+                self.remote.put_objects(node, self.config.name, shard_name,
+                                        [o.to_bytes() for o in objs])
+                wrote += 1
+        if wrote == 0:
+            raise RuntimeError(
+                f"no reachable replica for shard {shard_name!r} "
+                f"(placement {self.sharding.nodes_for(shard_name)}, "
+                f"local {self.local_node}, remote client "
+                f"{'set' if self.remote else 'missing'})")
+
     def put_object(self, properties: dict, vector=None, vectors: dict | None = None,
                    uuid: str | None = None, tenant: str | None = None) -> str:
         uuid = uuid or str(uuid_mod.uuid4())
@@ -146,8 +229,10 @@ class Collection:
             obj.vector = np.asarray(vector, dtype=np.float32)
         for name, vec in (vectors or {}).items():
             obj.vectors[name] = np.asarray(vec, dtype=np.float32)
-        shard = self._shard_for_write(uuid, tenant)
-        shard.put_object(obj)
+        if self.config.multi_tenancy.enabled:
+            self._ensure_tenant_shard(tenant)
+        shard_name = self.sharding.shard_for(uuid, tenant)
+        self._write_to_shard(shard_name, [obj])
         monitoring.objects_total.labels(self.config.name, "put").inc()
         return uuid
 
@@ -175,16 +260,9 @@ class Collection:
                                 "error": str(e)})
         for shard_name, objs in by_shard.items():
             try:
-                with self._lock:
-                    if (self.config.multi_tenancy.enabled
-                            and shard_name not in self.sharding.shard_names):
-                        if self.config.multi_tenancy.auto_tenant_creation:
-                            self.sharding.add_tenant(shard_name)
-                            self._on_sharding_change(self)
-                        else:
-                            raise KeyError(f"tenant {shard_name!r} does not exist")
-                    shard = self._load_shard(shard_name)
-                shard.put_object_batch(objs)
+                if self.config.multi_tenancy.enabled:
+                    self._ensure_tenant_shard(shard_name)
+                self._write_to_shard(shard_name, objs)
                 monitoring.objects_total.labels(self.config.name, "put"
                                                 ).inc(len(objs))
             except Exception as e:
@@ -194,29 +272,41 @@ class Collection:
         return results
 
     def get_object(self, uuid: str, tenant: str | None = None) -> StorageObject | None:
-        if self.config.multi_tenancy.enabled:
-            shard = self._target_shards(tenant)[0]
-            return shard.get_object(uuid)
+        self._check_tenant(tenant)
         name = self.sharding.shard_for(uuid, tenant)
-        if name not in self.shards:
-            return None
-        return self.shards[name].get_object(uuid)
+        if self._is_local(name):
+            return self._load_shard(name).get_object(uuid)
+        raw = self._require_remote(name).get_object(
+            self._read_node(name), self.config.name, name, uuid)
+        return None if raw is None else StorageObject.from_bytes(raw)
 
     def delete_object(self, uuid: str, tenant: str | None = None) -> bool:
-        if self.config.multi_tenancy.enabled:
-            ok = self._target_shards(tenant)[0].delete_object(uuid)
-        elif (name := self.sharding.shard_for(uuid, tenant)) not in self.shards:
-            ok = False
-        else:
-            ok = self.shards[name].delete_object(uuid)
+        self._check_tenant(tenant)
+        name = self.sharding.shard_for(uuid, tenant)
+        ok = False
+        for node in self.sharding.nodes_for(name):
+            if node == self.local_node:
+                ok = self._load_shard(name).delete_object(uuid) or ok
+            else:
+                ok = self._require_remote(name).delete_object(
+                    node, self.config.name, name, uuid) or ok
         if ok:
             monitoring.objects_total.labels(self.config.name, "delete").inc()
         return ok
 
     def object_count(self, tenant: str | None = None) -> int:
-        shards = self._target_shards(tenant) if (tenant or not
-                  self.config.multi_tenancy.enabled) else []
-        return sum(s.object_count() for s in shards)
+        """One replica per shard counts (replicas would double-count)."""
+        if self.config.multi_tenancy.enabled and not tenant:
+            return 0
+        total = 0
+        for name in self._target_shard_names(tenant):
+            if self._is_local(name):
+                total += self._load_shard(name).object_count()
+            elif self.remote is not None:
+                total += self.remote.overview(self._read_node(name),
+                                              self.config.name,
+                                              name)["object_count"]
+        return total
 
     def iter_objects(self, tenant: str | None = None):
         for shard in self._target_shards(tenant):
@@ -235,37 +325,58 @@ class Collection:
 
         if after is not None and sort:
             raise ValueError("'after' cursor cannot be combined with sort")
-        shards = self._target_shards(tenant)
+        names = self._target_shard_names(tenant)
+        where_dict = where.to_dict() if where is not None else None
         if sort:
             # property sort needs the values: materialize candidates
             objs: list[StorageObject] = []
-            for shard in shards:
-                mask = shard.allow_mask(where) if where is not None else None
-                for _key, raw in shard.objects.iter_items():
-                    obj = StorageObject.from_bytes(raw)
-                    if mask is not None and (obj.doc_id >= len(mask)
-                                             or not mask[obj.doc_id]):
-                        continue
-                    objs.append(obj)
+            for name in names:
+                if self._is_local(name):
+                    shard = self._load_shard(name)
+                    mask = shard.allow_mask(where) if where is not None else None
+                    for _key, raw in shard.objects.iter_items():
+                        obj = StorageObject.from_bytes(raw)
+                        if mask is not None and (obj.doc_id >= len(mask)
+                                                 or not mask[obj.doc_id]):
+                            continue
+                        objs.append(obj)
+                else:
+                    raws = self._require_remote(name).list_objects(
+                        self._read_node(name), self.config.name, name,
+                        where=where_dict)
+                    objs.extend(StorageObject.from_bytes(r) for r in raws)
             return sort_objects(objs, sort)[offset: offset + limit]
-        # uuid-ordered page: select uuids from the in-RAM docid map, only
-        # deserialize the page actually returned
-        candidates: list[tuple[str, Shard]] = []
-        for shard in shards:
-            mask = shard.allow_mask(where) if where is not None else None
-            with shard._lock:  # snapshot: writers mutate _doc_to_uuid
-                items = list(shard._doc_to_uuid.items())
-            for doc_id, uid in items:
-                if mask is not None and (doc_id >= len(mask) or not mask[doc_id]):
-                    continue
-                if after is not None and uid <= after:
-                    continue
-                candidates.append((uid, shard))
+        # uuid-ordered page: select uuids from the in-RAM docid map (or a
+        # remote page), only deserialize what is actually returned
+        candidates: list[tuple[str, object]] = []  # (uuid, shard name | obj)
+        for name in names:
+            if self._is_local(name):
+                shard = self._load_shard(name)
+                mask = shard.allow_mask(where) if where is not None else None
+                with shard._lock:  # snapshot: writers mutate _doc_to_uuid
+                    items = list(shard._doc_to_uuid.items())
+                for doc_id, uid in items:
+                    if mask is not None and (doc_id >= len(mask)
+                                             or not mask[doc_id]):
+                        continue
+                    if after is not None and uid <= after:
+                        continue
+                    candidates.append((uid, name))
+            else:
+                # each remote shard over-fetches its own first offset+limit
+                # matching objects; the merge below trims to the page
+                raws = self._require_remote(name).list_objects(
+                    self._read_node(name), self.config.name, name,
+                    limit=offset + limit, after=after, where=where_dict)
+                for raw in raws:
+                    obj = StorageObject.from_bytes(raw)
+                    candidates.append((obj.uuid, obj))
         candidates.sort(key=lambda t: t[0])
         page = candidates[offset: offset + limit]
         out = []
-        for uid, shard in page:
-            obj = shard.get_object(uid)
+        for uid, src in page:
+            obj = src if isinstance(src, StorageObject) else \
+                self._load_shard(src).get_object(uid)
             if obj is not None:
                 out.append(obj)
         return out
@@ -296,7 +407,13 @@ class Collection:
             partials = [aggregate_objects((r.object for r in hits if r.object),
                                           properties, group_by)]
         else:
-            def one(shard: Shard):
+            def one(name: str):
+                if not self._is_local(name):
+                    return self._require_remote(name).aggregate(
+                        self._read_node(name), self.config.name, name,
+                        properties, group_by,
+                        where.to_dict() if where is not None else None)
+                shard = self._load_shard(name)
                 mask = shard.allow_mask(where) if where is not None else None
 
                 def objs():
@@ -309,13 +426,33 @@ class Collection:
 
                 return aggregate_objects(objs(), properties, group_by)
 
-            shards = self._target_shards(tenant)
-            partials = [one(shards[0])] if len(shards) == 1 else \
-                list(self._pool.map(one, shards))
+            names = self._target_shard_names(tenant)
+            partials = [one(names[0])] if len(names) == 1 else \
+                list(self._pool.map(one, names))
         return finalize_aggregation(combine_partials(partials), requested,
                                     top_occurrences_limit)
 
     # -- search --------------------------------------------------------------
+
+    def _attach_objects(self, results: list[SearchResult]) -> None:
+        """Fill in .object for results that don't carry one yet — local
+        lookup, or ONE batched remote get per non-local shard (not one
+        RPC per result)."""
+        missing: dict[str, list[SearchResult]] = {}
+        for r in results:
+            if r.object is None:
+                missing.setdefault(r.shard, []).append(r)
+        for name, rs in missing.items():
+            if self._is_local(name):
+                shard = self._load_shard(name)
+                for r in rs:
+                    r.object = shard.get_object(r.uuid)
+            else:
+                raws = self._require_remote(name).get_objects(
+                    self._read_node(name), self.config.name, name,
+                    [r.uuid for r in rs])
+                for r, raw in zip(rs, raws):
+                    r.object = StorageObject.from_bytes(raw) if raw else None
 
     @staticmethod
     def _and_masks(a, b) -> np.ndarray:
@@ -346,46 +483,49 @@ class Collection:
         ``where``: optional Filter tree, evaluated per shard to an AllowList
         mask applied inside the device scan."""
         query = np.asarray(query, dtype=np.float32)
-        shards = self._target_shards(tenant)
+        names = self._target_shard_names(tenant)
 
-        def one(shard: Shard):
-            allow = None if allow_list_by_shard is None else \
-                allow_list_by_shard.get(shard.name)
-            if where is not None:
-                fmask = shard.allow_mask(where)
-                allow = fmask if allow is None else \
-                    self._and_masks(allow, fmask)
-            ids, dists = shard.vector_search(query, k, vec_name, allow)
-            return shard, ids, dists
+        def one(name: str) -> list[SearchResult]:
+            if self._is_local(name):
+                shard = self._load_shard(name)
+                allow = None if allow_list_by_shard is None else \
+                    allow_list_by_shard.get(name)
+                if where is not None:
+                    fmask = shard.allow_mask(where)
+                    allow = fmask if allow is None else \
+                        self._and_masks(allow, fmask)
+                ids, dists = shard.vector_search(query, k, vec_name, allow)
+                out = []
+                for doc_id, dist in zip(ids.tolist(), dists.tolist()):
+                    uuid = shard._doc_to_uuid.get(doc_id)
+                    if uuid is not None:
+                        out.append(SearchResult(uuid=uuid, distance=dist,
+                                                shard=name))
+                return out
+            # remote shard: the owning node evaluates filters and resolves
+            # objects (reference: remote.SearchShard, index.go:1607)
+            items = self._require_remote(name).search_shard(
+                self._read_node(name), self.config.name, name,
+                vector=query, k=k, vec_name=vec_name,
+                where=where.to_dict() if where is not None else None,
+                include_objects=include_objects)
+            return [_remote_result(i, name) for i in items]
 
-        if len(shards) == 1:
-            gathered = [one(shards[0])]
-        else:
-            gathered = list(self._pool.map(one, shards))
+        gathered = [one(names[0])] if len(names) == 1 else \
+            list(self._pool.map(one, names))
 
-        merged: list[tuple[float, int, Shard]] = []
-        for shard, ids, dists in gathered:
-            for doc_id, dist in zip(ids.tolist(), dists.tolist()):
-                merged.append((dist, doc_id, shard))
-        merged.sort(key=lambda t: t[0])
+        merged = [r for results in gathered for r in results]
+        merged.sort(key=lambda r: r.distance)
         merged = merged[:k]
         if max_distance is not None:
-            merged = [m for m in merged if m[0] <= max_distance]
+            merged = [r for r in merged if r.distance <= max_distance]
         if autocut > 0 and merged:
             from weaviate_tpu.query.autocut import autocut as _autocut
 
-            merged = merged[: _autocut([m[0] for m in merged], autocut)]
-
-        out = []
-        for dist, doc_id, shard in merged:
-            uuid = shard._doc_to_uuid.get(doc_id)
-            if uuid is None:
-                continue
-            res = SearchResult(uuid=uuid, distance=dist, shard=shard.name)
-            if include_objects:
-                res.object = shard.get_object(uuid)
-            out.append(res)
-        return out
+            merged = merged[: _autocut([r.distance for r in merged], autocut)]
+        if include_objects:
+            self._attach_objects(merged)
+        return merged
 
     @_timed("bm25")
     def bm25(self, query: str, k: int = 10, properties: list[str] | None = None,
@@ -394,40 +534,45 @@ class Collection:
              where=None, autocut: int = 0) -> list[SearchResult]:
         """Scatter-gather keyword search; merge by score descending
         (reference: Index.objectSearch → per-shard BM25 → merge)."""
-        shards = self._target_shards(tenant)
+        names = self._target_shard_names(tenant)
 
-        def one(shard: Shard):
-            allow = None if allow_list_by_shard is None else \
-                allow_list_by_shard.get(shard.name)
-            if where is not None:
-                fmask = shard.allow_mask(where)
-                allow = fmask if allow is None else \
-                    self._and_masks(allow, fmask)
-            ids, scores = shard.bm25_search(query, k, properties, allow)
-            return shard, ids, scores
+        def one(name: str) -> list[SearchResult]:
+            if self._is_local(name):
+                shard = self._load_shard(name)
+                allow = None if allow_list_by_shard is None else \
+                    allow_list_by_shard.get(name)
+                if where is not None:
+                    fmask = shard.allow_mask(where)
+                    allow = fmask if allow is None else \
+                        self._and_masks(allow, fmask)
+                ids, scores = shard.bm25_search(query, k, properties, allow)
+                out = []
+                for doc_id, score in zip(ids.tolist(), scores.tolist()):
+                    uuid = shard._doc_to_uuid.get(doc_id)
+                    if uuid is not None:
+                        out.append(SearchResult(uuid=uuid, score=score,
+                                                shard=name))
+                return out
+            items = self._require_remote(name).search_shard(
+                self._read_node(name), self.config.name, name,
+                query=query, k=k, properties=properties,
+                where=where.to_dict() if where is not None else None,
+                include_objects=include_objects)
+            return [_remote_result(i, name) for i in items]
 
-        gathered = [one(shards[0])] if len(shards) == 1 else \
-            list(self._pool.map(one, shards))
+        gathered = [one(names[0])] if len(names) == 1 else \
+            list(self._pool.map(one, names))
 
-        merged: list[tuple[float, int, Shard]] = []
-        for shard, ids, scores in gathered:
-            merged.extend(zip(scores.tolist(), ids.tolist(), [shard] * len(ids)))
-        merged.sort(key=lambda t: -t[0])
+        merged = [r for results in gathered for r in results]
+        merged.sort(key=lambda r: -r.score)
         merged = merged[:k]
         if autocut > 0 and merged:
             from weaviate_tpu.query.autocut import autocut as _autocut
 
-            merged = merged[: _autocut([-m[0] for m in merged], autocut)]
-        out = []
-        for score, doc_id, shard in merged:
-            uuid = shard._doc_to_uuid.get(doc_id)
-            if uuid is None:
-                continue
-            res = SearchResult(uuid=uuid, score=score, shard=shard.name)
-            if include_objects:
-                res.object = shard.get_object(uuid)
-            out.append(res)
-        return out
+            merged = merged[: _autocut([-r.score for r in merged], autocut)]
+        if include_objects:
+            self._attach_objects(merged)
+        return merged
 
     @_timed("hybrid")
     def hybrid(self, query: str, vector=None, alpha: float = 0.75, k: int = 10,
@@ -449,11 +594,17 @@ class Collection:
         if vector is None:
             alpha = 0.0  # degrade to sparse-only (reference does the same
             # when no vectorizer can produce a query vector)
-        # evaluate the filter once per shard; both legs reuse the masks
+        # evaluate the filter once per shard and let both legs reuse the
+        # masks — only possible when every target shard is local; with
+        # remote shards the filter tree travels down instead
         allow_by_shard = None
+        where_down = where
         if where is not None:
-            allow_by_shard = {s.name: s.allow_mask(where)
-                              for s in self._target_shards(tenant)}
+            names = self._target_shard_names(tenant)
+            if all(self._is_local(n) for n in names):
+                allow_by_shard = {n: self._load_shard(n).allow_mask(where)
+                                  for n in names}
+                where_down = None
 
         fetch = max(k * 10, 100)
         legs, weights = [], []
@@ -472,12 +623,12 @@ class Collection:
             threads.append(_threading.Thread(
                 target=run, args=("sparse", self.bm25, query, fetch,
                                   properties, tenant, False, allow_by_shard,
-                                  None)))
+                                  where_down)))
         if vector is not None and alpha > 0.0:
             threads.append(_threading.Thread(
                 target=run, args=("dense", self.near_vector, vector, fetch,
                                   vec_name, tenant, False, allow_by_shard,
-                                  None, None)))
+                                  None, where_down)))
         for t in threads:
             t.start()
         for t in threads:
@@ -504,11 +655,7 @@ class Collection:
 
             fused = autocut_results(fused, autocut, by="score")
         if include_objects:
-            by_shard = {s.name: s for s in self._target_shards(tenant)}
-            for r in fused:
-                shard = by_shard.get(r.shard)
-                if shard is not None:
-                    r.object = shard.get_object(r.uuid)
+            self._attach_objects(fused)
         return fused
 
     # -- maintenance ---------------------------------------------------------

@@ -22,10 +22,15 @@ class Database:
     def __init__(self, data_dir: str = "./data", mesh=None,
                  local_node: str = "node-0", start_cycles: bool = False,
                  maintenance_interval: float = 5.0,
-                 memory_monitor=None):
+                 memory_monitor=None, remote=None, nodes_provider=None):
         self.data_dir = data_dir
         self.mesh = mesh
         self.local_node = local_node
+        self.remote = remote
+        self.nodes_provider = nodes_provider or (lambda: [local_node])
+        # cluster hook fn(collection, [tenant]): routes auto tenant
+        # creation through Raft (set by ClusterNode); None = local apply
+        self.auto_tenant_hook = None
         os.makedirs(data_dir, exist_ok=True)
         self._lock = threading.RLock()
         self._schema_store = KVStore(os.path.join(data_dir, "_schema"))
@@ -56,26 +61,43 @@ class Database:
             d = self._schema.get(key)
             cfg = CollectionConfig.from_dict(d["config"])
             state = ShardingState.from_dict(d["sharding"])
-            self.collections[cfg.name] = Collection(
+            col = Collection(
                 self.data_dir, cfg, sharding_state=state, mesh=self.mesh,
                 local_node=self.local_node, on_sharding_change=self._persist,
-                memwatch=self.memwatch,
+                memwatch=self.memwatch, remote=self.remote,
+                nodes_provider=self.nodes_provider,
             )
+            col._auto_tenant_hook = self.auto_tenant_hook
+            self.collections[cfg.name] = col
 
     # -- schema ops (the Raft FSM op set, cluster/store_apply.go:133-160) ----
 
-    def create_collection(self, config: CollectionConfig) -> Collection:
+    def create_collection(self, config: CollectionConfig,
+                          sharding_state=None) -> Collection:
+        """``sharding_state`` is provided when the placement was computed
+        elsewhere (the Raft proposer computes it once so every node
+        applies an identical placement — reference: GetPartitions runs in
+        the schema handler BEFORE the Raft submit)."""
         config.validate()
         with self._lock:
             if config.name in self.collections:
                 raise ValueError(f"collection {config.name!r} already exists")
-            col = Collection(self.data_dir, config, mesh=self.mesh,
+            col = Collection(self.data_dir, config,
+                             sharding_state=sharding_state, mesh=self.mesh,
                              local_node=self.local_node,
                              on_sharding_change=self._persist,
-                             memwatch=self.memwatch)
+                             memwatch=self.memwatch, remote=self.remote,
+                             nodes_provider=self.nodes_provider)
+            col._auto_tenant_hook = self.auto_tenant_hook
             self.collections[config.name] = col
             self._persist(col)
             return col
+
+    def set_auto_tenant_hook(self, hook) -> None:
+        with self._lock:
+            self.auto_tenant_hook = hook
+            for col in self.collections.values():
+                col._auto_tenant_hook = hook
 
     def delete_collection(self, name: str) -> bool:
         with self._lock:
