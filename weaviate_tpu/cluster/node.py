@@ -40,6 +40,9 @@ class ClusterNode:
                            remote=self.remote,
                            nodes_provider=self.membership.alive_nodes)
         register_incoming(self.server, self.db)
+        from weaviate_tpu.replication import register_replication
+
+        register_replication(self.server, self.db)
         self.fsm = SchemaFSM(self.db)
         raft_bucket = self.db._schema_store.bucket("raft", "replace")
         self.raft = RaftNode(name, raft_peers, self.membership.resolve,
@@ -59,6 +62,20 @@ class ClusterNode:
             self.membership.join(seed_addrs)
         self.membership.start()
         self.raft.start()
+        # anti-entropy beat over all replicated collections
+        # (reference: shard_hashbeater launched per shard at shard load)
+        self.db.cycles.register("hashbeat", self._hashbeat_cycle,
+                                interval=5.0, max_interval=60.0)
+        self.db.cycles.start()
+
+    def _hashbeat_cycle(self) -> bool:
+        from weaviate_tpu.replication import HashBeater
+
+        did = False
+        for col in list(self.db.collections.values()):
+            if col.config.replication.factor > 1:
+                did = HashBeater(col).beat() or did
+        return did
 
     def close(self) -> None:
         self.raft.stop()

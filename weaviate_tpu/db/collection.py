@@ -201,28 +201,28 @@ class Collection:
 
     # -- object CRUD ---------------------------------------------------------
 
-    def _write_to_shard(self, shard_name: str, objs: list[StorageObject]) -> None:
-        """Write a batch to every replica of one shard (reference: with
-        replication off, index.go:922 writes local or remote; the
-        replica.Replicator 2PC path refines this)."""
-        wrote = 0
-        for node in self.sharding.nodes_for(shard_name):
-            if node == self.local_node:
-                self._load_shard(shard_name).put_object_batch(objs)
-                wrote += 1
-            elif self.remote is not None:
-                self.remote.put_objects(node, self.config.name, shard_name,
-                                        [o.to_bytes() for o in objs])
-                wrote += 1
-        if wrote == 0:
-            raise RuntimeError(
-                f"no reachable replica for shard {shard_name!r} "
-                f"(placement {self.sharding.nodes_for(shard_name)}, "
-                f"local {self.local_node}, remote client "
-                f"{'set' if self.remote else 'missing'})")
+    def _write_to_shard(self, shard_name: str, objs: list[StorageObject],
+                        consistency: str = "QUORUM") -> None:
+        """Write a batch to the shard's replicas. Replicated shards take
+        the 2PC coordinator (reference: replica.Replicator, replicator.go:57);
+        single-replica shards write directly (index.go:922)."""
+        nodes = self.sharding.nodes_for(shard_name)
+        if len(nodes) > 1:
+            from weaviate_tpu.replication import Replicator
+
+            Replicator(self).put_objects(shard_name, objs, consistency)
+            return
+        node = nodes[0]
+        if node == self.local_node:
+            self._load_shard(shard_name).put_object_batch(objs)
+        else:
+            self._require_remote(shard_name).put_objects(
+                node, self.config.name, shard_name,
+                [o.to_bytes() for o in objs])
 
     def put_object(self, properties: dict, vector=None, vectors: dict | None = None,
-                   uuid: str | None = None, tenant: str | None = None) -> str:
+                   uuid: str | None = None, tenant: str | None = None,
+                   consistency: str = "QUORUM") -> str:
         uuid = uuid or str(uuid_mod.uuid4())
         obj = StorageObject(uuid=uuid, properties=properties)
         if vector is not None:
@@ -232,7 +232,7 @@ class Collection:
         if self.config.multi_tenancy.enabled:
             self._ensure_tenant_shard(tenant)
         shard_name = self.sharding.shard_for(uuid, tenant)
-        self._write_to_shard(shard_name, [obj])
+        self._write_to_shard(shard_name, [obj], consistency)
         monitoring.objects_total.labels(self.config.name, "put").inc()
         return uuid
 
@@ -271,25 +271,37 @@ class Collection:
                                   "error": str(e)}
         return results
 
-    def get_object(self, uuid: str, tenant: str | None = None) -> StorageObject | None:
+    def get_object(self, uuid: str, tenant: str | None = None,
+                   consistency: str | None = None) -> StorageObject | None:
+        """``consistency``: None = direct read from the preferred replica;
+        a level (ONE/QUORUM/ALL) = digest-compared read with read repair
+        (reference: Finder.Pull, coordinator.go:178)."""
         self._check_tenant(tenant)
         name = self.sharding.shard_for(uuid, tenant)
+        if consistency is not None and len(self.sharding.nodes_for(name)) > 1:
+            from weaviate_tpu.replication import Finder
+
+            return Finder(self).get_object(uuid, name, consistency)
         if self._is_local(name):
             return self._load_shard(name).get_object(uuid)
         raw = self._require_remote(name).get_object(
             self._read_node(name), self.config.name, name, uuid)
         return None if raw is None else StorageObject.from_bytes(raw)
 
-    def delete_object(self, uuid: str, tenant: str | None = None) -> bool:
+    def delete_object(self, uuid: str, tenant: str | None = None,
+                      consistency: str = "QUORUM") -> bool:
         self._check_tenant(tenant)
         name = self.sharding.shard_for(uuid, tenant)
-        ok = False
-        for node in self.sharding.nodes_for(name):
-            if node == self.local_node:
-                ok = self._load_shard(name).delete_object(uuid) or ok
-            else:
-                ok = self._require_remote(name).delete_object(
-                    node, self.config.name, name, uuid) or ok
+        nodes = self.sharding.nodes_for(name)
+        if len(nodes) > 1:
+            from weaviate_tpu.replication import Replicator
+
+            ok = Replicator(self).delete(name, uuid, consistency)
+        elif nodes[0] == self.local_node:
+            ok = self._load_shard(name).delete_object(uuid)
+        else:
+            ok = self._require_remote(name).delete_object(
+                nodes[0], self.config.name, name, uuid)
         if ok:
             monitoring.objects_total.labels(self.config.name, "delete").inc()
         return ok

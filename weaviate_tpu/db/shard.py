@@ -106,6 +106,11 @@ class Shard:
         self.objects = self.store.bucket(BUCKET_OBJECTS, "replace")
         self.docid = self.store.bucket(BUCKET_DOCID, "replace")
         self.meta = self.store.bucket(BUCKET_META, "replace")
+        # deletion tombstones (uuid -> mtime ms) so anti-entropy can tell
+        # "deleted here" from "never seen" and not resurrect deletes
+        self.tombstones = self.store.bucket("tombstones", "replace")
+        # staged 2PC batches: request id -> ("put", [objs]) | ("delete", uuid)
+        self._staged: dict[str, tuple] = {}
         self._counter = self.meta.get(b"doc_counter") or 0
         self.mesh = mesh
         # named vector indexes, built lazily at first insert (dim inference)
@@ -226,6 +231,7 @@ class Shard:
                 if old_raw is not None:
                     self._delete_doc(int(old_raw), obj.uuid)
                 obj.doc_id = self._next_doc_id()
+                self.tombstones.delete(obj.uuid.encode())
                 self.docid.put(obj.uuid.encode(), obj.doc_id)
                 self._doc_to_uuid[obj.doc_id] = obj.uuid
                 self.objects.put(obj.uuid.encode(), obj.to_bytes())
@@ -250,7 +256,9 @@ class Shard:
             self._inverted.unindex_object(old)
         self._doc_to_uuid.pop(doc_id, None)
 
-    def delete_object(self, uuid: str) -> bool:
+    def delete_object(self, uuid: str, tombstone_ms: int | None = None) -> bool:
+        import time as _time
+
         with self._lock:
             raw = self.docid.get(uuid.encode())
             if raw is None:
@@ -258,6 +266,8 @@ class Shard:
             self._delete_doc(int(raw), uuid)
             self.docid.delete(uuid.encode())
             self.objects.delete(uuid.encode())
+            self.tombstones.put(uuid.encode(),
+                                tombstone_ms or int(_time.time() * 1000))
             return True
 
     # -- read path -----------------------------------------------------------
@@ -321,6 +331,112 @@ class Shard:
 
         with self._lock:
             return compute_allow_mask(where, self._inverted, self.doc_id_space)
+
+    # -- replication support -------------------------------------------------
+
+    def stage(self, request_id: str, task: tuple) -> None:
+        """2PC prepare: hold a write until commit/abort
+        (reference: replica store staging before commit)."""
+        with self._lock:
+            self._staged[request_id] = task
+
+    def commit_staged(self, request_id: str):
+        with self._lock:
+            task = self._staged.pop(request_id, None)
+        if task is None:
+            raise KeyError(f"unknown replication request {request_id!r}")
+        kind = task[0]
+        if kind == "put":
+            return self.put_object_batch(task[1])
+        if kind == "delete":
+            return self.delete_object(task[1], tombstone_ms=task[2])
+        raise ValueError(f"unknown staged task kind {kind!r}")
+
+    def abort_staged(self, request_id: str) -> None:
+        with self._lock:
+            self._staged.pop(request_id, None)
+
+    def object_digest(self, uuid: str) -> dict | None:
+        """Replica-comparable digest (reference: Finder digest reads,
+        repairer.go). None = never seen here."""
+        raw = self.objects.get(uuid.encode())
+        if raw is not None:
+            obj = StorageObject.from_bytes(raw)
+            return {"uuid": uuid, "mtime": obj.last_update_time_ms,
+                    "deleted": False, "hash": obj.content_hash()}
+        ts = self.tombstones.get(uuid.encode())
+        if ts is not None:
+            return {"uuid": uuid, "mtime": int(ts), "deleted": True,
+                    "hash": b""}
+        return None
+
+    def iter_digests(self):
+        with self._lock:
+            uuids = list(self._doc_to_uuid.values())
+            tombs = [(k.decode(), int(v)) for k, v in
+                     ((k, self.tombstones.get(k)) for k in
+                      self.tombstones.keys()) if v is not None]
+        for uuid in uuids:
+            d = self.object_digest(uuid)
+            if d is not None and not d["deleted"]:
+                yield d
+        for uuid, ts in tombs:
+            yield {"uuid": uuid, "mtime": ts, "deleted": True, "hash": b""}
+
+    def build_hashtree(self, depth: int = 8):
+        """Merkle tree over all digests (reference: shard hashtree kept
+        by the hashbeater; we rebuild per beat — object counts per shard
+        make this cheap relative to the network round-trips saved)."""
+        from weaviate_tpu.replication.hashtree import MerkleTree
+
+        tree = MerkleTree(depth)
+        for d in self.iter_digests():
+            tree.insert(d["uuid"], d["mtime"], d["deleted"], d["hash"])
+        return tree
+
+    def bucket_digests(self, depth: int, buckets: list[int]) -> list[dict]:
+        """Digest entries falling into the given hashtree leaf buckets."""
+        from weaviate_tpu.replication.hashtree import MerkleTree
+
+        want = set(buckets)
+        return [d for d in self.iter_digests()
+                if MerkleTree.bucket_of(d["uuid"], depth) in want]
+
+    def apply_sync(self, raw_objects: list[bytes],
+                   deletes: list[dict]) -> int:
+        """Apply newer peer state (anti-entropy propagation). Winner per
+        uuid decided by digest_rank (mtime, tombstone-beats-object,
+        content-hash tie-break)."""
+        from weaviate_tpu.replication.hashtree import digest_rank
+
+        applied = 0
+        with self._lock:
+            for raw in raw_objects:
+                obj = StorageObject.from_bytes(raw)
+                mine = self.object_digest(obj.uuid)
+                incoming = {"mtime": obj.last_update_time_ms,
+                            "deleted": False, "hash": obj.content_hash()}
+                if mine is not None and digest_rank(mine) >= digest_rank(incoming):
+                    continue
+                obj.doc_id = 0  # re-assigned locally
+                self.put_object_batch([obj])
+                applied += 1
+            for d in deletes:
+                mine = self.object_digest(d["uuid"])
+                incoming = {"mtime": d["mtime"], "deleted": True, "hash": b""}
+                if mine is None:
+                    # never saw it: record the tombstone so our tree converges
+                    self.tombstones.put(d["uuid"].encode(), d["mtime"])
+                    applied += 1
+                    continue
+                if digest_rank(mine) >= digest_rank(incoming):
+                    continue
+                if mine["deleted"]:
+                    self.tombstones.put(d["uuid"].encode(), d["mtime"])
+                else:
+                    self.delete_object(d["uuid"], tombstone_ms=d["mtime"])
+                applied += 1
+        return applied
 
     # -- maintenance ---------------------------------------------------------
 

@@ -1,0 +1,103 @@
+"""Consistent reads with digest comparison + read repair.
+
+Reference: usecases/replica coordinator.go:178 (Finder.Pull): fetch the
+full object from one replica and digests from the others, compare, and
+if replicas disagree return the newest version and push it to the stale
+replicas (repairer.go).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from weaviate_tpu.cluster.transport import RpcError, rpc
+from weaviate_tpu.replication.replicator import ConsistencyError, required_acks
+from weaviate_tpu.storage.objects import StorageObject
+
+logger = logging.getLogger(__name__)
+
+
+class Finder:
+    def __init__(self, collection):
+        self.col = collection
+
+    def _digest(self, node: str, shard_name: str, uuid: str) -> dict | None:
+        if node == self.col.local_node:
+            return self.col._load_shard(shard_name).object_digest(uuid)
+        remote = self.col._require_remote(shard_name)
+        return rpc(remote.resolver(node),
+                   f"/replicas/{self.col.config.name}/{shard_name}/digest",
+                   {"uuid": uuid}, timeout=remote.timeout).get("digest")
+
+    def _fetch(self, node: str, shard_name: str, uuid: str) -> bytes | None:
+        if node == self.col.local_node:
+            return self.col._load_shard(shard_name).objects.get(uuid.encode())
+        remote = self.col._require_remote(shard_name)
+        return rpc(remote.resolver(node),
+                   f"/replicas/{self.col.config.name}/{shard_name}/objects:fetch",
+                   {"uuids": [uuid]}, timeout=remote.timeout)["objects"][0]
+
+    def _repair(self, node: str, shard_name: str, raw: bytes | None,
+                delete: dict | None) -> None:
+        try:
+            if node == self.col.local_node:
+                self.col._load_shard(shard_name).apply_sync(
+                    [raw] if raw else [], [delete] if delete else [])
+                return
+            remote = self.col._require_remote(shard_name)
+            rpc(remote.resolver(node),
+                f"/replicas/{self.col.config.name}/{shard_name}/sync:apply",
+                {"objects": [raw] if raw else [],
+                 "deletes": [delete] if delete else []},
+                timeout=remote.timeout)
+        except (RpcError, KeyError):
+            logger.warning("read repair push to %s/%s failed", node, shard_name)
+
+    def get_object(self, uuid: str, shard_name: str,
+                   level: str = "QUORUM") -> StorageObject | None:
+        """Read at a consistency level; repairs stale replicas as a side
+        effect (reference: Finder.Pull + repairer)."""
+        nodes = self.col.sharding.nodes_for(shard_name)
+        need = required_acks(level, len(nodes))
+        digests: dict[str, dict | None] = {}
+        errors = []
+        for node in nodes:
+            if len(digests) >= need and level != "ALL":
+                # enough replicas answered for the level — but keep going
+                # only if we still need votes
+                break
+            try:
+                digests[node] = self._digest(node, shard_name, uuid)
+            except (RpcError, KeyError) as e:
+                errors.append(f"{node}: {e}")
+        if len(digests) < need:
+            raise ConsistencyError(
+                f"{len(digests)}/{len(nodes)} replicas answered, need "
+                f"{need} for {level}: {'; '.join(errors)}")
+
+        # winner by digest_rank: newest mtime, tombstone beats object at
+        # a tie, content hash as the deterministic tie-break
+        from weaviate_tpu.replication.hashtree import digest_rank
+
+        seen = {n: d for n, d in digests.items() if d is not None}
+        if not seen:
+            return None
+        winner_node, winner = max(seen.items(),
+                                  key=lambda kv: digest_rank(kv[1]))
+
+        stale = [n for n, d in digests.items()
+                 if d is None or digest_rank(d) < digest_rank(winner)]
+
+        if winner["deleted"]:
+            for node in stale:
+                self._repair(node, shard_name, None,
+                             {"uuid": uuid, "mtime": winner["mtime"]})
+            return None
+        raw = self._fetch(winner_node, shard_name, uuid)
+        if raw is None:
+            return None
+        if stale:
+            logger.info("read repair: %s stale for %s", stale, uuid)
+            for node in stale:
+                self._repair(node, shard_name, raw, None)
+        return StorageObject.from_bytes(raw)

@@ -1,0 +1,109 @@
+"""Anti-entropy: background Merkle diff + object propagation.
+
+Reference: adapters/repos/db/shard_hashbeater.go:32,216 — each shard
+periodically compares its hashtree with every peer replica
+(CollectShardDifferences), fetches digests for the differing ranges,
+and propagates whichever side is newer. Runs on the cycle manager.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from weaviate_tpu.cluster.transport import RpcError, rpc
+from weaviate_tpu.replication.hashtree import MerkleTree, digest_rank
+
+logger = logging.getLogger(__name__)
+
+
+class HashBeater:
+    def __init__(self, collection, depth: int = 8):
+        self.col = collection
+        self.depth = depth
+
+    def _peer_rpc(self, node: str, shard_name: str, op: str, payload: dict):
+        remote = self.col._require_remote(shard_name)
+        return rpc(remote.resolver(node),
+                   f"/replicas/{self.col.config.name}/{shard_name}/{op}",
+                   payload, timeout=remote.timeout)
+
+    def beat_shard(self, shard_name: str) -> int:
+        """One anti-entropy round for one locally-owned shard against all
+        peer replicas. Returns number of entries reconciled."""
+        shard = self.col._load_shard(shard_name)
+        peers = [n for n in self.col.sharding.nodes_for(shard_name)
+                 if n != self.col.local_node]
+        if not peers:
+            return 0
+        total = 0
+        tree = shard.build_hashtree(self.depth)
+        for peer in peers:
+            try:
+                total += self._beat_peer(shard, tree, shard_name, peer)
+            except (RpcError, KeyError) as e:
+                logger.debug("hashbeat %s/%s vs %s skipped: %s",
+                             self.col.config.name, shard_name, peer, e)
+        return total
+
+    def _beat_peer(self, shard, tree: MerkleTree, shard_name: str,
+                   peer: str) -> int:
+        def peer_level(level: int, positions: list[int]):
+            return self._peer_rpc(peer, shard_name, "hashtree:level",
+                                  {"depth": self.depth, "level": level,
+                                   "positions": positions})["hashes"]
+
+        buckets = tree.diff_buckets(peer_level)
+        if not buckets:
+            return 0
+        theirs = {d["uuid"]: d for d in
+                  self._peer_rpc(peer, shard_name, "digests:bucket",
+                                 {"depth": self.depth,
+                                  "buckets": buckets})["digests"]}
+        mine = {d["uuid"]: d for d in shard.bucket_digests(self.depth, buckets)}
+
+        push_objs: list[str] = []
+        push_dels: list[dict] = []
+        pull_uuids: list[str] = []
+        pull_dels: list[dict] = []
+        for uuid in set(mine) | set(theirs):
+            m, t = mine.get(uuid), theirs.get(uuid)
+            if t is None or (m is not None and digest_rank(m) > digest_rank(t)):
+                if m["deleted"]:
+                    push_dels.append({"uuid": uuid, "mtime": m["mtime"]})
+                else:
+                    push_objs.append(uuid)
+            elif m is None or digest_rank(t) > digest_rank(m):
+                if t["deleted"]:
+                    pull_dels.append({"uuid": uuid, "mtime": t["mtime"]})
+                else:
+                    pull_uuids.append(uuid)
+
+        n = 0
+        if push_objs or push_dels:
+            raws = [shard.objects.get(u.encode()) for u in push_objs]
+            n += self._peer_rpc(peer, shard_name, "sync:apply",
+                                {"objects": [r for r in raws if r],
+                                 "deletes": push_dels})["applied"]
+        if pull_uuids or pull_dels:
+            raws = self._peer_rpc(peer, shard_name, "objects:fetch",
+                                  {"uuids": pull_uuids})["objects"] \
+                if pull_uuids else []
+            n += shard.apply_sync([r for r in raws if r], pull_dels)
+        if n:
+            logger.info("hashbeat %s/%s vs %s reconciled %d entries",
+                        self.col.config.name, shard_name, peer, n)
+        return n
+
+    def beat(self) -> bool:
+        """Cycle callback: beat every locally-owned shard of the
+        collection. True when anything was reconciled."""
+        if self.col.config.replication.factor < 2:
+            return False
+        did = 0
+        for name in list(self.col.sharding.shard_names):
+            if self.col._is_local(name):
+                try:
+                    did += self.beat_shard(name)
+                except Exception:
+                    logger.exception("hashbeat failed for %s", name)
+        return did > 0

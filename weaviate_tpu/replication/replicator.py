@@ -1,0 +1,210 @@
+"""2PC write replication with consistency levels.
+
+Reference: usecases/replica/replicator.go:57 + coordinator.go — the
+coordinator broadcasts "prepare" to every replica (coordinator.go:69
+broadcast), counts acks against the consistency level (config.go
+ONE/QUORUM/ALL), then commits (commitAll :132, Push :158); failed
+prepares trigger aborts. The intra-cluster endpoints live beside the
+shard data plane (clusterapi /replicas/...).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid as uuid_mod
+
+from weaviate_tpu.cluster.transport import RpcError, rpc
+from weaviate_tpu.storage.objects import StorageObject
+
+logger = logging.getLogger(__name__)
+
+LEVELS = ("ONE", "QUORUM", "ALL")
+
+
+class ConsistencyError(RuntimeError):
+    pass
+
+
+def required_acks(level: str, n_replicas: int) -> int:
+    if level == "ONE":
+        return 1
+    if level == "QUORUM":
+        return n_replicas // 2 + 1
+    if level == "ALL":
+        return n_replicas
+    raise ValueError(f"unknown consistency level {level!r}; "
+                     f"expected one of {LEVELS}")
+
+
+class Replicator:
+    """Write coordinator for one collection (reference Replicator)."""
+
+    def __init__(self, collection):
+        self.col = collection
+
+    # -- replica RPC primitives (local replicas short-circuit HTTP) ---------
+
+    def _shard_local(self, shard_name: str):
+        return self.col._load_shard(shard_name)
+
+    def _prepare(self, node: str, shard_name: str, rid: str, task: tuple) -> None:
+        if node == self.col.local_node:
+            self._shard_local(shard_name).stage(rid, task)
+            return
+        kind = task[0]
+        payload = {"request_id": rid, "kind": kind}
+        if kind == "put":
+            payload["objects"] = [o.to_bytes() for o in task[1]]
+        else:
+            payload["uuid"], payload["tombstone_ms"] = task[1], task[2]
+        self._rpc(node, shard_name, "prepare", payload)
+
+    def _commit(self, node: str, shard_name: str, rid: str):
+        if node == self.col.local_node:
+            return self._shard_local(shard_name).commit_staged(rid)
+        return self._rpc(node, shard_name, "commit", {"request_id": rid})
+
+    def _abort(self, node: str, shard_name: str, rid: str) -> None:
+        try:
+            if node == self.col.local_node:
+                self._shard_local(shard_name).abort_staged(rid)
+            else:
+                self._rpc(node, shard_name, "abort", {"request_id": rid})
+        except Exception:
+            logger.warning("abort failed on %s/%s", node, shard_name)
+
+    def _rpc(self, node: str, shard_name: str, op: str, payload: dict):
+        remote = self.col._require_remote(shard_name)
+        return rpc(remote.resolver(node),
+                   f"/replicas/{self.col.config.name}/{shard_name}/{op}",
+                   payload, timeout=remote.timeout)
+
+    # -- coordinator (reference coordinator.go Push) --------------------------
+
+    def _two_phase(self, shard_name: str, task: tuple, level: str) -> list:
+        """Returns the per-replica commit results (callers aggregate).
+
+        Catches ALL exceptions per replica — a commit-time validation or
+        memory error on one replica must still commit/abort the others,
+        or their staged entries leak and the set diverges silently."""
+        nodes = self.col.sharding.nodes_for(shard_name)
+        need = required_acks(level, len(nodes))
+        rid = str(uuid_mod.uuid4())
+        prepared: list[str] = []
+        errors: list[str] = []
+        for node in nodes:
+            try:
+                self._prepare(node, shard_name, rid, task)
+                prepared.append(node)
+            except Exception as e:
+                errors.append(f"{node}: {e}")
+        if len(prepared) < need:
+            for node in prepared:
+                self._abort(node, shard_name, rid)
+            raise ConsistencyError(
+                f"prepare acked by {len(prepared)}/{len(nodes)} replicas, "
+                f"need {need} for {level}: {'; '.join(errors)}")
+        # commit phase: commit everywhere that prepared; the write succeeds
+        # once `need` commits land (stragglers are repaired by anti-entropy)
+        results: list = []
+        commit_errors: list[str] = []
+        for node in prepared:
+            try:
+                results.append(self._commit(node, shard_name, rid))
+            except Exception as e:
+                commit_errors.append(f"{node}: {e}")
+                # release any still-staged entry (idempotent if the commit
+                # half-landed or the node is unreachable)
+                self._abort(node, shard_name, rid)
+        if len(results) < need:
+            raise ConsistencyError(
+                f"commit acked by {len(results)}/{len(prepared)} prepared "
+                f"replicas, need {need}: {'; '.join(commit_errors)}")
+        return results
+
+    def put_objects(self, shard_name: str, objs: list[StorageObject],
+                    level: str = "QUORUM"):
+        results = self._two_phase(shard_name, ("put", objs), level)
+        return results[0] if results else None
+
+    def delete(self, shard_name: str, uuid: str, level: str = "QUORUM",
+               tombstone_ms: int | None = None) -> bool:
+        import time as _time
+
+        ts = tombstone_ms or int(_time.time() * 1000)
+        results = self._two_phase(shard_name, ("delete", uuid, ts), level)
+        # deleted anywhere = deleted (a replica that missed the put and
+        # reports False is simply stale, not authoritative)
+        return any(bool(r.get("result") if isinstance(r, dict) else r)
+                   for r in results)
+
+
+def register_replication(server, db) -> None:
+    """Mount /replicas/{collection}/{shard}/{op} (reference: clusterapi
+    serve.go routes /replicas/indices/ to the replica store)."""
+
+    def handler(subpath: str, payload: dict):
+        parts = subpath.split("/")
+        if len(parts) != 3:
+            raise KeyError(subpath)
+        collection_name, shard_name, op = parts
+        col = db.get_collection(collection_name)
+        if db.local_node not in col.sharding.nodes_for(shard_name):
+            raise ValueError(
+                f"node {db.local_node} is not a replica of {shard_name!r}")
+        shard = col._load_shard(shard_name)
+
+        if op == "prepare":
+            if payload["kind"] == "put":
+                objs = [StorageObject.from_bytes(raw)
+                        for raw in payload["objects"]]
+                shard.stage(payload["request_id"], ("put", objs))
+            else:
+                shard.stage(payload["request_id"],
+                            ("delete", payload["uuid"],
+                             payload["tombstone_ms"]))
+            return {"ok": True}
+        if op == "commit":
+            return {"result": shard.commit_staged(payload["request_id"])}
+        if op == "abort":
+            shard.abort_staged(payload["request_id"])
+            return {"ok": True}
+        if op == "digest":
+            d = shard.object_digest(payload["uuid"])
+            return {"digest": d}
+        if op == "digests:bucket":
+            return {"digests": shard.bucket_digests(payload["depth"],
+                                                    payload["buckets"])}
+        if op == "hashtree:level":
+            tree = _tree_cache_get(shard, payload["depth"],
+                                   fresh=payload.get("level") == 0)
+            return {"hashes": tree.level_hashes(payload["level"],
+                                                payload["positions"])}
+        if op == "sync:apply":
+            n = shard.apply_sync(payload.get("objects", []),
+                                 payload.get("deletes", []))
+            return {"applied": n}
+        if op == "objects:fetch":
+            return {"objects": [shard.objects.get(u.encode())
+                                for u in payload["uuids"]]}
+        raise KeyError(op)
+
+    server.route("/replicas/", handler)
+
+
+# hashtree walks issue several level RPCs per beat; rebuilding the tree
+# for each would turn O(diff*depth) exchanges into O(n*depth) hashing.
+# The cache lives ON the shard (evicted with it — a process-global map
+# keyed by id() would leak closed shards and risk id-reuse collisions),
+# refreshed whenever a walk starts at the root.
+_tree_lock = threading.Lock()
+
+
+def _tree_cache_get(shard, depth: int, fresh: bool):
+    with _tree_lock:
+        cached = getattr(shard, "_hashtree_cache", None)
+        if cached is None or cached[0] != depth or fresh:
+            cached = (depth, shard.build_hashtree(depth))
+            shard._hashtree_cache = cached
+        return cached[1]

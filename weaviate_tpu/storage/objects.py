@@ -111,3 +111,18 @@ class StorageObject:
 
     def touch(self):
         self.last_update_time_ms = int(time.time() * 1000)
+
+    def content_hash(self) -> bytes:
+        """Replica-comparable digest: EXCLUDES doc_id, which is assigned
+        per-replica and legitimately differs (replication digests,
+        usecases/replica hashtree leaves)."""
+        import hashlib
+
+        h = hashlib.sha1()
+        h.update(uuid_mod.UUID(self.uuid).bytes)
+        h.update(self.last_update_time_ms.to_bytes(8, "little"))
+        for name, vec in sorted(self.vectors.items()):
+            h.update(name.encode())
+            h.update(np.ascontiguousarray(vec, dtype=np.float32).tobytes())
+        h.update(msgpack.packb(self.properties, use_bin_type=True))
+        return h.digest()[:16]
