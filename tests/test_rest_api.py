@@ -1,0 +1,177 @@
+"""REST API black-box tests over a real socket.
+
+Reference pattern: test/acceptance/ runs black-box REST tests against a
+live server — here against RestServer on localhost.
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.client import Client, RestError
+from weaviate_tpu.api.rest import RestServer
+from weaviate_tpu.db.database import Database
+
+
+@pytest.fixture
+def server(tmp_path):
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+@pytest.fixture
+def client(server):
+    return Client(server.address)
+
+
+def test_meta_and_wellknown(client):
+    assert client.ready()
+    meta = client.meta()
+    assert meta["version"]
+    nodes = client.nodes()
+    assert nodes[0]["status"] == "HEALTHY"
+
+
+def test_schema_crud(client):
+    client.create_class({"name": "Article", "properties": [
+        {"name": "title", "data_type": "text"},
+        {"name": "wordCount", "data_type": "int"},
+    ]})
+    schema = client.get_schema()
+    assert [c["name"] for c in schema["classes"]] == ["Article"]
+    cls = client.get_class("Article")
+    assert {p["name"] for p in cls["properties"]} == {"title", "wordCount"}
+    # weaviate-style property payload
+    client.add_property("Article", {"name": "tag", "dataType": ["text"]})
+    assert any(p["name"] == "tag"
+               for p in client.get_class("Article")["properties"])
+    client.delete_class("Article")
+    with pytest.raises(RestError) as e:
+        client.get_class("Article")
+    assert e.value.status == 404
+
+
+def test_object_crud_roundtrip(client):
+    client.create_class({"name": "Doc", "properties": [
+        {"name": "body", "data_type": "text"}]})
+    created = client.create_object("Doc", {"body": "hello world"},
+                                   vector=[1.0, 2.0, 3.0])
+    uid = created["id"]
+    got = client.get_object("Doc", uid)
+    assert got["properties"]["body"] == "hello world"
+    assert got["vector"] == [1.0, 2.0, 3.0]
+    patched = client.patch_object("Doc", uid, {"extra": "yes"})
+    assert patched["properties"] == {"body": "hello world", "extra": "yes"}
+    assert patched["vector"] == [1.0, 2.0, 3.0]  # merge keeps the vector
+    client.delete_object("Doc", uid)
+    with pytest.raises(RestError) as e:
+        client.get_object("Doc", uid)
+    assert e.value.status == 404
+    with pytest.raises(RestError):
+        client.delete_object("Doc", uid)  # second delete -> 404
+
+
+def test_batch_and_listing(client):
+    client.create_class({"name": "Item", "properties": [
+        {"name": "n", "data_type": "int"}]})
+    rng = np.random.default_rng(0)
+    results = client.batch_objects([
+        {"class": "Item", "properties": {"n": i},
+         "vector": rng.standard_normal(4).tolist()}
+        for i in range(30)
+    ])
+    assert all(r["result"]["status"] == "SUCCESS" for r in results)
+    page = client.list_objects("Item", limit=10)
+    assert len(page["objects"]) == 10
+    page2 = client.list_objects("Item", limit=10,
+                                after=page["objects"][-1]["id"])
+    assert not {o["id"] for o in page["objects"]} & \
+        {o["id"] for o in page2["objects"]}
+    # sorted listing
+    top = client.list_objects("Item", limit=3, sort="n", order="desc")
+    assert [o["properties"]["n"] for o in top["objects"]] == [29, 28, 27]
+    # filtered listing
+    flt = client.list_objects("Item", limit=50, where={
+        "path": "n", "operator": "LessThan", "value": 5})
+    assert len(flt["objects"]) == 5
+
+
+def test_batch_partial_failure(client):
+    client.create_class({"name": "Part"})
+    results = client.batch_objects([
+        {"class": "Part", "properties": {"a": 1}, "vector": [1.0, 2.0]},
+        {"class": "DoesNotExist", "properties": {}},
+    ])
+    assert results[0]["result"]["status"] == "SUCCESS"
+    assert results[1]["result"]["status"] == "FAILED"
+
+
+def test_multi_tenant_rest(client):
+    client.create_class({"name": "MT",
+                         "multi_tenancy": {"enabled": True}})
+    client.add_tenants("MT", ["alpha", "beta"])
+    assert {t["name"] for t in client.get_tenants("MT")} == {"alpha", "beta"}
+    created = client.create_object("MT", {"x": 1}, vector=[1.0, 0.0],
+                                   tenant="alpha")
+    got = client.get_object("MT", created["id"], tenant="alpha")
+    assert got["properties"]["x"] == 1
+    with pytest.raises(RestError):
+        client.get_object("MT", created["id"], tenant="beta")
+
+
+def test_rest_over_cluster(tmp_path):
+    """REST against a 3-node cluster: schema via Raft, data via the
+    scatter-gather data plane (reference: multi_node acceptance tests)."""
+    import time
+
+    from weaviate_tpu.cluster import ClusterNode
+
+    names = ["n0", "n1", "n2"]
+    nodes = [ClusterNode(n, str(tmp_path / n), raft_peers=names,
+                         gossip_interval=0.1, election_timeout=(0.2, 0.4))
+             for n in names]
+    try:
+        for n in nodes:
+            n.membership.join([p.address for p in nodes])
+        for n in nodes:
+            n.start()
+        for n in nodes:
+            n.raft.wait_for_leader(10.0)
+        clients = [Client(n.serve_rest().address) for n in nodes]
+        clients[1].create_class({"name": "Multi",
+                                 "sharding": {"desired_count": 4}})
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all("Multi" in n.db.collections for n in nodes):
+                break
+            time.sleep(0.05)
+        ids = [clients[0].create_object("Multi", {"i": i},
+                                        vector=[float(i), 1.0])["id"]
+               for i in range(12)]
+        # every node's REST API sees every object
+        for c in clients:
+            assert c.get_object("Multi", ids[5])["properties"]["i"] == 5
+            assert len(c.list_objects("Multi", limit=50)["objects"]) == 12
+        statuses = {n["name"]: n["status"] for n in clients[2].nodes()}
+        assert statuses == {"n0": "ALIVE", "n1": "ALIVE", "n2": "ALIVE"}
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass
+
+
+def test_validation_errors(client):
+    with pytest.raises(RestError) as e:
+        client.create_class({"name": "lowercase"})
+    assert e.value.status == 422
+    with pytest.raises(RestError) as e:
+        client.request("POST", "/v1/objects", body={"properties": {}})
+    assert e.value.status == 422
+    with pytest.raises(RestError) as e:
+        client.request("GET", "/v1/unknown")
+    assert e.value.status == 404

@@ -1,0 +1,384 @@
+"""REST API: the /v1 surface.
+
+Reference: adapters/handlers/rest/ (go-swagger server; spec
+openapi-specs/schema.json) — /v1/objects, /v1/schema (+tenants),
+/v1/batch/objects, /v1/graphql, /v1/nodes, /v1/meta, /.well-known/*.
+Hand-rolled stdlib server instead of generated swagger code; the route
+set and JSON shapes mirror the reference handlers
+(handlers_objects.go, handlers_schema.go, handlers_batch_objects.go).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from weaviate_tpu import __version__ as VERSION
+from weaviate_tpu.filters.filters import Filter
+from weaviate_tpu.schema.config import CollectionConfig, Property
+
+logger = logging.getLogger(__name__)
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def object_to_json(class_name: str, obj) -> dict:
+    out = {
+        "class": class_name,
+        "id": obj.uuid,
+        "properties": obj.properties,
+        "creationTimeUnix": obj.creation_time_ms,
+        "lastUpdateTimeUnix": obj.last_update_time_ms,
+    }
+    if obj.vector is not None:
+        out["vector"] = np.asarray(obj.vector).tolist()
+    named = {k: np.asarray(v).tolist() for k, v in obj.vectors.items() if k}
+    if named:
+        out["vectors"] = named
+    return out
+
+
+def property_from_json(d: dict) -> Property:
+    """Accepts native {"name", "data_type"} and reference-style
+    {"name", "dataType": ["text"]} payloads."""
+    data_type = d.get("data_type")
+    if data_type is None and d.get("dataType"):
+        dt = d["dataType"]
+        data_type = dt[0] if isinstance(dt, list) else dt
+    return Property(
+        name=d["name"],
+        data_type=data_type or "text",
+        tokenization=d.get("tokenization", "word"),
+        index_filterable=d.get("index_filterable",
+                               d.get("indexFilterable", True)),
+        index_searchable=d.get("index_searchable",
+                               d.get("indexSearchable", True)),
+        description=d.get("description", ""),
+    )
+
+
+def config_from_json(d: dict) -> CollectionConfig:
+    """Accepts the native config dict; tolerates the reference's "class"
+    key for the name."""
+    d = dict(d)
+    if "name" not in d and "class" in d:
+        d["name"] = d.pop("class")
+    if d.get("properties") and isinstance(d["properties"][0], dict) \
+            and ("dataType" in d["properties"][0]):
+        d["properties"] = [
+            {"name": p["name"],
+             "data_type": (p["dataType"][0] if isinstance(p.get("dataType"), list)
+                           else p.get("dataType", "text")),
+             "tokenization": p.get("tokenization", "word")}
+            for p in d["properties"]
+        ]
+    return CollectionConfig.from_dict(d)
+
+
+class RestServer:
+    """``db``: the node-local Database. ``schema_target``: where schema
+    writes go — the Database itself (single node) or a ClusterNode
+    (Raft path); both expose the same method names. ``node``: optional
+    ClusterNode for /v1/nodes."""
+
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
+                 schema_target=None, node=None, graphql_executor=None):
+        self.db = db
+        self.schema_target = schema_target or db
+        self.node = node
+        self.graphql_executor = graphql_executor
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _run(self, method: str):
+                parsed = urllib.parse.urlparse(self.path)
+                params = {k: v[0] for k, v in
+                          urllib.parse.parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    body = json.loads(raw) if raw else None
+                    status, payload = outer.dispatch(method, parsed.path,
+                                                     params, body)
+                except ApiError as e:
+                    status, payload = e.status, {"error": [{"message": e.message}]}
+                except (KeyError, FileNotFoundError) as e:
+                    status, payload = 404, {"error": [{"message": str(e)}]}
+                except ValueError as e:
+                    status, payload = 422, {"error": [{"message": str(e)}]}
+                except Exception as e:
+                    logger.exception("REST %s %s failed", method, self.path)
+                    status, payload = 500, {"error": [{"message": str(e)}]}
+                data = b"" if payload is None else json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if method != "HEAD":
+                    self.wfile.write(data)
+
+            def do_GET(self):
+                self._run("GET")
+
+            def do_POST(self):
+                self._run("POST")
+
+            def do_PUT(self):
+                self._run("PUT")
+
+            def do_PATCH(self):
+                self._run("PATCH")
+
+            def do_DELETE(self):
+                self._run("DELETE")
+
+            def do_HEAD(self):
+                self._run("HEAD")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                            daemon=True,
+                                            name=f"rest-{self.port}")
+            self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread = None
+
+    # -- routing --------------------------------------------------------------
+
+    def dispatch(self, method: str, path: str, params: dict, body):
+        seg = [s for s in path.split("/") if s]
+        # /.well-known/*  (configure_api.go wires ready/live/openid)
+        if seg[:1] == [".well-known"]:
+            if seg[1:] == ["ready"] or seg[1:] == ["live"]:
+                return 200, {}
+            raise KeyError(path)
+        if not seg or seg[0] != "v1":
+            raise KeyError(path)
+        seg = seg[1:]
+
+        if seg == ["meta"]:
+            return 200, {"version": VERSION, "hostname": self.address,
+                         "modules": {}}
+        if seg == ["metrics"]:
+            from weaviate_tpu.runtime.metrics import registry
+
+            return 200, {"text": registry.expose()}
+        if seg == ["nodes"]:
+            return 200, {"nodes": self._nodes_payload()}
+        if seg == ["graphql"] and method == "POST":
+            if self.graphql_executor is None:
+                raise ApiError(501, "graphql not enabled")
+            return 200, self.graphql_executor(body or {})
+        if seg[:1] == ["schema"]:
+            return self._schema(method, seg[1:], body)
+        if seg[:1] == ["objects"]:
+            return self._objects(method, seg[1:], params, body)
+        if seg == ["batch", "objects"] and method == "POST":
+            return self._batch_objects(body or {})
+        raise KeyError(path)
+
+    def _nodes_payload(self) -> list[dict]:
+        if self.node is not None:
+            infos = self.node.membership.nodes()
+            return [{
+                "name": i.name, "status": i.status.upper(),
+                "version": VERSION,
+                "stats": i.meta,
+            } for i in sorted(infos.values(), key=lambda x: x.name)]
+        shard_count = sum(len(c.shards) for c in self.db.collections.values())
+        object_count = sum(
+            s.object_count() for c in self.db.collections.values()
+            for s in c.shards.values())
+        return [{"name": self.db.local_node, "status": "HEALTHY",
+                 "version": VERSION,
+                 "stats": {"shardCount": shard_count,
+                           "objectCount": object_count}}]
+
+    # -- /v1/schema -----------------------------------------------------------
+
+    def _schema(self, method: str, seg: list[str], body):
+        if not seg:
+            if method == "GET":
+                return 200, {"classes": [
+                    self.db.get_collection(n).config.to_dict()
+                    for n in self.db.list_collections()]}
+            if method == "POST":
+                cfg = config_from_json(body or {})
+                self.schema_target.create_collection(cfg)
+                return 200, cfg.to_dict()
+        elif len(seg) == 1:
+            name = seg[0]
+            if method == "GET":
+                return 200, self.db.get_collection(name).config.to_dict()
+            if method == "DELETE":
+                self.schema_target.delete_collection(name)
+                return 200, None
+        elif len(seg) == 2 and seg[1] == "properties" and method == "POST":
+            prop = property_from_json(body or {})
+            self.schema_target.add_property(seg[0], prop)
+            return 200, body
+        elif len(seg) == 2 and seg[1] == "tenants":
+            name = seg[0]
+            col = self.db.get_collection(name)
+            if method == "GET":
+                return 200, [{"name": t} for t in col.tenants()]
+            tenants = [t["name"] if isinstance(t, dict) else t
+                       for t in (body or [])]
+            if method == "POST":
+                self.schema_target.add_tenants(name, tenants)
+                return 200, [{"name": t} for t in tenants]
+            if method == "DELETE":
+                self.schema_target.remove_tenants(name, tenants)
+                return 200, None
+        raise KeyError("/v1/schema/" + "/".join(seg))
+
+    # -- /v1/objects ----------------------------------------------------------
+
+    def _objects(self, method: str, seg: list[str], params: dict, body):
+        tenant = params.get("tenant")
+        if not seg:
+            if method == "GET":
+                return self._list_objects(params)
+            if method == "POST":
+                return self._put_object(body or {}, tenant)
+        elif len(seg) == 2:
+            class_name, uuid = seg
+            col = self.db.get_collection(class_name)
+            if method in ("GET", "HEAD"):
+                consistency = params.get("consistency_level")
+                obj = col.get_object(uuid, tenant=tenant,
+                                     consistency=consistency)
+                if obj is None:
+                    raise ApiError(404, f"object {uuid} not found")
+                return 200, object_to_json(class_name, obj)
+            if method in ("PUT", "PATCH"):
+                body = dict(body or {})
+                body.setdefault("class", class_name)
+                body["id"] = uuid
+                if method == "PATCH":
+                    existing = col.get_object(uuid, tenant=tenant)
+                    if existing is None:
+                        raise ApiError(404, f"object {uuid} not found")
+                    merged = dict(existing.properties)
+                    merged.update(body.get("properties", {}))
+                    body["properties"] = merged
+                    if "vector" not in body and existing.vector is not None:
+                        body["vector"] = np.asarray(existing.vector).tolist()
+                return self._put_object(body, tenant)
+            if method == "DELETE":
+                deleted = col.delete_object(
+                    uuid, tenant=tenant,
+                    consistency=params.get("consistency_level", "QUORUM"))
+                if not deleted:
+                    raise ApiError(404, f"object {uuid} not found")
+                return 204, None
+        raise KeyError("/v1/objects/" + "/".join(seg))
+
+    def _put_object(self, body: dict, tenant: str | None):
+        class_name = body.get("class") or body.get("collection")
+        if not class_name:
+            raise ApiError(422, "object is missing a class")
+        col = self.db.get_collection(class_name)
+        uuid = col.put_object(
+            body.get("properties", {}),
+            vector=body.get("vector"),
+            vectors=body.get("vectors"),
+            uuid=body.get("id"),
+            tenant=tenant or body.get("tenant"),
+        )
+        obj = col.get_object(uuid, tenant=tenant or body.get("tenant"))
+        return 200, object_to_json(class_name, obj)
+
+    def _list_objects(self, params: dict):
+        class_name = params.get("class")
+        if not class_name:
+            raise ApiError(422, "listing requires ?class=")
+        col = self.db.get_collection(class_name)
+        limit = int(params.get("limit", 25))
+        offset = int(params.get("offset", 0))
+        sort = None
+        if params.get("sort"):
+            orders = (params.get("order") or "asc").split(",")
+            paths = params["sort"].split(",")
+            sort = [{"path": p, "order": orders[min(i, len(orders) - 1)]}
+                    for i, p in enumerate(paths)]
+        where = None
+        if params.get("where"):
+            where = Filter.from_dict(json.loads(params["where"]))
+        objs = col.fetch_objects(limit=limit, offset=offset, sort=sort,
+                                 where=where, tenant=params.get("tenant"),
+                                 after=params.get("after"))
+        return 200, {
+            "objects": [object_to_json(class_name, o) for o in objs],
+            "totalResults": len(objs),
+        }
+
+    # -- /v1/batch/objects -----------------------------------------------------
+
+    def _batch_objects(self, body: dict):
+        objects = body.get("objects", [])
+        by_class: dict[str, list[tuple[int, dict]]] = {}
+        for i, spec in enumerate(objects):
+            cname = spec.get("class") or spec.get("collection") or ""
+            by_class.setdefault(cname, []).append((i, spec))
+        results: list[dict | None] = [None] * len(objects)
+        for cname, entries in by_class.items():
+            try:
+                col = self.db.get_collection(cname)
+            except KeyError as e:
+                for i, spec in entries:
+                    results[i] = {"id": spec.get("id"), "result": {
+                        "status": "FAILED", "errors": {"error": [
+                            {"message": str(e)}]}}}
+                continue
+            tenant = entries[0][1].get("tenant")
+            specs = [{
+                "uuid": spec.get("id"),
+                "properties": spec.get("properties", {}),
+                "vector": spec.get("vector"),
+                "vectors": spec.get("vectors"),
+            } for _i, spec in entries]
+            outcomes = col.batch_put(specs, tenant=tenant)
+            for (i, _spec), out in zip(entries, outcomes):
+                if out["status"] == "SUCCESS":
+                    results[i] = {"id": out["uuid"],
+                                  "result": {"status": "SUCCESS"}}
+                else:
+                    results[i] = {"id": out.get("uuid"), "result": {
+                        "status": "FAILED", "errors": {"error": [
+                            {"message": out.get("error", "")}]}}}
+        return 200, results
+
+
+_UUID_RE = re.compile(
+    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$")

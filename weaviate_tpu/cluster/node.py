@@ -52,6 +52,7 @@ class ClusterNode:
         # auto tenant creation must take the Raft path in a cluster
         self.db.set_auto_tenant_hook(self.add_tenants)
         self.server.start()
+        self.rest = None
 
     @property
     def address(self) -> str:
@@ -77,7 +78,20 @@ class ClusterNode:
                 did = HashBeater(col).beat() or did
         return did
 
+    def serve_rest(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the public /v1 REST API for this node (schema writes
+        take the Raft path; reads/writes hit the local Database which
+        scatter-gathers as needed)."""
+        from weaviate_tpu.api.rest import RestServer
+
+        self.rest = RestServer(self.db, host=host, port=port,
+                               schema_target=self, node=self)
+        self.rest.start()
+        return self.rest
+
     def close(self) -> None:
+        if self.rest is not None:
+            self.rest.stop()
         self.raft.stop()
         self.membership.stop()
         self.server.stop()

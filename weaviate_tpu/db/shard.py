@@ -334,17 +334,36 @@ class Shard:
 
     # -- replication support -------------------------------------------------
 
+    STAGED_TTL_S = 120.0
+
     def stage(self, request_id: str, task: tuple) -> None:
         """2PC prepare: hold a write until commit/abort
         (reference: replica store staging before commit)."""
+        import time as _time
+
         with self._lock:
-            self._staged[request_id] = task
+            self._staged[request_id] = (_time.monotonic(), task)
+
+    def gc_staged(self) -> int:
+        """Drop staged batches whose coordinator never came back (crash
+        between prepare and commit/abort) — anti-entropy re-delivers the
+        write if it committed elsewhere."""
+        import time as _time
+
+        cutoff = _time.monotonic() - self.STAGED_TTL_S
+        with self._lock:
+            stale = [rid for rid, (t, _task) in self._staged.items()
+                     if t < cutoff]
+            for rid in stale:
+                del self._staged[rid]
+        return len(stale)
 
     def commit_staged(self, request_id: str):
         with self._lock:
-            task = self._staged.pop(request_id, None)
-        if task is None:
+            entry = self._staged.pop(request_id, None)
+        if entry is None:
             raise KeyError(f"unknown replication request {request_id!r}")
+        _t, task = entry
         kind = task[0]
         if kind == "put":
             return self.put_object_batch(task[1])
@@ -451,6 +470,8 @@ class Shard:
         from weaviate_tpu.runtime.metrics import lsm_segment_count
 
         did = False
+        if self.gc_staged():
+            did = True
         for b in self.store.buckets():
             if b.dirty:
                 b.flush()

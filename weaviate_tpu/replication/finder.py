@@ -50,8 +50,11 @@ class Finder:
                 {"objects": [raw] if raw else [],
                  "deletes": [delete] if delete else []},
                 timeout=remote.timeout)
-        except (RpcError, KeyError):
-            logger.warning("read repair push to %s/%s failed", node, shard_name)
+        except Exception:
+            # best-effort side effect: a failed repair (unreachable peer,
+            # local validation error) must not fail the read itself
+            logger.warning("read repair push to %s/%s failed", node,
+                           shard_name, exc_info=True)
 
     def get_object(self, uuid: str, shard_name: str,
                    level: str = "QUORUM") -> StorageObject | None:

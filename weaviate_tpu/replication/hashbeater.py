@@ -47,10 +47,15 @@ class HashBeater:
 
     def _beat_peer(self, shard, tree: MerkleTree, shard_name: str,
                    peer: str) -> int:
+        walk: dict = {}  # token pins the peer's snapshot across levels
+
         def peer_level(level: int, positions: list[int]):
-            return self._peer_rpc(peer, shard_name, "hashtree:level",
-                                  {"depth": self.depth, "level": level,
-                                   "positions": positions})["hashes"]
+            reply = self._peer_rpc(peer, shard_name, "hashtree:level",
+                                   {"depth": self.depth, "level": level,
+                                    "positions": positions,
+                                    "token": walk.get("token")})
+            walk["token"] = reply.get("token")
+            return reply["hashes"]
 
         buckets = tree.diff_buckets(peer_level)
         if not buckets:

@@ -63,7 +63,9 @@ class Replicator:
     def _commit(self, node: str, shard_name: str, rid: str):
         if node == self.col.local_node:
             return self._shard_local(shard_name).commit_staged(rid)
-        return self._rpc(node, shard_name, "commit", {"request_id": rid})
+        # unwrap so local and remote commits return the same shape
+        return self._rpc(node, shard_name, "commit",
+                         {"request_id": rid}).get("result")
 
     def _abort(self, node: str, shard_name: str, rid: str) -> None:
         try:
@@ -88,17 +90,30 @@ class Replicator:
         Catches ALL exceptions per replica — a commit-time validation or
         memory error on one replica must still commit/abort the others,
         or their staged entries leak and the set diverges silently."""
+        from concurrent.futures import ThreadPoolExecutor
+
         nodes = self.col.sharding.nodes_for(shard_name)
         need = required_acks(level, len(nodes))
         rid = str(uuid_mod.uuid4())
         prepared: list[str] = []
         errors: list[str] = []
-        for node in nodes:
+
+        # broadcast CONCURRENTLY (reference coordinator.broadcast): one
+        # partitioned replica hanging until its RPC timeout must not add
+        # that timeout to every write that still has quorum
+        def try_prepare(node):
             try:
                 self._prepare(node, shard_name, rid, task)
-                prepared.append(node)
+                return node, None
             except Exception as e:
-                errors.append(f"{node}: {e}")
+                return node, e
+
+        with ThreadPoolExecutor(max_workers=max(1, len(nodes))) as pool:
+            for node, err in pool.map(try_prepare, nodes):
+                if err is None:
+                    prepared.append(node)
+                else:
+                    errors.append(f"{node}: {err}")
         if len(prepared) < need:
             for node in prepared:
                 self._abort(node, shard_name, rid)
@@ -109,11 +124,20 @@ class Replicator:
         # once `need` commits land (stragglers are repaired by anti-entropy)
         results: list = []
         commit_errors: list[str] = []
-        for node in prepared:
+
+        def try_commit(node):
             try:
-                results.append(self._commit(node, shard_name, rid))
+                return node, self._commit(node, shard_name, rid), None
             except Exception as e:
-                commit_errors.append(f"{node}: {e}")
+                return node, None, e
+
+        with ThreadPoolExecutor(max_workers=max(1, len(prepared))) as pool:
+            outcomes = list(pool.map(try_commit, prepared))
+        for node, result, err in outcomes:
+            if err is None:
+                results.append(result)
+            else:
+                commit_errors.append(f"{node}: {err}")
                 # release any still-staged entry (idempotent if the commit
                 # half-landed or the node is unreachable)
                 self._abort(node, shard_name, rid)
@@ -136,8 +160,7 @@ class Replicator:
         results = self._two_phase(shard_name, ("delete", uuid, ts), level)
         # deleted anywhere = deleted (a replica that missed the put and
         # reports False is simply stale, not authoritative)
-        return any(bool(r.get("result") if isinstance(r, dict) else r)
-                   for r in results)
+        return any(bool(r) for r in results)
 
 
 def register_replication(server, db) -> None:
@@ -177,10 +200,11 @@ def register_replication(server, db) -> None:
             return {"digests": shard.bucket_digests(payload["depth"],
                                                     payload["buckets"])}
         if op == "hashtree:level":
-            tree = _tree_cache_get(shard, payload["depth"],
-                                   fresh=payload.get("level") == 0)
+            tree, token = _tree_for_walk(shard, payload["depth"],
+                                         payload.get("token"))
             return {"hashes": tree.level_hashes(payload["level"],
-                                                payload["positions"])}
+                                                payload["positions"]),
+                    "token": token}
         if op == "sync:apply":
             n = shard.apply_sync(payload.get("objects", []),
                                  payload.get("deletes", []))
@@ -195,16 +219,28 @@ def register_replication(server, db) -> None:
 
 # hashtree walks issue several level RPCs per beat; rebuilding the tree
 # for each would turn O(diff*depth) exchanges into O(n*depth) hashing.
-# The cache lives ON the shard (evicted with it — a process-global map
-# keyed by id() would leak closed shards and risk id-reuse collisions),
-# refreshed whenever a walk starts at the root.
+# Each walk gets a TOKEN naming its snapshot so concurrent walks from
+# different peers never see a tree swapped mid-walk; a few snapshots are
+# kept per shard (on the shard itself, so they die with it).
 _tree_lock = threading.Lock()
+_MAX_WALKS = 4
 
 
-def _tree_cache_get(shard, depth: int, fresh: bool):
+def _tree_for_walk(shard, depth: int, token: str | None):
+    from collections import OrderedDict
+
     with _tree_lock:
-        cached = getattr(shard, "_hashtree_cache", None)
-        if cached is None or cached[0] != depth or fresh:
-            cached = (depth, shard.build_hashtree(depth))
-            shard._hashtree_cache = cached
-        return cached[1]
+        walks = getattr(shard, "_hashtree_walks", None)
+        if walks is None:
+            walks = shard._hashtree_walks = OrderedDict()
+        if token is not None:
+            cached = walks.get(token)
+            if cached is not None and cached[0] == depth:
+                return cached[1], token
+            # token evicted/unknown: fall through to a fresh snapshot —
+            # the walk continues on newer data, worst case a wasted round
+        token = str(uuid_mod.uuid4())
+        walks[token] = (depth, shard.build_hashtree(depth))
+        while len(walks) > _MAX_WALKS:
+            walks.popitem(last=False)
+        return walks[token][1], token
