@@ -1,0 +1,211 @@
+"""HNSW graph index tests.
+
+Mirrors the reference's recall gate (hnsw/recall_test.go: recall asserted
+against brute force), delete/tombstone tests (delete.go), and commit-log
+replay tests (persistence_integration_test.go)."""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.engine.hnsw import HNSWIndex
+
+
+def brute_force(xs, q, k, metric="l2-squared"):
+    if metric == "l2-squared":
+        d = ((xs - q) ** 2).sum(axis=1)
+    elif metric == "cosine":
+        xn = xs / np.linalg.norm(xs, axis=1, keepdims=True)
+        qn = q / np.linalg.norm(q)
+        d = 1 - xn @ qn
+    else:
+        raise ValueError(metric)
+    return np.argsort(d, kind="stable")[:k]
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(42)
+    return rng.standard_normal((2000, 32)).astype(np.float32)
+
+
+def test_recall_gate(corpus):
+    idx = HNSWIndex(dim=32, metric="l2-squared", ef_construction=128,
+                    max_connections=16)
+    idx.add_batch(np.arange(len(corpus)), corpus)
+    rng = np.random.default_rng(7)
+    queries = rng.standard_normal((20, 32)).astype(np.float32)
+    k = 10
+    hits = total = 0
+    for q in queries:
+        truth = set(brute_force(corpus, q, k).tolist())
+        got, dists = idx.search_by_vector(q, k)
+        assert len(got) == k
+        assert np.all(np.diff(dists) >= -1e-5)
+        hits += len(truth & set(got.tolist()))
+        total += k
+    recall = hits / total
+    assert recall >= 0.9, f"recall {recall} below gate"
+
+
+def test_cosine_recall(corpus):
+    idx = HNSWIndex(dim=32, metric="cosine", ef_construction=96,
+                    max_connections=16)
+    idx.add_batch(np.arange(len(corpus)), corpus)
+    q = corpus[17] + 0.01
+    got, dists = idx.search_by_vector(q, 5)
+    assert 17 in got.tolist()
+    truth = brute_force(corpus, q, 5, "cosine")
+    assert len(set(got.tolist()) & set(truth.tolist())) >= 4
+
+
+def test_update_overwrites(corpus):
+    idx = HNSWIndex(dim=32)
+    idx.add_batch(np.arange(100), corpus[:100])
+    new_vec = corpus[500]
+    idx.add(5, new_vec)  # re-add id 5 with a different vector
+    got, dists = idx.search_by_vector(new_vec, 1)
+    assert got[0] == 5
+    assert dists[0] < 1e-5
+    assert len(idx) == 100
+
+
+def test_delete_and_cleanup(corpus):
+    idx = HNSWIndex(dim=32, max_connections=8)
+    idx.add_batch(np.arange(300), corpus[:300])
+    q = corpus[10]
+    idx.delete(10, 11, 12)
+    got, _ = idx.search_by_vector(q, 10)
+    assert 10 not in got.tolist()
+    assert not idx.contains(10)
+    assert len(idx) == 297
+    removed = idx.cleanup_tombstones()
+    assert removed == 3
+    # graph still searches fine after re-linking
+    got, _ = idx.search_by_vector(corpus[20], 5)
+    assert 20 in got.tolist()
+
+
+def test_delete_entrypoint_reelects(corpus):
+    idx = HNSWIndex(dim=32)
+    idx.add_batch(np.arange(50), corpus[:50])
+    ep_doc = int(idx._doc_ids[idx._ep])
+    idx.delete(ep_doc)
+    idx.cleanup_tombstones()
+    got, _ = idx.search_by_vector(corpus[(ep_doc + 1) % 50], 5)
+    assert len(got) == 5
+    assert ep_doc not in got.tolist()
+
+
+def test_delete_all_then_insert(corpus):
+    idx = HNSWIndex(dim=32)
+    idx.add_batch(np.arange(10), corpus[:10])
+    idx.delete(*range(10))
+    idx.cleanup_tombstones()
+    assert len(idx) == 0
+    ids, _ = idx.search_by_vector(corpus[0], 3)
+    assert len(ids) == 0
+    idx.add_batch(np.arange(100, 110), corpus[10:20])
+    ids, _ = idx.search_by_vector(corpus[10], 1)
+    assert ids[0] == 100
+
+
+def test_allow_list_filtering(corpus):
+    idx = HNSWIndex(dim=32)
+    idx.add_batch(np.arange(500), corpus[:500])
+    allowed = np.arange(50, 60)
+    got, dists = idx.search_by_vector(corpus[0], 5, allow_list=allowed)
+    assert set(got.tolist()) <= set(allowed.tolist())
+    assert len(got) == 5
+    # exact because the small filter takes the brute-force cutoff path
+    truth = ((corpus[50:60] - corpus[0]) ** 2).sum(axis=1)
+    assert np.allclose(sorted(truth)[:5], dists, atol=1e-4)
+
+
+def test_allow_list_graph_path(corpus):
+    # force the graph path by shrinking the cutoff below the filter size
+    idx = HNSWIndex(dim=32, flat_cutoff=5)
+    idx.add_batch(np.arange(500), corpus[:500])
+    allowed = np.arange(0, 400)
+    got, _ = idx.search_by_vector(corpus[0], 10, allow_list=allowed)
+    assert set(got.tolist()) <= set(allowed.tolist())
+    assert 0 in got.tolist()
+
+
+def test_search_by_distance(corpus):
+    idx = HNSWIndex(dim=32)
+    idx.add_batch(np.arange(200), corpus[:200])
+    q = corpus[3]
+    d_all = ((corpus[:200] - q) ** 2).sum(axis=1)
+    thresh = float(np.sort(d_all)[10])
+    ids, dists = idx.search_by_vector_distance(q, thresh)
+    assert np.all(dists <= thresh)
+    assert 3 in ids.tolist()
+    assert len(ids) >= 8  # ~11 within threshold, ANN may miss a couple
+
+
+def test_batch_search(corpus):
+    idx = HNSWIndex(dim=32)
+    idx.add_batch(np.arange(300), corpus[:300])
+    ids, dists = idx.search_by_vector_batch(corpus[:4], 5)
+    assert ids.shape == (4, 5)
+    for b in range(4):
+        assert ids[b, 0] == b
+
+
+def test_snapshot_restore(corpus):
+    idx = HNSWIndex(dim=32, max_connections=8)
+    idx.add_batch(np.arange(200), corpus[:200])
+    idx.delete(7)
+    snap = idx.snapshot()
+    idx2 = HNSWIndex.restore(snap)
+    assert len(idx2) == 199
+    got, _ = idx2.search_by_vector(corpus[42], 5)
+    assert 42 in got.tolist()
+    assert 7 not in got.tolist()
+
+
+def test_commit_log_replay(tmp_path, corpus):
+    log_dir = str(tmp_path / "hnsw")
+    idx = HNSWIndex(dim=32, commit_log_dir=log_dir)
+    idx.add_batch(np.arange(150), corpus[:150])
+    idx.delete(9)
+    idx._log.close()  # simulate crash: no condense, raw WAL replay
+    idx2 = HNSWIndex(dim=32, commit_log_dir=log_dir)
+    assert len(idx2) == 149
+    assert not idx2.contains(9)
+    got, _ = idx2.search_by_vector(corpus[33], 5)
+    assert 33 in got.tolist()
+
+
+def test_commit_log_condense(tmp_path, corpus):
+    log_dir = str(tmp_path / "hnsw2")
+    idx = HNSWIndex(dim=32, commit_log_dir=log_dir)
+    idx.add_batch(np.arange(100), corpus[:100])
+    idx.condense()
+    assert idx._log.size() == 0  # WAL truncated after snapshot
+    idx.add_batch(np.arange(100, 120), corpus[100:120])
+    idx.close()
+    idx2 = HNSWIndex(dim=32, commit_log_dir=log_dir)
+    assert len(idx2) == 120
+    got, _ = idx2.search_by_vector(corpus[110], 3)
+    assert 110 in got.tolist()
+
+
+def test_dim_mismatch_rejected():
+    idx = HNSWIndex(dim=8)
+    with pytest.raises(ValueError):
+        idx.add(0, np.zeros(16, dtype=np.float32))
+
+
+def test_via_shard_config(tmp_path, corpus):
+    """hnsw index_type flows through the shard factory."""
+    from weaviate_tpu.db.shard import _make_vector_index
+    from weaviate_tpu.schema.config import VectorConfig, VectorIndexConfig
+
+    vc = VectorConfig(index=VectorIndexConfig(index_type="hnsw",
+                                              max_connections=8))
+    idx = _make_vector_index(vc, dim=32)
+    assert idx.index_type == "hnsw"
+    idx.add_batch(np.arange(50), corpus[:50])
+    got, _ = idx.search_by_vector(corpus[5], 3)
+    assert 5 in got.tolist()

@@ -175,3 +175,49 @@ def test_validation_errors(client):
     with pytest.raises(RestError) as e:
         client.request("GET", "/v1/unknown")
     assert e.value.status == 404
+
+
+def test_batch_cross_tenant_grouping(client):
+    """Objects of one class but different tenants must land in their own
+    tenants (regression: grouping by class alone wrote both to the first)."""
+    client.create_class({"name": "MTB",
+                         "multi_tenancy": {"enabled": True}})
+    client.add_tenants("MTB", ["alpha", "beta"])
+    res = client.batch_objects([
+        {"class": "MTB", "tenant": "alpha", "properties": {"x": "a"}},
+        {"class": "MTB", "tenant": "beta", "properties": {"x": "b"}},
+    ])
+    assert all(r["result"]["status"] == "SUCCESS" for r in res)
+    a, b = res[0]["id"], res[1]["id"]
+    assert client.get_object("MTB", a, tenant="alpha")["properties"]["x"] == "a"
+    assert client.get_object("MTB", b, tenant="beta")["properties"]["x"] == "b"
+    with pytest.raises(RestError):
+        client.get_object("MTB", b, tenant="alpha")
+
+
+def test_patch_preserves_named_vectors_and_creation_time(client):
+    client.create_class({"name": "NV", "vectors": [
+        {"name": "title", "index": {"index_type": "flat"}}]})
+    created = client.request("POST", "/v1/objects", body={
+        "class": "NV", "properties": {"a": "one"},
+        "vectors": {"title": [1.0, 2.0, 3.0]}})
+    uid = created["id"]
+    before = client.get_object("NV", uid)
+    patched = client.patch_object("NV", uid, {"b": "two"})
+    after = client.get_object("NV", uid)
+    assert after["properties"] == {"a": "one", "b": "two"}
+    assert after["vectors"]["title"] == [1.0, 2.0, 3.0]
+    assert after["creationTimeUnix"] == before["creationTimeUnix"]
+
+
+def test_schema_mixed_property_styles(client):
+    """Reference-style and native-style properties may mix in one payload;
+    types and index flags must survive (regression: first-entry sniffing
+    coerced native entries to text)."""
+    client.create_class({"name": "Mixed", "properties": [
+        {"name": "a", "dataType": ["text"], "indexSearchable": False},
+        {"name": "n", "data_type": "int"},
+    ]})
+    props = {p["name"]: p for p in client.get_class("Mixed")["properties"]}
+    assert props["n"]["data_type"] == "int"
+    assert props["a"]["index_searchable"] is False

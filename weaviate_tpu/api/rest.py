@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import json
 import logging
-import re
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -74,15 +73,11 @@ def config_from_json(d: dict) -> CollectionConfig:
     d = dict(d)
     if "name" not in d and "class" in d:
         d["name"] = d.pop("class")
-    if d.get("properties") and isinstance(d["properties"][0], dict) \
-            and ("dataType" in d["properties"][0]):
-        d["properties"] = [
-            {"name": p["name"],
-             "data_type": (p["dataType"][0] if isinstance(p.get("dataType"), list)
-                           else p.get("dataType", "text")),
-             "tokenization": p.get("tokenization", "word")}
-            for p in d["properties"]
-        ]
+    if d.get("properties") and isinstance(d["properties"][0], dict):
+        # normalize per property — payloads may mix native and
+        # reference-style entries
+        d["properties"] = [vars(property_from_json(p)) if isinstance(p, dict)
+                           else p for p in d["properties"]]
     return CollectionConfig.from_dict(d)
 
 
@@ -294,6 +289,12 @@ class RestServer:
                     body["properties"] = merged
                     if "vector" not in body and existing.vector is not None:
                         body["vector"] = np.asarray(existing.vector).tolist()
+                    if "vectors" not in body:
+                        named = {k: np.asarray(v).tolist()
+                                 for k, v in existing.vectors.items() if k}
+                        if named:
+                            body["vectors"] = named
+                    body["creationTimeUnix"] = existing.creation_time_ms
                 return self._put_object(body, tenant)
             if method == "DELETE":
                 deleted = col.delete_object(
@@ -315,6 +316,7 @@ class RestServer:
             vectors=body.get("vectors"),
             uuid=body.get("id"),
             tenant=tenant or body.get("tenant"),
+            creation_time_ms=int(body.get("creationTimeUnix") or 0),
         )
         obj = col.get_object(uuid, tenant=tenant or body.get("tenant"))
         return 200, object_to_json(class_name, obj)
@@ -347,12 +349,15 @@ class RestServer:
 
     def _batch_objects(self, body: dict):
         objects = body.get("objects", [])
-        by_class: dict[str, list[tuple[int, dict]]] = {}
+        # group by (class, tenant): one batch_put call writes to exactly one
+        # tenant — grouping by class alone would land cross-tenant objects
+        # in the first entry's tenant
+        by_target: dict[tuple[str, str | None], list[tuple[int, dict]]] = {}
         for i, spec in enumerate(objects):
             cname = spec.get("class") or spec.get("collection") or ""
-            by_class.setdefault(cname, []).append((i, spec))
+            by_target.setdefault((cname, spec.get("tenant")), []).append((i, spec))
         results: list[dict | None] = [None] * len(objects)
-        for cname, entries in by_class.items():
+        for (cname, tenant), entries in by_target.items():
             try:
                 col = self.db.get_collection(cname)
             except KeyError as e:
@@ -361,7 +366,6 @@ class RestServer:
                         "status": "FAILED", "errors": {"error": [
                             {"message": str(e)}]}}}
                 continue
-            tenant = entries[0][1].get("tenant")
             specs = [{
                 "uuid": spec.get("id"),
                 "properties": spec.get("properties", {}),
@@ -380,5 +384,3 @@ class RestServer:
         return 200, results
 
 
-_UUID_RE = re.compile(
-    r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}$")

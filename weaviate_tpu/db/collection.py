@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 import uuid as uuid_mod
 from concurrent.futures import ThreadPoolExecutor
 
@@ -222,9 +223,15 @@ class Collection:
 
     def put_object(self, properties: dict, vector=None, vectors: dict | None = None,
                    uuid: str | None = None, tenant: str | None = None,
-                   consistency: str = "QUORUM") -> str:
+                   consistency: str = "QUORUM", creation_time_ms: int = 0) -> str:
+        """``creation_time_ms``: carried through on updates so a re-put keeps
+        the original creation stamp (reference merge semantics)."""
         uuid = uuid or str(uuid_mod.uuid4())
-        obj = StorageObject(uuid=uuid, properties=properties)
+        obj = StorageObject(uuid=uuid, properties=properties,
+                            creation_time_ms=creation_time_ms)
+        if creation_time_ms:
+            # an update keeps its creation stamp but is "touched" now
+            obj.last_update_time_ms = int(time.time() * 1000)
         if vector is not None:
             obj.vector = np.asarray(vector, dtype=np.float32)
         for name, vec in (vectors or {}).items():

@@ -64,10 +64,27 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
                         dtype=jnp.bfloat16 if cfg.storage_dtype == "bfloat16"
                         else jnp.float32,
                         **common)
-    if cfg.index_type in ("hnsw", "dynamic"):
-        # "hnsw" is accepted for reference-config compatibility; the ANN
-        # regime on TPU is IVF (SURVEY §7 step 5), entered via the dynamic
-        # flat→ANN upgrade so small corpora stay exact
+    if cfg.index_type == "hnsw":
+        # reference-parity graph index (engine/hnsw.py); quantized configs
+        # stay on the flat TPU scan — the graph keeps exact f32 vectors
+        if cfg.quantization:
+            return FlatIndex(
+                quantization=cfg.quantization,
+                pq_segments=cfg.pq_segments,
+                pq_centroids=cfg.pq_centroids,
+                rescore_limit=cfg.rescore_limit,
+                **common,
+            )
+        from weaviate_tpu.engine.hnsw import HNSWIndex
+
+        return HNSWIndex(
+            dim=dim, metric=cfg.metric,
+            max_connections=cfg.max_connections,
+            ef_construction=cfg.ef_construction, ef=cfg.ef,
+        )
+    if cfg.index_type == "dynamic":
+        # the ANN regime on TPU is IVF (SURVEY §7 step 5), entered via the
+        # dynamic flat→ANN upgrade so small corpora stay exact
         from weaviate_tpu.engine.dynamic import DynamicIndex
 
         if cfg.quantization:

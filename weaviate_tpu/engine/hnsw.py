@@ -1,0 +1,610 @@
+"""HNSW graph index — reference-parity ANN with batched candidate scoring.
+
+Reference: adapters/repos/db/vector/hnsw/ (index.go:39 struct, insert.go:226
+Add, search.go:64 SearchByVector, heuristic.go neighbor selection,
+delete.go tombstones, commit_logger.go:246 durability).
+
+Role in this framework: the TPU-native ANN regime is IVF (engine/ivf.py) —
+a graph walk is dependent pointer-chasing, the one shape a systolic array
+cannot help with. HNSW exists for reference parity (classes configured with
+``vectorIndexType: hnsw`` behave like the reference, including recall
+characteristics, tombstone semantics, and filtered-search cutoff) and for
+workloads where single-query latency on the host beats a device round-trip.
+
+Design difference vs the reference's hot loop
+(search.go:173-341, one SIMD call per neighbor): every hop scores ALL
+unvisited neighbors of the popped candidate in one vectorized batch —
+the "batched candidate scoring" plan of SURVEY §7 step 5. The batch engine
+is the host VPU (numpy/BLAS over an [m,d] block); shipping each ~32-row
+batch over PCIe to the TPU would cost more in dispatch latency than the
+score itself, so the device is reserved for the flat-cutoff path and bulk
+rescore where batches are large enough to fill the MXU.
+
+Durability: optional append-only commit log (reference commit_logger.go)
+with snapshot-condense (condensor.go) and replay-on-open (startup.go:57).
+The shard layer instead replays vectors from the objects bucket; the commit
+log serves standalone/embedded users of the index.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+import os
+import pickle
+import random
+import threading
+
+import numpy as np
+
+from weaviate_tpu.storage.wal import WriteAheadLog
+
+# filtered queries with fewer allowed candidates than this do a brute-force
+# scan instead of a graph walk (reference: flatSearchCutoff, hnsw/index.go:95)
+DEFAULT_FLAT_CUTOFF = 40_000
+
+# reference: dynamic ef bounds (entities/vectorindex/hnsw/config.go defaults)
+AUTO_EF_MIN, AUTO_EF_MAX, AUTO_EF_FACTOR = 100, 500, 8
+
+
+class HNSWIndex:
+    """Implements the reference ``VectorIndex`` contract
+    (adapters/repos/db/vector_index.go:24-45) with an HNSW graph."""
+
+    index_type = "hnsw"
+
+    def __init__(self, dim: int, metric: str = "l2-squared",
+                 max_connections: int = 32, ef_construction: int = 128,
+                 ef: int = -1, capacity: int = 1024, seed: int = 0,
+                 flat_cutoff: int = DEFAULT_FLAT_CUTOFF,
+                 commit_log_dir: str | None = None,
+                 condense_above_bytes: int = 16 << 20, **_ignored):
+        if metric not in ("l2-squared", "dot", "cosine", "cosine-dot",
+                          "manhattan", "hamming"):
+            raise ValueError(f"unsupported hnsw metric {metric!r}")
+        self.dim = dim
+        self.metric = metric
+        self.m = max_connections
+        self.m0 = 2 * max_connections  # layer-0 budget (reference maxConnections*2)
+        self.ef_construction = ef_construction
+        self.ef = ef
+        self.flat_cutoff = flat_cutoff
+        self._ml = 1.0 / math.log(max(self.m, 2))
+        self._rng = random.Random(seed)
+        self._lock = threading.RLock()
+
+        cap = max(capacity, 64)
+        self._vecs = np.zeros((cap, dim), dtype=np.float32)
+        self._levels = np.full(cap, -1, dtype=np.int32)  # -1 = unused slot
+        self._doc_ids = np.full(cap, -1, dtype=np.int64)
+        self._tombstone = np.zeros(cap, dtype=bool)
+        # per-slot list over layers of int32 neighbor-slot arrays
+        self._links: list[list[np.ndarray]] = [[] for _ in range(cap)]
+        self._id_to_slot: dict[int, int] = {}
+        self._count = 0
+        self._ep = -1  # entrypoint slot
+        self._max_level = -1
+
+        self._log: WriteAheadLog | None = None
+        self._log_dir = commit_log_dir
+        self._condense_above = condense_above_bytes
+        if commit_log_dir:
+            os.makedirs(commit_log_dir, exist_ok=True)
+            self._replay(commit_log_dir)
+            self._log = WriteAheadLog(os.path.join(commit_log_dir, "hnsw.wal"))
+
+    # -- distance (host batch engine) ----------------------------------------
+
+    def _norm(self, v: np.ndarray) -> np.ndarray:
+        if self.metric in ("cosine", "cosine-dot"):
+            n = np.linalg.norm(v, axis=-1, keepdims=True)
+            return v / np.where(n > 1e-30, n, 1.0)
+        return v
+
+    def _dist(self, q: np.ndarray, slots: np.ndarray) -> np.ndarray:
+        """Distance from query [d] to a slot batch [m] — one vectorized op
+        (replaces the per-pair asm call of distancer/asm/*.s)."""
+        rows = self._vecs[slots]
+        if self.metric == "l2-squared":
+            diff = rows - q
+            return np.einsum("md,md->m", diff, diff)
+        if self.metric in ("dot",):
+            return -(rows @ q)
+        if self.metric in ("cosine", "cosine-dot"):
+            return 1.0 - rows @ q  # both sides normalized at insert/query
+        if self.metric == "manhattan":
+            return np.abs(rows - q).sum(axis=1)
+        # hamming over float values (reference hamming.go:18-27)
+        return (rows != q).sum(axis=1).astype(np.float32)
+
+    def _dist_pair(self, a: int, b: int) -> float:
+        return float(self._dist(self._vecs[a], np.array([b]))[0])
+
+    # -- capacity -------------------------------------------------------------
+
+    def _grow(self, need: int):
+        cap = len(self._vecs)
+        if need <= cap:
+            return
+        new_cap = cap
+        while new_cap < need:
+            new_cap *= 2
+        self._vecs = np.vstack([self._vecs,
+                                np.zeros((new_cap - cap, self.dim), np.float32)])
+        self._levels = np.concatenate([self._levels,
+                                       np.full(new_cap - cap, -1, np.int32)])
+        self._doc_ids = np.concatenate([self._doc_ids,
+                                        np.full(new_cap - cap, -1, np.int64)])
+        self._tombstone = np.concatenate([self._tombstone,
+                                          np.zeros(new_cap - cap, bool)])
+        self._links.extend([] for _ in range(new_cap - cap))
+        for i in range(cap, new_cap):
+            self._links[i] = []
+
+    # -- graph search core ----------------------------------------------------
+
+    def _search_layer(self, q: np.ndarray, eps: list[tuple[float, int]],
+                      ef: int, layer: int) -> list[tuple[float, int]]:
+        """Best-first ef-search on one layer (reference
+        searchLayerByVectorWithDistancer, search.go:173-341). Entry/exit is
+        a list of (dist, slot) tuples. Tombstoned nodes are traversed but
+        returned too — callers filter; pruning them here would disconnect
+        regions behind tombstones (same reason the reference keeps them)."""
+        visited = np.zeros(len(self._vecs), dtype=bool)
+        cand: list[tuple[float, int]] = []  # min-heap
+        top: list[tuple[float, int]] = []  # max-heap via negated dist
+        for d, s in eps:
+            visited[s] = True
+            heapq.heappush(cand, (d, s))
+            heapq.heappush(top, (-d, s))
+        while cand:
+            d, c = heapq.heappop(cand)
+            if top and d > -top[0][0] and len(top) >= ef:
+                break
+            links = self._links[c]
+            if layer >= len(links):
+                continue
+            neigh = links[layer]
+            if len(neigh) == 0:
+                continue
+            fresh = neigh[~visited[neigh]]
+            if len(fresh) == 0:
+                continue
+            visited[fresh] = True
+            dists = self._dist(q, fresh)  # ← the batched hop
+            worst = -top[0][0] if top else np.inf
+            for nd, ns in zip(dists.tolist(), fresh.tolist()):
+                if len(top) < ef or nd < worst:
+                    heapq.heappush(cand, (nd, ns))
+                    heapq.heappush(top, (-nd, ns))
+                    if len(top) > ef:
+                        heapq.heappop(top)
+                    worst = -top[0][0]
+        return sorted((-d, s) for d, s in top)
+
+    def _greedy_descend(self, q: np.ndarray, slot: int, dist: float,
+                        from_level: int, to_level: int) -> tuple[float, int]:
+        """ef=1 walk down the upper layers (search.go:479 descent loop)."""
+        for layer in range(from_level, to_level, -1):
+            improved = True
+            while improved:
+                improved = False
+                links = self._links[slot]
+                if layer >= len(links) or len(links[layer]) == 0:
+                    break
+                neigh = links[layer]
+                dists = self._dist(q, neigh)
+                j = int(np.argmin(dists))
+                if dists[j] < dist:
+                    dist, slot = float(dists[j]), int(neigh[j])
+                    improved = True
+        return dist, slot
+
+    # -- neighbor selection (heuristic.go) ------------------------------------
+
+    def _select_heuristic(self, cands: list[tuple[float, int]],
+                          m: int) -> list[int]:
+        """Keep a candidate only if it is closer to the query than to every
+        already-selected neighbor — the diversity heuristic of
+        heuristic.go (selectNeighborsHeuristic)."""
+        selected: list[int] = []
+        for d, c in sorted(cands):
+            if len(selected) >= m:
+                break
+            if not selected:
+                selected.append(c)
+                continue
+            dists_to_sel = self._dist(self._vecs[c], np.asarray(selected))
+            if np.all(dists_to_sel > d):
+                selected.append(c)
+        return selected
+
+    def _set_links(self, slot: int, layer: int, neighbors: list[int]):
+        links = self._links[slot]
+        while len(links) <= layer:
+            links.append(np.empty(0, dtype=np.int32))
+        links[layer] = np.asarray(neighbors, dtype=np.int32)
+        if self._log is not None:
+            self._log.append(pickle.dumps(
+                ("L", int(self._doc_ids[slot]), layer,
+                 self._doc_ids[links[layer]].tolist()),
+                protocol=pickle.HIGHEST_PROTOCOL))
+
+    def _add_backlink(self, neighbor: int, slot: int, layer: int):
+        links = self._links[neighbor]
+        while len(links) <= layer:
+            links.append(np.empty(0, dtype=np.int32))
+        cur = links[layer]
+        if slot in cur:
+            return
+        budget = self.m0 if layer == 0 else self.m
+        if len(cur) < budget:
+            self._set_links(neighbor, layer, cur.tolist() + [slot])
+            return
+        # over-full: re-select with the heuristic over old + new
+        # (reference insert.go connectNeighbor shrink path)
+        q = self._vecs[neighbor]
+        cand_slots = np.concatenate([cur, [slot]])
+        dists = self._dist(q, cand_slots)
+        cands = list(zip(dists.tolist(), cand_slots.tolist()))
+        self._set_links(neighbor, layer, self._select_heuristic(cands, budget))
+
+    # -- mutation -------------------------------------------------------------
+
+    def add(self, doc_id: int, vector: np.ndarray) -> None:
+        self.add_batch([doc_id], np.asarray(vector, dtype=np.float32)[None, :])
+
+    def add_batch(self, doc_ids, vectors: np.ndarray) -> None:
+        doc_ids = np.asarray(doc_ids, dtype=np.int64)
+        vectors = self._norm(np.asarray(vectors, dtype=np.float32))
+        if vectors.ndim == 1:
+            vectors = vectors[None, :]
+        if len(doc_ids) != len(vectors):
+            raise ValueError(f"{len(doc_ids)} ids != {len(vectors)} vectors")
+        if vectors.shape[1] != self.dim:
+            raise ValueError(f"vector dim {vectors.shape[1]} != index dim {self.dim}")
+        with self._lock:
+            for doc_id, vec in zip(doc_ids.tolist(), vectors):
+                self._insert_one(int(doc_id), vec)
+
+    def _insert_one(self, doc_id: int, vec: np.ndarray):
+        old = self._id_to_slot.get(doc_id)
+        if old is not None:
+            # update = tombstone old node + fresh insert (the reference
+            # re-adds under a new doc id; inside one index this is the analog)
+            self._tombstone[old] = True
+            self._doc_ids[old] = -1
+        slot = self._count
+        self._grow(slot + 1)
+        self._count += 1
+        level = int(-math.log(max(self._rng.random(), 1e-12)) * self._ml)
+        self._vecs[slot] = vec
+        self._levels[slot] = level
+        self._doc_ids[slot] = doc_id
+        self._id_to_slot[doc_id] = slot
+        if self._log is not None:
+            self._log.append(pickle.dumps(
+                ("N", doc_id, level, vec.tobytes()),
+                protocol=pickle.HIGHEST_PROTOCOL))
+        if self._ep < 0:
+            self._ep, self._max_level = slot, level
+            self._set_links(slot, 0, [])
+            self._maybe_condense()
+            return
+        ep_d = float(self._dist(vec, np.array([self._ep]))[0])
+        ep_d, ep = self._greedy_descend(vec, self._ep, ep_d,
+                                        self._max_level, level)
+        eps = [(ep_d, ep)]
+        for layer in range(min(level, self._max_level), -1, -1):
+            cands = self._search_layer(vec, eps, self.ef_construction, layer)
+            budget = self.m0 if layer == 0 else self.m
+            neighbors = self._select_heuristic(cands, budget)
+            self._set_links(slot, layer, neighbors)
+            for n in neighbors:
+                self._add_backlink(n, slot, layer)
+            eps = cands
+        if level > self._max_level:
+            self._ep, self._max_level = slot, level
+            if self._log is not None:
+                self._log.append(pickle.dumps(("E", doc_id, level),
+                                              protocol=pickle.HIGHEST_PROTOCOL))
+        self._maybe_condense()
+
+    def delete(self, *doc_ids) -> None:
+        """Tombstone (reference delete.go: delete marks, cleanup re-links)."""
+        with self._lock:
+            for doc_id in doc_ids:
+                slot = self._id_to_slot.pop(int(doc_id), None)
+                if slot is None:
+                    continue
+                self._tombstone[slot] = True
+                self._doc_ids[slot] = -1
+                if self._log is not None:
+                    self._log.append(pickle.dumps(("D", int(doc_id)),
+                                                  protocol=pickle.HIGHEST_PROTOCOL))
+
+    def cleanup_tombstones(self) -> int:
+        """Physically unlink tombstoned nodes, re-linking their neighbors
+        through the heuristic (reference tombstone-cleanup cycle,
+        hnsw/delete.go + index_cyclecallbacks). Returns nodes removed."""
+        with self._lock:
+            dead = np.nonzero(self._tombstone[: self._count])[0]
+            if len(dead) == 0:
+                return 0
+            dead_set = set(dead.tolist())
+            for slot in range(self._count):
+                if slot in dead_set:
+                    continue
+                for layer, neigh in enumerate(self._links[slot]):
+                    if len(neigh) == 0 or not np.any(self._tombstone[neigh]):
+                        continue
+                    alive = neigh[~self._tombstone[neigh]].tolist()
+                    # candidates: alive old neighbors + alive 2-hop via dead
+                    cand_set = set(alive)
+                    for dn in neigh[self._tombstone[neigh]].tolist():
+                        if layer < len(self._links[dn]):
+                            for nn in self._links[dn][layer].tolist():
+                                if nn != slot and not self._tombstone[nn]:
+                                    cand_set.add(nn)
+                    budget = self.m0 if layer == 0 else self.m
+                    cand = np.fromiter(cand_set, dtype=np.int64)
+                    if len(cand):
+                        dists = self._dist(self._vecs[slot], cand)
+                        sel = self._select_heuristic(
+                            list(zip(dists.tolist(), cand.tolist())), budget)
+                    else:
+                        sel = []
+                    self._set_links(slot, layer, sel)
+            for slot in dead.tolist():
+                self._links[slot] = []
+                self._levels[slot] = -1
+                self._tombstone[slot] = False  # slot stays burned (not reused)
+            if self._ep in dead_set:
+                self._elect_entrypoint()
+            return len(dead)
+
+    def _elect_entrypoint(self):
+        live = [s for s in range(self._count)
+                if self._doc_ids[s] >= 0 and not self._tombstone[s]]
+        if not live:
+            self._ep, self._max_level = -1, -1
+            return
+        best = max(live, key=lambda s: int(self._levels[s]))
+        self._ep, self._max_level = best, int(self._levels[best])
+        if self._log is not None:
+            self._log.append(pickle.dumps(
+                ("E", int(self._doc_ids[best]), self._max_level),
+                protocol=pickle.HIGHEST_PROTOCOL))
+
+    # -- queries --------------------------------------------------------------
+
+    def contains(self, doc_id: int) -> bool:
+        return int(doc_id) in self._id_to_slot
+
+    def __len__(self) -> int:
+        return len(self._id_to_slot)
+
+    def _effective_ef(self, k: int) -> int:
+        if self.ef > 0:
+            return max(self.ef, k)
+        # dynamic ef (reference autoEf* defaults)
+        return min(max(k * AUTO_EF_FACTOR, AUTO_EF_MIN), AUTO_EF_MAX)
+
+    def _allowed_slots(self, allow_list) -> np.ndarray | None:
+        if allow_list is None:
+            return None
+        allow_list = np.asarray(allow_list)
+        if allow_list.dtype == np.bool_:
+            allow_list = np.nonzero(allow_list)[0]
+        slots = [self._id_to_slot[int(i)] for i in allow_list.tolist()
+                 if int(i) in self._id_to_slot]
+        return np.asarray(slots, dtype=np.int64)
+
+    def search_by_vector(self, query: np.ndarray, k: int,
+                         allow_list: np.ndarray | None = None):
+        q = self._norm(np.asarray(query, dtype=np.float32).reshape(-1))
+        with self._lock:
+            allowed = self._allowed_slots(allow_list)
+            if allowed is not None and len(allowed) <= self.flat_cutoff:
+                # small filter → brute force beats a constrained graph walk
+                # (reference flat_search.go + flatSearchCutoff, index.go:95)
+                if len(allowed) == 0:
+                    return (np.empty(0, np.int64), np.empty(0, np.float32))
+                dists = self._dist(q, allowed)
+                order = np.argsort(dists, kind="stable")[:k]
+                return self._doc_ids[allowed[order]], dists[order].astype(np.float32)
+            if self._ep < 0:
+                return (np.empty(0, np.int64), np.empty(0, np.float32))
+            ef = max(self._effective_ef(k), k)
+            d0 = float(self._dist(q, np.array([self._ep]))[0])
+            d0, ep = self._greedy_descend(q, self._ep, d0, self._max_level, 0)
+            cands = self._search_layer(q, [(d0, ep)], ef, 0)
+            allow_mask = None
+            if allowed is not None:
+                allow_mask = np.zeros(len(self._vecs), dtype=bool)
+                allow_mask[allowed] = True
+            out_ids, out_d = [], []
+            for d, s in cands:
+                if self._tombstone[s] or self._doc_ids[s] < 0:
+                    continue
+                if allow_mask is not None and not allow_mask[s]:
+                    continue
+                out_ids.append(int(self._doc_ids[s]))
+                out_d.append(d)
+                if len(out_ids) == k:
+                    break
+            return (np.asarray(out_ids, dtype=np.int64),
+                    np.asarray(out_d, dtype=np.float32))
+
+    def search_by_vector_batch(self, queries: np.ndarray, k: int,
+                               allow_list: np.ndarray | None = None):
+        queries = np.asarray(queries, dtype=np.float32)
+        ids = np.full((len(queries), k), -1, dtype=np.int64)
+        dists = np.full((len(queries), k), np.float32(np.inf), dtype=np.float32)
+        for b, q in enumerate(queries):
+            i, d = self.search_by_vector(q, k, allow_list)
+            ids[b, : len(i)] = i
+            dists[b, : len(d)] = d
+        return ids, dists
+
+    def search_by_vector_distance(self, query: np.ndarray, max_distance: float,
+                                  allow_list: np.ndarray | None = None):
+        """Range search by widening ef until the frontier crosses the
+        threshold (reference SearchByVectorDistance: iterative widening)."""
+        k = 64
+        while True:
+            ids, d = self.search_by_vector(query, k, allow_list)
+            if len(d) < k or (len(d) and d[-1] > max_distance):
+                within = d <= max_distance
+                return ids[within], d[within]
+            if k >= max(len(self._id_to_slot), 1):
+                within = d <= max_distance
+                return ids[within], d[within]
+            k *= 4
+
+    # -- compression hook -----------------------------------------------------
+
+    @property
+    def compressed(self) -> bool:
+        return False
+
+    def compress(self, *a, **kw):
+        raise NotImplementedError(
+            "PQ/BQ-compressed scans live on the flat/IVF TPU path "
+            "(engine/quantized.py); the host graph keeps exact f32 vectors"
+        )
+
+    # -- maintenance ----------------------------------------------------------
+
+    def maintenance(self) -> bool:
+        return self.cleanup_tombstones() > 0
+
+    def compact(self):
+        self.cleanup_tombstones()
+
+    # -- persistence ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "index_type": self.index_type,
+                "dim": self.dim,
+                "metric": self.metric,
+                "m": self.m,
+                "ef_construction": self.ef_construction,
+                "ef": self.ef,
+                "count": self._count,
+                "vectors": self._vecs[: self._count].copy(),
+                "levels": self._levels[: self._count].copy(),
+                "doc_ids": self._doc_ids[: self._count].copy(),
+                "tombstone": self._tombstone[: self._count].copy(),
+                "links": [[l.tolist() for l in self._links[s]]
+                          for s in range(self._count)],
+                "ep": self._ep,
+                "max_level": self._max_level,
+            }
+
+    @classmethod
+    def restore(cls, snap: dict, **kwargs) -> "HNSWIndex":
+        idx = cls(dim=snap["dim"], metric=snap["metric"],
+                  max_connections=snap["m"],
+                  ef_construction=snap["ef_construction"], ef=snap["ef"],
+                  capacity=max(snap["count"], 64), **kwargs)
+        n = snap["count"]
+        idx._count = n
+        idx._vecs[:n] = snap["vectors"]
+        idx._levels[:n] = snap["levels"]
+        idx._doc_ids[:n] = snap["doc_ids"]
+        idx._tombstone[:n] = snap["tombstone"]
+        for s in range(n):
+            idx._links[s] = [np.asarray(l, dtype=np.int32)
+                             for l in snap["links"][s]]
+        idx._ep = snap["ep"]
+        idx._max_level = snap["max_level"]
+        idx._id_to_slot = {int(d): s for s, d in enumerate(snap["doc_ids"])
+                           if d >= 0}
+        return idx
+
+    # -- commit log (reference commit_logger.go / condensor.go) ---------------
+
+    def _maybe_condense(self):
+        if self._log is None or self._log.size() < self._condense_above:
+            return
+        self.condense()
+
+    def condense(self):
+        """Replace the op log with a snapshot (reference condensor.go:27 —
+        theirs rewrites a minimal op stream; a snapshot is the same
+        fixed point)."""
+        if self._log_dir is None:
+            return
+        with self._lock:
+            tmp = os.path.join(self._log_dir, "hnsw.snap.tmp")
+            final = os.path.join(self._log_dir, "hnsw.snap")
+            with open(tmp, "wb") as f:
+                pickle.dump(self.snapshot(), f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, final)
+            self._log.reset()
+
+    def _replay(self, log_dir: str):
+        snap_path = os.path.join(log_dir, "hnsw.snap")
+        if os.path.exists(snap_path):
+            with open(snap_path, "rb") as f:
+                snap = pickle.load(f)
+            restored = HNSWIndex.restore(snap)
+            # adopt graph state + graph hyperparams from the snapshot, but
+            # keep this instance's runtime knobs (flat_cutoff, RNG seed,
+            # log config) — restore() would reset them to defaults
+            keep = ("_log", "_log_dir", "_condense_above", "flat_cutoff",
+                    "_rng", "ef")
+            self.__dict__.update(
+                {k: v for k, v in restored.__dict__.items() if k not in keep})
+        wal_path = os.path.join(log_dir, "hnsw.wal")
+        if not os.path.exists(wal_path):
+            return
+        for payload in WriteAheadLog.replay(wal_path):
+            op = pickle.loads(payload)
+            tag = op[0]
+            if tag == "N":
+                _, doc_id, level, raw = op
+                vec = np.frombuffer(raw, dtype=np.float32)
+                old = self._id_to_slot.get(doc_id)
+                if old is not None:
+                    self._tombstone[old] = True
+                    self._doc_ids[old] = -1
+                slot = self._count
+                self._grow(slot + 1)
+                self._count += 1
+                self._vecs[slot] = vec
+                self._levels[slot] = level
+                self._doc_ids[slot] = doc_id
+                self._id_to_slot[doc_id] = slot
+                if self._ep < 0 or level > self._max_level:
+                    self._ep, self._max_level = slot, level
+            elif tag == "L":
+                _, doc_id, layer, neigh_ids = op
+                slot = self._id_to_slot.get(doc_id)
+                if slot is None:
+                    continue
+                neigh = [self._id_to_slot[i] for i in neigh_ids
+                         if i in self._id_to_slot]
+                links = self._links[slot]
+                while len(links) <= layer:
+                    links.append(np.empty(0, dtype=np.int32))
+                links[layer] = np.asarray(neigh, dtype=np.int32)
+            elif tag == "D":
+                _, doc_id = op
+                slot = self._id_to_slot.pop(doc_id, None)
+                if slot is not None:
+                    self._tombstone[slot] = True
+                    self._doc_ids[slot] = -1
+            elif tag == "E":
+                _, doc_id, level = op
+                slot = self._id_to_slot.get(doc_id)
+                if slot is not None:
+                    self._ep, self._max_level = slot, level
+
+    def close(self):
+        if self._log is not None:
+            self.condense()
+            self._log.close()

@@ -90,62 +90,96 @@ class Replicator:
         Catches ALL exceptions per replica — a commit-time validation or
         memory error on one replica must still commit/abort the others,
         or their staged entries leak and the set diverges silently."""
-        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
         nodes = self.col.sharding.nodes_for(shard_name)
         need = required_acks(level, len(nodes))
         rid = str(uuid_mod.uuid4())
-        prepared: list[str] = []
-        errors: list[str] = []
 
-        # broadcast CONCURRENTLY (reference coordinator.broadcast): one
-        # partitioned replica hanging until its RPC timeout must not add
-        # that timeout to every write that still has quorum
-        def try_prepare(node):
+        # Both phases broadcast CONCURRENTLY and return as soon as `need`
+        # acks land (reference coordinator.broadcast + level counting,
+        # coordinator.go:96-130): one partitioned replica hanging until its
+        # RPC timeout must not add that timeout to a write that already has
+        # quorum. Stragglers finish on pool threads after we return —
+        # successes are committed so they converge, failures are aborted
+        # (and any leaked staged entry falls to the gc_staged TTL +
+        # anti-entropy).
+        pool = ThreadPoolExecutor(max_workers=max(1, 2 * len(nodes)))
+
+        def safe_abort(node):
             try:
-                self._prepare(node, shard_name, rid, task)
-                return node, None
-            except Exception as e:
-                return node, e
-
-        with ThreadPoolExecutor(max_workers=max(1, len(nodes))) as pool:
-            for node, err in pool.map(try_prepare, nodes):
-                if err is None:
-                    prepared.append(node)
-                else:
-                    errors.append(f"{node}: {err}")
-        if len(prepared) < need:
-            for node in prepared:
                 self._abort(node, shard_name, rid)
-            raise ConsistencyError(
-                f"prepare acked by {len(prepared)}/{len(nodes)} replicas, "
-                f"need {need} for {level}: {'; '.join(errors)}")
-        # commit phase: commit everywhere that prepared; the write succeeds
-        # once `need` commits land (stragglers are repaired by anti-entropy)
-        results: list = []
-        commit_errors: list[str] = []
+            except Exception:
+                pass  # unreachable abort → staged-entry TTL cleans up
 
-        def try_commit(node):
-            try:
-                return node, self._commit(node, shard_name, rid), None
-            except Exception as e:
-                return node, None, e
+        def commit_straggler(fut, node):
+            if fut.exception() is None:
+                try:
+                    self._commit(node, shard_name, rid)
+                except Exception:
+                    safe_abort(node)
 
-        with ThreadPoolExecutor(max_workers=max(1, len(prepared))) as pool:
-            outcomes = list(pool.map(try_commit, prepared))
-        for node, result, err in outcomes:
-            if err is None:
-                results.append(result)
-            else:
-                commit_errors.append(f"{node}: {err}")
-                # release any still-staged entry (idempotent if the commit
-                # half-landed or the node is unreachable)
-                self._abort(node, shard_name, rid)
-        if len(results) < need:
-            raise ConsistencyError(
-                f"commit acked by {len(results)}/{len(prepared)} prepared "
-                f"replicas, need {need}: {'; '.join(commit_errors)}")
-        return results
+        try:
+            prep_futs = {pool.submit(self._prepare, node, shard_name, rid,
+                                     task): node for node in nodes}
+            prepared: list[str] = []
+            errors: list[str] = []
+            pending = set(prep_futs)
+            while pending and len(prepared) < need \
+                    and len(errors) <= len(nodes) - need:
+                done, pending = wait(pending, return_when=FIRST_COMPLETED)
+                for f in done:
+                    node = prep_futs[f]
+                    if f.exception() is None:
+                        prepared.append(node)
+                    else:
+                        errors.append(f"{node}: {f.exception()}")
+            if len(prepared) < need:
+                # quorum impossible: abort what prepared; late preparers
+                # abort themselves via callback
+                for f in pending:
+                    node = prep_futs[f]
+                    f.add_done_callback(
+                        lambda fut, n=node: fut.exception() is None
+                        and safe_abort(n))
+                for node in prepared:
+                    pool.submit(safe_abort, node)
+                raise ConsistencyError(
+                    f"prepare acked by {len(prepared)}/{len(nodes)} replicas, "
+                    f"need {need} for {level}: {'; '.join(errors)}")
+            # quorum prepared; late preparers get committed as they arrive
+            for f in pending:
+                f.add_done_callback(
+                    lambda fut, n=prep_futs[f]: commit_straggler(fut, n))
+            # commit phase over the quorum set
+            commit_futs = {pool.submit(self._commit, node, shard_name, rid):
+                           node for node in prepared}
+            results: list = []
+            commit_errors: list[str] = []
+            pending = set(commit_futs)
+            while pending and len(results) < need:
+                done, pending = wait(pending, return_when=FIRST_COMPLETED)
+                for f in done:
+                    node = commit_futs[f]
+                    if f.exception() is None:
+                        results.append(f.result())
+                    else:
+                        commit_errors.append(f"{node}: {f.exception()}")
+                        # release any still-staged entry (idempotent if the
+                        # commit half-landed or the node is unreachable)
+                        pool.submit(safe_abort, node)
+            for f in pending:  # commit stragglers: abort on failure
+                node = commit_futs[f]
+                f.add_done_callback(
+                    lambda fut, n=node: fut.exception() is not None
+                    and safe_abort(n))
+            if len(results) < need:
+                raise ConsistencyError(
+                    f"commit acked by {len(results)}/{len(prepared)} prepared "
+                    f"replicas, need {need}: {'; '.join(commit_errors)}")
+            return results
+        finally:
+            pool.shutdown(wait=False)
 
     def put_objects(self, shard_name: str, objs: list[StorageObject],
                     level: str = "QUORUM"):
