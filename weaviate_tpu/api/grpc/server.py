@@ -1,0 +1,711 @@
+"""gRPC v1 service implementation.
+
+Reference: adapters/handlers/grpc/v1/service.go (Search :173, BatchObjects
+:126), parse_search_request.go (proto -> search params), prepare_reply.go
+(results -> proto). One unary-unary handler per RPC; request parsing and
+reply marshalling live next to each other per RPC, mirroring the
+reference's parse/prepare split.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid as _uuid
+from concurrent.futures import ThreadPoolExecutor
+
+import grpc
+import numpy as np
+from google.protobuf import json_format
+
+from weaviate_tpu.api.grpc import v1_pb2 as pb
+from weaviate_tpu.filters.filters import Filter, Operator
+from weaviate_tpu.schema.config import DataType
+
+logger = logging.getLogger(__name__)
+
+_SERVICE = "weaviate.v1.Weaviate"
+
+_CONSISTENCY = {
+    pb.CONSISTENCY_LEVEL_UNSPECIFIED: "QUORUM",
+    pb.CONSISTENCY_LEVEL_ONE: "ONE",
+    pb.CONSISTENCY_LEVEL_QUORUM: "QUORUM",
+    pb.CONSISTENCY_LEVEL_ALL: "ALL",
+}
+
+_OPERATORS = {
+    pb.Filters.OPERATOR_EQUAL: Operator.EQUAL,
+    pb.Filters.OPERATOR_NOT_EQUAL: Operator.NOT_EQUAL,
+    pb.Filters.OPERATOR_GREATER_THAN: Operator.GREATER_THAN,
+    pb.Filters.OPERATOR_GREATER_THAN_EQUAL: Operator.GREATER_THAN_EQUAL,
+    pb.Filters.OPERATOR_LESS_THAN: Operator.LESS_THAN,
+    pb.Filters.OPERATOR_LESS_THAN_EQUAL: Operator.LESS_THAN_EQUAL,
+    pb.Filters.OPERATOR_AND: Operator.AND,
+    pb.Filters.OPERATOR_OR: Operator.OR,
+    pb.Filters.OPERATOR_WITHIN_GEO_RANGE: Operator.WITHIN_GEO_RANGE,
+    pb.Filters.OPERATOR_LIKE: Operator.LIKE,
+    pb.Filters.OPERATOR_IS_NULL: Operator.IS_NULL,
+    pb.Filters.OPERATOR_CONTAINS_ANY: Operator.CONTAINS_ANY,
+    pb.Filters.OPERATOR_CONTAINS_ALL: Operator.CONTAINS_ALL,
+}
+
+
+class ApiError(Exception):
+    def __init__(self, code: grpc.StatusCode, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+# ---------------------------------------------------------------------------
+# request parsing (reference: v1/parse_search_request.go)
+# ---------------------------------------------------------------------------
+
+def _vector_from(vector_bytes: bytes, vector_floats) -> np.ndarray | None:
+    if vector_bytes:
+        return np.frombuffer(vector_bytes, dtype="<f4").astype(np.float32)
+    if len(vector_floats):
+        return np.asarray(list(vector_floats), dtype=np.float32)
+    return None
+
+
+def filters_from_pb(f: "pb.Filters") -> Filter:
+    op = _OPERATORS.get(f.operator)
+    if op is None:
+        raise ApiError(grpc.StatusCode.INVALID_ARGUMENT,
+                       f"unknown filter operator {f.operator}")
+    if op in (Operator.AND, Operator.OR):
+        return Filter(op, operands=[filters_from_pb(c) for c in f.filters])
+    # target path: new-style FilterTarget.property, else legacy 'on'
+    path: list[str] | None = None
+    which = f.target.WhichOneof("target")
+    if which == "property":
+        path = [f.target.property]
+    elif which in ("single_target", "multi_target"):
+        tgt = getattr(f.target, which)
+        sub = tgt.target.WhichOneof("target")
+        path = [tgt.on] + ([tgt.target.property] if sub == "property" else [])
+    elif which == "count":
+        path = [f.target.count.on]
+    elif len(f.on):
+        path = list(f.on)
+    value_field = f.WhichOneof("test_value")
+    value = None
+    if value_field is not None:
+        raw = getattr(f, value_field)
+        if value_field in ("value_text_array", "value_int_array",
+                          "value_boolean_array", "value_number_array"):
+            value = list(raw.values)
+        elif value_field == "value_geo":
+            value = {"geoCoordinates": {"latitude": raw.latitude,
+                                        "longitude": raw.longitude},
+                     "distance": {"max": raw.distance}}
+        else:
+            value = raw
+    return Filter(op, path=path, value=value)
+
+
+def _props_from_batch_object(bo: "pb.BatchObject") -> dict:
+    """Flatten the typed batch property payload back into a plain dict
+    (the reference re-assembles models.Object the same way,
+    v1/batch_parse_request.go)."""
+    p = bo.properties
+    props = json_format.MessageToDict(p.non_ref_properties)
+    for arr in p.number_array_properties:
+        props[arr.prop_name] = (
+            list(np.frombuffer(arr.values_bytes, dtype="<f8"))
+            if arr.values_bytes else list(arr.values))
+    for arr in p.int_array_properties:
+        props[arr.prop_name] = list(arr.values)
+    for arr in p.text_array_properties:
+        props[arr.prop_name] = list(arr.values)
+    for arr in p.boolean_array_properties:
+        props[arr.prop_name] = list(arr.values)
+    for obj in p.object_properties:
+        props[obj.prop_name] = _object_value_to_dict(obj.value)
+    for arr in p.object_array_properties:
+        props[arr.prop_name] = [_object_value_to_dict(v) for v in arr.values]
+    for name in p.empty_list_props:
+        props[name] = []
+    for ref in p.single_target_ref_props:
+        props[ref.prop_name] = [
+            {"beacon": f"weaviate://localhost/{u}"} for u in ref.uuids]
+    for ref in p.multi_target_ref_props:
+        props[ref.prop_name] = [
+            {"beacon": f"weaviate://localhost/{ref.target_collection}/{u}"}
+            for u in ref.uuids]
+    return props
+
+
+def _object_value_to_dict(val: "pb.ObjectPropertiesValue") -> dict:
+    out = json_format.MessageToDict(val.non_ref_properties)
+    for arr in val.number_array_properties:
+        out[arr.prop_name] = (
+            list(np.frombuffer(arr.values_bytes, dtype="<f8"))
+            if arr.values_bytes else list(arr.values))
+    for arr in val.int_array_properties:
+        out[arr.prop_name] = list(arr.values)
+    for arr in val.text_array_properties:
+        out[arr.prop_name] = list(arr.values)
+    for arr in val.boolean_array_properties:
+        out[arr.prop_name] = list(arr.values)
+    for obj in val.object_properties:
+        out[obj.prop_name] = _object_value_to_dict(obj.value)
+    for arr in val.object_array_properties:
+        out[arr.prop_name] = [_object_value_to_dict(v) for v in arr.values]
+    for name in val.empty_list_props:
+        out[name] = []
+    return out
+
+
+# ---------------------------------------------------------------------------
+# reply marshalling (reference: v1/prepare_reply.go, mapping.go)
+# ---------------------------------------------------------------------------
+
+def _to_value(x, dtype: str | None) -> "pb.Value":
+    v = pb.Value()
+    if x is None:
+        v.null_value = 0
+        return v
+    if isinstance(x, bool):
+        v.bool_value = x
+        return v
+    if isinstance(x, (int, float, np.integer, np.floating)) \
+            and dtype == DataType.INT:
+        # Struct-borne numbers are f64; the schema says this one is an int
+        v.int_value = int(x)
+        return v
+    if isinstance(x, (int, float, np.floating, np.integer)):
+        if dtype == DataType.DATE:
+            v.date_value = str(x)
+        else:
+            v.number_value = float(x)
+        return v
+    if isinstance(x, str):
+        if dtype == DataType.DATE:
+            v.date_value = x
+        elif dtype == DataType.UUID:
+            v.uuid_value = x
+        elif dtype == DataType.BLOB:
+            v.blob_value = x
+        else:
+            v.text_value = x
+        return v
+    if isinstance(x, dict):
+        if "latitude" in x and "longitude" in x:
+            v.geo_value.latitude = float(x["latitude"])
+            v.geo_value.longitude = float(x["longitude"])
+            return v
+        for key, sub in x.items():
+            v.object_value.fields[key].CopyFrom(_to_value(sub, None))
+        return v
+    if isinstance(x, (list, tuple, np.ndarray)):
+        lv = v.list_value
+        seq = list(x)
+        if not seq:
+            lv.text_values.SetInParent()
+        elif all(isinstance(e, bool) for e in seq):
+            lv.bool_values.values.extend(seq)
+        elif dtype == DataType.INT_ARRAY or all(
+                isinstance(e, (int, np.integer)) and not isinstance(e, bool)
+                for e in seq):
+            lv.int_values.values = np.asarray(seq, dtype="<i8").tobytes()
+        elif all(isinstance(e, (int, float, np.floating, np.integer))
+                 for e in seq):
+            lv.number_values.values = np.asarray(seq, dtype="<f8").tobytes()
+        elif dtype == DataType.DATE_ARRAY:
+            lv.date_values.values.extend(str(e) for e in seq)
+        elif dtype == DataType.UUID_ARRAY:
+            lv.uuid_values.values.extend(str(e) for e in seq)
+        elif all(isinstance(e, dict) for e in seq):
+            for e in seq:
+                props = lv.object_values.values.add()
+                for key, sub in e.items():
+                    props.fields[key].CopyFrom(_to_value(sub, None))
+        else:
+            lv.text_values.values.extend(str(e) for e in seq)
+        return v
+    v.text_value = str(x)
+    return v
+
+
+def _f32_bytes(vec) -> bytes:
+    return np.asarray(vec, dtype="<f4").tobytes()
+
+
+class GrpcServer:
+    """``db``: node-local Database (or anything exposing get_collection).
+    ``modules``: optional module Provider for nearText / generative /
+    rerank (usecases/modules analog)."""
+
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
+                 modules=None, auth=None, max_workers: int = 16):
+        self.db = db
+        self.modules = modules
+        self.auth = auth
+        handlers = {
+            "Search": self._search,
+            "BatchObjects": self._batch_objects,
+            "BatchDelete": self._batch_delete,
+            "TenantsGet": self._tenants_get,
+        }
+        req_types = {
+            "Search": pb.SearchRequest,
+            "BatchObjects": pb.BatchObjectsRequest,
+            "BatchDelete": pb.BatchDeleteRequest,
+            "TenantsGet": pb.TenantsGetRequest,
+        }
+        method_handlers = {}
+        for name, fn in handlers.items():
+            method_handlers[name] = grpc.unary_unary_rpc_method_handler(
+                self._wrap(fn),
+                request_deserializer=req_types[name].FromString,
+                response_serializer=lambda resp: resp.SerializeToString(),
+            )
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, method_handlers),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    def start(self):
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 0.5):
+        self._server.stop(grace)
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _wrap(self, fn):
+        def handler(request, context):
+            try:
+                self._check_auth(context)
+                return fn(request, context)
+            except ApiError as e:
+                context.abort(e.code, e.message)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except Exception as e:  # noqa: BLE001 — surface as INTERNAL
+                logger.exception("grpc handler failed")
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return handler
+
+    def _check_auth(self, context):
+        if self.auth is None:
+            return
+        md = dict(context.invocation_metadata() or [])
+        token = md.get("authorization", "")
+        if token.lower().startswith("bearer "):
+            token = token[7:]
+        principal = self.auth.authenticate(token or None)
+        self.auth.authorize(principal)
+
+    def _collection(self, name: str):
+        return self.db.get_collection(name)
+
+    # -- Search (service.go:173) --------------------------------------------
+
+    def _search(self, req: "pb.SearchRequest", context) -> "pb.SearchReply":
+        start = time.perf_counter()
+        col = self._collection(req.collection)
+        tenant = req.tenant or None
+        limit = req.limit or 10
+        where = filters_from_pb(req.filters) if req.HasField("filters") else None
+        autocut = req.autocut
+
+        search_kind = None
+        for field in ("near_vector", "near_object", "near_text", "bm25_search",
+                      "hybrid_search", "near_image", "near_audio", "near_video",
+                      "near_depth", "near_thermal", "near_imu"):
+            if req.HasField(field):
+                search_kind = field
+                break
+
+        results = None
+        fetched_objects = None
+        if search_kind == "near_vector":
+            nv = req.near_vector
+            vec = _vector_from(nv.vector_bytes, nv.vector)
+            if vec is None:
+                raise ApiError(grpc.StatusCode.INVALID_ARGUMENT,
+                               "nearVector requires a vector")
+            max_dist = nv.distance if nv.HasField("distance") else (
+                2 * (1 - nv.certainty) if nv.HasField("certainty") else None)
+            vec_name = nv.target_vectors[0] if nv.target_vectors else ""
+            results = col.near_vector(
+                vec, k=limit + req.offset, vec_name=vec_name, tenant=tenant,
+                where=where, max_distance=max_dist, autocut=autocut)
+        elif search_kind == "near_object":
+            no = req.near_object
+            anchor = col.get_object(no.id, tenant=tenant)
+            if anchor is None:
+                raise ApiError(grpc.StatusCode.NOT_FOUND,
+                               f"nearObject id {no.id} not found")
+            vec_name = no.target_vectors[0] if no.target_vectors else ""
+            vec = anchor.vectors.get(vec_name)
+            if vec is None:
+                raise ApiError(grpc.StatusCode.INVALID_ARGUMENT,
+                               f"anchor object has no vector {vec_name!r}")
+            max_dist = no.distance if no.HasField("distance") else None
+            results = col.near_vector(
+                vec, k=limit + req.offset, vec_name=vec_name, tenant=tenant,
+                where=where, max_distance=max_dist, autocut=autocut)
+        elif search_kind == "near_text":
+            nt = req.near_text
+            vec = self._vectorize_query(col, " ".join(nt.query), nt)
+            vec_name = nt.target_vectors[0] if nt.target_vectors else ""
+            max_dist = nt.distance if nt.HasField("distance") else (
+                2 * (1 - nt.certainty) if nt.HasField("certainty") else None)
+            results = col.near_vector(
+                vec, k=limit + req.offset, vec_name=vec_name, tenant=tenant,
+                where=where, max_distance=max_dist, autocut=autocut)
+        elif search_kind == "bm25_search":
+            results = col.bm25(req.bm25_search.query, k=limit + req.offset,
+                               properties=list(req.bm25_search.properties) or None,
+                               tenant=tenant, where=where, autocut=autocut)
+        elif search_kind == "hybrid_search":
+            h = req.hybrid_search
+            vec = _vector_from(h.vector_bytes, h.vector)
+            if vec is None and h.HasField("near_vector"):
+                vec = _vector_from(h.near_vector.vector_bytes,
+                                   h.near_vector.vector)
+            if vec is None and (h.HasField("near_text") or h.query) \
+                    and self._has_vectorizer(col):
+                text = " ".join(h.near_text.query) if h.HasField("near_text") \
+                    else h.query
+                vec = self._vectorize_query(col, text, None)
+            fusion = "rankedFusion" \
+                if h.fusion_type == pb.Hybrid.FUSION_TYPE_RANKED \
+                else "relativeScore"
+            vec_name = h.target_vectors[0] if h.target_vectors else ""
+            # honor alpha verbatim — clients always send it, and proto3
+            # cannot distinguish an explicit 0 (pure BM25) from unset
+            results = col.hybrid(h.query, vector=vec, alpha=h.alpha,
+                                 k=limit + req.offset,
+                                 properties=list(h.properties) or None,
+                                 vec_name=vec_name, tenant=tenant,
+                                 fusion=fusion, where=where, autocut=autocut)
+        elif search_kind is not None:
+            results = self._near_media(col, req, search_kind, limit, tenant,
+                                       where, autocut)
+        else:
+            sort = [{"path": list(s.path), "order":
+                     "asc" if s.ascending else "desc"} for s in req.sort_by]
+            fetched_objects = col.fetch_objects(
+                limit=limit, offset=req.offset, sort=sort or None,
+                where=where, tenant=tenant, after=req.after or None)
+
+        if results is not None and req.offset:
+            results = results[req.offset:]
+        if results is not None:
+            results = results[:limit]
+
+        reply = pb.SearchReply()
+        meta_req = req.metadata if req.HasField("metadata") else None
+        props_req = req.properties if req.HasField("properties") else None
+        generative = req.generative if req.HasField("generative") else None
+        rerank = req.rerank if req.HasField("rerank") else None
+
+        if results is not None and rerank is not None:
+            results = self._rerank(col, results, rerank)
+
+        dtype_of = {p.name: p.data_type for p in col.config.properties}
+        if results is not None and req.HasField("group_by"):
+            self._group_results(col, reply, results, req.group_by,
+                                meta_req, props_req, dtype_of)
+        elif results is not None:
+            for r in results:
+                if r.object is None:
+                    continue
+                out = reply.results.add()
+                self._fill_result(col, out, r.object, r, meta_req, props_req,
+                                  dtype_of)
+        else:
+            for obj in fetched_objects:
+                out = reply.results.add()
+                self._fill_result(col, out, obj, None, meta_req, props_req,
+                                  dtype_of)
+
+        if generative is not None:
+            self._generate(col, reply, generative)
+
+        reply.took = time.perf_counter() - start
+        return reply
+
+    # -- module hooks (filled in by the module provider when attached) -------
+
+    def _has_vectorizer(self, col) -> bool:
+        return (self.modules is not None
+                and self.modules.vectorizer_for(col.config) is not None)
+
+    def _vectorize_query(self, col, text: str, near_text) -> np.ndarray:
+        if self.modules is None:
+            raise ApiError(grpc.StatusCode.UNIMPLEMENTED,
+                           "nearText requires a vectorizer module")
+        vec = self.modules.vectorize_query(col.config, text)
+        if near_text is not None:
+            vec = self.modules.apply_moves(col, vec, near_text)
+        return vec
+
+    def _near_media(self, col, req, kind, limit, tenant, where, autocut):
+        if self.modules is None:
+            raise ApiError(grpc.StatusCode.UNIMPLEMENTED,
+                           f"{kind} requires a multi2vec module")
+        msg = getattr(req, kind)
+        media = getattr(msg, kind.replace("near_", ""))
+        vec = self.modules.vectorize_media(col.config,
+                                           kind.replace("near_", ""), media)
+        vec_name = msg.target_vectors[0] if msg.target_vectors else ""
+        max_dist = msg.distance if msg.HasField("distance") else None
+        return col.near_vector(vec, k=limit + req.offset, vec_name=vec_name,
+                               tenant=tenant, where=where,
+                               max_distance=max_dist, autocut=autocut)
+
+    def _rerank(self, col, results, rerank):
+        if self.modules is None:
+            raise ApiError(grpc.StatusCode.UNIMPLEMENTED,
+                           "rerank requires a reranker module")
+        docs = [str((r.object.properties if r.object else {}).get(
+            rerank.property, "")) for r in results]
+        scores = self.modules.rerank(col.config, rerank.query or "", docs)
+        for r, s in zip(results, scores):
+            r.rerank_score = s
+        results.sort(key=lambda r: -(r.rerank_score or 0.0))
+        return results
+
+    def _generate(self, col, reply, generative):
+        if self.modules is None:
+            raise ApiError(grpc.StatusCode.UNIMPLEMENTED,
+                           "generative search requires a generative module")
+        outs = list(reply.results) or [o for g in reply.group_by_results
+                                       for o in g.objects]
+        if generative.single_response_prompt:
+            for out in outs:
+                props = json_format.MessageToDict(
+                    out.properties.non_ref_properties)
+                props.update({k: _value_to_py(v) for k, v in
+                              out.properties.non_ref_props.fields.items()})
+                text = self.modules.generate_single(
+                    col.config, generative.single_response_prompt, props)
+                out.metadata.generative = text
+                out.metadata.generative_present = True
+        if generative.grouped_response_task:
+            all_props = []
+            for out in outs:
+                props = {k: _value_to_py(v) for k, v in
+                         out.properties.non_ref_props.fields.items()}
+                if generative.grouped_properties:
+                    props = {k: v for k, v in props.items()
+                             if k in generative.grouped_properties}
+                all_props.append(props)
+            reply.generative_grouped_result = self.modules.generate_grouped(
+                col.config, generative.grouped_response_task, all_props)
+
+    # -- result marshalling --------------------------------------------------
+
+    def _fill_result(self, col, out: "pb.SearchResult", obj, res,
+                     meta_req, props_req, dtype_of=None):
+        md = out.metadata
+        if meta_req is None or meta_req.uuid:
+            md.id = obj.uuid
+        if meta_req is not None:
+            if meta_req.vector and obj.vector is not None:
+                md.vector_bytes = _f32_bytes(obj.vector)
+            for name in meta_req.vectors:
+                if name in obj.vectors:
+                    v = md.vectors.add()
+                    v.name = name
+                    v.vector_bytes = _f32_bytes(obj.vectors[name])
+            if meta_req.creation_time_unix:
+                md.creation_time_unix = obj.creation_time_ms
+                md.creation_time_unix_present = True
+            if meta_req.last_update_time_unix:
+                md.last_update_time_unix = obj.last_update_time_ms
+                md.last_update_time_unix_present = True
+            if res is not None and res.distance is not None:
+                if meta_req.distance:
+                    md.distance = res.distance
+                    md.distance_present = True
+                if meta_req.certainty:
+                    md.certainty = max(0.0, 1.0 - res.distance / 2.0)
+                    md.certainty_present = True
+            if res is not None and meta_req.score and res.score is not None:
+                md.score = res.score
+                md.score_present = True
+            rr = getattr(res, "rerank_score", None) if res is not None else None
+            if rr is not None:
+                md.rerank_score = rr
+                md.rerank_score_present = True
+        props = out.properties
+        if dtype_of is None:
+            dtype_of = {p.name: p.data_type for p in col.config.properties}
+        requested = None
+        if props_req is not None and not props_req.return_all_nonref_properties:
+            requested = set(props_req.non_ref_properties) or None
+        for key, val in obj.properties.items():
+            if requested is not None and key not in requested:
+                continue
+            dtype = dtype_of.get(key)
+            if dtype == DataType.REFERENCE:
+                continue
+            props.non_ref_props.fields[key].CopyFrom(_to_value(val, dtype))
+        props.target_collection = col.config.name
+
+    def _group_results(self, col, reply, results, group_by,
+                       meta_req, props_req, dtype_of=None):
+        """Group hits by a property value (reference: GroupBy over one
+        path entry, prepare_reply.go groupByResults)."""
+        path = list(group_by.path)
+        prop = path[0] if path else ""
+        groups: dict[str, list] = {}
+        order: list[str] = []
+        for r in results:
+            if r.object is None:
+                continue
+            key = str(r.object.properties.get(prop))
+            if key not in groups:
+                if group_by.number_of_groups and \
+                        len(order) >= group_by.number_of_groups:
+                    continue
+                groups[key] = []
+                order.append(key)
+            if group_by.objects_per_group and \
+                    len(groups[key]) >= group_by.objects_per_group:
+                continue
+            groups[key].append(r)
+        for key in order:
+            members = groups[key]
+            g = reply.group_by_results.add()
+            g.name = key
+            dists = [m.distance for m in members if m.distance is not None]
+            if dists:
+                g.min_distance = min(dists)
+                g.max_distance = max(dists)
+            g.number_of_objects = len(members)
+            for m in members:
+                out = g.objects.add()
+                self._fill_result(col, out, m.object, m, meta_req, props_req,
+                                  dtype_of)
+
+    # -- BatchObjects (service.go:126) ---------------------------------------
+
+    def _batch_objects(self, req: "pb.BatchObjectsRequest",
+                       context) -> "pb.BatchObjectsReply":
+        start = time.perf_counter()
+        consistency = _CONSISTENCY[req.consistency_level] \
+            if req.HasField("consistency_level") else "QUORUM"
+        by_target: dict[tuple[str, str], list[tuple[int, "pb.BatchObject"]]] = {}
+        for i, bo in enumerate(req.objects):
+            by_target.setdefault((bo.collection, bo.tenant), []).append((i, bo))
+        reply = pb.BatchObjectsReply()
+        for (cname, tenant), entries in by_target.items():
+            try:
+                col = self._collection(cname)
+            except KeyError as e:
+                for i, _bo in entries:
+                    err = reply.errors.add()
+                    err.index = i
+                    err.error = str(e)
+                continue
+            specs = []
+            for _i, bo in entries:
+                spec = {"uuid": bo.uuid or None,
+                        "properties": _props_from_batch_object(bo)}
+                vec = _vector_from(bo.vector_bytes, bo.vector)
+                if vec is not None:
+                    spec["vector"] = vec
+                named = {}
+                for v in bo.vectors:
+                    named[v.name] = np.frombuffer(
+                        v.vector_bytes, dtype="<f4").astype(np.float32)
+                if named:
+                    spec["vectors"] = named
+                specs.append(spec)
+            if self.modules is not None:
+                self.modules.vectorize_batch(col.config, specs)
+            outcomes = col.batch_put(specs, tenant=tenant or None,
+                                     consistency=consistency)
+            for (i, _bo), out in zip(entries, outcomes):
+                if out["status"] != "SUCCESS":
+                    err = reply.errors.add()
+                    err.index = i
+                    err.error = out.get("error", "")
+        reply.took = time.perf_counter() - start
+        return reply
+
+    # -- BatchDelete ---------------------------------------------------------
+
+    def _batch_delete(self, req: "pb.BatchDeleteRequest",
+                      context) -> "pb.BatchDeleteReply":
+        start = time.perf_counter()
+        col = self._collection(req.collection)
+        if not req.HasField("filters"):
+            # a filterless batch delete would wipe the collection; the
+            # reference requires match.where (usecases/objects validation)
+            raise ApiError(grpc.StatusCode.INVALID_ARGUMENT,
+                           "batch delete requires a where filter")
+        where = filters_from_pb(req.filters)
+        consistency = _CONSISTENCY[req.consistency_level] \
+            if req.HasField("consistency_level") else "QUORUM"
+        result = col.batch_delete(
+            where, tenant=req.tenant or None, dry_run=req.dry_run,
+            verbose=req.verbose, consistency=consistency)
+        reply = pb.BatchDeleteReply(
+            matches=result["matches"], successful=result["successful"],
+            failed=result["failed"])
+        for entry in result["objects"]:
+            obj = reply.objects.add()
+            try:  # clients expect raw UUID bytes (batch_delete.proto uuid)
+                obj.uuid = _uuid.UUID(entry["id"]).bytes
+            except ValueError:
+                obj.uuid = entry["id"].encode()
+            obj.successful = entry["successful"]
+            if entry.get("error"):
+                obj.error = entry["error"]
+        reply.took = time.perf_counter() - start
+        return reply
+
+    # -- TenantsGet ----------------------------------------------------------
+
+    def _tenants_get(self, req: "pb.TenantsGetRequest",
+                     context) -> "pb.TenantsGetReply":
+        start = time.perf_counter()
+        col = self._collection(req.collection)
+        if not col.config.multi_tenancy.enabled:
+            raise ApiError(grpc.StatusCode.FAILED_PRECONDITION,
+                           "multi-tenancy is not enabled")
+        names = col.tenants()
+        if req.HasField("names"):
+            wanted = set(req.names.values)
+            names = [n for n in names if n in wanted]
+        reply = pb.TenantsGetReply()
+        for n in sorted(names):
+            t = reply.tenants.add()
+            t.name = n
+            t.activity_status = pb.TENANT_ACTIVITY_STATUS_HOT
+        reply.took = time.perf_counter() - start
+        return reply
+
+
+def _value_to_py(v: "pb.Value"):
+    kind = v.WhichOneof("kind")
+    if kind is None or kind == "null_value":
+        return None
+    raw = getattr(v, kind)
+    if kind == "list_value":
+        lk = raw.WhichOneof("kind")
+        if lk == "number_values":
+            return list(np.frombuffer(raw.number_values.values, dtype="<f8"))
+        if lk == "int_values":
+            return list(np.frombuffer(raw.int_values.values, dtype="<i8"))
+        if lk is not None:
+            return list(getattr(raw, lk).values)
+        return [_value_to_py(e) for e in raw.values]
+    if kind == "object_value":
+        return {k: _value_to_py(sub) for k, sub in raw.fields.items()}
+    if kind == "geo_value":
+        return {"latitude": raw.latitude, "longitude": raw.longitude}
+    return raw

@@ -243,7 +243,8 @@ class Collection:
         monitoring.objects_total.labels(self.config.name, "put").inc()
         return uuid
 
-    def batch_put(self, objects: list[dict], tenant: str | None = None) -> list[dict]:
+    def batch_put(self, objects: list[dict], tenant: str | None = None,
+                  consistency: str = "QUORUM") -> list[dict]:
         """Batch import; per-object error reporting, not transactional
         (reference: usecases/objects/batch_add.go)."""
         results = []
@@ -269,7 +270,7 @@ class Collection:
             try:
                 if self.config.multi_tenancy.enabled:
                     self._ensure_tenant_shard(shard_name)
-                self._write_to_shard(shard_name, objs)
+                self._write_to_shard(shard_name, objs, consistency)
                 monitoring.objects_total.labels(self.config.name, "put"
                                                 ).inc(len(objs))
             except Exception as e:
@@ -312,6 +313,57 @@ class Collection:
         if ok:
             monitoring.objects_total.labels(self.config.name, "delete").inc()
         return ok
+
+    def batch_delete(self, where, tenant: str | None = None,
+                     dry_run: bool = False, verbose: bool = False,
+                     consistency: str = "QUORUM",
+                     max_matches: int = 10_000) -> dict:
+        """Delete all objects matching a filter (reference: batch_delete —
+        REST DELETE /v1/batch/objects and gRPC BatchDelete; match set capped
+        at QUERY_MAXIMUM_RESULTS like the reference's dryRun/match cap).
+        Returns {"matches", "successful", "failed", "objects": [...]}, where
+        ``objects`` is populated per-uuid only when ``verbose``."""
+        names = self._target_shard_names(tenant)
+        where_dict = where.to_dict() if where is not None else None
+        uuids: list[str] = []
+        for name in names:
+            if len(uuids) >= max_matches:
+                break
+            if self._is_local(name):
+                shard = self._load_shard(name)
+                mask = shard.allow_mask(where) if where is not None else None
+                with shard._lock:
+                    items = list(shard._doc_to_uuid.items())
+                for doc_id, uid in items:
+                    if mask is not None and (doc_id >= len(mask)
+                                             or not mask[doc_id]):
+                        continue
+                    uuids.append(uid)
+                    if len(uuids) >= max_matches:
+                        break
+            else:
+                raws = self._require_remote(name).list_objects(
+                    self._read_node(name), self.config.name, name,
+                    limit=max_matches - len(uuids), where=where_dict)
+                uuids.extend(StorageObject.from_bytes(r).uuid for r in raws)
+        result = {"matches": len(uuids), "successful": 0, "failed": 0,
+                  "objects": []}
+        for uid in uuids:
+            if dry_run:
+                ok, err = True, None
+            else:
+                try:
+                    ok = self.delete_object(uid, tenant, consistency)
+                    err = None if ok else "not found"
+                except Exception as e:  # per-object errors, not transactional
+                    ok, err = False, str(e)
+            result["successful" if ok else "failed"] += 1
+            if verbose:
+                entry = {"id": uid, "successful": ok}
+                if err:
+                    entry["error"] = err
+                result["objects"].append(entry)
+        return result
 
     def object_count(self, tenant: str | None = None) -> int:
         """One replica per shard counts (replicas would double-count)."""
