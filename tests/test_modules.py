@@ -1,0 +1,329 @@
+"""Module system tests: provider dispatch, local hash vectorizer, sidecar
+HTTP clients (against an in-process stub sidecar), ref2vec, and the
+gRPC nearText/generative/rerank integration.
+
+Reference pattern: test/modules/* runs per-module tests against sidecar
+containers; here the sidecar is a stdlib HTTP stub on localhost.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import grpc
+import numpy as np
+import pytest
+
+from weaviate_tpu.db.database import Database
+from weaviate_tpu.modules import (
+    Generative,
+    ModuleError,
+    Provider,
+    RefVectorizer,
+    Reranker,
+    TextVectorizer,
+    default_provider,
+)
+from weaviate_tpu.modules.http_modules import (
+    OllamaGenerative,
+    TransformersReranker,
+    TransformersVectorizer,
+)
+from weaviate_tpu.modules.text2vec_hash import HashVectorizer
+from weaviate_tpu.modules.text_utils import camel_to_lower, object_corpus
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    Property,
+    VectorConfig,
+)
+
+
+def test_camel_to_lower():
+    assert camel_to_lower("ArticleAuthor") == "article author"
+    assert camel_to_lower("wordCount") == "word count"
+    assert camel_to_lower("HTMLBody") == "html body"
+
+
+def test_object_corpus_rules():
+    props = {"title": "The Cat", "body": "sat on a MAT", "count": 3,
+             "tags": ["Indoor", "Pets"]}
+    text = object_corpus("NewsArticle", props, {})
+    assert text.startswith("news article ")
+    assert "the cat" in text and "sat on a mat" in text
+    assert "indoor" in text and "3" not in text
+    # vectorizeClassName off, property allow-list, name prefixing
+    text = object_corpus("NewsArticle", props,
+                         {"vectorizeClassName": False,
+                          "properties": ["title"],
+                          "vectorizePropertyName": True})
+    assert text == "title the cat"
+
+
+def test_hash_vectorizer_properties():
+    v = HashVectorizer(dim=128)
+    a, b, c = v.vectorize(["the quick brown fox", "the quick brown fox",
+                           "completely different words entirely"], {})
+    assert np.allclose(a, b)
+    assert np.linalg.norm(a) == pytest.approx(1.0, abs=1e-5)
+    related = v.vectorize(["the quick red fox"], {})[0]
+    assert np.dot(a, related) > np.dot(a, c)
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = Database(str(tmp_path))
+    yield d
+    d.close()
+
+
+def _vectorized_config(name="Doc"):
+    return CollectionConfig(name=name, properties=[
+        Property(name="title", data_type="text"),
+    ], vectors=[VectorConfig(vectorizer="text2vec-hash",
+                             module_config={"dimensions": 64})])
+
+
+def test_provider_vectorize_batch_and_query(db):
+    db.create_collection(_vectorized_config())
+    col = db.get_collection("Doc")
+    provider = Provider(db)
+    provider.register(HashVectorizer())
+    specs = [{"properties": {"title": f"document number {i}"}}
+             for i in range(4)]
+    provider.vectorize_batch(col.config, specs)
+    assert all(spec["vector"].shape == (64,) for spec in specs)
+    col.batch_put(specs)
+    qvec = provider.vectorize_query(col.config, "document number 2")
+    hits = col.near_vector(qvec, k=1)
+    assert hits[0].object.properties["title"] == "document number 2"
+
+
+def test_ref2vec_centroid(db):
+    db.create_collection(CollectionConfig(name="Author", properties=[
+        Property(name="name", data_type="text")]))
+    authors = db.get_collection("Author")
+    u1 = authors.put_object({"name": "a"}, vector=[1.0, 0.0])
+    u2 = authors.put_object({"name": "b"}, vector=[0.0, 1.0])
+    db.create_collection(CollectionConfig(
+        name="Book",
+        properties=[Property(name="wrote", data_type="cref")],
+        vectors=[VectorConfig(vectorizer="ref2vec-centroid")]))
+    book = db.get_collection("Book")
+    provider = Provider(db)
+    provider.register(RefVectorizer())
+    specs = [{"properties": {"wrote": [
+        {"beacon": f"weaviate://localhost/Author/{u1}"},
+        {"beacon": f"weaviate://localhost/Author/{u2}"},
+    ]}}]
+    provider.vectorize_batch(book.config, specs)
+    assert np.allclose(specs[0]["vector"], [0.5, 0.5])
+
+
+# -- sidecar HTTP stub --------------------------------------------------------
+
+class _Sidecar(BaseHTTPRequestHandler):
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])).decode())
+        if self.path.startswith("/vectors"):
+            text = body["text"]
+            out = {"vector": [float(len(text)), 1.0, 0.0]}
+        elif self.path == "/rerank":
+            out = {"scores": [{"document": d, "score": float(len(d))}
+                              for d in body["documents"]]}
+        elif self.path == "/api/generate":
+            out = {"response": f"echo: {body['prompt'][:40]}"}
+        else:
+            self.send_error(404)
+            return
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def sidecar():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Sidecar)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_transformers_sidecar_client(sidecar):
+    mod = TransformersVectorizer()
+    mod.init({"inferenceUrl": sidecar})
+    vecs = mod.vectorize(["abc", "abcdef"], {})
+    assert vecs.shape == (2, 3)
+    assert vecs[0][0] == 3.0 and vecs[1][0] == 6.0
+
+
+def test_reranker_sidecar_client(sidecar):
+    mod = TransformersReranker()
+    mod.init({"inferenceUrl": sidecar})
+    scores = mod.rerank("q", ["abc", "a"], {})
+    assert scores == [3.0, 1.0]
+
+
+def test_ollama_generative_client(sidecar):
+    mod = OllamaGenerative()
+    mod.init({"apiEndpoint": sidecar})
+    assert mod.generate("tell me", {}).startswith("echo: tell me")
+
+
+def test_module_error_when_sidecar_down():
+    mod = TransformersVectorizer()
+    mod.init({"inferenceUrl": "http://127.0.0.1:1"})
+    with pytest.raises(ModuleError):
+        mod.vectorize(["x"], {})
+
+
+# -- gRPC integration ---------------------------------------------------------
+
+class _EchoGenerative(Generative):
+    name = "generative-echo"
+
+    def generate(self, prompt: str, config: dict) -> str:
+        return f"GEN[{prompt}]"
+
+
+class _LenReranker(Reranker):
+    name = "reranker-len"
+
+    def rerank(self, query, documents, config):
+        return [float(len(d)) for d in documents]
+
+
+@pytest.fixture
+def grpc_stack(db):
+    from weaviate_tpu.api.grpc import GrpcServer
+    from weaviate_tpu.api.grpc import v1_pb2 as pb
+
+    provider = Provider(db)
+    provider.register(HashVectorizer())
+    provider.register(_EchoGenerative())
+    provider.register(_LenReranker())
+    server = GrpcServer(db, modules=provider).start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{server.port}")
+    search = channel.unary_unary(
+        "/weaviate.v1.Weaviate/Search",
+        request_serializer=pb.SearchRequest.SerializeToString,
+        response_deserializer=pb.SearchReply.FromString)
+    batch = channel.unary_unary(
+        "/weaviate.v1.Weaviate/BatchObjects",
+        request_serializer=pb.BatchObjectsRequest.SerializeToString,
+        response_deserializer=pb.BatchObjectsReply.FromString)
+    yield pb, search, batch
+    channel.close()
+    server.stop()
+
+
+def test_grpc_near_text_and_generative(db, grpc_stack):
+    pb, search, batch = grpc_stack
+    db.create_collection(_vectorized_config())
+    req = pb.BatchObjectsRequest()
+    for title in ["jazz music history", "classical piano concert",
+                  "deep learning compilers"]:
+        bo = req.objects.add(collection="Doc")
+        bo.properties.non_ref_properties.update({"title": title})
+    reply = batch(req)
+    assert list(reply.errors) == []
+
+    sreq = pb.SearchRequest(collection="Doc", limit=2)
+    sreq.near_text.query.append("jazz music")
+    sreq.generative.single_response_prompt = "Summarize {title}"
+    rep = search(sreq)
+    top = rep.results[0]
+    assert top.properties.non_ref_props.fields["title"].text_value \
+        == "jazz music history"
+    assert top.metadata.generative == "GEN[Summarize jazz music history]"
+
+    # moveAway from 'jazz' must strictly increase the jazz doc's distance
+    def jazz_distance(with_move: bool) -> float:
+        r = pb.SearchRequest(collection="Doc", limit=3)
+        r.near_text.query.append("jazz music")
+        r.metadata.distance = True
+        if with_move:
+            r.near_text.move_away.force = 1.0
+            r.near_text.move_away.concepts.append("jazz")
+        rep = search(r)
+        for res in rep.results:
+            if res.properties.non_ref_props.fields["title"].text_value \
+                    == "jazz music history":
+                return res.metadata.distance
+        return float("inf")  # pushed out of top-3 entirely
+
+    assert jazz_distance(True) > jazz_distance(False)
+
+
+def test_grpc_rerank(db, grpc_stack):
+    pb, search, batch = grpc_stack
+    db.create_collection(_vectorized_config())
+    req = pb.BatchObjectsRequest()
+    for title in ["short", "a much longer title here", "mid title"]:
+        bo = req.objects.add(collection="Doc")
+        bo.properties.non_ref_properties.update({"title": title})
+    assert list(batch(req).errors) == []
+
+    sreq = pb.SearchRequest(collection="Doc", limit=3)
+    sreq.near_text.query.append("title")
+    sreq.rerank.property = "title"
+    sreq.rerank.query = "q"
+    rep = search(sreq)
+    titles = [r.properties.non_ref_props.fields["title"].text_value
+              for r in rep.results]
+    # reranked by document length descending
+    assert titles == ["a much longer title here", "mid title", "short"]
+    assert rep.results[0].metadata.rerank_score_present
+
+
+class _BrokenVectorizer(TextVectorizer):
+    name = "text2vec-hash"  # stands in for the configured module
+
+    def vectorize(self, texts, config):
+        raise ModuleError("sidecar down")
+
+
+def test_grpc_batch_vectorize_failure_is_per_object(db):
+    from weaviate_tpu.api.grpc import GrpcServer
+    from weaviate_tpu.api.grpc import v1_pb2 as pb
+
+    db.create_collection(_vectorized_config())
+    provider = Provider(db)
+    provider.register(_BrokenVectorizer())
+    server = GrpcServer(db, modules=provider).start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{server.port}")
+    batch = channel.unary_unary(
+        "/weaviate.v1.Weaviate/BatchObjects",
+        request_serializer=pb.BatchObjectsRequest.SerializeToString,
+        response_deserializer=pb.BatchObjectsReply.FromString)
+    req = pb.BatchObjectsRequest()
+    bo = req.objects.add(collection="Doc")  # needs vectorization -> fails
+    bo.properties.non_ref_properties.update({"title": "no vector"})
+    bo2 = req.objects.add(collection="Doc")  # brings its own vector -> ok
+    bo2.properties.non_ref_properties.update({"title": "has vector"})
+    bo2.vector_bytes = np.ones(64, dtype="<f4").tobytes()
+    reply = batch(req)
+    channel.close()
+    server.stop()
+    assert len(reply.errors) == 1
+    assert reply.errors[0].index == 0
+    assert "vectorize" in reply.errors[0].error
+    assert db.get_collection("Doc").object_count() == 1
+
+
+def test_default_provider_registry(db):
+    provider = default_provider(db)
+    names = provider.names()
+    assert "text2vec-hash" in names
+    assert "text2vec-transformers" in names
+    assert "generative-openai" in names
+    assert "reranker-cohere" in names
+    assert "ref2vec-centroid" in names
+    meta = provider.meta()
+    assert meta["text2vec-hash"]["name"] == "text2vec-hash"

@@ -355,8 +355,8 @@ class GrpcServer:
                 where=where, max_distance=max_dist, autocut=autocut)
         elif search_kind == "near_text":
             nt = req.near_text
-            vec = self._vectorize_query(col, " ".join(nt.query), nt)
             vec_name = nt.target_vectors[0] if nt.target_vectors else ""
+            vec = self._vectorize_query(col, " ".join(nt.query), nt, vec_name)
             max_dist = nt.distance if nt.HasField("distance") else (
                 2 * (1 - nt.certainty) if nt.HasField("certainty") else None)
             results = col.near_vector(
@@ -372,15 +372,15 @@ class GrpcServer:
             if vec is None and h.HasField("near_vector"):
                 vec = _vector_from(h.near_vector.vector_bytes,
                                    h.near_vector.vector)
+            vec_name = h.target_vectors[0] if h.target_vectors else ""
             if vec is None and (h.HasField("near_text") or h.query) \
-                    and self._has_vectorizer(col):
-                text = " ".join(h.near_text.query) if h.HasField("near_text") \
-                    else h.query
-                vec = self._vectorize_query(col, text, None)
+                    and self._has_vectorizer(col, vec_name):
+                nt = h.near_text if h.HasField("near_text") else None
+                text = " ".join(nt.query) if nt is not None else h.query
+                vec = self._vectorize_query(col, text, nt, vec_name)
             fusion = "rankedFusion" \
                 if h.fusion_type == pb.Hybrid.FUSION_TYPE_RANKED \
                 else "relativeScore"
-            vec_name = h.target_vectors[0] if h.target_vectors else ""
             # honor alpha verbatim — clients always send it, and proto3
             # cannot distinguish an explicit 0 (pure BM25) from unset
             results = col.hybrid(h.query, vector=vec, alpha=h.alpha,
@@ -437,15 +437,20 @@ class GrpcServer:
 
     # -- module hooks (filled in by the module provider when attached) -------
 
-    def _has_vectorizer(self, col) -> bool:
-        return (self.modules is not None
-                and self.modules.vectorizer_for(col.config) is not None)
+    def _has_vectorizer(self, col, vec_name: str = "") -> bool:
+        if self.modules is None:
+            return False
+        try:
+            return self.modules.vectorizer_for(col.config, vec_name) is not None
+        except Exception:  # configured module not registered -> BM25 fallback
+            return False
 
-    def _vectorize_query(self, col, text: str, near_text) -> np.ndarray:
+    def _vectorize_query(self, col, text: str, near_text,
+                         vec_name: str = "") -> np.ndarray:
         if self.modules is None:
             raise ApiError(grpc.StatusCode.UNIMPLEMENTED,
                            "nearText requires a vectorizer module")
-        vec = self.modules.vectorize_query(col.config, text)
+        vec = self.modules.vectorize_query(col.config, text, vec_name)
         if near_text is not None:
             vec = self.modules.apply_moves(col, vec, near_text)
         return vec
@@ -456,9 +461,9 @@ class GrpcServer:
                            f"{kind} requires a multi2vec module")
         msg = getattr(req, kind)
         media = getattr(msg, kind.replace("near_", ""))
-        vec = self.modules.vectorize_media(col.config,
-                                           kind.replace("near_", ""), media)
         vec_name = msg.target_vectors[0] if msg.target_vectors else ""
+        vec = self.modules.vectorize_media(
+            col.config, kind.replace("near_", ""), media, vec_name)
         max_dist = msg.distance if msg.HasField("distance") else None
         return col.near_vector(vec, k=limit + req.offset, vec_name=vec_name,
                                tenant=tenant, where=where,
@@ -535,10 +540,12 @@ class GrpcServer:
             if res is not None and meta_req.score and res.score is not None:
                 md.score = res.score
                 md.score_present = True
-            rr = getattr(res, "rerank_score", None) if res is not None else None
-            if rr is not None:
-                md.rerank_score = rr
-                md.rerank_score_present = True
+        # rerank score rides along whenever a reranker ran, like the
+        # reference's _additional{rerank} — not gated on MetadataRequest
+        rr = getattr(res, "rerank_score", None) if res is not None else None
+        if rr is not None:
+            md.rerank_score = rr
+            md.rerank_score_present = True
         props = out.properties
         if dtype_of is None:
             dtype_of = {p.name: p.data_type for p in col.config.properties}
@@ -625,7 +632,21 @@ class GrpcServer:
                     spec["vectors"] = named
                 specs.append(spec)
             if self.modules is not None:
-                self.modules.vectorize_batch(col.config, specs)
+                try:
+                    self.modules.vectorize_batch(col.config, specs)
+                except Exception as e:  # per-object errors, not whole-batch
+                    from weaviate_tpu.modules.provider import needs_vector
+
+                    kept = []
+                    for (i, _bo), spec in zip(entries, specs):
+                        if needs_vector(col.config, spec):
+                            err = reply.errors.add()
+                            err.index = i
+                            err.error = f"vectorize: {e}"
+                        else:
+                            kept.append(((i, _bo), spec))
+                    entries = [ent for ent, _s in kept]
+                    specs = [s for _ent, s in kept]
             outcomes = col.batch_put(specs, tenant=tenant or None,
                                      consistency=consistency)
             for (i, _bo), out in zip(entries, outcomes):

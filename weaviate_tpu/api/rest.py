@@ -88,11 +88,13 @@ class RestServer:
     ClusterNode for /v1/nodes."""
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
-                 schema_target=None, node=None, graphql_executor=None):
+                 schema_target=None, node=None, graphql_executor=None,
+                 modules=None):
         self.db = db
         self.schema_target = schema_target or db
         self.node = node
         self.graphql_executor = graphql_executor
+        self.modules = modules  # module Provider for import vectorization
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -310,10 +312,14 @@ class RestServer:
         if not class_name:
             raise ApiError(422, "object is missing a class")
         col = self.db.get_collection(class_name)
+        spec = {"properties": body.get("properties", {}),
+                "vector": body.get("vector"), "vectors": body.get("vectors")}
+        if self.modules is not None:
+            self.modules.vectorize_batch(col.config, [spec])
         uuid = col.put_object(
-            body.get("properties", {}),
-            vector=body.get("vector"),
-            vectors=body.get("vectors"),
+            spec["properties"],
+            vector=spec.get("vector"),
+            vectors=spec.get("vectors"),
             uuid=body.get("id"),
             tenant=tenant or body.get("tenant"),
             creation_time_ms=int(body.get("creationTimeUnix") or 0),
@@ -372,6 +378,22 @@ class RestServer:
                 "vector": spec.get("vector"),
                 "vectors": spec.get("vectors"),
             } for _i, spec in entries]
+            if self.modules is not None:
+                try:
+                    self.modules.vectorize_batch(col.config, specs)
+                except Exception as exc:  # per-object errors, not whole-batch
+                    from weaviate_tpu.modules.provider import needs_vector
+
+                    kept_entries, kept_specs = [], []
+                    for (i, spec_body), spec in zip(entries, specs):
+                        if needs_vector(col.config, spec):
+                            results[i] = {"id": spec.get("uuid"), "result": {
+                                "status": "FAILED", "errors": {"error": [
+                                    {"message": f"vectorize: {exc}"}]}}}
+                        else:
+                            kept_entries.append((i, spec_body))
+                            kept_specs.append(spec)
+                    entries, specs = kept_entries, kept_specs
             outcomes = col.batch_put(specs, tenant=tenant)
             for (i, _spec), out in zip(entries, outcomes):
                 if out["status"] == "SUCCESS":

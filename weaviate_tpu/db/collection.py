@@ -24,7 +24,8 @@ from weaviate_tpu.storage.objects import StorageObject
 
 
 class SearchResult:
-    __slots__ = ("uuid", "distance", "score", "object", "shard")
+    __slots__ = ("uuid", "distance", "score", "object", "shard",
+                 "rerank_score")
 
     def __init__(self, uuid, distance=None, score=None, object=None, shard=None):
         self.uuid = uuid
@@ -32,6 +33,7 @@ class SearchResult:
         self.score = score
         self.object = object
         self.shard = shard
+        self.rerank_score = None  # set by the reranker module path
 
     def __repr__(self):
         return f"SearchResult({self.uuid}, dist={self.distance}, score={self.score})"

@@ -1,0 +1,50 @@
+"""Module ecosystem (reference: usecases/modules provider + modules/*).
+
+``default_provider(db)`` registers the self-contained modules plus every
+HTTP-client module, mirroring registerModules at configure_api.go:158 —
+enable-list via the ENABLE_MODULES env var handled by the config layer.
+"""
+
+from weaviate_tpu.modules.base import (
+    BackupBackend,
+    Generative,
+    MediaVectorizer,
+    Module,
+    ModuleError,
+    Reranker,
+    TextVectorizer,
+)
+from weaviate_tpu.modules.provider import Provider, RefVectorizer
+from weaviate_tpu.modules.text2vec_hash import HashVectorizer
+
+
+def default_provider(db=None, enabled: list[str] | None = None) -> Provider:
+    from weaviate_tpu.modules import http_modules as hm
+
+    provider = Provider(db)
+    mods = [
+        HashVectorizer(),
+        RefVectorizer(),
+        hm.TransformersVectorizer(),
+        hm.OpenAIVectorizer(),
+        hm.CohereVectorizer(),
+        hm.HuggingFaceVectorizer(),
+        hm.OllamaVectorizer(),
+        hm.ClipVectorizer(),
+        hm.TransformersReranker(),
+        hm.CohereReranker(),
+        hm.OpenAIGenerative(),
+        hm.OllamaGenerative(),
+        hm.CohereGenerative(),
+    ]
+    for mod in mods:
+        if enabled is None or mod.name in enabled:
+            provider.register(mod)
+    return provider
+
+
+__all__ = [
+    "BackupBackend", "Generative", "HashVectorizer", "MediaVectorizer",
+    "Module", "ModuleError", "Provider", "RefVectorizer", "Reranker",
+    "TextVectorizer", "default_provider",
+]

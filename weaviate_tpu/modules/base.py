@@ -1,0 +1,93 @@
+"""Module capability interfaces.
+
+Reference: entities/modulecapabilities/*.go — a module declares capabilities
+(Vectorizer, Searcher, AdditionalProperties, BackupBackend, ...) and the
+provider dispatches on them (usecases/modules/modules.go:40). Here a module
+subclasses the capability base matching what it provides; the provider
+dispatches on isinstance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ModuleError(Exception):
+    pass
+
+
+class Module:
+    """Base for all modules. ``name`` is the registry key used in
+    VectorConfig.vectorizer / CollectionConfig.module_config."""
+
+    name: str = ""
+
+    def init(self, settings: dict | None = None) -> None:
+        """Startup hook (reference: module Init at configure_api.go:403)."""
+
+    def meta(self) -> dict:
+        return {"name": self.name}
+
+
+class TextVectorizer(Module):
+    """text2vec-* capability (reference: modulecapabilities/vectorizer.go)."""
+
+    def vectorize(self, texts: list[str], config: dict) -> np.ndarray:
+        """Embed a batch of corpus texts -> [n, dim] float32."""
+        raise NotImplementedError
+
+    def vectorize_query(self, text: str, config: dict) -> np.ndarray:
+        """Embed one query text; defaults to the corpus path (some APIs use
+        a dedicated query model / input_type)."""
+        return self.vectorize([text], config)[0]
+
+
+class MediaVectorizer(Module):
+    """multi2vec-* capability: embeds text and base64 media into one space."""
+
+    media_kinds: tuple[str, ...] = ()
+
+    def vectorize_media(self, kind: str, data_b64: str,
+                        config: dict) -> np.ndarray:
+        raise NotImplementedError
+
+    def vectorize(self, texts: list[str], config: dict) -> np.ndarray:
+        raise NotImplementedError
+
+    def vectorize_query(self, text: str, config: dict) -> np.ndarray:
+        return self.vectorize([text], config)[0]
+
+
+class Reranker(Module):
+    """reranker-* capability (reference: modules/reranker-*)."""
+
+    def rerank(self, query: str, documents: list[str],
+               config: dict) -> list[float]:
+        raise NotImplementedError
+
+
+class Generative(Module):
+    """generative-* capability (reference: modules/generative-*)."""
+
+    def generate(self, prompt: str, config: dict) -> str:
+        raise NotImplementedError
+
+
+class BackupBackend(Module):
+    """backup-* capability (reference: modulecapabilities/backup.go:
+    PutObject/GetObject/Initialize/HomeDir...)."""
+
+    def initialize(self, backup_id: str) -> None:
+        raise NotImplementedError
+
+    def put(self, backup_id: str, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, backup_id: str, key: str) -> bytes:
+        raise NotImplementedError
+
+    def list(self, backup_id: str) -> list[str]:
+        raise NotImplementedError
+
+    def home_dir(self, backup_id: str) -> str:
+        raise NotImplementedError

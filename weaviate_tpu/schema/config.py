@@ -102,6 +102,9 @@ class VectorConfig:
     dim: int = 0  # 0 = inferred from first insert
     index: VectorIndexConfig = field(default_factory=VectorIndexConfig)
     vectorizer: str = "none"  # module name, or "none" = client provides
+    # per-module settings (reference: moduleConfig per class/vector —
+    # e.g. {"vectorizeClassName": false, "properties": [...]})
+    module_config: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -145,6 +148,9 @@ class CollectionConfig:
     multi_tenancy: MultiTenancyConfig = field(default_factory=MultiTenancyConfig)
     replication: ReplicationConfig = field(default_factory=ReplicationConfig)
     inverted: InvertedIndexConfig = field(default_factory=InvertedIndexConfig)
+    # class-level module settings keyed by module name (reference:
+    # models.Class.ModuleConfig) — generative-*, reranker-* live here
+    module_config: dict = field(default_factory=dict)
 
     def validate(self):
         if not _NAME_RE.match(self.name) or not self.name[0].isupper():
@@ -200,6 +206,7 @@ class CollectionConfig:
                 dim=v.get("dim", 0),
                 index=VectorIndexConfig(**v.get("index", {})),
                 vectorizer=v.get("vectorizer", "none"),
+                module_config=v.get("module_config", {}),
             )
             for v in d.get("vectors", [{}])
         ]
