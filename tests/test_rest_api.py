@@ -221,3 +221,80 @@ def test_schema_mixed_property_styles(client):
     props = {p["name"]: p for p in client.get_class("Mixed")["properties"]}
     assert props["n"]["data_type"] == "int"
     assert props["a"]["index_searchable"] is False
+
+
+def test_config_from_json_reference_shape():
+    """The reference's class JSON (models.Class): top-level vectorizer,
+    vectorIndexType/Config, camelCase sub-configs — must parse."""
+    from weaviate_tpu.api.rest import config_from_json
+
+    cfg = config_from_json({
+        "class": "Doc",
+        "vectorizer": "none",
+        "vectorIndexType": "hnsw",
+        "vectorIndexConfig": {
+            "distance": "cosine", "efConstruction": 64,
+            "maxConnections": 16, "pq": {"enabled": True, "segments": 8},
+        },
+        "invertedIndexConfig": {"bm25": {"k1": 1.4, "b": 0.6}},
+        "shardingConfig": {"desiredCount": 2},
+        "multiTenancyConfig": {"enabled": True},
+        "replicationConfig": {"factor": 3},
+        "moduleConfig": {"generative-openai": {}},
+        "properties": [{"name": "title", "dataType": ["text"]}],
+    })
+    v = cfg.vector_config("")
+    assert v.index.index_type == "hnsw"
+    assert v.index.metric == "cosine"
+    assert v.index.quantization == "pq" and v.index.pq_segments == 8
+    assert v.index.ef_construction == 64 and v.index.max_connections == 16
+    assert cfg.inverted.bm25_k1 == 1.4 and cfg.inverted.bm25_b == 0.6
+    assert cfg.sharding.desired_count == 2
+    assert cfg.multi_tenancy.enabled
+    assert cfg.replication.factor == 3
+    assert "generative-openai" in cfg.module_config
+
+
+def test_config_from_json_named_vectors():
+    from weaviate_tpu.api.rest import config_from_json
+
+    cfg = config_from_json({
+        "class": "Multi",
+        "vectorConfig": {
+            "title": {"vectorizer": {"text2vec-hash": {"dim": 64}},
+                      "vectorIndexType": "flat"},
+            "body": {"vectorizer": {"none": {}}},
+        },
+    })
+    t = cfg.vector_config("title")
+    assert t.vectorizer == "text2vec-hash"
+    assert t.module_config == {"dim": 64}
+    assert cfg.vector_config("body").vectorizer == "none"
+
+
+def test_patch_revectorizes_changed_text(tmp_path):
+    """PATCH that edits text of a vectorizer-backed class must re-embed the
+    merged properties, not carry the stale vector forward (reference
+    re-vectorizes on merge)."""
+    from weaviate_tpu.modules import Provider
+    from weaviate_tpu.modules.text2vec_hash import HashVectorizer
+
+    db = Database(str(tmp_path))
+    provider = Provider(db).register(HashVectorizer())
+    srv = RestServer(db, modules=provider)
+    srv.start()
+    try:
+        c = Client(srv.address)
+        c.create_class({
+            "class": "Note", "vectorizer": "text2vec-hash",
+            "properties": [{"name": "body", "dataType": ["text"]}],
+        })
+        created = c.create_object("Note", {"body": "alpha"})
+        uid = created["id"]
+        v0 = c.get_object("Note", uid)["vector"]
+        c.patch_object("Note", uid, {"body": "completely different"})
+        v1 = c.get_object("Note", uid)["vector"]
+        assert v0 != v1, "stale embedding survived a text-changing PATCH"
+    finally:
+        srv.stop()
+        db.close()

@@ -452,7 +452,7 @@ class GrpcServer:
                            "nearText requires a vectorizer module")
         vec = self.modules.vectorize_query(col.config, text, vec_name)
         if near_text is not None:
-            vec = self.modules.apply_moves(col, vec, near_text)
+            vec = self.modules.apply_moves(col, vec, near_text, vec_name)
         return vec
 
     def _near_media(self, col, req, kind, limit, tenant, where, autocut):

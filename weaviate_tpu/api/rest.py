@@ -67,9 +67,53 @@ def property_from_json(d: dict) -> Property:
     )
 
 
+def _index_config_from_json(index_type: str | None, d: dict | None):
+    """Map the reference's vectorIndexConfig JSON (entities/vectorindex/
+    {hnsw,flat}/config.go) onto VectorIndexConfig; native snake_case keys
+    pass straight through."""
+    from weaviate_tpu.schema.config import VectorIndexConfig
+    import dataclasses
+
+    out = VectorIndexConfig()
+    if index_type:
+        out.index_type = index_type
+    if not d:
+        return out
+    native = {f.name for f in dataclasses.fields(VectorIndexConfig)}
+    for k, v in d.items():
+        if k in native:
+            setattr(out, k, v)
+    if "distance" in d:
+        out.metric = d["distance"]
+    if "efConstruction" in d:
+        out.ef_construction = d["efConstruction"]
+    if "maxConnections" in d:
+        out.max_connections = d["maxConnections"]
+    pq = d.get("pq") or {}
+    if pq.get("enabled"):
+        out.quantization = "pq"
+        out.pq_segments = pq.get("segments") or None
+        out.pq_centroids = pq.get("centroids", out.pq_centroids)
+    bq = d.get("bq") or {}
+    if bq.get("enabled"):
+        out.quantization = "bq"
+        out.rescore_limit = bq.get("rescoreLimit", out.rescore_limit)
+    return out
+
+
 def config_from_json(d: dict) -> CollectionConfig:
-    """Accepts the native config dict; tolerates the reference's "class"
-    key for the name."""
+    """Accepts the native config dict AND the reference's class JSON shape
+    (entities/models.Class): top-level "class"/"vectorizer"/
+    "vectorIndexType"/"vectorIndexConfig"/"moduleConfig", camelCase
+    sub-configs, and named-vector "vectorConfig"."""
+    from weaviate_tpu.schema.config import (
+        InvertedIndexConfig,
+        MultiTenancyConfig,
+        ReplicationConfig,
+        ShardingConfig,
+        VectorConfig,
+    )
+
     d = dict(d)
     if "name" not in d and "class" in d:
         d["name"] = d.pop("class")
@@ -78,6 +122,93 @@ def config_from_json(d: dict) -> CollectionConfig:
         # reference-style entries
         d["properties"] = [vars(property_from_json(p)) if isinstance(p, dict)
                            else p for p in d["properties"]]
+
+    # reference-style top-level vectorizer / index config → default space
+    vectorizer = d.pop("vectorizer", None)
+    v_index_type = d.pop("vectorIndexType", None)
+    v_index_cfg = d.pop("vectorIndexConfig", None)
+    module_config = d.pop("moduleConfig", None)
+    named = d.pop("vectorConfig", None)  # weaviate named vectors
+    if "vectors" not in d and (vectorizer or v_index_type or v_index_cfg
+                               or named):
+        vecs = []
+        if named:
+            for vname, vc in named.items():
+                vz, mc = "none", {}
+                raw_vz = vc.get("vectorizer")
+                if isinstance(raw_vz, dict) and raw_vz:
+                    vz = next(iter(raw_vz))
+                    mc = raw_vz[vz] or {}
+                elif isinstance(raw_vz, str):
+                    vz = raw_vz
+                vecs.append(VectorConfig(
+                    name=vname,
+                    index=_index_config_from_json(
+                        vc.get("vectorIndexType"),
+                        vc.get("vectorIndexConfig")),
+                    vectorizer=vz if vz else "none",
+                    module_config=mc,
+                ))
+        else:
+            mc = {}
+            if isinstance(module_config, dict) and vectorizer and \
+                    vectorizer in module_config:
+                mc = module_config[vectorizer] or {}
+            vecs.append(VectorConfig(
+                index=_index_config_from_json(v_index_type, v_index_cfg),
+                vectorizer=vectorizer or "none",
+                module_config=mc,
+            ))
+        d["vectors"] = [vars(v) if not isinstance(v, dict) else v
+                        for v in vecs]
+        d["vectors"] = [
+            {**v, "index": vars(v["index"])
+             if not isinstance(v["index"], dict) else v["index"]}
+            for v in d["vectors"]
+        ]
+    if module_config is not None and "module_config" not in d:
+        d["module_config"] = module_config
+
+    # camelCase sub-config shims
+    inv = d.pop("invertedIndexConfig", None)
+    if inv is not None and "inverted" not in d:
+        bm25 = inv.get("bm25") or {}
+        sw = inv.get("stopwords") or {}
+        d["inverted"] = vars(InvertedIndexConfig(
+            bm25_k1=bm25.get("k1", 1.2),
+            bm25_b=bm25.get("b", 0.75),
+            stopwords_preset=sw.get("preset", "en"),
+            stopwords_additions=sw.get("additions") or [],
+            stopwords_removals=sw.get("removals") or [],
+            index_timestamps=inv.get("indexTimestamps", False),
+            index_null_state=inv.get("indexNullState", False),
+            index_property_length=inv.get("indexPropertyLength", False),
+        ))
+    sh = d.pop("shardingConfig", None)
+    if sh is not None and "sharding" not in d:
+        d["sharding"] = vars(ShardingConfig(
+            desired_count=sh.get("desiredCount", 1),
+            virtual_per_physical=sh.get("virtualPerPhysical", 128),
+        ))
+    mt = d.pop("multiTenancyConfig", None)
+    if mt is not None and "multi_tenancy" not in d:
+        d["multi_tenancy"] = vars(MultiTenancyConfig(
+            enabled=mt.get("enabled", False),
+            auto_tenant_creation=mt.get("autoTenantCreation", False),
+            auto_tenant_activation=mt.get("autoTenantActivation", False),
+        ))
+    rp = d.pop("replicationConfig", None)
+    if rp is not None and "replication" not in d:
+        d["replication"] = vars(ReplicationConfig(
+            factor=rp.get("factor", 1),
+            async_enabled=rp.get("asyncEnabled", False),
+        ))
+
+    # drop unknown top-level keys rather than TypeError-ing the constructor
+    import dataclasses
+
+    known = {f.name for f in dataclasses.fields(CollectionConfig)}
+    d = {k: v for k, v in d.items() if k in known}
     return CollectionConfig.from_dict(d)
 
 
@@ -289,11 +420,22 @@ class RestServer:
                     merged = dict(existing.properties)
                     merged.update(body.get("properties", {}))
                     body["properties"] = merged
-                    if "vector" not in body and existing.vector is not None:
+                    # Carry existing vectors forward ONLY for spaces with no
+                    # vectorizer — vectorizer-backed spaces are left absent
+                    # so _put_object re-embeds the merged properties
+                    # (reference re-vectorizes on merge; a copied vector
+                    # would pin the pre-edit embedding forever).
+                    def _keeps(vec_name):
+                        vc = col.config.vector_config(vec_name)
+                        return vc is None or vc.vectorizer in ("", "none")
+
+                    if "vector" not in body and existing.vector is not None \
+                            and _keeps(""):
                         body["vector"] = np.asarray(existing.vector).tolist()
                     if "vectors" not in body:
                         named = {k: np.asarray(v).tolist()
-                                 for k, v in existing.vectors.items() if k}
+                                 for k, v in existing.vectors.items()
+                                 if k and _keeps(k)}
                         if named:
                             body["vectors"] = named
                     body["creationTimeUnix"] = existing.creation_time_ms

@@ -242,9 +242,12 @@ class QuantizedVectorStore:
                     k=k_cand, chunk_size=cs, metric=metric, valid=valid,
                 )
             else:
+                from weaviate_tpu.ops.pallas_kernels import recommended
+
                 q_words = bq_ops.bq_encode(jnp.asarray(queries))
                 d, i = bq_ops.bq_topk(
                     q_words, codes, k=k_cand, chunk_size=cs, valid=valid,
+                    use_pallas=recommended(),
                 )
         cand_ids = np.asarray(i)  # [B, k_cand]
         # exact rescore on host vectors (gather candidates, tiny matmul)

@@ -105,6 +105,11 @@ class DeviceVectorStore:
             else normalize_on_add
         )
         self._lock = threading.RLock()
+        # Compiled Pallas distance kernels on TPU; XLA path elsewhere
+        # (interpret-mode Pallas is test-only — far too slow to serve from).
+        from weaviate_tpu.ops.pallas_kernels import PALLAS_METRICS, recommended
+
+        self.use_pallas = recommended() and metric in PALLAS_METRICS
         self._count = 0  # high-water mark of allocated slots
         capacity = self._align(capacity)
         self.capacity = capacity
@@ -276,11 +281,13 @@ class DeviceVectorStore:
                 d, i = chunked_topk_distances(
                     jnp.asarray(queries), vectors, k=k_eff, chunk_size=cs,
                     metric=metric, valid=valid, x_sq_norms=norms,
+                    use_pallas=self.use_pallas,
                 )
             else:
                 d, i = sharded_topk(
                     jnp.asarray(queries), vectors, valid, norms,
                     k=k_eff, chunk_size=cs, metric=metric, mesh=self.mesh,
+                    use_pallas=self.use_pallas,
                 )
         d_np, i_np = np.asarray(d), np.asarray(i)
         if squeeze:

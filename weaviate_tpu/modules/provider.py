@@ -71,7 +71,6 @@ class Provider:
         for vc in config.vectors:
             if vc.vectorizer in ("", "none"):
                 continue
-            mod = self.vectorizer_for(config, vc.name)
             todo = []
             for spec in specs:
                 if vc.name:
@@ -81,13 +80,56 @@ class Provider:
                 if not has:
                     todo.append(spec)
             if not todo:
+                # every object supplied its own vector: no server-side
+                # vectorization needed, so an unregistered module is fine
                 continue
+            mod = self.vectorizer_for(config, vc.name)
             if isinstance(mod, RefVectorizer):
                 for spec in todo:
                     vec = mod.centroid(config, vc.module_config,
                                        spec.get("properties", {}))
                     if vec is not None:
                         self._store(spec, vc.name, vec)
+                continue
+            if isinstance(mod, MediaVectorizer):
+                # multi2vec: combine text + blob-property embeddings per
+                # object (reference: multi2vec-clip imageFields/textFields
+                # weighted mean, modules/multi2vec-clip/vectorizer.go).
+                # Unlike text2vec, the class name is NOT vectorized by
+                # default — a constant text component would dilute every
+                # media vector of the class toward the same point.
+                mc = {"vectorizeClassName": False, **vc.module_config}
+                blob_props = [p.name for p in config.properties
+                              if p.data_type == "blob"]
+                texts = [object_corpus(config.name,
+                                       spec.get("properties", {}),
+                                       mc, searchable)
+                         for spec in todo]
+                text_vecs: dict[int, np.ndarray] = {}
+                nonempty = [i for i, t in enumerate(texts) if t.strip()]
+                if nonempty:
+                    # one batched sidecar call for all text components
+                    embedded = mod.vectorize([texts[i] for i in nonempty],
+                                             vc.module_config)
+                    for i, v in zip(nonempty, embedded):
+                        text_vecs[i] = np.asarray(v, dtype=np.float32)
+                for idx, spec in enumerate(todo):
+                    props = spec.get("properties", {})
+                    parts = []
+                    if idx in text_vecs:
+                        parts.append(text_vecs[idx])
+                    for pname in blob_props:
+                        blob = props.get(pname)
+                        if blob:
+                            # blobs carry no media-type tag; embed with the
+                            # module's primary kind (clip: "image")
+                            parts.append(np.asarray(
+                                mod.vectorize_media(mod.media_kinds[0],
+                                                    blob, vc.module_config),
+                                dtype=np.float32))
+                    if parts:
+                        self._store(spec, vc.name,
+                                    np.mean(np.stack(parts), axis=0))
                 continue
             texts = [object_corpus(config.name, spec.get("properties", {}),
                                    vc.module_config, searchable)
@@ -130,9 +172,11 @@ class Provider:
                                               vc.module_config),
                           dtype=np.float32)
 
-    def apply_moves(self, col, vec: np.ndarray, near_text) -> np.ndarray:
+    def apply_moves(self, col, vec: np.ndarray, near_text,
+                    vec_name: str = "") -> np.ndarray:
         """nearText moveTo/moveAwayFrom: targets are the centroid of the
-        moved-to concepts and/or anchor objects."""
+        moved-to concepts and/or anchor objects, in the same (possibly
+        named) vector space as the query itself."""
         vec = np.asarray(vec, dtype=np.float32)
         for which in ("move_to", "move_away"):
             if not near_text.HasField(which):
@@ -140,11 +184,16 @@ class Provider:
             move = getattr(near_text, which)
             targets = []
             for concept in move.concepts:
-                targets.append(self.vectorize_query(col.config, concept))
+                targets.append(
+                    self.vectorize_query(col.config, concept, vec_name))
             for uid in move.uuids:
                 obj = col.get_object(uid)
-                if obj is not None and obj.vector is not None:
-                    targets.append(obj.vector)
+                if obj is None:
+                    continue
+                anchor = (obj.vectors or {}).get(vec_name) if vec_name \
+                    else obj.vector
+                if anchor is not None:
+                    targets.append(anchor)
             if not targets:
                 continue
             target = np.mean(np.stack(targets), axis=0)
@@ -196,14 +245,19 @@ class Provider:
                     module_name = key
                     break
         if module_name is None:
-            for key, mod in self._modules.items():
-                if isinstance(mod, kind):
-                    module_name = key
-                    break
+            # No class config: only a single registered module of this kind
+            # is an unambiguous default. Never silently pick one of many —
+            # that could route user data to an unintended external service.
+            candidates = [key for key, mod in self._modules.items()
+                          if isinstance(mod, kind)]
+            if len(candidates) == 1:
+                module_name = candidates[0]
         mod = self._modules.get(module_name) if module_name else None
         if not isinstance(mod, kind):
             raise ModuleError(
-                f"class {config.name} has no {prefix.rstrip('-')} module")
+                f"class {config.name} has no {prefix.rstrip('-')} module "
+                f"configured (set one in moduleConfig or pass an explicit "
+                f"provider)")
         return mod, config.module_config.get(module_name, {})
 
 

@@ -44,7 +44,7 @@ def bq_encode(vectors: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(bits << shifts, axis=-1, dtype=jnp.uint32)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "chunk_size"))
+@functools.partial(jax.jit, static_argnames=("k", "chunk_size", "use_pallas"))
 def bq_topk(
     q_words: jnp.ndarray,
     x_words: jnp.ndarray,
@@ -52,6 +52,7 @@ def bq_topk(
     chunk_size: int,
     valid: jnp.ndarray | None = None,
     id_offset: jnp.ndarray | int = 0,
+    use_pallas: bool = False,
 ):
     """Hamming top-k over packed words: q [B, w] uint32, x [N, w] uint32.
 
@@ -74,10 +75,15 @@ def bq_topk(
     def body(carry, inp):
         best_d, best_i = carry
         chunk_idx, xc, vc = inp
-        x_or = jax.lax.bitwise_xor(q_words[:, None, :], xc[None, :, :])
-        d = jnp.sum(
-            jax.lax.population_count(x_or), axis=-1, dtype=jnp.int32
-        ).astype(jnp.float32)
+        if use_pallas:
+            from weaviate_tpu.ops.pallas_kernels import bq_hamming_block
+
+            d = bq_hamming_block(q_words, xc, interpret=None)
+        else:
+            x_or = jax.lax.bitwise_xor(q_words[:, None, :], xc[None, :, :])
+            d = jnp.sum(
+                jax.lax.population_count(x_or), axis=-1, dtype=jnp.int32
+            ).astype(jnp.float32)
         if vc is not None:
             d = jnp.where(vc[None, :], d, MASKED_DISTANCE)
         ids = (

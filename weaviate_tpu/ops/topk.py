@@ -50,7 +50,9 @@ def merge_topk(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
     return topk_smallest(dists, ids, k)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "chunk_size", "metric"))
+@functools.partial(
+    jax.jit, static_argnames=("k", "chunk_size", "metric", "use_pallas")
+)
 def chunked_topk_distances(
     q: jnp.ndarray,
     x: jnp.ndarray,
@@ -60,6 +62,7 @@ def chunked_topk_distances(
     valid: jnp.ndarray | None = None,
     x_sq_norms: jnp.ndarray | None = None,
     id_offset: jnp.ndarray | int = 0,
+    use_pallas: bool = False,
 ):
     """Brute-force top-k of ``q`` [B,d] against ``x`` [N,d], scanning in chunks.
 
@@ -87,9 +90,20 @@ def chunked_topk_distances(
     def body(carry, inp):
         best_d, best_i = carry
         chunk_idx, xc, vc, nc = inp
-        d = pairwise_distance(q, xc, metric=metric, x_sq_norms=nc)
-        if vc is not None:
-            d = jnp.where(vc[None, :], d, MASKED_DISTANCE)
+        if use_pallas:
+            # Fused Pallas tile kernel: MXU matmul + mask epilogue in VMEM
+            # (ops/pallas_kernels.py) — the TPU stand-in for the reference's
+            # SIMD distance asm.
+            from weaviate_tpu.ops.pallas_kernels import distance_block
+
+            # interpret=None → compiled on TPU, interpreter elsewhere (tests)
+            d = distance_block(
+                q, xc, metric=metric, valid=vc, x_sq_norms=nc, interpret=None
+            )
+        else:
+            d = pairwise_distance(q, xc, metric=metric, x_sq_norms=nc)
+            if vc is not None:
+                d = jnp.where(vc[None, :], d, MASKED_DISTANCE)
         local_ids = (
             chunk_idx * chunk_size
             + id_offset

@@ -27,7 +27,7 @@ from weaviate_tpu.parallel.mesh import SHARD_AXIS
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "chunk_size", "metric", "mesh", "axis"),
+    static_argnames=("k", "chunk_size", "metric", "mesh", "axis", "use_pallas"),
 )
 def sharded_topk(
     q: jnp.ndarray,
@@ -39,6 +39,7 @@ def sharded_topk(
     metric: str,
     mesh: Mesh,
     axis: str = SHARD_AXIS,
+    use_pallas: bool = False,
 ):
     """Top-k of q [B,d] against row-sharded corpus x [N,d].
 
@@ -61,6 +62,7 @@ def sharded_topk(
             valid=valid_,
             x_sq_norms=norms_,
             id_offset=shard_idx * local_rows,
+            use_pallas=use_pallas,
         )
         # gather every shard's candidates: [n_shards, B, k] each
         all_d = jax.lax.all_gather(d, axis)
