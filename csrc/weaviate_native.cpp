@@ -1,0 +1,169 @@
+// Native host-side runtime primitives.
+//
+// The reference's host hot loops outside the distance kernels are its
+// roaring-bitmap set algebra (dgraph-io/sroar behind
+// adapters/repos/db/roaringset/), the posting-list segment codecs
+// (lsmkv segment_serialization.go), and the cross-shard top-k merge
+// (adapters/repos/db/index.go:1644-1648). These are their C++ equivalents,
+// operating on the framework's canonical host representations:
+// sorted uint64 doc-id arrays (the dense analog of roaring containers),
+// varint-delta-coded posting blocks, and per-shard ascending candidate
+// lists. Exposed with a C ABI for ctypes (no pybind11 in this toolchain);
+// every entry point has a numpy fallback in weaviate_tpu/native/__init__.py.
+//
+// Build: make -C csrc   (g++ -O3 -shared; see csrc/Makefile)
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <vector>
+
+extern "C" {
+
+// ---- sorted uint64 set algebra ------------------------------------------
+// Inputs must be ascending and duplicate-free; outputs are too.
+// Output buffers sized by the caller (intersect: min(na,nb); union: na+nb;
+// difference: na). Returns the number of elements written.
+
+int64_t wn_intersect_u64(const uint64_t* a, int64_t na,
+                         const uint64_t* b, int64_t nb, uint64_t* out) {
+    int64_t i = 0, j = 0, n = 0;
+    // galloping when one side is much smaller: the filter-vs-postings case
+    if (na > 64 && nb > 64 && (na > 32 * nb || nb > 32 * na)) {
+        const uint64_t* small = na < nb ? a : b;
+        const uint64_t* big = na < nb ? b : a;
+        int64_t ns = std::min(na, nb), nbg = std::max(na, nb);
+        const uint64_t* lo = big;
+        const uint64_t* end = big + nbg;
+        for (int64_t s = 0; s < ns; ++s) {
+            lo = std::lower_bound(lo, end, small[s]);
+            if (lo == end) break;
+            if (*lo == small[s]) out[n++] = small[s];
+        }
+        return n;
+    }
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) ++i;
+        else if (a[i] > b[j]) ++j;
+        else { out[n++] = a[i]; ++i; ++j; }
+    }
+    return n;
+}
+
+int64_t wn_union_u64(const uint64_t* a, int64_t na,
+                     const uint64_t* b, int64_t nb, uint64_t* out) {
+    int64_t i = 0, j = 0, n = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) out[n++] = a[i++];
+        else if (a[i] > b[j]) out[n++] = b[j++];
+        else { out[n++] = a[i]; ++i; ++j; }
+    }
+    while (i < na) out[n++] = a[i++];
+    while (j < nb) out[n++] = b[j++];
+    return n;
+}
+
+int64_t wn_difference_u64(const uint64_t* a, int64_t na,
+                          const uint64_t* b, int64_t nb, uint64_t* out) {
+    int64_t i = 0, j = 0, n = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) out[n++] = a[i++];
+        else if (a[i] > b[j]) ++j;
+        else { ++i; ++j; }
+    }
+    while (i < na) out[n++] = a[i++];
+    return n;
+}
+
+// membership: out[i] = 1 iff vals[i] >= 0 and (uint64)vals[i] ∈ allow
+// (sorted). The slot->docid AllowList translation of filtered vector
+// search (engine/flat.py::_allow_mask).
+void wn_membership_i64(const int64_t* vals, int64_t n,
+                       const uint64_t* allow, int64_t m, uint8_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        if (vals[i] < 0) { out[i] = 0; continue; }
+        uint64_t v = (uint64_t)vals[i];
+        const uint64_t* p = std::lower_bound(allow, allow + m, v);
+        out[i] = (p != allow + m && *p == v) ? 1 : 0;
+    }
+}
+
+// ---- varint delta codec --------------------------------------------------
+// Sorted uint64 -> delta -> LEB128. The posting/segment block codec
+// (reference: lsmkv segment serialization + sroar containers).
+
+int64_t wn_varint_encode_u64(const uint64_t* vals, int64_t n, uint8_t* out) {
+    uint8_t* p = out;
+    uint64_t prev = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t d = vals[i] - prev;
+        prev = vals[i];
+        while (d >= 0x80) { *p++ = (uint8_t)(d | 0x80); d >>= 7; }
+        *p++ = (uint8_t)d;
+    }
+    return (int64_t)(p - out);
+}
+
+// Decodes at most ``cap`` values into ``out`` but returns the TOTAL number
+// of varints present in the buffer — a return value > cap tells the caller
+// the declared count was wrong (corrupt/truncated record) without ever
+// writing past the buffer.
+int64_t wn_varint_decode_u64(const uint8_t* buf, int64_t nbytes,
+                             uint64_t* out, int64_t cap) {
+    const uint8_t* p = buf;
+    const uint8_t* end = buf + nbytes;
+    int64_t n = 0;
+    uint64_t prev = 0;
+    while (p < end) {
+        uint64_t d = 0;
+        int shift = 0;
+        while (p < end && (*p & 0x80)) {
+            d |= (uint64_t)(*p++ & 0x7f) << shift;
+            shift += 7;
+        }
+        if (p >= end) break;
+        d |= (uint64_t)(*p++) << shift;
+        prev += d;
+        if (n < cap) out[n] = prev;
+        ++n;
+    }
+    return n;
+}
+
+// ---- cross-shard top-k merge ---------------------------------------------
+// nlists ascending candidate lists of length len (dist f32 + id i64;
+// id<0 = dead slot) -> global ascending top-k. The host side of the
+// scatter-gather reduce when remote shards answer over the wire
+// (reference: index.go:1644-1648 sort+truncate).
+
+void wn_merge_topk(const float* dists, const int64_t* ids,
+                   int64_t nlists, int64_t len, int64_t k,
+                   float* out_d, int64_t* out_i) {
+    struct Head { float d; int64_t id; int64_t list; int64_t pos; };
+    auto cmp = [](const Head& x, const Head& y) { return x.d > y.d; };
+    std::vector<Head> heap;
+    heap.reserve((size_t)nlists);
+    for (int64_t l = 0; l < nlists; ++l) {
+        if (len > 0 && ids[l * len] >= 0)
+            heap.push_back({dists[l * len], ids[l * len], l, 0});
+    }
+    std::make_heap(heap.begin(), heap.end(), cmp);
+    int64_t n = 0;
+    while (n < k && !heap.empty()) {
+        std::pop_heap(heap.begin(), heap.end(), cmp);
+        Head h = heap.back();
+        heap.pop_back();
+        out_d[n] = h.d;
+        out_i[n] = h.id;
+        ++n;
+        int64_t next = h.pos + 1;
+        if (next < len && ids[h.list * len + next] >= 0) {
+            heap.push_back({dists[h.list * len + next],
+                            ids[h.list * len + next], h.list, next});
+            std::push_heap(heap.begin(), heap.end(), cmp);
+        }
+    }
+    for (int64_t i = n; i < k; ++i) { out_d[i] = 3.0e38f; out_i[i] = -1; }
+}
+
+}  // extern "C"

@@ -420,14 +420,21 @@ class RestServer:
                     merged = dict(existing.properties)
                     merged.update(body.get("properties", {}))
                     body["properties"] = merged
-                    # Carry existing vectors forward ONLY for spaces with no
+                    # Carry existing vectors forward for spaces with no
                     # vectorizer — vectorizer-backed spaces are left absent
                     # so _put_object re-embeds the merged properties
                     # (reference re-vectorizes on merge; a copied vector
-                    # would pin the pre-edit embedding forever).
+                    # would pin the pre-edit embedding forever). If this
+                    # server CANNOT re-embed (no module provider, or the
+                    # module isn't registered), keep the existing vector:
+                    # stale beats silently dropping the object from
+                    # vector search.
                     def _keeps(vec_name):
                         vc = col.config.vector_config(vec_name)
-                        return vc is None or vc.vectorizer in ("", "none")
+                        if vc is None or vc.vectorizer in ("", "none"):
+                            return True
+                        return (self.modules is None
+                                or self.modules.get(vc.vectorizer) is None)
 
                     if "vector" not in body and existing.vector is not None \
                             and _keeps(""):

@@ -530,8 +530,16 @@ class Collection:
     @staticmethod
     def _and_masks(a, b) -> np.ndarray:
         """Intersect two allow lists (bool mask or doc-id array forms)."""
+        a, b = np.asarray(a), np.asarray(b)
+        if a.dtype != np.bool_ and b.dtype != np.bool_:
+            # both doc-id arrays: native sorted-set intersect (the roaring
+            # AND of the reference, csrc/weaviate_native.cpp)
+            from weaviate_tpu import native
+
+            return native.intersect_sorted(
+                np.unique(a), np.unique(b)).astype(np.int64)
+
         def to_mask(x, size):
-            x = np.asarray(x)
             if x.dtype == np.bool_:
                 m = np.zeros(size, dtype=bool)
                 m[: len(x)] = x
@@ -540,10 +548,34 @@ class Collection:
             m[x[x < size]] = True
             return m
 
-        a, b = np.asarray(a), np.asarray(b)
         size = max(len(a) if a.dtype == np.bool_ else (int(a.max()) + 1 if len(a) else 0),
                    len(b) if b.dtype == np.bool_ else (int(b.max()) + 1 if len(b) else 0))
         return to_mask(a, size) & to_mask(b, size)
+
+    @staticmethod
+    def _merge_by_distance(gathered: list[list], k: int) -> list:
+        """Cross-shard reduce: each shard's list is already ascending, so
+        the k-way heap merge runs in the native library
+        (csrc/weaviate_native.cpp wn_merge_topk; reference:
+        index.go:1644-1648 sort+truncate)."""
+        lists = [g for g in gathered if g]
+        if not lists:
+            return []
+        if len(lists) == 1:
+            return lists[0][:k]
+        from weaviate_tpu import native
+
+        width = max(len(g) for g in lists)
+        d = np.full((len(lists), width), np.float32(3.0e38), dtype=np.float32)
+        idx = np.full((len(lists), width), -1, dtype=np.int64)
+        flat: list = []
+        for li, g in enumerate(lists):
+            for pos, r in enumerate(g):
+                d[li, pos] = r.distance
+                idx[li, pos] = len(flat)
+                flat.append(r)
+        _, out_i = native.merge_topk_host(d, idx, k=min(k, len(flat)))
+        return [flat[i] for i in out_i.tolist() if i >= 0]
 
     @_timed("vector")
     def near_vector(self, query, k: int = 10, vec_name: str = "",
@@ -587,9 +619,7 @@ class Collection:
         gathered = [one(names[0])] if len(names) == 1 else \
             list(self._pool.map(one, names))
 
-        merged = [r for results in gathered for r in results]
-        merged.sort(key=lambda r: r.distance)
-        merged = merged[:k]
+        merged = self._merge_by_distance(gathered, k)
         if max_distance is not None:
             merged = [r for r in merged if r.distance <= max_distance]
         if autocut > 0 and merged:

@@ -18,6 +18,7 @@ import threading
 
 import numpy as np
 
+from weaviate_tpu import native
 from weaviate_tpu.engine.store import DeviceVectorStore
 
 
@@ -170,9 +171,10 @@ class FlatIndex:
         with self._lock:
             # vectorized doc-id -> slot translation via the inverse table;
             # a Python-loop of dict lookups here would dominate filtered
-            # queries with large allow lists
+            # queries with large allow lists. Binary-search membership runs
+            # in the native library (csrc/weaviate_native.cpp).
             table = self._slot_to_id[: self.store.capacity]
-            return (table >= 0) & np.isin(table, allow_list)
+            return native.membership(table, np.unique(allow_list))
 
     def _slot_to_id_safe(self, slots):
         clipped = np.clip(slots, 0, len(self._slot_to_id) - 1)

@@ -1,0 +1,245 @@
+"""ctypes bindings for the native host-runtime library (csrc/).
+
+The reference keeps its runtime in Go with hand-written SIMD only for
+distances; our TPU compute path is JAX/Pallas, and the host-side hot loops
+— doc-id set algebra, posting-block codecs, cross-shard merge — live in
+C++ (csrc/weaviate_native.cpp). Loading strategy:
+
+1. use ``libweaviate_native.so`` next to this file if present,
+2. else try to build it with g++ (one-time, ~1s, cached on disk),
+3. else fall back to the numpy implementations below (same semantics,
+   used on machines without a toolchain and as the conformance oracle).
+
+``available()`` reports which path is active; set ``WEAVIATE_TPU_NO_NATIVE=1``
+to force the numpy fallbacks (used by tests to cross-check both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libweaviate_native.so")
+_SRC = os.path.join(os.path.dirname(_HERE), os.pardir, "csrc",
+                    "weaviate_native.cpp")
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("WEAVIATE_TPU_NO_NATIVE"):
+            return None
+        src = os.path.abspath(_SRC)
+        stale = (
+            os.path.exists(_SO) and os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_SO)
+        )
+        if not os.path.exists(_SO) or stale:
+            if os.path.exists(src):
+                try:
+                    subprocess.run(
+                        ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                         "-o", _SO, src],
+                        check=True, capture_output=True, timeout=120,
+                    )
+                except Exception:
+                    # a stale .so may have the wrong ABI — numpy fallback
+                    # is safer than loading it
+                    return None
+        if not os.path.exists(_SO):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i64 = ctypes.c_int64
+        for name, args, res in [
+            ("wn_intersect_u64", [u64p, i64, u64p, i64, u64p], i64),
+            ("wn_union_u64", [u64p, i64, u64p, i64, u64p], i64),
+            ("wn_difference_u64", [u64p, i64, u64p, i64, u64p], i64),
+            ("wn_membership_i64", [i64p, i64, u64p, i64, u8p], None),
+            ("wn_varint_encode_u64", [u64p, i64, u8p], i64),
+            ("wn_varint_decode_u64", [u8p, i64, u64p, i64], i64),
+            ("wn_merge_topk", [f32p, i64p, i64, i64, i64, f32p, i64p], None),
+        ]:
+            fn = getattr(lib, name)
+            fn.argtypes = args
+            fn.restype = res
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u64(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, dtype=np.uint64))
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---- sorted uint64 set algebra -------------------------------------------
+
+
+def intersect_sorted(a, b) -> np.ndarray:
+    """Intersection of two ascending unique uint64 arrays."""
+    a, b = _u64(a), _u64(b)
+    lib = _load()
+    if lib is None or min(len(a), len(b)) == 0:
+        return np.intersect1d(a, b, assume_unique=True)
+    out = np.empty(min(len(a), len(b)), dtype=np.uint64)
+    n = lib.wn_intersect_u64(_ptr(a, ctypes.c_uint64), len(a),
+                             _ptr(b, ctypes.c_uint64), len(b),
+                             _ptr(out, ctypes.c_uint64))
+    return out[:n]
+
+
+def union_sorted(a, b) -> np.ndarray:
+    a, b = _u64(a), _u64(b)
+    lib = _load()
+    if lib is None:
+        return np.union1d(a, b)
+    out = np.empty(len(a) + len(b), dtype=np.uint64)
+    n = lib.wn_union_u64(_ptr(a, ctypes.c_uint64), len(a),
+                         _ptr(b, ctypes.c_uint64), len(b),
+                         _ptr(out, ctypes.c_uint64))
+    return out[:n]
+
+
+def difference_sorted(a, b) -> np.ndarray:
+    """a \\ b for ascending unique uint64 arrays."""
+    a, b = _u64(a), _u64(b)
+    lib = _load()
+    if lib is None or len(a) == 0:
+        return np.setdiff1d(a, b, assume_unique=True)
+    out = np.empty(len(a), dtype=np.uint64)
+    n = lib.wn_difference_u64(_ptr(a, ctypes.c_uint64), len(a),
+                              _ptr(b, ctypes.c_uint64), len(b),
+                              _ptr(out, ctypes.c_uint64))
+    return out[:n]
+
+
+def membership(vals, allow_sorted) -> np.ndarray:
+    """Bool mask: vals[i] >= 0 and vals[i] in allow_sorted (ascending u64).
+
+    The doc-id AllowList test of filtered vector search
+    (reference: helpers/allow_list.go consumed in flat/index.go:319)."""
+    vals = np.ascontiguousarray(np.asarray(vals, dtype=np.int64))
+    allow = _u64(allow_sorted)
+    lib = _load()
+    if lib is None:
+        return (vals >= 0) & np.isin(vals, allow.astype(np.int64))
+    out = np.empty(len(vals), dtype=np.uint8)
+    lib.wn_membership_i64(_ptr(vals, ctypes.c_int64), len(vals),
+                          _ptr(allow, ctypes.c_uint64), len(allow),
+                          _ptr(out, ctypes.c_uint8))
+    return out.astype(bool)
+
+
+# ---- varint delta codec ---------------------------------------------------
+
+
+def varint_encode(vals) -> bytes:
+    """Ascending uint64 -> delta + LEB128 bytes (posting-block codec)."""
+    vals = _u64(vals)
+    lib = _load()
+    if lib is None:
+        out = bytearray()
+        prev = 0
+        for v in vals.tolist():
+            d = v - prev
+            prev = v
+            while d >= 0x80:
+                out.append((d & 0x7F) | 0x80)
+                d >>= 7
+            out.append(d)
+        return bytes(out)
+    out = np.empty(len(vals) * 10 or 1, dtype=np.uint8)
+    n = lib.wn_varint_encode_u64(_ptr(vals, ctypes.c_uint64), len(vals),
+                                 _ptr(out, ctypes.c_uint8))
+    return out[:n].tobytes()
+
+
+def varint_decode(buf: bytes, count_hint: int | None = None) -> np.ndarray:
+    """Decode a varint-delta block. ``count_hint`` is the declared element
+    count from the surrounding record; a block holding MORE values than
+    declared raises (corrupt/truncated data) rather than over- or
+    under-reading — the count field is untrusted on-disk input."""
+    lib = _load()
+    if lib is None:
+        out, prev, d, shift = [], 0, 0, 0
+        for byte in buf:
+            d |= (byte & 0x7F) << shift
+            if byte & 0x80:
+                shift += 7
+            else:
+                prev += d
+                out.append(prev)
+                d, shift = 0, 0
+        if count_hint is not None and len(out) != count_hint:
+            raise ValueError(
+                f"corrupt varint block: {len(out)} values, "
+                f"{count_hint} declared")
+        return np.asarray(out, dtype=np.uint64)
+    arr = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    # every value takes >= 1 byte, so len(buf) always bounds the count
+    cap = count_hint if count_hint is not None else len(buf)
+    out = np.empty(max(cap, 1), dtype=np.uint64)
+    n = lib.wn_varint_decode_u64(_ptr(arr, ctypes.c_uint8), len(arr),
+                                 _ptr(out, ctypes.c_uint64), cap)
+    if count_hint is not None and n != count_hint:
+        raise ValueError(
+            f"corrupt varint block: {n} values, {count_hint} declared")
+    return out[:n]
+
+
+# ---- cross-shard top-k merge ----------------------------------------------
+
+
+def merge_topk_host(dists: np.ndarray, ids: np.ndarray, k: int):
+    """Merge [L, len] ascending per-shard candidates into global top-k.
+
+    ids < 0 mark dead tail slots. Returns (dists [k] f32, ids [k] i64),
+    padded with (3e38, -1). The host half of the scatter-gather reduce
+    (reference: index.go:1644-1648) when shards answer over the network
+    rather than over ICI."""
+    dists = np.ascontiguousarray(np.asarray(dists, dtype=np.float32))
+    ids = np.ascontiguousarray(np.asarray(ids, dtype=np.int64))
+    if dists.ndim == 1:
+        dists, ids = dists[None, :], ids[None, :]
+    lib = _load()
+    if lib is None:
+        flat_d, flat_i = dists.ravel(), ids.ravel()
+        live = flat_i >= 0
+        flat_d, flat_i = flat_d[live], flat_i[live]
+        order = np.argsort(flat_d, kind="stable")[:k]
+        out_d = np.full(k, 3.0e38, dtype=np.float32)
+        out_i = np.full(k, -1, dtype=np.int64)
+        out_d[: len(order)] = flat_d[order]
+        out_i[: len(order)] = flat_i[order]
+        return out_d, out_i
+    out_d = np.empty(k, dtype=np.float32)
+    out_i = np.empty(k, dtype=np.int64)
+    lib.wn_merge_topk(_ptr(dists, ctypes.c_float), _ptr(ids, ctypes.c_int64),
+                      dists.shape[0], dists.shape[1], k,
+                      _ptr(out_d, ctypes.c_float), _ptr(out_i, ctypes.c_int64))
+    return out_d, out_i
