@@ -107,7 +107,8 @@ int64_t wn_varint_encode_u64(const uint64_t* vals, int64_t n, uint8_t* out) {
 // Decodes at most ``cap`` values into ``out`` but returns the TOTAL number
 // of varints present in the buffer — a return value > cap tells the caller
 // the declared count was wrong (corrupt/truncated record) without ever
-// writing past the buffer.
+// writing past the buffer. Returns -1 on an over-long varint (shift past
+// 63 bits would be UB and would decode corrupt bytes into plausible ids).
 int64_t wn_varint_decode_u64(const uint8_t* buf, int64_t nbytes,
                              uint64_t* out, int64_t cap) {
     const uint8_t* p = buf;
@@ -118,10 +119,12 @@ int64_t wn_varint_decode_u64(const uint8_t* buf, int64_t nbytes,
         uint64_t d = 0;
         int shift = 0;
         while (p < end && (*p & 0x80)) {
+            if (shift > 63) return -1;
             d |= (uint64_t)(*p++ & 0x7f) << shift;
             shift += 7;
         }
         if (p >= end) break;
+        if (shift > 63) return -1;
         d |= (uint64_t)(*p++) << shift;
         prev += d;
         if (n < cap) out[n] = prev;

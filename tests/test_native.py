@@ -99,11 +99,16 @@ b = np.unique(np.random.default_rng(2).integers(0, 100, 50).astype(np.uint64))
 print(repr(native.intersect_sorted(a, b).tolist()))
 print(repr(native.varint_encode(a).hex()))
 """
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({"WEAVIATE_TPU_NO_NATIVE": "1", "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": repo_root + os.pathsep
+                + env.get("PYTHONPATH", "")})
     out = subprocess.run(
         [sys.executable, "-c", code],
-        capture_output=True, text=True, timeout=120,
-        env={"WEAVIATE_TPU_NO_NATIVE": "1", "JAX_PLATFORMS": "cpu",
-             "PATH": "/usr/bin:/bin", "PYTHONPATH": "/root/repo"},
+        capture_output=True, text=True, timeout=120, env=env,
     )
     assert out.returncode == 0, out.stderr
     lines = out.stdout.strip().splitlines()
@@ -151,3 +156,11 @@ def test_merge_by_distance_matches_sort():
     want = sorted((r for g in gathered for r in g),
                   key=lambda r: r.distance)[:7]
     assert [r.distance for r in merged] == [r.distance for r in want]
+
+
+def test_varint_decode_rejects_overlong_varint():
+    """11+ continuation bytes would shift past 63 bits — must raise, not
+    decode garbage (both native and fallback paths)."""
+    bad = bytes([0xFF] * 12 + [0x01])
+    with pytest.raises(ValueError):
+        native.varint_decode(bad, count_hint=1)

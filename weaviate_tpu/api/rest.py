@@ -218,12 +218,19 @@ class RestServer:
     (Raft path); both expose the same method names. ``node``: optional
     ClusterNode for /v1/nodes."""
 
+    _DEFAULT_GRAPHQL = object()  # sentinel: build an executor; None = off
+
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
-                 schema_target=None, node=None, graphql_executor=None,
+                 schema_target=None, node=None,
+                 graphql_executor=_DEFAULT_GRAPHQL,
                  modules=None):
         self.db = db
         self.schema_target = schema_target or db
         self.node = node
+        if graphql_executor is RestServer._DEFAULT_GRAPHQL:
+            from weaviate_tpu.api.graphql import GraphQLExecutor
+
+            graphql_executor = GraphQLExecutor(db, modules)
         self.graphql_executor = graphql_executor
         self.modules = modules  # module Provider for import vectorization
         outer = self

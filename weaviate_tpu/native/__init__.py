@@ -49,11 +49,16 @@ def _load():
         if not os.path.exists(_SO) or stale:
             if os.path.exists(src):
                 try:
+                    # build to a per-pid temp path and rename into place:
+                    # os.replace is atomic, so concurrent processes never
+                    # dlopen a half-written library
+                    tmp = f"{_SO}.{os.getpid()}.tmp"
                     subprocess.run(
                         ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
-                         "-o", _SO, src],
+                         "-o", tmp, src],
                         check=True, capture_output=True, timeout=120,
                     )
+                    os.replace(tmp, _SO)
                 except Exception:
                     # a stale .so may have the wrong ABI — numpy fallback
                     # is safer than loading it
@@ -188,6 +193,8 @@ def varint_decode(buf: bytes, count_hint: int | None = None) -> np.ndarray:
     if lib is None:
         out, prev, d, shift = [], 0, 0, 0
         for byte in buf:
+            if shift > 63:
+                raise ValueError("corrupt varint block: over-long varint")
             d |= (byte & 0x7F) << shift
             if byte & 0x80:
                 shift += 7
@@ -201,11 +208,14 @@ def varint_decode(buf: bytes, count_hint: int | None = None) -> np.ndarray:
                 f"{count_hint} declared")
         return np.asarray(out, dtype=np.uint64)
     arr = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
-    # every value takes >= 1 byte, so len(buf) always bounds the count
-    cap = count_hint if count_hint is not None else len(buf)
+    # every value takes >= 1 byte, so len(buf) bounds the count — the
+    # declared count is untrusted and must never size an allocation alone
+    cap = len(buf) if count_hint is None else min(count_hint, len(buf))
     out = np.empty(max(cap, 1), dtype=np.uint64)
     n = lib.wn_varint_decode_u64(_ptr(arr, ctypes.c_uint8), len(arr),
                                  _ptr(out, ctypes.c_uint64), cap)
+    if n < 0:
+        raise ValueError("corrupt varint block: over-long varint")
     if count_hint is not None and n != count_hint:
         raise ValueError(
             f"corrupt varint block: {n} values, {count_hint} declared")
