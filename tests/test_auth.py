@@ -1,0 +1,159 @@
+"""AuthN/AuthZ tests: unit coverage of the stack + black-box REST/gRPC.
+
+Reference pattern: usecases/auth tests + acceptance authz flows (API key
+login, anonymous toggle, admin-list read-only enforcement).
+"""
+
+import pytest
+
+from weaviate_tpu.auth import (
+    AuthConfig,
+    AuthError,
+    AuthStack,
+    Authenticator,
+    Authorizer,
+    ForbiddenError,
+    Principal,
+)
+
+
+def test_anonymous_default():
+    a = Authenticator(AuthConfig())
+    p = a.authenticate(None)
+    assert p.is_anonymous
+
+
+def test_anonymous_disabled_requires_key():
+    a = Authenticator(AuthConfig(anonymous_enabled=False,
+                                 api_keys=["secret"]))
+    with pytest.raises(AuthError):
+        a.authenticate(None)
+    with pytest.raises(AuthError):
+        a.authenticate("Bearer wrong")
+    with pytest.raises(AuthError):
+        a.authenticate("Basic secret")
+    p = a.authenticate("Bearer secret")
+    assert p.auth_method == "apikey"
+
+
+def test_api_key_user_mapping():
+    a = Authenticator(AuthConfig(api_keys=["k1", "k2", "k3"],
+                                 api_users=["alice", "bob"]))
+    assert a.authenticate("Bearer k1").username == "alice"
+    assert a.authenticate("Bearer k2").username == "bob"
+    # more keys than users: last user catches the tail (reference semantics)
+    assert a.authenticate("Bearer k3").username == "bob"
+
+
+def test_admin_list():
+    z = Authorizer(AuthConfig(admin_users=["root"],
+                              readonly_users=["viewer"]))
+    z.authorize(Principal("root"), "write")
+    z.authorize(Principal("viewer"), "read")
+    with pytest.raises(ForbiddenError):
+        z.authorize(Principal("viewer"), "write")
+    with pytest.raises(ForbiddenError):
+        z.authorize(Principal("stranger"), "read")
+    # no admin list at all -> everything allowed
+    Authorizer(AuthConfig()).authorize(Principal("anyone"), "write")
+
+
+def test_from_env():
+    env = {
+        "AUTHENTICATION_APIKEY_ENABLED": "true",
+        "AUTHENTICATION_APIKEY_ALLOWED_KEYS": "k1, k2",
+        "AUTHENTICATION_APIKEY_USERS": "alice,bob",
+        "AUTHORIZATION_ADMINLIST_ENABLED": "true",
+        "AUTHORIZATION_ADMINLIST_USERS": "alice",
+        "AUTHORIZATION_ADMINLIST_READONLY_USERS": "bob",
+    }
+    cfg = AuthConfig.from_env(env)
+    assert cfg.api_keys == ["k1", "k2"]
+    assert not cfg.anonymous_enabled  # defaults off once keys are on
+    assert cfg.admin_users == ["alice"]
+    stack = AuthStack(cfg)
+    assert stack.check("Bearer k1", "write").username == "alice"
+    with pytest.raises(ForbiddenError):
+        stack.check("Bearer k2", "write")
+
+
+def test_rest_auth_enforcement(tmp_path):
+    from weaviate_tpu.api.client import Client, RestError
+    from weaviate_tpu.api.rest import RestServer
+    from weaviate_tpu.db.database import Database
+
+    db = Database(str(tmp_path))
+    stack = AuthStack(AuthConfig(
+        anonymous_enabled=False, api_keys=["rw-key", "ro-key"],
+        api_users=["writer", "reader"], admin_users=["writer"],
+        readonly_users=["reader"]))
+    srv = RestServer(db, auth=stack)
+    srv.start()
+    try:
+        import http.client
+        import json as _json
+
+        def req(method, path, token=None, body=None):
+            conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+            headers = {"Content-Type": "application/json"}
+            if token:
+                headers["Authorization"] = f"Bearer {token}"
+            conn.request(method, path,
+                         body=_json.dumps(body) if body else None,
+                         headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            conn.close()
+            return resp.status, _json.loads(raw) if raw else None
+
+        assert req("GET", "/v1/meta")[0] == 401
+        assert req("GET", "/v1/meta", token="bogus")[0] == 401
+        assert req("GET", "/v1/meta", token="ro-key")[0] == 200
+        status, _ = req("POST", "/v1/schema", token="ro-key",
+                        body={"class": "Doc"})
+        assert status == 403
+        status, _ = req("POST", "/v1/schema", token="rw-key",
+                        body={"class": "Doc"})
+        assert status == 200
+        # health endpoints stay open (load balancers probe unauthenticated)
+        assert req("GET", "/.well-known/ready")[0] == 200
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_grpc_auth_enforcement(tmp_path):
+    grpc = pytest.importorskip("grpc")
+    from weaviate_tpu.api.grpc.server import GrpcServer, _SERVICE
+    from weaviate_tpu.api.grpc import v1_pb2 as pb
+    from weaviate_tpu.db.database import Database
+
+    db = Database(str(tmp_path))
+    stack = AuthStack(AuthConfig(anonymous_enabled=False,
+                                 api_keys=["key1"]))
+    srv = GrpcServer(db, auth=stack).start()
+    try:
+        chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        search = chan.unary_unary(
+            f"/{_SERVICE}/Search",
+            request_serializer=pb.SearchRequest.SerializeToString,
+            response_deserializer=pb.SearchReply.FromString)
+        with pytest.raises(grpc.RpcError) as e:
+            search(pb.SearchRequest(collection="Nope"))
+        assert e.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        # valid key: failure becomes NOT_FOUND (auth passed)
+        with pytest.raises(grpc.RpcError) as e2:
+            search(pb.SearchRequest(collection="Nope"),
+                   metadata=[("authorization", "Bearer key1")])
+        assert e2.value.code() == grpc.StatusCode.NOT_FOUND
+        chan.close()
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_non_ascii_token_is_401_not_500():
+    a = Authenticator(AuthConfig(anonymous_enabled=False,
+                                 api_keys=["secret"]))
+    with pytest.raises(AuthError):
+        a.authenticate("Bearer kluczé")

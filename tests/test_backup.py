@@ -1,0 +1,171 @@
+"""Backup/restore tests: manager-level round-trip plus REST endpoints.
+
+Reference pattern: usecases/backup handler tests + test/acceptance backup
+flows (create backup -> poll -> delete class -> restore -> data intact).
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.client import Client, RestError
+from weaviate_tpu.api.rest import RestServer
+from weaviate_tpu.backup import BackupError, BackupManager, SUCCESS
+from weaviate_tpu.db.database import Database
+from weaviate_tpu.modules import Provider
+from weaviate_tpu.modules.backup_backends import FilesystemBackend
+
+
+def _provider(db, backup_root):
+    p = Provider(db)
+    p.register(FilesystemBackend(), {"path": str(backup_root)})
+    return p
+
+
+@pytest.fixture
+def env(tmp_path):
+    db = Database(str(tmp_path / "data"))
+    provider = _provider(db, tmp_path / "backups")
+    mgr = BackupManager(db, provider)
+    yield db, mgr
+    db.close()
+
+
+def _seed(db, name="Doc", n=25):
+    from weaviate_tpu.api.rest import config_from_json
+
+    db.create_collection(config_from_json({
+        "class": name,
+        "properties": [{"name": "n", "dataType": ["int"]}]}))
+    col = db.get_collection(name)
+    rng = np.random.default_rng(1)
+    uids = []
+    for i in range(n):
+        uids.append(col.put_object({"n": i},
+                                   vector=rng.standard_normal(16)))
+    return col, uids
+
+
+def test_backup_restore_roundtrip(env, tmp_path):
+    db, mgr = env
+    col, uids = _seed(db)
+    q = np.asarray(np.random.default_rng(2).standard_normal(16),
+                   dtype=np.float32)
+    before = [r.uuid for r in col.near_vector(q, k=5)]
+
+    st = mgr.start_backup("filesystem", "bk1", wait=True)
+    assert mgr.backup_status("filesystem", "bk1")["status"] == SUCCESS
+
+    db.delete_collection("Doc")
+    assert "Doc" not in db.list_collections()
+
+    mgr.start_restore("filesystem", "bk1", wait=True)
+    assert mgr.restore_status("filesystem", "bk1")["status"] == SUCCESS
+    col2 = db.get_collection("Doc")
+    assert col2.object_count() == 25
+    after = [r.uuid for r in col2.near_vector(q, k=5)]
+    assert before == after
+    assert col2.get_object(uids[0]).properties["n"] == 0
+
+
+def test_backup_include_exclude(env):
+    db, mgr = env
+    _seed(db, "A", 3)
+    _seed(db, "B", 3)
+    mgr.start_backup("filesystem", "bk2", include=["A"], wait=True)
+    db.delete_collection("A")
+    db.delete_collection("B")
+    mgr.start_restore("filesystem", "bk2", wait=True)
+    assert db.list_collections() == ["A"]
+    with pytest.raises(BackupError):
+        mgr.start_backup("filesystem", "x", include=["A"], exclude=["B"])
+
+
+def test_backup_validation(env):
+    db, mgr = env
+    _seed(db, "C", 2)
+    with pytest.raises(BackupError):
+        mgr.start_backup("filesystem", "BAD ID")
+    with pytest.raises(BackupError):
+        mgr.start_backup("filesystem", "ok", include=["Nope"])
+    mgr.start_backup("filesystem", "dup", wait=True)
+    with pytest.raises(BackupError):  # already exists on the backend
+        mgr.start_backup("filesystem", "dup")
+    with pytest.raises(BackupError):  # restore refuses to overwrite
+        mgr.start_restore("filesystem", "dup", wait=True)
+    with pytest.raises(BackupError):
+        mgr.start_restore("filesystem", "missing")
+    with pytest.raises(BackupError):  # backend not registered
+        BackupManager(db, Provider(db)).start_backup("s3", "x")
+
+
+def test_backup_rest_endpoints(tmp_path):
+    db = Database(str(tmp_path / "data"))
+    provider = _provider(db, tmp_path / "backups")
+    srv = RestServer(db, modules=provider)
+    srv.start()
+    try:
+        c = Client(srv.address)
+        c.create_class({"class": "Doc", "properties": [
+            {"name": "n", "dataType": ["int"]}]})
+        c.create_object("Doc", {"n": 1}, vector=[1.0, 2.0])
+        out = c.request("POST", "/v1/backups/filesystem", body={"id": "r1"})
+        assert out["id"] == "r1"
+        import time
+
+        for _ in range(100):
+            st = c.request("GET", "/v1/backups/filesystem/r1")
+            if st["status"] in ("SUCCESS", "FAILED"):
+                break
+            time.sleep(0.05)
+        assert st["status"] == "SUCCESS", st
+        c.delete_class("Doc")
+        c.request("POST", "/v1/backups/filesystem/r1/restore", body={})
+        for _ in range(100):
+            st = c.request("GET", "/v1/backups/filesystem/r1/restore")
+            if st["status"] in ("SUCCESS", "FAILED"):
+                break
+            time.sleep(0.05)
+        assert st["status"] == "SUCCESS", st
+        got = c.list_objects("Doc", limit=10)
+        assert len(got["objects"]) == 1
+        with pytest.raises(RestError) as e:
+            c.request("POST", "/v1/backups/nope", body={"id": "x"})
+        assert e.value.status == 422
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_restore_rejects_traversal_descriptor(env, tmp_path):
+    """backup_config.json is untrusted backend content: class names and
+    file paths must not escape the data directory."""
+    import json
+
+    db, mgr = env
+    _seed(db, "Safe", 2)
+    mgr.start_backup("filesystem", "evil", wait=True)
+    # tamper with the stored descriptor
+    backend = mgr.modules.backup_backend("filesystem")
+    desc = json.loads(backend.get("evil", "backup_config.json"))
+    desc["classes"][0]["files"] = ["../../../pwned.txt"]
+    backend.put("evil", "backup_config.json", json.dumps(desc).encode())
+    db.delete_collection("Safe")
+    st = mgr.start_restore("filesystem", "evil", wait=True)
+    assert st is not None
+    final = mgr.restore_status("filesystem", "evil")
+    assert final["status"] == "FAILED"
+    assert "escapes" in final["error"]
+    import os
+
+    assert not os.path.exists(str(tmp_path / "pwned.txt"))
+
+
+def test_backend_rejects_traversal_backup_id(env, tmp_path):
+    from weaviate_tpu.modules.base import ModuleError
+
+    db, mgr = env
+    backend = mgr.modules.backup_backend("filesystem")
+    with pytest.raises(ModuleError):
+        backend.get("..", "anything")
+    with pytest.raises(BackupError):  # manager rejects before the backend
+        mgr.start_restore("filesystem", "..")

@@ -255,10 +255,12 @@ class GrpcServer:
             "BatchDelete": pb.BatchDeleteRequest,
             "TenantsGet": pb.TenantsGetRequest,
         }
+        verbs = {"Search": "read", "TenantsGet": "read",
+                 "BatchObjects": "write", "BatchDelete": "write"}
         method_handlers = {}
         for name, fn in handlers.items():
             method_handlers[name] = grpc.unary_unary_rpc_method_handler(
-                self._wrap(fn),
+                self._wrap(fn, verbs[name]),
                 request_deserializer=req_types[name].FromString,
                 response_serializer=lambda resp: resp.SerializeToString(),
             )
@@ -277,10 +279,10 @@ class GrpcServer:
 
     # -- plumbing -----------------------------------------------------------
 
-    def _wrap(self, fn):
+    def _wrap(self, fn, verb: str = "write"):
         def handler(request, context):
             try:
-                self._check_auth(context)
+                self._check_auth(context, verb)
                 return fn(request, context)
             except ApiError as e:
                 context.abort(e.code, e.message)
@@ -293,15 +295,20 @@ class GrpcServer:
                 context.abort(grpc.StatusCode.INTERNAL, str(e))
         return handler
 
-    def _check_auth(self, context):
+    def _check_auth(self, context, verb: str):
+        """auth interceptor analog (reference: grpc/server.go auth
+        interceptor reads the authorization metadata key)."""
         if self.auth is None:
             return
+        from weaviate_tpu.auth import AuthError, ForbiddenError
+
         md = dict(context.invocation_metadata() or [])
-        token = md.get("authorization", "")
-        if token.lower().startswith("bearer "):
-            token = token[7:]
-        principal = self.auth.authenticate(token or None)
-        self.auth.authorize(principal)
+        try:
+            self.auth.check(md.get("authorization") or None, verb)
+        except AuthError as e:
+            raise ApiError(grpc.StatusCode.UNAUTHENTICATED, str(e))
+        except ForbiddenError as e:
+            raise ApiError(grpc.StatusCode.PERMISSION_DENIED, str(e))
 
     def _collection(self, name: str):
         return self.db.get_collection(name)

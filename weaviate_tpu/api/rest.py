@@ -223,16 +223,25 @@ class RestServer:
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
                  schema_target=None, node=None,
                  graphql_executor=_DEFAULT_GRAPHQL,
-                 modules=None):
+                 modules=None, auth=None):
         self.db = db
         self.schema_target = schema_target or db
         self.node = node
+        self.auth = auth  # AuthStack | None (None = open access)
         if graphql_executor is RestServer._DEFAULT_GRAPHQL:
             from weaviate_tpu.api.graphql import GraphQLExecutor
 
             graphql_executor = GraphQLExecutor(db, modules)
         self.graphql_executor = graphql_executor
         self.modules = modules  # module Provider for import vectorization
+        if modules is not None:
+            from weaviate_tpu.backup import BackupManager
+
+            self.backup_manager = BackupManager(
+                db, modules,
+                node_name=getattr(node, "name", None) or "node-0")
+        else:
+            self.backup_manager = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -248,6 +257,24 @@ class RestServer:
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b""
                 try:
+                    if outer.auth is not None and \
+                            not parsed.path.startswith("/.well-known"):
+                        from weaviate_tpu.auth import (
+                            AuthError,
+                            ForbiddenError,
+                        )
+
+                        # POST /v1/graphql is query-only (this API has no
+                        # mutations) — same verb as gRPC Search
+                        verb = "read" if method in ("GET", "HEAD") \
+                            or parsed.path == "/v1/graphql" else "write"
+                        try:
+                            outer.auth.check(
+                                self.headers.get("Authorization"), verb)
+                        except AuthError as e:
+                            raise ApiError(401, str(e))
+                        except ForbiddenError as e:
+                            raise ApiError(403, str(e))
                     body = json.loads(raw) if raw else None
                     status, payload = outer.dispatch(method, parsed.path,
                                                      params, body)
@@ -316,6 +343,12 @@ class RestServer:
         if seg[:1] == [".well-known"]:
             if seg[1:] == ["ready"] or seg[1:] == ["live"]:
                 return 200, {}
+            if seg[1:] == ["openid-configuration"]:
+                oidc = None if self.auth is None else \
+                    self.auth.openid_configuration()
+                if oidc is None:
+                    raise ApiError(404, "OIDC is not configured")
+                return 200, oidc
             raise KeyError(path)
         if not seg or seg[0] != "v1":
             raise KeyError(path)
@@ -340,7 +373,42 @@ class RestServer:
             return self._objects(method, seg[1:], params, body)
         if seg == ["batch", "objects"] and method == "POST":
             return self._batch_objects(body or {})
+        if seg[:1] == ["backups"]:
+            return self._backups(method, seg[1:], body)
         raise KeyError(path)
+
+    def _backups(self, method: str, seg: list[str], body):
+        """Reference routes (handlers_backup.go):
+        POST /v1/backups/{backend}            start backup
+        GET  /v1/backups/{backend}/{id}       backup status
+        POST /v1/backups/{backend}/{id}/restore    start restore
+        GET  /v1/backups/{backend}/{id}/restore    restore status
+        """
+        from weaviate_tpu.backup import BackupError
+        from weaviate_tpu.modules.base import ModuleError
+
+        if self.backup_manager is None:
+            raise ApiError(422, "backups require a module provider")
+        try:
+            if len(seg) == 1 and method == "POST":
+                b = body or {}
+                return 200, self.backup_manager.start_backup(
+                    seg[0], b.get("id", ""), include=b.get("include"),
+                    exclude=b.get("exclude"))
+            if len(seg) == 2 and method == "GET":
+                return 200, self.backup_manager.backup_status(seg[0], seg[1])
+            if len(seg) == 3 and seg[2] == "restore":
+                if method == "POST":
+                    b = body or {}
+                    return 200, self.backup_manager.start_restore(
+                        seg[0], seg[1], include=b.get("include"),
+                        exclude=b.get("exclude"))
+                if method == "GET":
+                    return 200, self.backup_manager.restore_status(
+                        seg[0], seg[1])
+        except (BackupError, ModuleError) as e:
+            raise ApiError(422, str(e))
+        raise KeyError("/v1/backups/" + "/".join(seg))
 
     def _nodes_payload(self) -> list[dict]:
         if self.node is not None:

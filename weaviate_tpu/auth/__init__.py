@@ -1,0 +1,165 @@
+"""Authentication and authorization.
+
+Reference:
+- authn: API keys (usecases/auth/authentication/apikey — static key list
+  mapped to users via AUTHENTICATION_APIKEY_ALLOWED_KEYS/USERS),
+  anonymous access (AUTHENTICATION_ANONYMOUS_ACCESS_ENABLED), and OIDC
+  (adapters/handlers/rest/configure_api.go:601; validated against the
+  issuer's JWKS).
+- authz: admin-list (usecases/auth/authorization/adminlist — admins get
+  everything, read-only users get GET/HEAD), composed at
+  configure_api.go:468.
+
+OIDC configuration is exposed (/.well-known/openid-configuration, same as
+the reference) but token *validation* requires fetching the issuer's JWKS
+over the network; in this zero-egress environment OIDC bearer tokens are
+rejected with a clear error unless they match a configured API key.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+from dataclasses import dataclass, field
+
+
+class AuthError(Exception):
+    """401 — missing/invalid credentials."""
+
+
+class ForbiddenError(Exception):
+    """403 — authenticated but not allowed."""
+
+
+@dataclass
+class Principal:
+    username: str
+    auth_method: str = "anonymous"  # anonymous | apikey | oidc
+
+    @property
+    def is_anonymous(self) -> bool:
+        return self.auth_method == "anonymous"
+
+
+@dataclass
+class AuthConfig:
+    anonymous_enabled: bool = True
+    # api keys: keys[i] authenticates as users[min(i, len(users)-1)]
+    # (reference: AUTHENTICATION_APIKEY_ALLOWED_KEYS / _USERS semantics)
+    api_keys: list[str] = field(default_factory=list)
+    api_users: list[str] = field(default_factory=list)
+    oidc_enabled: bool = False
+    oidc_issuer: str = ""
+    oidc_client_id: str = ""
+    # authorization: admin list (empty admin list = everyone may write)
+    admin_users: list[str] = field(default_factory=list)
+    readonly_users: list[str] = field(default_factory=list)
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> "AuthConfig":
+        """Reference env surface (usecases/config/environment.go)."""
+        def flag(name, default="false"):
+            return env.get(name, default).lower() in ("true", "1", "on")
+
+        def csv(name):
+            raw = env.get(name, "")
+            return [s.strip() for s in raw.split(",") if s.strip()]
+
+        keys_on = flag("AUTHENTICATION_APIKEY_ENABLED")
+        return cls(
+            anonymous_enabled=flag(
+                "AUTHENTICATION_ANONYMOUS_ACCESS_ENABLED",
+                "false" if keys_on else "true"),
+            api_keys=csv("AUTHENTICATION_APIKEY_ALLOWED_KEYS")
+            if keys_on else [],
+            api_users=csv("AUTHENTICATION_APIKEY_USERS") if keys_on else [],
+            oidc_enabled=flag("AUTHENTICATION_OIDC_ENABLED"),
+            oidc_issuer=env.get("AUTHENTICATION_OIDC_ISSUER", ""),
+            oidc_client_id=env.get("AUTHENTICATION_OIDC_CLIENT_ID", ""),
+            admin_users=csv("AUTHORIZATION_ADMINLIST_USERS")
+            if flag("AUTHORIZATION_ADMINLIST_ENABLED") else [],
+            readonly_users=csv("AUTHORIZATION_ADMINLIST_READONLY_USERS")
+            if flag("AUTHORIZATION_ADMINLIST_ENABLED") else [],
+        )
+
+
+class Authenticator:
+    def __init__(self, config: AuthConfig):
+        self.config = config
+
+    def authenticate(self, authorization: str | None) -> Principal:
+        """``authorization``: the Authorization header value or None."""
+        cfg = self.config
+        if authorization:
+            scheme, _, token = authorization.partition(" ")
+            if scheme.lower() != "bearer" or not token:
+                raise AuthError("Authorization header must be 'Bearer <key>'")
+            token = token.strip()
+            # compare as bytes: str compare_digest raises on non-ASCII,
+            # which would turn a bad credential into a 500 instead of 401
+            token_b = token.encode("utf-8", "surrogatepass")
+            for i, key in enumerate(cfg.api_keys):
+                if hmac.compare_digest(token_b, key.encode("utf-8")):
+                    users = cfg.api_users
+                    user = users[min(i, len(users) - 1)] if users else "api-key-user"
+                    return Principal(user, "apikey")
+            if cfg.oidc_enabled:
+                raise AuthError(
+                    "OIDC token validation requires issuer connectivity; "
+                    "this deployment accepts only configured API keys")
+            raise AuthError("invalid api key")
+        if cfg.anonymous_enabled:
+            return Principal("anonymous", "anonymous")
+        raise AuthError("anonymous access is disabled; provide a Bearer key")
+
+
+class Authorizer:
+    """Admin-list authorization (reference: authorization/adminlist):
+    - no admin list configured → every authenticated principal may do
+      anything (the reference's default 'all allowed' authorizer)
+    - admin list configured → admins: everything; read-only users: reads;
+      everyone else: denied
+    """
+
+    def __init__(self, config: AuthConfig):
+        self.config = config
+
+    def authorize(self, principal: Principal, verb: str) -> None:
+        """``verb``: "read" or "write"."""
+        cfg = self.config
+        if not cfg.admin_users and not cfg.readonly_users:
+            return
+        if principal.username in cfg.admin_users:
+            return
+        if principal.username in cfg.readonly_users:
+            if verb == "read":
+                return
+            raise ForbiddenError(
+                f"user {principal.username!r} has read-only access")
+        raise ForbiddenError(
+            f"user {principal.username!r} is not on the admin list")
+
+
+class AuthStack:
+    """Authenticator + authorizer bundle the API servers consume."""
+
+    def __init__(self, config: AuthConfig | None = None):
+        self.config = config or AuthConfig()
+        self.authenticator = Authenticator(self.config)
+        self.authorizer = Authorizer(self.config)
+
+    def check(self, authorization: str | None, verb: str) -> Principal:
+        p = self.authenticator.authenticate(authorization)
+        self.authorizer.authorize(p, verb)
+        return p
+
+    def openid_configuration(self) -> dict | None:
+        """Payload for /.well-known/openid-configuration (reference serves
+        the issuer's discovery document location + client id)."""
+        if not self.config.oidc_enabled:
+            return None
+        return {
+            "href": f"{self.config.oidc_issuer.rstrip('/')}"
+                    "/.well-known/openid-configuration",
+            "clientId": self.config.oidc_client_id,
+        }

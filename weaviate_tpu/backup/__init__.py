@@ -1,0 +1,268 @@
+"""Backup / restore subsystem.
+
+Reference: usecases/backup — Handler validates and spawns async work,
+the coordinator runs a 2-phase protocol over participating nodes
+(coordinator.go:133 Backup, :199 Restore), each node's backupper pauses
+compaction, lists shard files, and streams them to a module backend
+(S3/GCS/Azure/filesystem); progress is polled via /v1/backups/.../status.
+
+Single-node manager here (the multi-node path rides the cluster layer's
+remote API the same way queries do): snapshot = flush + copy the
+collection's on-disk tree through a ``BackupBackend`` module, plus a
+``backup_config.json`` descriptor carrying schema + sharding so restore
+can rebuild the collection without pre-existing schema.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+from weaviate_tpu.modules.base import BackupBackend, ModuleError
+from weaviate_tpu.schema.config import CollectionConfig
+
+_ID_RE = re.compile(r"^[a-z0-9][a-z0-9_-]*$")
+
+# reference: entities/backup/status.go
+STARTED = "STARTED"
+TRANSFERRING = "TRANSFERRING"
+TRANSFERRED = "TRANSFERRED"
+SUCCESS = "SUCCESS"
+FAILED = "FAILED"
+
+DESCRIPTOR = "backup_config.json"
+
+
+class BackupError(Exception):
+    pass
+
+
+def _walk_files(root: str) -> list[str]:
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            full = os.path.join(dirpath, fn)
+            out.append(os.path.relpath(full, root))
+    return sorted(out)
+
+
+class BackupManager:
+    """``modules``: module Provider — backends resolve via
+    ``backup_backend(name)`` (reference: module registry lookup,
+    usecases/backup/handler.go)."""
+
+    def __init__(self, db, modules, node_name: str = "node-0"):
+        self.db = db
+        self.modules = modules
+        self.node_name = node_name
+        self._lock = threading.Lock()
+        self._backups: dict[tuple[str, str], dict] = {}
+        self._restores: dict[tuple[str, str], dict] = {}
+
+    # -- backup --------------------------------------------------------------
+
+    def start_backup(self, backend_name: str, backup_id: str,
+                     include: list[str] | None = None,
+                     exclude: list[str] | None = None,
+                     wait: bool = False) -> dict:
+        backend = self._backend(backend_name)
+        self._check_id(backup_id)
+        if include and exclude:
+            raise BackupError("include and exclude are mutually exclusive")
+        all_classes = self.db.list_collections()
+        classes = list(include) if include else \
+            [c for c in all_classes if c not in set(exclude or [])]
+        for c in classes:
+            if c not in all_classes:
+                raise BackupError(f"class {c!r} does not exist")
+        if not classes:
+            raise BackupError("no classes to back up")
+        if self._descriptor_exists(backend, backend_name, backup_id):
+            raise BackupError(
+                f"backup {backup_id!r} already exists on {backend_name!r}")
+        key = (backend_name, backup_id)
+        status = {"id": backup_id, "backend": backend_name,
+                  "status": STARTED, "error": None, "classes": classes,
+                  "path": self._home(backend, backup_id)}
+        with self._lock:
+            if key in self._backups and \
+                    self._backups[key]["status"] in (STARTED, TRANSFERRING):
+                raise BackupError(f"backup {backup_id!r} already running")
+            self._backups[key] = status
+
+        def work():
+            try:
+                status["status"] = TRANSFERRING
+                backend.initialize(backup_id)
+                descriptor = {
+                    "id": backup_id,
+                    "node": self.node_name,
+                    "startedAt": time.time(),
+                    "version": "1",
+                    "classes": [],
+                }
+                # pause background compaction/flush cycles for a consistent
+                # file set (reference: Shard.BeginBackup pauses compaction
+                # + commit-log switching, shard_backup.go)
+                with self.db.cycles.pause():
+                    self.db.flush()
+                    for cls in classes:
+                        col = self.db.get_collection(cls)
+                        root = os.path.join(self.db.data_dir, cls)
+                        files = _walk_files(root) if os.path.isdir(root) \
+                            else []
+                        for rel in files:
+                            with open(os.path.join(root, rel), "rb") as f:
+                                backend.put(backup_id, f"{cls}/{rel}",
+                                            f.read())
+                        descriptor["classes"].append({
+                            "name": cls,
+                            "config": col.config.to_dict(),
+                            "sharding": col.sharding.to_dict(),
+                            "files": files,
+                        })
+                status["status"] = TRANSFERRED
+                descriptor["completedAt"] = time.time()
+                backend.put(backup_id, DESCRIPTOR,
+                            json.dumps(descriptor).encode())
+                status["status"] = SUCCESS
+            except Exception as e:  # surfaced via status polling
+                status["status"] = FAILED
+                status["error"] = str(e)
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"backup-{backup_id}")
+        t.start()
+        if wait:
+            t.join()
+        return dict(status)
+
+    # -- restore -------------------------------------------------------------
+
+    def start_restore(self, backend_name: str, backup_id: str,
+                      include: list[str] | None = None,
+                      exclude: list[str] | None = None,
+                      wait: bool = False) -> dict:
+        backend = self._backend(backend_name)
+        self._check_id(backup_id)
+        try:
+            descriptor = json.loads(backend.get(backup_id, DESCRIPTOR))
+        except Exception:
+            raise BackupError(
+                f"backup {backup_id!r} not found on {backend_name!r}")
+        if include and exclude:
+            raise BackupError("include and exclude are mutually exclusive")
+        by_name = {c["name"]: c for c in descriptor["classes"]}
+        classes = list(include) if include else \
+            [n for n in by_name if n not in set(exclude or [])]
+        for c in classes:
+            if c not in by_name:
+                raise BackupError(f"class {c!r} not in backup {backup_id!r}")
+            if c in self.db.list_collections():
+                raise BackupError(
+                    f"class {c!r} already exists; delete it before restore "
+                    "(reference behavior: restore never overwrites)")
+        key = (backend_name, backup_id)
+        status = {"id": backup_id, "backend": backend_name,
+                  "status": STARTED, "error": None, "classes": classes,
+                  "path": self._home(backend, backup_id)}
+        with self._lock:
+            if key in self._restores and \
+                    self._restores[key]["status"] in (STARTED, TRANSFERRING):
+                raise BackupError(f"restore {backup_id!r} already running")
+            self._restores[key] = status
+
+        def work():
+            try:
+                status["status"] = TRANSFERRING
+                from weaviate_tpu.db.sharding import ShardingState
+
+                data_root = os.path.abspath(self.db.data_dir)
+                for cls in classes:
+                    entry = by_name[cls]
+                    root = os.path.abspath(
+                        os.path.join(self.db.data_dir, cls))
+                    # the descriptor is UNTRUSTED backend content: class
+                    # names and file paths must stay inside data_dir
+                    if os.path.dirname(root) != data_root:
+                        raise BackupError(
+                            f"descriptor class name {cls!r} escapes the "
+                            "data directory")
+                    for rel in entry["files"]:
+                        dst = os.path.abspath(os.path.join(root, rel))
+                        if not dst.startswith(root + os.sep):
+                            raise BackupError(
+                                f"descriptor file path {rel!r} escapes "
+                                "the class directory")
+                        data = backend.get(backup_id, f"{cls}/{rel}")
+                        os.makedirs(os.path.dirname(dst), exist_ok=True)
+                        with open(dst, "wb") as f:
+                            f.write(data)
+                    cfg = CollectionConfig.from_dict(entry["config"])
+                    state = ShardingState.from_dict(entry["sharding"])
+                    self.db.create_collection(cfg, sharding_state=state)
+                status["status"] = SUCCESS
+            except Exception as e:
+                status["status"] = FAILED
+                status["error"] = str(e)
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"restore-{backup_id}")
+        t.start()
+        if wait:
+            t.join()
+        return dict(status)
+
+    # -- status --------------------------------------------------------------
+
+    @staticmethod
+    def _check_id(backup_id: str) -> None:
+        if not _ID_RE.match(backup_id or ""):
+            raise BackupError(f"invalid backup id {backup_id!r} (lowercase "
+                              "letters, numbers, '_', '-' only)")
+
+    @staticmethod
+    def _descriptor_exists(backend, backend_name, backup_id) -> bool:
+        try:
+            return bool(backend.get(backup_id, DESCRIPTOR))
+        except (KeyError, FileNotFoundError):
+            return False
+        except ModuleError as e:
+            raise BackupError(str(e))
+        except Exception as e:  # unreachable endpoint etc. → clean 422
+            raise BackupError(
+                f"backend {backend_name!r} probe failed: {e}")
+
+    def backup_status(self, backend_name: str, backup_id: str) -> dict:
+        return self._status(self._backups, backend_name, backup_id, "backup")
+
+    def restore_status(self, backend_name: str, backup_id: str) -> dict:
+        return self._status(self._restores, backend_name, backup_id,
+                            "restore")
+
+    def _status(self, table, backend_name, backup_id, kind) -> dict:
+        with self._lock:
+            st = table.get((backend_name, backup_id))
+        if st is None:
+            raise BackupError(f"no {kind} {backup_id!r} on {backend_name!r}")
+        return dict(st)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _backend(self, name: str) -> BackupBackend:
+        if self.modules is None:
+            raise BackupError("backups require a module provider")
+        try:
+            return self.modules.backup_backend(name)
+        except ModuleError as e:
+            raise BackupError(str(e))
+
+    @staticmethod
+    def _home(backend, backup_id) -> str:
+        try:
+            return backend.home_dir(backup_id)
+        except Exception:
+            return ""

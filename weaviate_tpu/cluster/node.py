@@ -78,14 +78,18 @@ class ClusterNode:
                 did = HashBeater(col).beat() or did
         return did
 
-    def serve_rest(self, host: str = "127.0.0.1", port: int = 0):
+    def serve_rest(self, host: str = "127.0.0.1", port: int = 0,
+                   modules=None, auth=None):
         """Start the public /v1 REST API for this node (schema writes
         take the Raft path; reads/writes hit the local Database which
-        scatter-gathers as needed)."""
+        scatter-gathers as needed). ``modules``/``auth`` pass through to
+        the server so cluster nodes get the same vectorizer/backup/auth
+        surface as standalone ones."""
         from weaviate_tpu.api.rest import RestServer
 
         self.rest = RestServer(self.db, host=host, port=port,
-                               schema_target=self, node=self)
+                               schema_target=self, node=self,
+                               modules=modules, auth=auth)
         self.rest.start()
         return self.rest
 

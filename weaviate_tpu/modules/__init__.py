@@ -19,6 +19,7 @@ from weaviate_tpu.modules.text2vec_hash import HashVectorizer
 
 
 def default_provider(db=None, enabled: list[str] | None = None) -> Provider:
+    from weaviate_tpu.modules import backup_backends as bb
     from weaviate_tpu.modules import http_modules as hm
 
     provider = Provider(db)
@@ -36,6 +37,10 @@ def default_provider(db=None, enabled: list[str] | None = None) -> Provider:
         hm.OpenAIGenerative(),
         hm.OllamaGenerative(),
         hm.CohereGenerative(),
+        bb.FilesystemBackend(),
+        bb.S3Backend(),
+        bb.GCSBackend(),
+        bb.AzureBackend(),
     ]
     for mod in mods:
         if enabled is None or mod.name in enabled:

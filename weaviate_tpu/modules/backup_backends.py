@@ -1,0 +1,154 @@
+"""Backup backend modules.
+
+Reference: modules/backup-{filesystem,s3,gcs,azure} implementing
+modulecapabilities.BackupBackend (entities/modulecapabilities/backup.go:
+Initialize/PutObject/GetObject/HomeDir/...). The filesystem backend is
+fully local (BACKUP_FILESYSTEM_PATH, modules/backup-filesystem/backend.go);
+the cloud backends talk to object stores. Here, s3/gcs/azure speak the
+shared minimal "HTTP object store" dialect (unauthenticated PUT/GET
+against an endpoint, the shape a local minio/azurite/fake-gcs test
+container accepts) and fail with a clear error when no endpoint is
+configured — this environment has no network egress, so real cloud
+authentication (SigV4 etc.) is intentionally out of scope.
+"""
+
+from __future__ import annotations
+
+import os
+import urllib.error
+import urllib.request
+
+from weaviate_tpu.modules.base import BackupBackend, ModuleError
+
+
+class FilesystemBackend(BackupBackend):
+    """backup-filesystem: objects under <path>/<backup_id>/<key>."""
+
+    name = "backup-filesystem"
+
+    def init(self, settings: dict | None = None) -> None:
+        settings = settings or {}
+        self.root = settings.get("path") or os.environ.get(
+            "BACKUP_FILESYSTEM_PATH", "")
+
+    def _require_root(self) -> str:
+        if not self.root:
+            raise ModuleError(
+                "backup-filesystem needs a path (module setting 'path' or "
+                "BACKUP_FILESYSTEM_PATH)")
+        return self.root
+
+    def initialize(self, backup_id: str) -> None:
+        os.makedirs(os.path.join(self._require_root(), backup_id),
+                    exist_ok=True)
+
+    def put(self, backup_id: str, key: str, data: bytes) -> None:
+        path = self._safe_path(backup_id, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get(self, backup_id: str, key: str) -> bytes:
+        path = self._safe_path(backup_id, key)
+        if not os.path.exists(path):
+            raise KeyError(f"{backup_id}/{key} not found")
+        with open(path, "rb") as f:
+            return f.read()
+
+    def list(self, backup_id: str) -> list[str]:
+        root = os.path.join(self._require_root(), backup_id)
+        out = []
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in files:
+                out.append(os.path.relpath(os.path.join(dirpath, fn), root))
+        return sorted(out)
+
+    def home_dir(self, backup_id: str) -> str:
+        return os.path.join(self._require_root(), backup_id)
+
+    def _safe_path(self, backup_id: str, key: str) -> str:
+        # containment is anchored at the CONFIGURED root, so a traversal
+        # backup_id ('..') can't move the anchor outside it
+        base = os.path.abspath(self._require_root())
+        root = os.path.abspath(os.path.join(base, backup_id))
+        if not root.startswith(base + os.sep):
+            raise ModuleError(f"backup id {backup_id!r} escapes the "
+                              "backup root")
+        path = os.path.abspath(os.path.join(root, key))
+        if not path.startswith(root + os.sep) and path != root:
+            raise ModuleError(f"backup key {key!r} escapes the backup root")
+        return path
+
+
+class _HttpObjectStoreBackend(BackupBackend):
+    """Shared minimal HTTP object-store client for the cloud backends:
+    PUT/GET <endpoint>/<container>/<backup_id>/<key>."""
+
+    endpoint_setting = "endpoint"
+    endpoint_env = ""
+    container_setting = "bucket"
+    container_env = ""
+    default_container = "weaviate-backups"
+
+    def init(self, settings: dict | None = None) -> None:
+        settings = settings or {}
+        self.endpoint = (settings.get(self.endpoint_setting)
+                         or os.environ.get(self.endpoint_env, "")).rstrip("/")
+        self.container = (settings.get(self.container_setting)
+                          or os.environ.get(self.container_env, "")
+                          or self.default_container)
+
+    def _url(self, backup_id: str, key: str) -> str:
+        if not self.endpoint:
+            raise ModuleError(
+                f"{self.name} needs an endpoint (module setting "
+                f"{self.endpoint_setting!r} or {self.endpoint_env})")
+        return f"{self.endpoint}/{self.container}/{backup_id}/{key}"
+
+    def initialize(self, backup_id: str) -> None:
+        self._url(backup_id, "")  # endpoint check
+
+    def put(self, backup_id: str, key: str, data: bytes) -> None:
+        req = urllib.request.Request(self._url(backup_id, key), data=data,
+                                     method="PUT")
+        with urllib.request.urlopen(req, timeout=60):
+            pass
+
+    def get(self, backup_id: str, key: str) -> bytes:
+        try:
+            with urllib.request.urlopen(self._url(backup_id, key),
+                                        timeout=60) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise KeyError(f"{backup_id}/{key} not found")
+            raise
+
+    def list(self, backup_id: str) -> list[str]:
+        raise ModuleError(f"{self.name} does not support listing without "
+                          "cloud credentials")
+
+    def home_dir(self, backup_id: str) -> str:
+        return f"{self.endpoint}/{self.container}/{backup_id}" \
+            if self.endpoint else ""
+
+
+class S3Backend(_HttpObjectStoreBackend):
+    name = "backup-s3"
+    endpoint_env = "BACKUP_S3_ENDPOINT"
+    container_env = "BACKUP_S3_BUCKET"
+
+
+class GCSBackend(_HttpObjectStoreBackend):
+    name = "backup-gcs"
+    endpoint_env = "BACKUP_GCS_ENDPOINT"
+    container_env = "BACKUP_GCS_BUCKET"
+
+
+class AzureBackend(_HttpObjectStoreBackend):
+    name = "backup-azure"
+    endpoint_env = "BACKUP_AZURE_ENDPOINT"
+    container_setting = "container"
+    container_env = "BACKUP_AZURE_CONTAINER"

@@ -66,6 +66,7 @@ class CycleManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._wake = threading.Event()
+        self._pause_lock = threading.Lock()
         self._thread: threading.Thread | None = None
 
     def register(self, name: str, fn, interval: float,
@@ -118,13 +119,28 @@ class CycleManager:
             for cb in due:
                 if self._stop.is_set():
                     return
-                cb.run()
+                with self._pause_lock:
+                    cb.run()
             with self._lock:
                 pending = [cb.next_due for cb in self._callbacks.values() if cb.active]
             wait = min(pending) - time.monotonic() if pending else 1.0
             if wait > 0:
                 self._wake.wait(min(wait, 1.0))
                 self._wake.clear()
+
+    def pause(self):
+        """Context manager: block callback execution for the duration
+        (reference: Shard.BeginBackup pauses compaction and commit-log
+        switching while backup files are streamed, shard_backup.go).
+        An in-flight callback finishes first; new ones wait."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _paused():
+            with self._pause_lock:
+                yield
+
+        return _paused()
 
     @property
     def running(self) -> bool:
