@@ -1,0 +1,213 @@
+"""Classification (kNN + zeroshot) and replica scaler tests.
+
+Reference pattern: usecases/classification classifier tests +
+usecases/scaler tests.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.rest import config_from_json
+from weaviate_tpu.classification import (
+    ClassificationError,
+    ClassificationManager,
+    COMPLETED,
+)
+from weaviate_tpu.cluster.scaler import ScaleError, Scaler
+from weaviate_tpu.db.database import Database
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = Database(str(tmp_path))
+    yield d
+    d.close()
+
+
+def _cluster(rng, center, n, dim=16):
+    return center + 0.05 * rng.standard_normal((n, dim)).astype(np.float32)
+
+
+def test_knn_classification(db):
+    db.create_collection(config_from_json({
+        "class": "Review",
+        "properties": [{"name": "text", "dataType": ["text"]},
+                       {"name": "sentiment", "dataType": ["text"]}]}))
+    col = db.get_collection("Review")
+    rng = np.random.default_rng(0)
+    pos_c = np.ones(16, dtype=np.float32)
+    neg_c = -np.ones(16, dtype=np.float32)
+    # labeled training set
+    for v in _cluster(rng, pos_c, 10):
+        col.put_object({"text": "good", "sentiment": "positive"}, vector=v)
+    for v in _cluster(rng, neg_c, 10):
+        col.put_object({"text": "bad", "sentiment": "negative"}, vector=v)
+    # unlabeled
+    pos_ids = [col.put_object({"text": "nice"}, vector=v)
+               for v in _cluster(rng, pos_c, 5)]
+    neg_ids = [col.put_object({"text": "awful"}, vector=v)
+               for v in _cluster(rng, neg_c, 5)]
+
+    mgr = ClassificationManager(db)
+    job = mgr.start("Review", ["sentiment"], kind="knn",
+                    settings={"k": 3}, wait=True)
+    final = mgr.get(job["id"])
+    assert final["status"] == COMPLETED, final
+    assert final["meta"]["count"] == 10
+    assert final["meta"]["countSucceeded"] == 10
+    for uid in pos_ids:
+        assert col.get_object(uid).properties["sentiment"] == "positive"
+    for uid in neg_ids:
+        assert col.get_object(uid).properties["sentiment"] == "negative"
+
+
+def test_zeroshot_classification(db):
+    db.create_collection(config_from_json({
+        "class": "Label",
+        "properties": [{"name": "name", "dataType": ["text"]}]}))
+    db.create_collection(config_from_json({
+        "class": "Item",
+        "properties": [{"name": "title", "dataType": ["text"]},
+                       {"name": "category", "dataType": ["cref"]}]}))
+    labels = db.get_collection("Label")
+    items = db.get_collection("Item")
+    rng = np.random.default_rng(1)
+    a = np.zeros(8, dtype=np.float32); a[0] = 1.0
+    b = np.zeros(8, dtype=np.float32); b[1] = 1.0
+    uid_a = labels.put_object({"name": "animals"}, vector=a)
+    uid_b = labels.put_object({"name": "buildings"}, vector=b)
+    it = items.put_object({"title": "a dog"},
+                          vector=a + 0.01 * rng.standard_normal(8)
+                          .astype(np.float32))
+
+    mgr = ClassificationManager(db)
+    job = mgr.start("Item", ["category"], kind="zeroshot",
+                    settings={"targetClass": "Label"}, wait=True)
+    assert mgr.get(job["id"])["status"] == COMPLETED
+    got = items.get_object(it).properties["category"]
+    assert got[0]["beacon"].endswith(uid_a)
+
+
+def test_classification_validation(db):
+    db.create_collection(config_from_json({
+        "class": "C", "properties": [{"name": "p", "dataType": ["text"]}]}))
+    mgr = ClassificationManager(db)
+    with pytest.raises(ClassificationError):
+        mgr.start("C", [], kind="knn")
+    with pytest.raises(ClassificationError):
+        mgr.start("C", ["nope"], kind="knn")
+    with pytest.raises(ClassificationError):
+        mgr.start("C", ["p"], kind="wat")
+    with pytest.raises(ClassificationError):
+        mgr.start("C", ["p"], kind="zeroshot")  # no targetClass
+    with pytest.raises(KeyError):
+        mgr.get("missing-id")
+    # no labeled examples -> job fails with a clear error
+    col = db.get_collection("C")
+    col.put_object({}, vector=np.ones(4, dtype=np.float32))
+    job = mgr.start("C", ["p"], kind="knn", wait=True)
+    final = mgr.get(job["id"])
+    assert final["status"] == "failed"
+    assert "labeled" in final["error"]
+
+
+def test_classification_rest(tmp_path):
+    from weaviate_tpu.api.client import Client
+    from weaviate_tpu.api.rest import RestServer
+
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    try:
+        c = Client(srv.address)
+        c.create_class({"class": "R", "properties": [
+            {"name": "label", "dataType": ["text"]}]})
+        for i in range(6):
+            vec = [1.0, 0.0] if i % 2 == 0 else [0.0, 1.0]
+            props = {"label": "even" if i % 2 == 0 else "odd"} \
+                if i < 4 else {}
+            c.create_object("R", props, vector=vec)
+        out = c.request("POST", "/v1/classifications", body={
+            "class": "R", "type": "knn",
+            "classifyProperties": ["label"], "settings": {"k": 1}})
+        assert out["status"] in ("running", "completed")
+        for _ in range(100):
+            st = c.request("GET", f"/v1/classifications/{out['id']}")
+            if st["status"] in ("completed", "failed"):
+                break
+            time.sleep(0.05)
+        assert st["status"] == "completed", st
+        assert st["meta"]["countSucceeded"] == 2
+    finally:
+        srv.stop()
+        db.close()
+
+
+# -- scaler ------------------------------------------------------------------
+
+
+def test_scaler_scale_out_local(tmp_path):
+    """Two in-process 'nodes' sharing a nodes list; the second node is
+    reachable through a loopback remote client."""
+
+    class LoopbackRemote:
+        """Routes remote shard ops straight into another Database."""
+
+        def __init__(self):
+            self.dbs = {}
+
+        def put_objects(self, node, collection, shard, raws):
+            from weaviate_tpu.storage.objects import StorageObject
+
+            col = self.dbs[node].get_collection(collection)
+            col._load_shard(shard).put_object_batch(
+                [StorageObject.from_bytes(r) for r in raws])
+
+        def list_objects(self, node, collection, shard, **kw):
+            col = self.dbs[node].get_collection(collection)
+            return [raw for _k, raw in
+                    col._load_shard(shard).objects.iter_items()]
+
+    remote = LoopbackRemote()
+    nodes = ["n0", "n1"]
+    db0 = Database(str(tmp_path / "n0"), local_node="n0",
+                   nodes_provider=lambda: nodes, remote=remote)
+    db1 = Database(str(tmp_path / "n1"), local_node="n1",
+                   nodes_provider=lambda: nodes, remote=remote)
+    remote.dbs = {"n0": db0, "n1": db1}
+    try:
+        cfg = config_from_json({
+            "class": "Doc", "replicationConfig": {"factor": 1},
+            "properties": [{"name": "n", "dataType": ["int"]}]})
+        col0 = db0.create_collection(cfg)
+        # mirror schema on node 1 (the Raft executor would do this)
+        import copy
+
+        db1.create_collection(copy.deepcopy(cfg),
+                              sharding_state=copy.deepcopy(col0.sharding))
+        rng = np.random.default_rng(3)
+        for i in range(20):
+            col0.put_object({"n": i}, vector=rng.standard_normal(8))
+        assert col0.sharding.nodes_for("shard-0") == ["n0"]
+
+        res = Scaler(db0).scale("Doc", 2)
+        assert res["to"] == 2
+        assert set(col0.sharding.nodes_for("shard-0")) == {"n0", "n1"}
+        col1 = db1.get_collection("Doc")
+        assert col1._load_shard("shard-0").object_count() == \
+            col0._load_shard("shard-0").object_count()
+        assert col0.config.replication.factor == 2
+
+        # scale back in trims placement
+        Scaler(db0).scale("Doc", 1)
+        assert len(col0.sharding.nodes_for("shard-0")) == 1
+
+        with pytest.raises(ScaleError):
+            Scaler(db0).scale("Doc", 5)  # more than cluster size
+        with pytest.raises(ScaleError):
+            Scaler(db0).scale("Doc", 0)
+    finally:
+        db0.close()
+        db1.close()

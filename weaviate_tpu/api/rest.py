@@ -239,9 +239,11 @@ class RestServer:
 
             self.backup_manager = BackupManager(
                 db, modules,
-                node_name=getattr(node, "name", None) or "node-0")
+                node_name=getattr(node, "name", None) or "node-0",
+                schema_target=self.schema_target)
         else:
             self.backup_manager = None
+        self.classification_manager = None  # built lazily on first use
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -375,7 +377,45 @@ class RestServer:
             return self._batch_objects(body or {})
         if seg[:1] == ["backups"]:
             return self._backups(method, seg[1:], body)
+        if seg[:1] == ["classifications"]:
+            return self._classifications(method, seg[1:], body)
         raise KeyError(path)
+
+    def _classifications(self, method: str, seg: list[str], body):
+        """POST /v1/classifications, GET /v1/classifications/{id}
+        (reference: handlers_classification.go)."""
+        from weaviate_tpu.classification import (
+            ClassificationError,
+            ClassificationManager,
+        )
+
+        if self.classification_manager is None:
+            self.classification_manager = ClassificationManager(
+                self.db, self.modules)
+        mgr = self.classification_manager
+        try:
+            if not seg and method == "POST":
+                b = body or {}
+                settings = b.get("settings") or {}
+                where = b.get("filters", {}).get("sourceWhere") \
+                    if b.get("filters") else None
+                train = b.get("filters", {}).get("trainingSetWhere") \
+                    if b.get("filters") else None
+                from weaviate_tpu.filters.filters import Filter
+
+                return 201, mgr.start(
+                    b.get("class", ""),
+                    b.get("classifyProperties") or [],
+                    based_on_properties=b.get("basedOnProperties"),
+                    kind=b.get("type", "knn"), settings=settings,
+                    where=None if where is None else Filter.from_dict(where),
+                    training_set_where=None if train is None
+                    else Filter.from_dict(train))
+            if len(seg) == 1 and method == "GET":
+                return 200, mgr.get(seg[0])
+        except ClassificationError as e:
+            raise ApiError(422, str(e))
+        raise KeyError("/v1/classifications/" + "/".join(seg))
 
     def _backups(self, method: str, seg: list[str], body):
         """Reference routes (handlers_backup.go):

@@ -40,24 +40,24 @@ class BackupError(Exception):
     pass
 
 
-def _walk_files(root: str) -> list[str]:
-    out = []
-    for dirpath, _dirs, files in os.walk(root):
-        for fn in files:
-            full = os.path.join(dirpath, fn)
-            out.append(os.path.relpath(full, root))
-    return sorted(out)
+from weaviate_tpu.modules.backup_backends import walk_files as _walk_files
+
+_ACTIVE = (STARTED, TRANSFERRING, TRANSFERRED)
 
 
 class BackupManager:
     """``modules``: module Provider — backends resolve via
     ``backup_backend(name)`` (reference: module registry lookup,
-    usecases/backup/handler.go)."""
+    usecases/backup/handler.go). ``schema_target``: where restored
+    classes are created — the Database itself (single node) or the
+    ClusterNode (Raft path), same seam the REST schema routes use."""
 
-    def __init__(self, db, modules, node_name: str = "node-0"):
+    def __init__(self, db, modules, node_name: str = "node-0",
+                 schema_target=None):
         self.db = db
         self.modules = modules
         self.node_name = node_name
+        self.schema_target = schema_target or db
         self._lock = threading.Lock()
         self._backups: dict[tuple[str, str], dict] = {}
         self._restores: dict[tuple[str, str], dict] = {}
@@ -89,7 +89,7 @@ class BackupManager:
                   "path": self._home(backend, backup_id)}
         with self._lock:
             if key in self._backups and \
-                    self._backups[key]["status"] in (STARTED, TRANSFERRING):
+                    self._backups[key]["status"] in _ACTIVE:
                 raise BackupError(f"backup {backup_id!r} already running")
             self._backups[key] = status
 
@@ -115,9 +115,10 @@ class BackupManager:
                         files = _walk_files(root) if os.path.isdir(root) \
                             else []
                         for rel in files:
-                            with open(os.path.join(root, rel), "rb") as f:
-                                backend.put(backup_id, f"{cls}/{rel}",
-                                            f.read())
+                            # streamed: multi-GB segment files never
+                            # materialize in memory
+                            backend.put_file(backup_id, f"{cls}/{rel}",
+                                             os.path.join(root, rel))
                         descriptor["classes"].append({
                             "name": cls,
                             "config": col.config.to_dict(),
@@ -155,7 +156,13 @@ class BackupManager:
                 f"backup {backup_id!r} not found on {backend_name!r}")
         if include and exclude:
             raise BackupError("include and exclude are mutually exclusive")
-        by_name = {c["name"]: c for c in descriptor["classes"]}
+        try:
+            by_name = {c["name"]: c for c in descriptor["classes"]}
+            for c in by_name.values():
+                c["files"], c["config"], c["sharding"]
+        except (KeyError, TypeError) as e:
+            raise BackupError(
+                f"backup {backup_id!r} has a malformed descriptor: {e}")
         classes = list(include) if include else \
             [n for n in by_name if n not in set(exclude or [])]
         for c in classes:
@@ -171,7 +178,7 @@ class BackupManager:
                   "path": self._home(backend, backup_id)}
         with self._lock:
             if key in self._restores and \
-                    self._restores[key]["status"] in (STARTED, TRANSFERRING):
+                    self._restores[key]["status"] in _ACTIVE:
                 raise BackupError(f"restore {backup_id!r} already running")
             self._restores[key] = status
 
@@ -197,13 +204,13 @@ class BackupManager:
                             raise BackupError(
                                 f"descriptor file path {rel!r} escapes "
                                 "the class directory")
-                        data = backend.get(backup_id, f"{cls}/{rel}")
-                        os.makedirs(os.path.dirname(dst), exist_ok=True)
-                        with open(dst, "wb") as f:
-                            f.write(data)
+                        backend.get_file(backup_id, f"{cls}/{rel}", dst)
                     cfg = CollectionConfig.from_dict(entry["config"])
                     state = ShardingState.from_dict(entry["sharding"])
-                    self.db.create_collection(cfg, sharding_state=state)
+                    # through the schema seam so cluster nodes take the
+                    # Raft path and peers learn the restored class
+                    self.schema_target.create_collection(
+                        cfg, sharding_state=state)
                 status["status"] = SUCCESS
             except Exception as e:
                 status["status"] = FAILED

@@ -103,10 +103,16 @@ class ClusterNode:
 
     # -- schema API (through Raft; reference raft_apply_endpoints.go) --------
 
-    def create_collection(self, config: CollectionConfig):
+    def create_collection(self, config: CollectionConfig,
+                          sharding_state=None):
+        """``sharding_state``: a pre-computed placement (backup restore
+        replays the descriptor's original placement so restored files
+        match their shards)."""
         config.validate()
         # placement computed ONCE here, applied identically everywhere
-        if config.multi_tenancy.enabled:
+        if sharding_state is not None:
+            state = sharding_state
+        elif config.multi_tenancy.enabled:
             state = ShardingState.create_partitioned()
         else:
             state = ShardingState.create(

@@ -21,6 +21,15 @@ import urllib.request
 from weaviate_tpu.modules.base import BackupBackend, ModuleError
 
 
+def walk_files(root: str) -> list[str]:
+    """Sorted relative paths of every file under ``root``."""
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            out.append(os.path.relpath(os.path.join(dirpath, fn), root))
+    return sorted(out)
+
+
 class FilesystemBackend(BackupBackend):
     """backup-filesystem: objects under <path>/<backup_id>/<key>."""
 
@@ -58,12 +67,28 @@ class FilesystemBackend(BackupBackend):
             return f.read()
 
     def list(self, backup_id: str) -> list[str]:
-        root = os.path.join(self._require_root(), backup_id)
-        out = []
-        for dirpath, _dirs, files in os.walk(root):
-            for fn in files:
-                out.append(os.path.relpath(os.path.join(dirpath, fn), root))
-        return sorted(out)
+        return walk_files(os.path.join(self._require_root(), backup_id))
+
+    def put_file(self, backup_id: str, key: str, src_path: str) -> None:
+        """Streamed variant: never materializes the file in memory."""
+        import shutil
+
+        dst = self._safe_path(backup_id, key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = f"{dst}.tmp"
+        with open(src_path, "rb") as src, open(tmp, "wb") as out:
+            shutil.copyfileobj(src, out, 1 << 20)
+        os.replace(tmp, dst)
+
+    def get_file(self, backup_id: str, key: str, dst_path: str) -> None:
+        import shutil
+
+        src = self._safe_path(backup_id, key)
+        if not os.path.exists(src):
+            raise KeyError(f"{backup_id}/{key} not found")
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        with open(src, "rb") as f, open(dst_path, "wb") as out:
+            shutil.copyfileobj(f, out, 1 << 20)
 
     def home_dir(self, backup_id: str) -> str:
         return os.path.join(self._require_root(), backup_id)
@@ -121,6 +146,29 @@ class _HttpObjectStoreBackend(BackupBackend):
             with urllib.request.urlopen(self._url(backup_id, key),
                                         timeout=60) as resp:
                 return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise KeyError(f"{backup_id}/{key} not found")
+            raise
+
+    def put_file(self, backup_id: str, key: str, src_path: str) -> None:
+        size = os.path.getsize(src_path)
+        with open(src_path, "rb") as f:
+            req = urllib.request.Request(
+                self._url(backup_id, key), data=f, method="PUT",
+                headers={"Content-Length": str(size)})
+            with urllib.request.urlopen(req, timeout=300):
+                pass
+
+    def get_file(self, backup_id: str, key: str, dst_path: str) -> None:
+        import shutil
+
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        try:
+            with urllib.request.urlopen(self._url(backup_id, key),
+                                        timeout=300) as resp, \
+                    open(dst_path, "wb") as out:
+                shutil.copyfileobj(resp, out, 1 << 20)
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 raise KeyError(f"{backup_id}/{key} not found")

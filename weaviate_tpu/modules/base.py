@@ -77,6 +77,20 @@ class BackupBackend(Module):
     """backup-* capability (reference: modulecapabilities/backup.go:
     PutObject/GetObject/Initialize/HomeDir...)."""
 
+    def put_file(self, backup_id: str, key: str, src_path: str) -> None:
+        """Streamed upload; default buffers (override to stream)."""
+        with open(src_path, "rb") as f:
+            self.put(backup_id, key, f.read())
+
+    def get_file(self, backup_id: str, key: str, dst_path: str) -> None:
+        """Streamed download; default buffers (override to stream)."""
+        import os
+
+        data = self.get(backup_id, key)
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        with open(dst_path, "wb") as f:
+            f.write(data)
+
     def initialize(self, backup_id: str) -> None:
         raise NotImplementedError
 
