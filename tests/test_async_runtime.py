@@ -1,0 +1,178 @@
+"""Async index queue, telemetry, and tenant-activity tests.
+
+Reference pattern: index_queue tests (adapters/repos/db/index_queue_test),
+usecases/telemetry tests, tenantactivity handler tests.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.rest import config_from_json
+from weaviate_tpu.db.database import Database
+
+
+# -- index queue -------------------------------------------------------------
+
+
+class _FakeIndex:
+    def __init__(self):
+        self.ids = []
+        self.lock = threading.Lock()
+
+    def add_batch(self, ids, vecs):
+        with self.lock:
+            self.ids.extend(np.asarray(ids).tolist())
+
+
+def test_index_queue_drains_and_tombstones():
+    from weaviate_tpu.runtime.index_queue import IndexQueue
+
+    idx = _FakeIndex()
+    q = IndexQueue(idx, batch_size=4, start_worker=False)
+    q.push([1, 2, 3], np.ones((3, 4), dtype=np.float32))
+    q.push([4, 5], np.ones((2, 4), dtype=np.float32))
+    q.delete(3)  # queued insert must be dropped
+    assert q.size() == 5
+    assert q.drain()
+    assert sorted(idx.ids) == [1, 2, 4, 5]
+    assert q.size() == 0
+    assert not q.drain()
+
+
+def test_index_queue_worker_thread():
+    from weaviate_tpu.runtime.index_queue import IndexQueue
+
+    idx = _FakeIndex()
+    q = IndexQueue(idx, batch_size=8)
+    q.push(list(range(100)), np.ones((100, 4), dtype=np.float32))
+    assert q.wait_idle(timeout=10.0)
+    assert sorted(idx.ids) == list(range(100))
+    q.stop()
+
+
+def test_shard_async_indexing(tmp_path):
+    """ASYNC_INDEXING shard: imports return before vectors are indexed;
+    flush() waits for the queue; deletes never resurrect."""
+    db = Database(str(tmp_path))
+    try:
+        db.create_collection(config_from_json({
+            "class": "Doc",
+            "properties": [{"name": "n", "dataType": ["int"]}]}))
+        col = db.get_collection("Doc")
+        shard = col._load_shard("shard-0")
+        shard.async_indexing = True
+        rng = np.random.default_rng(0)
+        vecs = rng.standard_normal((50, 8)).astype(np.float32)
+        uids = [col.put_object({"n": i}, vector=vecs[i]) for i in range(50)]
+        col.flush()  # waits for queue idle
+        q = vecs[7]
+        res = col.near_vector(q, k=1)
+        assert res[0].uuid == uids[7]
+        col.delete_object(uids[7])
+        col.flush()
+        res2 = col.near_vector(q, k=1)
+        assert res2[0].uuid != uids[7]
+    finally:
+        db.close()
+
+
+# -- telemetry ---------------------------------------------------------------
+
+
+def test_telemetry_payload_and_push(tmp_path):
+    from weaviate_tpu.runtime import telemetry
+
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    db = Database(str(tmp_path))
+    try:
+        db.create_collection(config_from_json({
+            "class": "C", "properties": [{"name": "p", "dataType": ["text"]}]}))
+        db.get_collection("C").put_object({"p": "x"}, vector=[1.0, 2.0])
+        tel = telemetry.Telemeter(
+            db, version="test",
+            endpoint=f"http://127.0.0.1:{httpd.server_address[1]}/t",
+            interval=3600)
+        payload = tel.build_payload(telemetry.INIT)
+        assert payload["numberObjects"] == 1
+        assert payload["type"] == "INIT"
+        assert tel._push(telemetry.INIT)
+        assert received[0]["machineId"] == tel.machine_id
+        # unreachable endpoint fails soft
+        tel2 = telemetry.Telemeter(db, endpoint="http://127.0.0.1:9/x")
+        assert not tel2._push(telemetry.UPDATE)
+    finally:
+        db.close()
+        httpd.shutdown()
+
+
+def test_telemetry_disabled_env(monkeypatch):
+    from weaviate_tpu.runtime import telemetry
+
+    monkeypatch.setenv("DISABLE_TELEMETRY", "true")
+    assert telemetry.disabled()
+
+
+# -- tenant activity ---------------------------------------------------------
+
+
+def test_tenant_activity_tracking(tmp_path):
+    db = Database(str(tmp_path))
+    try:
+        db.create_collection(config_from_json({
+            "class": "MT",
+            "multiTenancyConfig": {"enabled": True},
+            "properties": [{"name": "p", "dataType": ["text"]}]}))
+        db.add_tenants("MT", ["acme", "globex"])
+        col = db.get_collection("MT")
+        col.put_object({"p": "hello"}, vector=[1.0, 0.0], tenant="acme")
+        col.near_vector(np.asarray([1.0, 0.0]), k=1, tenant="acme")
+        col.near_vector(np.asarray([1.0, 0.0]), k=1, tenant="acme")
+        act = col.tenant_activity
+        assert act["acme"]["writes"] >= 1
+        assert act["acme"]["reads"] >= 2
+        assert act["acme"]["lastRead"] is not None
+        assert "globex" not in act  # untouched tenant stays cold
+    finally:
+        db.close()
+
+
+def test_tenant_activity_rest(tmp_path):
+    from weaviate_tpu.api.client import Client
+    from weaviate_tpu.api.rest import RestServer
+
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    try:
+        c = Client(srv.address)
+        c.create_class({"class": "MT",
+                        "multiTenancyConfig": {"enabled": True},
+                        "properties": [{"name": "p", "dataType": ["text"]}]})
+        c.request("POST", "/v1/schema/MT/tenants",
+                  body=[{"name": "acme"}])
+        c.create_object("MT", {"p": "x"}, vector=[1.0], tenant="acme")
+        out = c.request("GET", "/v1/tenant-activity")
+        assert out["MT"]["acme"]["writes"] >= 1
+    finally:
+        srv.stop()
+        db.close()

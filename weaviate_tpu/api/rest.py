@@ -365,6 +365,16 @@ class RestServer:
             return 200, {"text": registry.expose()}
         if seg == ["nodes"]:
             return 200, {"nodes": self._nodes_payload()}
+        if seg == ["tenant-activity"]:
+            # hot/cold tenant usage (reference:
+            # rest/tenantactivity/handler.go)
+            out = {}
+            for name in self.db.list_collections():
+                col = self.db.get_collection(name)
+                if col.tenant_activity:
+                    out[name] = {t: dict(v)
+                                 for t, v in col.tenant_activity.items()}
+            return 200, out
         if seg == ["graphql"] and method == "POST":
             if self.graphql_executor is None:
                 raise ApiError(501, "graphql not enabled")

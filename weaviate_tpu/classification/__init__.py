@@ -68,13 +68,18 @@ class ClassificationManager:
                 "objects are the candidate labels)")
 
         job_id = str(uuid_mod.uuid4())
+        try:
+            k_setting = int(settings.get("k", 3))
+        except (TypeError, ValueError):
+            raise ClassificationError(
+                f"settings.k must be an integer, got {settings.get('k')!r}")
         job = {
             "id": job_id,
             "class": class_name,
             "classifyProperties": classify_properties,
             "basedOnProperties": based_on_properties or [],
             "type": kind,
-            "settings": {"k": int(settings.get("k", 3)), **settings},
+            "settings": {**settings, "k": k_setting},
             "status": RUNNING,
             "error": None,
             "meta": {"started": time.time(), "count": 0,
@@ -111,18 +116,54 @@ class ClassificationManager:
 
     # -- engines -------------------------------------------------------------
 
-    def _split(self, col, props: list[str], where):
-        """(unlabeled, labeled) object lists: labeled = every classify
-        property present and non-empty."""
+    def _split(self, col, props: list[str], source_where,
+               training_where=None):
+        """(unlabeled, labeled) object lists. labeled = every classify
+        property present and non-empty. ``source_where`` narrows which
+        objects get classified; ``training_where`` narrows the training
+        set (reference: filters.sourceWhere / trainingSetWhere,
+        usecases/classification/filters.go). Masks are evaluated
+        PER SHARD — doc ids are per-shard counters, so one shard's mask
+        must never be applied to another shard's objects."""
+        from weaviate_tpu.filters.filters import compute_allow_mask
+        from weaviate_tpu.storage.objects import StorageObject
+
         unlabeled, labeled = [], []
-        mask = None
-        for obj in col.iter_objects():
-            if obj.vector is None:
-                continue
-            has_all = all(obj.properties.get(p) not in (None, "", [])
-                          for p in props)
-            (labeled if has_all else unlabeled).append(obj)
+        for shard in col.shards.values():
+            src_mask = train_mask = None
+            if source_where is not None:
+                src_mask = compute_allow_mask(source_where, shard._inverted,
+                                              shard.doc_id_space)
+            if training_where is not None:
+                train_mask = compute_allow_mask(training_where,
+                                                shard._inverted,
+                                                shard.doc_id_space)
+
+            def hit(mask, obj):
+                return mask is None or (obj.doc_id < len(mask)
+                                        and mask[obj.doc_id])
+
+            for _key, raw in shard.objects.iter_items():
+                obj = StorageObject.from_bytes(raw)
+                if obj.vector is None:
+                    continue
+                has_all = all(obj.properties.get(p) not in (None, "", [])
+                              for p in props)
+                if has_all:
+                    if hit(train_mask, obj):
+                        labeled.append(obj)
+                elif hit(src_mask, obj):
+                    unlabeled.append(obj)
         return unlabeled, labeled
+
+    @staticmethod
+    def _unit(rows: list[np.ndarray]) -> np.ndarray:
+        """Stack + L2-normalize: stored object vectors are RAW (the index
+        normalizes on add, the object store does not), so cosine ranking
+        here must normalize both sides itself."""
+        m = np.stack(rows).astype(np.float32)
+        norms = np.linalg.norm(m, axis=1, keepdims=True)
+        return m / np.where(norms > 1e-30, norms, 1.0)
 
     def _run_knn(self, col, job, where, training_set_where):
         from weaviate_tpu.ops.topk import chunked_topk
@@ -130,15 +171,8 @@ class ClassificationManager:
 
         props = job["classifyProperties"]
         k = job["settings"]["k"]
-        unlabeled, labeled = self._split(col, props, where)
-        if training_set_where is not None:
-            from weaviate_tpu.filters.filters import compute_allow_mask
-
-            shard = next(iter(col.shards.values()))
-            mask = compute_allow_mask(training_set_where, shard._inverted,
-                                      shard.doc_id_space)
-            labeled = [o for o in labeled
-                       if o.doc_id < len(mask) and mask[o.doc_id]]
+        unlabeled, labeled = self._split(col, props, where,
+                                         training_set_where)
         job["meta"]["count"] = len(unlabeled)
         if not unlabeled:
             return
@@ -146,8 +180,8 @@ class ClassificationManager:
             raise ClassificationError(
                 "no labeled training objects (every object is missing the "
                 "classify properties)")
-        q = np.stack([o.vector for o in unlabeled]).astype(np.float32)
-        x = np.stack([o.vector for o in labeled]).astype(np.float32)
+        q = self._unit([o.vector for o in unlabeled])
+        x = self._unit([o.vector for o in labeled])
         k_eff = min(k, len(labeled))
         # one batched scan: [B, d] x [N, d] -> [B, k] neighbor indices
         _, idx = chunked_topk(jnp.asarray(q), jnp.asarray(x), k=k_eff,
@@ -167,7 +201,6 @@ class ClassificationManager:
                         votes[key] += 1
                     if votes:
                         winner = votes.most_common(1)[0][0]
-                        v0 = labeled[0].properties.get(p)
                         updates[p] = list(winner) \
                             if isinstance(winner, tuple) else winner
                 self._apply(col, obj, updates)
@@ -191,8 +224,8 @@ class ClassificationManager:
         job["meta"]["count"] = len(unlabeled)
         if not unlabeled:
             return
-        q = np.stack([o.vector for o in unlabeled]).astype(np.float32)
-        x = np.stack([o.vector for o in candidates]).astype(np.float32)
+        q = self._unit([o.vector for o in unlabeled])
+        x = self._unit([o.vector for o in candidates])
         _, idx = chunked_topk(jnp.asarray(q), jnp.asarray(x), k=1,
                               metric="cosine")
         idx = np.asarray(idx)

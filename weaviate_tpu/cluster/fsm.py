@@ -57,5 +57,18 @@ class SchemaFSM:
             for name in op["tenants"]:
                 col.remove_tenant(name)
             self.db._persist(col)
+        elif t == "update_sharding":
+            # replica scale-out/in (usecases/scaler): every node applies
+            # the same placement + factor; nodes that just became owners
+            # load their (already-copied) shards
+            col = self.db.get_collection(op["class"])
+            col.sharding.placement = {k: list(v)
+                                      for k, v in op["placement"].items()}
+            col.config.replication.factor = op["factor"]
+            for shard in col.sharding.shard_names:
+                if self.db.local_node in col.sharding.nodes_for(shard) \
+                        and shard not in col.shards:
+                    col._load_shard(shard)
+            self.db._persist(col)
         else:
             logger.warning("unknown FSM op type %r", t)

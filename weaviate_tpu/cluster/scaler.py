@@ -18,10 +18,14 @@ class ScaleError(Exception):
 
 class Scaler:
     """``db``: node-local Database (its ``nodes_provider``/``local_node``/
-    ``remote`` wire the cluster view, the same plumbing queries use)."""
+    ``remote`` wire the cluster view, the same plumbing queries use).
+    ``propose``: optional Raft-propose callable (ClusterNode passes
+    ``raft.propose``) so the new placement reaches EVERY node's schema —
+    without it (single node) the placement applies locally."""
 
-    def __init__(self, db):
+    def __init__(self, db, propose=None):
         self.db = db
+        self.propose = propose
 
     def scale(self, collection_name: str, new_factor: int,
               batch: int = 500) -> dict:
@@ -34,27 +38,40 @@ class Scaler:
             raise ScaleError(
                 f"replication factor {new_factor} exceeds cluster size "
                 f"{len(nodes)}")
-        copied: dict[str, list[str]] = {}
+        # plan first, mutate nothing: a failed copy must leave the live
+        # sharding state untouched
+        new_placement: dict[str, list[str]] = {}
+        to_copy: list[tuple[str, list[str], list[str]]] = []
         for shard in list(col.sharding.shard_names):
             current = list(col.sharding.nodes_for(shard))
             if len(current) >= new_factor:
-                # scale-in: keep the first replicas (reference only ever
-                # trims placement; data on removed replicas is orphaned
-                # until cleanup, same as the reference)
-                col.sharding.placement[shard] = current[:new_factor]
+                # scale-in: trim placement (reference only ever trims;
+                # data on removed replicas is orphaned until cleanup)
+                new_placement[shard] = current[:new_factor]
                 continue
             additions = [n for n in nodes if n not in current]
             new_nodes = additions[: new_factor - len(current)]
             if len(current) + len(new_nodes) < new_factor:
                 raise ScaleError(
                     f"not enough distinct nodes for shard {shard!r}")
+            new_placement[shard] = current + new_nodes
+            to_copy.append((shard, current, new_nodes))
+        copied: dict[str, list[str]] = {}
+        for shard, current, new_nodes in to_copy:
             for node in new_nodes:
                 self._copy_shard(col, shard, current, node, batch)
-            col.sharding.placement[shard] = current + new_nodes
             copied[shard] = new_nodes
-        # persist factor + placement atomically through the schema store
-        col.config.replication.factor = new_factor
-        self.db._persist(col)
+        # all copies landed: commit placement + factor — through Raft on
+        # a cluster so every node converges, locally otherwise
+        if self.propose is not None:
+            self.propose({"type": "update_sharding",
+                          "class": collection_name,
+                          "placement": new_placement,
+                          "factor": new_factor})
+        else:
+            col.sharding.placement = new_placement
+            col.config.replication.factor = new_factor
+            self.db._persist(col)
         return {"collection": collection_name, "from": old_factor,
                 "to": new_factor, "copied": copied}
 

@@ -101,6 +101,23 @@ class Collection:
                 self._load_shard(name)
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix=f"{config.name}-search")
+        # hot/cold tenant tracking (reference: entities/tenantactivity +
+        # rest/tenantactivity/handler.go): tenant -> last access stamps
+        self.tenant_activity: dict[str, dict] = {}
+
+    def _record_tenant(self, tenant: str | None, kind: str) -> None:
+        if not tenant or not self.config.multi_tenancy.enabled:
+            return
+        now = time.time()
+        entry = self.tenant_activity.setdefault(
+            tenant, {"reads": 0, "writes": 0, "lastRead": None,
+                     "lastWrite": None})
+        if kind == "read":
+            entry["reads"] += 1
+            entry["lastRead"] = now
+        else:
+            entry["writes"] += 1
+            entry["lastWrite"] = now
 
     # -- shard management ----------------------------------------------------
 
@@ -121,12 +138,14 @@ class Collection:
                 raise ValueError("multi-tenant collection requires a tenant")
             if tenant not in self.sharding.shard_names:
                 raise KeyError(f"tenant {tenant!r} does not exist")
+            self._record_tenant(tenant, "read")
 
     def _ensure_tenant_shard(self, tenant: str | None) -> None:
         if not self.config.multi_tenancy.enabled:
             return
         with self._lock:
             if tenant in self.sharding.shard_names:
+                self._record_tenant(tenant, "write")
                 return
             if not self.config.multi_tenancy.auto_tenant_creation:
                 raise KeyError(f"tenant {tenant!r} does not exist")
@@ -136,6 +155,7 @@ class Collection:
                     tenant, nodes=self._nodes_provider(),
                     replication_factor=self.config.replication.factor)
                 self._on_sharding_change(self)
+                self._record_tenant(tenant, "write")
                 return
         # cluster mode: tenant creation must go through Raft so every node
         # applies the same placement — a local-only mutation would diverge
@@ -146,6 +166,7 @@ class Collection:
         if tenant not in self.sharding.shard_names:
             raise RuntimeError(f"auto tenant creation for {tenant!r} did "
                                "not converge")
+        self._record_tenant(tenant, "write")
 
     def _require_remote(self, shard_name: str):
         if self.remote is None:
@@ -172,6 +193,7 @@ class Collection:
                 raise ValueError("multi-tenant collection requires a tenant")
             if tenant not in self.sharding.shard_names:
                 raise KeyError(f"tenant {tenant!r} does not exist")
+            self._record_tenant(tenant, "read")
             return [tenant]
         return list(self.sharding.shard_names)
 

@@ -109,9 +109,17 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
 
 class Shard:
     def __init__(self, data_dir: str, collection: CollectionConfig, name: str,
-                 mesh=None, memwatch=None):
+                 mesh=None, memwatch=None, async_indexing: bool | None = None):
         self.name = name
         self.memwatch = memwatch
+        # ASYNC_INDEXING (reference env gate, repo.go/index_queue.go):
+        # imports enqueue vectors; a background worker drains into the
+        # vector index. Off by default — searches stay read-your-writes.
+        if async_indexing is None:
+            async_indexing = os.environ.get(
+                "ASYNC_INDEXING", "").lower() in ("true", "1", "on")
+        self.async_indexing = async_indexing
+        self._index_queues: dict[str, "IndexQueue"] = {}
         self.collection_name = collection.name
         self.config = collection
         # exact-case directory: two collections differing only in case are
@@ -260,11 +268,27 @@ class Shard:
                 doc_ids.append(obj.doc_id)
             for vec_name, (ids, vecs) in vec_batches.items():
                 idx = self._ensure_vector_index(vec_name, len(vecs[0]))
-                if idx is not None:
+                if idx is None:
+                    continue
+                if self.async_indexing:
+                    self._index_queue(vec_name, idx).push(
+                        np.asarray(ids), np.stack(vecs))
+                else:
                     idx.add_batch(np.asarray(ids), np.stack(vecs))
         return doc_ids
 
+    def _index_queue(self, vec_name: str, idx):
+        q = self._index_queues.get(vec_name)
+        if q is None:
+            from weaviate_tpu.runtime.index_queue import IndexQueue
+
+            q = IndexQueue(idx)
+            self._index_queues[vec_name] = q
+        return q
+
     def _delete_doc(self, doc_id: int, uuid: str):
+        for q in self._index_queues.values():
+            q.delete(doc_id)  # drop any queued insert for this doc
         for idx in self.vector_indexes.values():
             if idx is not None:
                 idx.delete(doc_id)
@@ -477,6 +501,8 @@ class Shard:
     # -- maintenance ---------------------------------------------------------
 
     def flush(self):
+        for q in self._index_queues.values():
+            q.wait_idle(timeout=30.0)
         for b in (self.objects, self.docid, self.meta):
             b.flush()
 
@@ -501,4 +527,6 @@ class Shard:
         return did
 
     def close(self):
+        for q in self._index_queues.values():
+            q.stop()
         self.store.close()
