@@ -1,0 +1,5 @@
+"""``python -m weaviate_tpu`` — start the server (cmd/weaviate-server)."""
+
+from weaviate_tpu.server import main
+
+main()

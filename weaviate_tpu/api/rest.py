@@ -358,7 +358,8 @@ class RestServer:
 
         if seg == ["meta"]:
             return 200, {"version": VERSION, "hostname": self.address,
-                         "modules": {}}
+                         "modules": self.modules.meta()
+                         if self.modules is not None else {}}
         if seg == ["metrics"]:
             from weaviate_tpu.runtime.metrics import registry
 
@@ -370,10 +371,9 @@ class RestServer:
             # rest/tenantactivity/handler.go)
             out = {}
             for name in self.db.list_collections():
-                col = self.db.get_collection(name)
-                if col.tenant_activity:
-                    out[name] = {t: dict(v)
-                                 for t, v in col.tenant_activity.items()}
+                snap = self.db.get_collection(name).tenant_activity_snapshot()
+                if snap:
+                    out[name] = snap
             return 200, out
         if seg == ["graphql"] and method == "POST":
             if self.graphql_executor is None:
@@ -420,7 +420,8 @@ class RestServer:
                     kind=b.get("type", "knn"), settings=settings,
                     where=None if where is None else Filter.from_dict(where),
                     training_set_where=None if train is None
-                    else Filter.from_dict(train))
+                    else Filter.from_dict(train),
+                    tenant=b.get("tenant"))
             if len(seg) == 1 and method == "GET":
                 return 200, mgr.get(seg[0])
         except ClassificationError as e:

@@ -49,11 +49,16 @@ class ClassificationManager:
               based_on_properties: list[str] | None = None,
               kind: str = "knn", settings: dict | None = None,
               where=None, training_set_where=None,
+              tenant: str | None = None,
               wait: bool = False) -> dict:
         """Returns the job descriptor (id + status), reference:
         handlers_classification.go → classification.Classifier.Schedule."""
         settings = settings or {}
         col = self.db.get_collection(class_name)  # KeyError → 404 upstream
+        if col.config.multi_tenancy.enabled and not tenant:
+            # never mix tenants' objects into one training set
+            raise ClassificationError(
+                "classification on a multi-tenant class requires a tenant")
         if kind not in ("knn", "zeroshot"):
             raise ClassificationError(f"unknown classification type {kind!r}")
         if not classify_properties:
@@ -91,9 +96,10 @@ class ClassificationManager:
         def work():
             try:
                 if kind == "knn":
-                    self._run_knn(col, job, where, training_set_where)
+                    self._run_knn(col, job, where, training_set_where,
+                                  tenant)
                 else:
-                    self._run_zeroshot(col, job, where)
+                    self._run_zeroshot(col, job, where, tenant)
                 job["status"] = COMPLETED
                 job["meta"]["completed"] = time.time()
             except Exception as e:
@@ -117,7 +123,7 @@ class ClassificationManager:
     # -- engines -------------------------------------------------------------
 
     def _split(self, col, props: list[str], source_where,
-               training_where=None):
+               training_where=None, tenant: str | None = None):
         """(unlabeled, labeled) object lists. labeled = every classify
         property present and non-empty. ``source_where`` narrows which
         objects get classified; ``training_where`` narrows the training
@@ -129,7 +135,9 @@ class ClassificationManager:
         from weaviate_tpu.storage.objects import StorageObject
 
         unlabeled, labeled = [], []
-        for shard in col.shards.values():
+        # MT collections classify ONE tenant's shard; others span all local
+        # shards (col._target_shards enforces the tenant requirement)
+        for shard in col._target_shards(tenant):
             src_mask = train_mask = None
             if source_where is not None:
                 src_mask = compute_allow_mask(source_where, shard._inverted,
@@ -165,14 +173,15 @@ class ClassificationManager:
         norms = np.linalg.norm(m, axis=1, keepdims=True)
         return m / np.where(norms > 1e-30, norms, 1.0)
 
-    def _run_knn(self, col, job, where, training_set_where):
+    def _run_knn(self, col, job, where, training_set_where,
+                 tenant=None):
         from weaviate_tpu.ops.topk import chunked_topk
         import jax.numpy as jnp
 
         props = job["classifyProperties"]
         k = job["settings"]["k"]
         unlabeled, labeled = self._split(col, props, where,
-                                         training_set_where)
+                                         training_set_where, tenant)
         job["meta"]["count"] = len(unlabeled)
         if not unlabeled:
             return
@@ -203,12 +212,12 @@ class ClassificationManager:
                         winner = votes.most_common(1)[0][0]
                         updates[p] = list(winner) \
                             if isinstance(winner, tuple) else winner
-                self._apply(col, obj, updates)
+                self._apply(col, obj, updates, tenant)
                 job["meta"]["countSucceeded"] += 1
             except Exception:
                 job["meta"]["countFailed"] += 1
 
-    def _run_zeroshot(self, col, job, where):
+    def _run_zeroshot(self, col, job, where, tenant=None):
         from weaviate_tpu.ops.topk import chunked_topk
         import jax.numpy as jnp
 
@@ -220,7 +229,7 @@ class ClassificationManager:
             raise ClassificationError(
                 f"target class {target.config.name} has no vectorized "
                 "objects")
-        unlabeled, _ = self._split(col, props, where)
+        unlabeled, _ = self._split(col, props, where, tenant=tenant)
         job["meta"]["count"] = len(unlabeled)
         if not unlabeled:
             return
@@ -246,17 +255,18 @@ class ClassificationManager:
                             (v for v in best.properties.values()
                              if isinstance(v, str)), best.uuid)
                         updates[p] = label
-                self._apply(col, obj, updates)
+                self._apply(col, obj, updates, tenant)
                 job["meta"]["countSucceeded"] += 1
             except Exception:
                 job["meta"]["countFailed"] += 1
 
     @staticmethod
-    def _apply(col, obj, updates: dict) -> None:
+    def _apply(col, obj, updates: dict, tenant=None) -> None:
         if not updates:
             return
         props = dict(obj.properties)
         props.update(updates)
         col.put_object(props, vector=obj.vector,
                        vectors=obj.vectors or None, uuid=obj.uuid,
+                       tenant=tenant,
                        creation_time_ms=obj.creation_time_ms)

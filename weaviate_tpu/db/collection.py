@@ -67,13 +67,15 @@ class Collection:
     def __init__(self, data_dir: str, config: CollectionConfig,
                  sharding_state: ShardingState | None = None, mesh=None,
                  local_node: str = "node-0", on_sharding_change=None,
-                 memwatch=None, remote=None, nodes_provider=None):
+                 memwatch=None, remote=None, nodes_provider=None,
+                 async_indexing: bool | None = None):
         config.validate()
         self.config = config
         self.data_dir = data_dir
         self.mesh = mesh
         self.local_node = local_node
         self.memwatch = memwatch
+        self.async_indexing = async_indexing  # None = shard reads the env
         # cross-node data plane (reference: Index holds a
         # sharding.RemoteIndexClient for non-local shards, index.go:1607)
         self.remote = remote
@@ -109,15 +111,20 @@ class Collection:
         if not tenant or not self.config.multi_tenancy.enabled:
             return
         now = time.time()
-        entry = self.tenant_activity.setdefault(
-            tenant, {"reads": 0, "writes": 0, "lastRead": None,
-                     "lastWrite": None})
-        if kind == "read":
-            entry["reads"] += 1
-            entry["lastRead"] = now
-        else:
-            entry["writes"] += 1
-            entry["lastWrite"] = now
+        with self._lock:  # REST reads snapshot under the same lock
+            entry = self.tenant_activity.setdefault(
+                tenant, {"reads": 0, "writes": 0, "lastRead": None,
+                         "lastWrite": None})
+            if kind == "read":
+                entry["reads"] += 1
+                entry["lastRead"] = now
+            else:
+                entry["writes"] += 1
+                entry["lastWrite"] = now
+
+    def tenant_activity_snapshot(self) -> dict:
+        with self._lock:
+            return {t: dict(v) for t, v in self.tenant_activity.items()}
 
     # -- shard management ----------------------------------------------------
 
@@ -127,18 +134,19 @@ class Collection:
         # same on-disk shard
         with self._lock:
             if name not in self.shards:
-                self.shards[name] = Shard(self.data_dir, self.config, name,
-                                          mesh=self.mesh,
-                                          memwatch=self.memwatch)
+                self.shards[name] = Shard(
+                    self.data_dir, self.config, name, mesh=self.mesh,
+                    memwatch=self.memwatch,
+                    async_indexing=self.async_indexing)
             return self.shards[name]
 
-    def _check_tenant(self, tenant: str | None) -> None:
+    def _check_tenant(self, tenant: str | None, kind: str = "read") -> None:
         if self.config.multi_tenancy.enabled:
             if not tenant:
                 raise ValueError("multi-tenant collection requires a tenant")
             if tenant not in self.sharding.shard_names:
                 raise KeyError(f"tenant {tenant!r} does not exist")
-            self._record_tenant(tenant, "read")
+            self._record_tenant(tenant, kind)
 
     def _ensure_tenant_shard(self, tenant: str | None) -> None:
         if not self.config.multi_tenancy.enabled:
@@ -187,13 +195,14 @@ class Collection:
             return self.local_node
         return self.sharding.nodes_for(shard_name)[0]
 
-    def _target_shard_names(self, tenant: str | None) -> list[str]:
+    def _target_shard_names(self, tenant: str | None,
+                            kind: str = "read") -> list[str]:
         if self.config.multi_tenancy.enabled:
             if not tenant:
                 raise ValueError("multi-tenant collection requires a tenant")
             if tenant not in self.sharding.shard_names:
                 raise KeyError(f"tenant {tenant!r} does not exist")
-            self._record_tenant(tenant, "read")
+            self._record_tenant(tenant, kind)
             return [tenant]
         return list(self.sharding.shard_names)
 
@@ -322,7 +331,7 @@ class Collection:
 
     def delete_object(self, uuid: str, tenant: str | None = None,
                       consistency: str = "QUORUM") -> bool:
-        self._check_tenant(tenant)
+        self._check_tenant(tenant, kind="write")  # deletes are writes
         name = self.sharding.shard_for(uuid, tenant)
         nodes = self.sharding.nodes_for(name)
         if len(nodes) > 1:
@@ -347,7 +356,7 @@ class Collection:
         at QUERY_MAXIMUM_RESULTS like the reference's dryRun/match cap).
         Returns {"matches", "successful", "failed", "objects": [...]}, where
         ``objects`` is populated per-uuid only when ``verbose``."""
-        names = self._target_shard_names(tenant)
+        names = self._target_shard_names(tenant, kind="write")
         where_dict = where.to_dict() if where is not None else None
         uuids: list[str] = []
         for name in names:

@@ -22,11 +22,13 @@ class Database:
     def __init__(self, data_dir: str = "./data", mesh=None,
                  local_node: str = "node-0", start_cycles: bool = False,
                  maintenance_interval: float = 5.0,
-                 memory_monitor=None, remote=None, nodes_provider=None):
+                 memory_monitor=None, remote=None, nodes_provider=None,
+                 async_indexing: bool | None = None):
         self.data_dir = data_dir
         self.mesh = mesh
         self.local_node = local_node
         self.remote = remote
+        self.async_indexing = async_indexing  # None = env decides per shard
         self.nodes_provider = nodes_provider or (lambda: [local_node])
         # cluster hook fn(collection, [tenant]): routes auto tenant
         # creation through Raft (set by ClusterNode); None = local apply
@@ -66,6 +68,7 @@ class Database:
                 local_node=self.local_node, on_sharding_change=self._persist,
                 memwatch=self.memwatch, remote=self.remote,
                 nodes_provider=self.nodes_provider,
+                async_indexing=self.async_indexing,
             )
             col._auto_tenant_hook = self.auto_tenant_hook
             self.collections[cfg.name] = col
@@ -87,7 +90,8 @@ class Database:
                              local_node=self.local_node,
                              on_sharding_change=self._persist,
                              memwatch=self.memwatch, remote=self.remote,
-                             nodes_provider=self.nodes_provider)
+                             nodes_provider=self.nodes_provider,
+                             async_indexing=self.async_indexing)
             col._auto_tenant_hook = self.auto_tenant_hook
             self.collections[config.name] = col
             self._persist(col)

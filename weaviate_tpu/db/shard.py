@@ -9,10 +9,13 @@ shard_read.go (ObjectVectorSearch / ObjectSearch).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from weaviate_tpu.engine.flat import FlatIndex
 from weaviate_tpu.schema.config import CollectionConfig, VectorConfig
@@ -115,9 +118,11 @@ class Shard:
         # ASYNC_INDEXING (reference env gate, repo.go/index_queue.go):
         # imports enqueue vectors; a background worker drains into the
         # vector index. Off by default — searches stay read-your-writes.
+        # Same accepted values as config._flag so the two never disagree.
         if async_indexing is None:
             async_indexing = os.environ.get(
-                "ASYNC_INDEXING", "").lower() in ("true", "1", "on")
+                "ASYNC_INDEXING", "").lower() in ("true", "1", "on",
+                                                  "enabled")
         self.async_indexing = async_indexing
         self._index_queues: dict[str, "IndexQueue"] = {}
         self.collection_name = collection.name
@@ -501,8 +506,13 @@ class Shard:
     # -- maintenance ---------------------------------------------------------
 
     def flush(self):
-        for q in self._index_queues.values():
-            q.wait_idle(timeout=30.0)
+        for name, q in self._index_queues.items():
+            if not q.wait_idle(timeout=30.0):
+                logger.warning(
+                    "shard %s/%s: index queue %r still has %d queued "
+                    "vectors after 30s — flush() returns with the vector "
+                    "index lagging the object store",
+                    self.collection_name, self.name, name, q.size())
         for b in (self.objects, self.docid, self.meta):
             b.flush()
 

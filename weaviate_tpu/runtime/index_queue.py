@@ -38,7 +38,11 @@ class IndexQueue:
         self._idle.set()
         self._stop = threading.Event()
         self._flushed = 0  # vectors actually handed to the index
-        self._in_flight = False  # a popped drain batch not yet applied
+        # COUNT of popped-but-unapplied drain batches: drain() can run on
+        # the worker AND a flush/stop caller concurrently, so a boolean
+        # would let one finishing drain clear tombstones out from under
+        # the other's in-flight batch
+        self._in_flight = 0
         self._thread = None
         if start_worker:
             self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -94,14 +98,15 @@ class IndexQueue:
                      for _ in range(min(self.batch_size,
                                         len(self._pending)))]
             dead = set(self._deleted)
-            self._in_flight = True
+            self._in_flight += 1
         try:
             live = [(d, v) for d, v in batch if d not in dead]
             if live:
                 ids = np.asarray([d for d, _ in live], dtype=np.int64)
                 vecs = np.stack([v for _, v in live])
                 self.index.add_batch(ids, vecs)
-            self._flushed += len(live)
+            with self._lock:
+                self._flushed += len(live)
             # a delete may have raced the add_batch above: its idx.delete
             # found nothing (vector not added yet) and our `dead` snapshot
             # predates it — undo the resurrect now
@@ -111,8 +116,8 @@ class IndexQueue:
                 self.index.delete(d)
         finally:
             with self._lock:
-                self._in_flight = False
-                if not self._pending:
+                self._in_flight -= 1
+                if not self._pending and not self._in_flight:
                     self._deleted.clear()
                     self._idle.set()
         return True

@@ -257,3 +257,36 @@ vector_index_operations = registry.counter(
 lsm_segment_count = registry.gauge(
     "weaviate_tpu_lsm_segment_count",
     "Segments per bucket", ("bucket",))
+
+
+def serve_metrics(host: str = "127.0.0.1", port: int = 2112):
+    """Start the Prometheus /metrics listener (reference: a dedicated
+    monitoring port, configure_api.go:148-153). Returns the HTTP server;
+    .shutdown() stops it."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    import threading as _threading
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = registry.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True
+    t = _threading.Thread(target=httpd.serve_forever, daemon=True,
+                          name="metrics")
+    t.start()
+    return httpd

@@ -82,9 +82,11 @@ class Telemeter:
     def start(self) -> None:
         if disabled() or self._thread is not None:
             return
-        self._push(INIT)
 
+        # INIT rides the background thread too: a hanging/unreachable
+        # telemetry endpoint must never stall server startup
         def loop():
+            self._push(INIT)
             while not self._stop.wait(self.interval):
                 self._push(UPDATE)
 
@@ -96,6 +98,9 @@ class Telemeter:
         if self._thread is None:
             return
         self._stop.set()
-        self._push(TERMINATE)
+        # TERMINATE is fired from a daemon thread so shutdown never blocks
+        # on a dead endpoint
+        threading.Thread(target=self._push, args=(TERMINATE,),
+                         daemon=True, name="telemetry-term").start()
         self._thread.join(1.0)
         self._thread = None

@@ -1,0 +1,166 @@
+"""Server entry point: ``python -m weaviate_tpu`` (or weaviate_tpu.server).
+
+Reference: cmd/weaviate-server/main.go → configure_api.go:456 — assemble
+config, auth, modules, DB, cluster, REST + gRPC + metrics listeners, then
+serve until signaled. Single-node by default; RAFT_JOIN with >1 member
+boots the cluster path (gossip + Raft + internal data plane), mirroring
+the reference's startupRoutine ordering.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+from weaviate_tpu.config import ServerConfig
+
+logger = logging.getLogger("weaviate_tpu.server")
+
+VERSION = "0.1.0"
+
+
+class Server:
+    """Owns every subsystem; ``start()`` returns once listeners are up
+    (tests drive it in-process), ``serve_forever()`` blocks."""
+
+    def __init__(self, config: ServerConfig | None = None):
+        self.config = config or ServerConfig.from_env()
+        self._stop = threading.Event()
+        self.node = None
+        self.db = None
+        self.rest = None
+        self.grpc = None
+        self.telemeter = None
+
+    # -- assembly (configure_api.go:456 ordering) -------------------------
+
+    def start(self) -> "Server":
+        cfg = self.config
+        self._setup_logging()
+
+        from weaviate_tpu.auth import AuthConfig, AuthStack
+        from weaviate_tpu.modules import default_provider
+
+        auth_cfg = AuthConfig.from_env()
+        auth = None
+        if not auth_cfg.anonymous_enabled or auth_cfg.api_keys or \
+                auth_cfg.oidc_enabled or auth_cfg.admin_users:
+            auth = AuthStack(auth_cfg)
+
+        memwatch = None
+        if cfg.memory_limit_bytes:
+            from weaviate_tpu.runtime import MemoryMonitor
+
+            memwatch = MemoryMonitor(
+                host_limit_bytes=cfg.memory_limit_bytes)
+
+        cluster_mode = len(cfg.raft_join) > 1 or bool(cfg.cluster_join)
+        if cluster_mode:
+            from weaviate_tpu.cluster.node import ClusterNode
+
+            peers = cfg.raft_join or [cfg.cluster_hostname]
+            self.node = ClusterNode(cfg.cluster_hostname, cfg.data_path,
+                                    raft_peers=peers, host=cfg.host,
+                                    port=cfg.cluster_data_port)
+            self.node.start(seed_addrs=cfg.cluster_join or None)
+            self.db = self.node.db
+        else:
+            from weaviate_tpu.db.database import Database
+
+            self.db = Database(cfg.data_path,
+                               local_node=cfg.cluster_hostname,
+                               start_cycles=True,
+                               memory_monitor=memwatch,
+                               async_indexing=cfg.async_indexing or None)
+
+        modules = default_provider(self.db, enabled=cfg.enabled_modules)
+
+        from weaviate_tpu.api.rest import RestServer
+
+        if self.node is not None:
+            self.rest = self.node.serve_rest(host=cfg.host,
+                                             port=cfg.rest_port,
+                                             modules=modules, auth=auth)
+        else:
+            self.rest = RestServer(self.db, host=cfg.host,
+                                   port=cfg.rest_port, modules=modules,
+                                   auth=auth)
+            self.rest.start()
+
+        from weaviate_tpu.api.grpc.server import GrpcServer
+
+        self.grpc = GrpcServer(self.db, host=cfg.host, port=cfg.grpc_port,
+                               modules=modules, auth=auth).start()
+
+        if cfg.prometheus_enabled:
+            from weaviate_tpu.runtime.metrics import serve_metrics
+
+            self.metrics_server = serve_metrics(cfg.host,
+                                                cfg.prometheus_port)
+
+        if not cfg.disable_telemetry:
+            from weaviate_tpu.runtime.telemetry import Telemeter
+
+            self.telemeter = Telemeter(self.db, version=VERSION)
+            self.telemeter.start()
+
+        logger.info("weaviate-tpu %s serving REST on %s gRPC on :%s",
+                    VERSION, self.rest.address, self.grpc.port)
+        return self
+
+    def _setup_logging(self) -> None:
+        level = getattr(logging, self.config.log_level.upper(),
+                        logging.INFO)
+        if self.config.log_format == "json":
+            import json as _json
+
+            class JsonFormatter(logging.Formatter):
+                def format(self, record):
+                    return _json.dumps({
+                        "level": record.levelname.lower(),
+                        "msg": record.getMessage(),
+                        "logger": record.name,
+                        "time": self.formatTime(record),
+                    })
+
+            handler = logging.StreamHandler()
+            handler.setFormatter(JsonFormatter())
+            logging.basicConfig(level=level, handlers=[handler])
+        else:
+            logging.basicConfig(
+                level=level,
+                format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        try:
+            signal.signal(signal.SIGTERM, lambda *_: self._stop.set())
+            signal.signal(signal.SIGINT, lambda *_: self._stop.set())
+        except ValueError:
+            pass  # not the main thread
+        self._stop.wait()
+        self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.telemeter is not None:
+            self.telemeter.stop()
+        if self.grpc is not None:
+            self.grpc.stop()
+        if self.node is not None:
+            self.node.close()  # closes rest + db too
+        else:
+            if self.rest is not None:
+                self.rest.stop()
+            if self.db is not None:
+                self.db.close()
+
+
+def main() -> None:
+    Server().start().serve_forever()
+
+
+if __name__ == "__main__":
+    main()
