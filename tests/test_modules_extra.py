@@ -1,0 +1,207 @@
+"""Extended module roster tests: a fake sidecar server exercises the
+transformers-style clients; vendor clients are checked for clear
+configuration errors; text2vec-bigram is fully functional locally.
+
+Reference pattern: per-module client tests against stub containers
+(test/modules/*)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.modules import default_provider
+from weaviate_tpu.modules.base import ModuleError
+from weaviate_tpu.modules import http_modules_extra as hx
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    """One fake sidecar speaking every transformers-family dialect."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n))
+            path = self.path.rstrip("/")
+            if path == "/v1/vectorize":  # contextionary
+                out = {"vector": [float(len(body["text"])), 1.0]}
+            elif path == "/vectorize":
+                if "texts" in body:  # bind text
+                    out = {"textVectors": [[1.0, 0.0]] * len(body["texts"])}
+                elif "audios" in body:
+                    out = {"audioVectors": [[0.0, 2.0]]}
+                elif "images" in body:
+                    out = {"imageVectors": [[0.0, 1.0]]}
+                else:  # gpt4all single text
+                    out = {"vector": [2.0, 2.0]}
+            elif path == "/vectors":  # img2vec-neural
+                out = {"vector": [9.0, 9.0]}
+            elif path == "/answers":
+                out = {"answer": "42", "certainty": 0.9}
+            elif path == "/ner":
+                out = {"tokens": [{"entity": "PER", "word": "ada",
+                                   "certainty": 0.8, "startPosition": 0,
+                                   "endPosition": 3}]}
+            elif path == "/sum":
+                out = {"summary": "short"}
+            elif path == "/spellcheck":
+                out = {"text": "hello world", "changes": [
+                    {"original": "helo", "corrected": "hello"}]}
+            else:
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_bigram_vectorizer_local():
+    mod = hx.BigramVectorizer()
+    mod.init({"dim": 64})
+    v = mod.vectorize(["hello world", "hello world", "different"], {})
+    assert v.shape == (3, 64)
+    np.testing.assert_allclose(v[0], v[1])
+    assert np.linalg.norm(v[0]) == pytest.approx(1.0, abs=1e-5)
+    assert not np.allclose(v[0], v[2])
+
+
+def test_contextionary_and_gpt4all(sidecar):
+    c = hx.ContextionaryVectorizer()
+    c.init({"inferenceUrl": sidecar})
+    out = c.vectorize(["abc", "defgh"], {})
+    assert out[0][0] == 3.0 and out[1][0] == 5.0
+    g = hx.GPT4AllVectorizer()
+    g.init({"inferenceUrl": sidecar})
+    assert g.vectorize(["x"], {}).tolist() == [[2.0, 2.0]]
+
+
+def test_bind_and_img2vec(sidecar):
+    b = hx.BindVectorizer()
+    b.init({"inferenceUrl": sidecar})
+    assert b.vectorize(["t"], {}).shape == (1, 2)
+    assert b.vectorize_media("audio", "AAA=", {}).tolist() == [0.0, 2.0]
+    assert "audio" in b.media_kinds and "video" in b.media_kinds
+    i = hx.Img2VecNeural()
+    i.init({"inferenceUrl": sidecar})
+    assert i.vectorize_media("image", "AAA=", {}).tolist() == [9.0, 9.0]
+    with pytest.raises(ModuleError):
+        i.vectorize(["text"], {})
+
+
+def test_readers(sidecar):
+    qna = hx.QnATransformers()
+    qna.init({"inferenceUrl": sidecar})
+    ans = qna.answer("the answer is 42 obviously", "what is it?", {})
+    assert ans["answer"] == "42" and ans["hasAnswer"]
+    assert ans["startPosition"] == 14
+
+    ner = hx.NERTransformers()
+    ner.init({"inferenceUrl": sidecar})
+    toks = ner.recognize("ada wrote notes", {})
+    assert toks[0]["entity"] == "PER" and toks[0]["word"] == "ada"
+
+    s = hx.SumTransformers()
+    s.init({"inferenceUrl": sidecar})
+    assert s.summarize("long text", {})[0]["result"] == "short"
+
+    sc = hx.TextSpellCheck()
+    sc.init({"inferenceUrl": sidecar})
+    out = sc.check("helo world", {})
+    assert out["correctedText"] == "hello world"
+    assert out["numberOfCorrections"] == 1
+    assert out["didYouMean"] == "hello world"
+
+
+def test_vendor_modules_need_configuration(monkeypatch):
+    for var in ("PALM_APIKEY", "JINAAI_APIKEY", "VOYAGEAI_APIKEY",
+                "OCTOAI_APIKEY", "ANYSCALE_APIKEY", "MISTRAL_APIKEY",
+                "AWS_BEDROCK_ENDPOINT"):
+        monkeypatch.delenv(var, raising=False)
+    cases = [
+        (hx.PalmVectorizer(), lambda m: m.vectorize(["x"], {})),
+        (hx.AWSVectorizer(), lambda m: m.vectorize(["x"], {})),
+        (hx.JinaAIVectorizer(), lambda m: m.vectorize(["x"], {})),
+        (hx.VoyageAIReranker(), lambda m: m.rerank("q", ["d"], {})),
+        (hx.AnyscaleGenerative(), lambda m: m.generate("p", {})),
+        (hx.MistralGenerative(), lambda m: m.generate("p", {})),
+        (hx.AWSGenerative(), lambda m: m.generate("p", {})),
+        (hx.PalmGenerative(), lambda m: m.generate("p", {})),
+    ]
+    for mod, call in cases:
+        mod.init({})
+        with pytest.raises(ModuleError):
+            call(mod)
+
+
+def test_default_provider_registers_full_roster():
+    p = default_provider()
+    names = p.names()
+    for expected in [
+        "text2vec-contextionary", "text2vec-palm", "text2vec-aws",
+        "text2vec-jinaai", "text2vec-voyageai", "text2vec-octoai",
+        "text2vec-gpt4all", "text2vec-bigram", "multi2vec-bind",
+        "multi2vec-palm", "img2vec-neural", "reranker-voyageai",
+        "generative-anyscale", "generative-mistral", "generative-octoai",
+        "generative-palm", "generative-aws", "qna-transformers",
+        "qna-openai", "ner-transformers", "sum-transformers",
+        "text-spellcheck", "backup-s3", "backup-gcs", "backup-azure",
+        "backup-filesystem",
+    ]:
+        assert expected in names, f"{expected} missing from registry"
+    assert len(names) >= 36
+
+
+def test_graphql_additional_readers(sidecar, tmp_path):
+    """_additional { answer tokens summary } flow through the reader
+    modules (reference: qna/ner/sum GraphQL additional properties)."""
+    from weaviate_tpu.api.client import Client
+    from weaviate_tpu.api.rest import RestServer
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.modules import Provider
+
+    db = Database(str(tmp_path))
+    p = Provider(db)
+    for mod in (hx.QnATransformers(), hx.NERTransformers(),
+                hx.SumTransformers()):
+        p.register(mod, {"inferenceUrl": sidecar})
+    srv = RestServer(db, modules=p)
+    srv.start()
+    try:
+        c = Client(srv.address)
+        c.create_class({"class": "Doc", "properties": [
+            {"name": "body", "dataType": ["text"]}]})
+        c.create_object("Doc", {"body": "the answer is 42 obviously"},
+                        vector=[1.0, 2.0])
+        out = c.graphql("""
+        { Get { Doc(limit: 1) {
+            body
+            _additional {
+              answer(question: "what is it?") { result hasAnswer }
+              tokens { entity word }
+              summary { result }
+            }
+        } } }""")
+        assert "errors" not in out, out
+        add = out["data"]["Get"]["Doc"][0]["_additional"]
+        assert add["answer"]["result"] == "42"
+        assert add["tokens"][0]["entity"] == "PER"
+        assert add["summary"][0]["result"] == "short"
+    finally:
+        srv.stop()
+        db.close()

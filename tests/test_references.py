@@ -1,0 +1,155 @@
+"""Cross-reference tests: REST reference CRUD, batch references, and
+GraphQL beacon resolution through inline fragments.
+
+Reference pattern: handlers_objects references endpoints + graphql ref
+resolver acceptance tests.
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.client import Client, RestError
+from weaviate_tpu.api.rest import RestServer
+from weaviate_tpu.db.database import Database
+
+
+@pytest.fixture
+def env(tmp_path):
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    c = Client(srv.address)
+    c.create_class({"class": "Author", "properties": [
+        {"name": "name", "dataType": ["text"]}]})
+    c.create_class({"class": "Book", "properties": [
+        {"name": "title", "dataType": ["text"]},
+        {"name": "writtenBy", "dataType": ["cref"]}]})
+    yield c
+    srv.stop()
+    db.close()
+
+
+def _beacon(cls, uid):
+    return f"weaviate://localhost/{cls}/{uid}"
+
+
+def test_reference_crud(env):
+    c = env
+    author = c.create_object("Author", {"name": "Ada"}, vector=[1.0])["id"]
+    author2 = c.create_object("Author", {"name": "Bob"}, vector=[2.0])["id"]
+    book = c.create_object("Book", {"title": "Notes"}, vector=[3.0])["id"]
+
+    # POST appends
+    c.request("POST", f"/v1/objects/Book/{book}/references/writtenBy",
+              body={"beacon": _beacon("Author", author)})
+    got = c.get_object("Book", book)
+    assert got["properties"]["writtenBy"] == [
+        {"beacon": _beacon("Author", author)}]
+
+    # PUT replaces
+    c.request("PUT", f"/v1/objects/Book/{book}/references/writtenBy",
+              body=[{"beacon": _beacon("Author", author)},
+                    {"beacon": _beacon("Author", author2)}])
+    got = c.get_object("Book", book)
+    assert len(got["properties"]["writtenBy"]) == 2
+
+    # DELETE removes one
+    c.request("DELETE", f"/v1/objects/Book/{book}/references/writtenBy",
+              body={"beacon": _beacon("Author", author)})
+    got = c.get_object("Book", book)
+    assert got["properties"]["writtenBy"] == [
+        {"beacon": _beacon("Author", author2)}]
+
+    # non-ref property rejected
+    with pytest.raises(RestError) as e:
+        c.request("POST", f"/v1/objects/Book/{book}/references/title",
+                  body={"beacon": _beacon("Author", author)})
+    assert e.value.status == 422
+
+
+def test_batch_references(env):
+    c = env
+    a = c.create_object("Author", {"name": "Cyn"}, vector=[1.0])["id"]
+    b1 = c.create_object("Book", {"title": "One"}, vector=[2.0])["id"]
+    b2 = c.create_object("Book", {"title": "Two"}, vector=[3.0])["id"]
+    out = c.request("POST", "/v1/batch/references", body=[
+        {"from": f"weaviate://localhost/Book/{b1}/writtenBy",
+         "to": _beacon("Author", a)},
+        {"from": f"weaviate://localhost/Book/{b2}/writtenBy",
+         "to": _beacon("Author", a)},
+        {"from": "weaviate://localhost/Book/missing-uuid/writtenBy",
+         "to": _beacon("Author", a)},
+    ])
+    assert out[0]["result"]["status"] == "SUCCESS"
+    assert out[1]["result"]["status"] == "SUCCESS"
+    assert out[2]["result"]["status"] == "FAILED"
+    assert c.get_object("Book", b1)["properties"]["writtenBy"]
+
+
+def test_graphql_resolves_refs(env):
+    c = env
+    a = c.create_object("Author", {"name": "Dee"}, vector=[1.0])["id"]
+    b = c.create_object("Book", {"title": "Deep"}, vector=[2.0])["id"]
+    c.request("POST", f"/v1/objects/Book/{b}/references/writtenBy",
+              body={"beacon": _beacon("Author", a)})
+    out = c.graphql("""
+    { Get { Book(limit: 5) {
+        title
+        writtenBy { ... on Author { name _additional { id } } }
+    } } }""")
+    assert "errors" not in out, out
+    books = out["data"]["Get"]["Book"]
+    target = next(x for x in books if x["title"] == "Deep")
+    assert target["writtenBy"][0]["name"] == "Dee"
+    assert target["writtenBy"][0]["_additional"]["id"] == a
+    assert target["writtenBy"][0]["__typename"] == "Author"
+
+
+def test_graphql_fragment_type_filter(env):
+    """A beacon pointing at a class the query doesn't select is dropped."""
+    c = env
+    a = c.create_object("Author", {"name": "E"}, vector=[1.0])["id"]
+    b = c.create_object("Book", {"title": "F"}, vector=[2.0])["id"]
+    c.request("POST", f"/v1/objects/Book/{b}/references/writtenBy",
+              body={"beacon": _beacon("Author", a)})
+    out = c.graphql("""
+    { Get { Book(limit: 5) {
+        title
+        writtenBy { ... on Book { title } }
+    } } }""")
+    assert "errors" not in out, out
+    target = next(x for x in out["data"]["Get"]["Book"]
+                  if x["title"] == "F")
+    assert target["writtenBy"] == []
+
+
+def test_batch_references_validation(env):
+    c = env
+    b = c.create_object("Book", {"title": "V"}, vector=[1.0])["id"]
+    out = c.request("POST", "/v1/batch/references", body=[
+        "not-a-dict",
+        {"from": f"weaviate://localhost/Book/{b}/title",
+         "to": _beacon("Author", "x")},  # non-ref property
+        {"from": f"weaviate://localhost/Book/{b}/writtenBy"},  # missing to
+    ])
+    assert all(r["result"]["status"] == "FAILED" for r in out)
+    # string property not corrupted
+    assert c.get_object("Book", b)["properties"]["title"] == "V"
+    with pytest.raises(RestError) as e:
+        c.request("POST", "/v1/batch/references", body={"from": "x"})
+    assert e.value.status == 422
+
+
+def test_reference_rejects_missing_beacon(env):
+    c = env
+    b = c.create_object("Book", {"title": "W"}, vector=[1.0])["id"]
+    with pytest.raises(RestError) as e:
+        c.request("POST", f"/v1/objects/Book/{b}/references/writtenBy",
+                  body={})
+    assert e.value.status == 422
+
+
+def test_graphql_fragment_at_root_is_clean_error(env):
+    out = env.graphql("{ Get { ... on Book { title } } }")
+    assert out["errors"]
+    assert "inline fragments" in out["errors"][0]["message"]

@@ -27,6 +27,7 @@ _TOKEN_RE = re.compile(
     r"""
     (?P<ws>[\s,]+)
   | (?P<comment>\#[^\n\r]*)
+  | (?P<spread>\.\.\.)
   | (?P<name>[_A-Za-z][_0-9A-Za-z]*)
   | (?P<float>-?\d+\.\d+([eE][+-]?\d+)?|-?\d+[eE][+-]?\d+)
   | (?P<int>-?\d+)
@@ -74,7 +75,28 @@ class Field:
 
     def sel(self, name: str) -> "Field | None":
         for f in self.selections:
-            if f.name == name:
+            if isinstance(f, Field) and f.name == name:
+                return f
+        return None
+
+    def fragments(self) -> "list[InlineFragment]":
+        return [f for f in self.selections if isinstance(f, InlineFragment)]
+
+
+class InlineFragment:
+    """``... on ClassName { ... }`` — how the reference's GraphQL schema
+    types cross-reference properties (class_builder_fields.go ref
+    resolution)."""
+
+    __slots__ = ("type_name", "selections")
+
+    def __init__(self, type_name, selections):
+        self.type_name = type_name
+        self.selections = selections
+
+    def sel(self, name: str):
+        for f in self.selections:
+            if isinstance(f, Field) and f.name == name:
                 return f
         return None
 
@@ -130,6 +152,15 @@ class _Parser:
 
     def parse_field(self) -> Field:
         kind, name = self.next()
+        if kind == "spread":
+            _, on = self.next()
+            if on != "on":
+                raise GraphQLError("only inline fragments ('... on Type') "
+                                   "are supported")
+            kind2, type_name = self.next()
+            if kind2 != "name":
+                raise GraphQLError("expected type name after '... on'")
+            return InlineFragment(type_name, self.parse_selection_set())
         if kind != "name":
             raise GraphQLError(f"expected field name, got {name!r}")
         alias = None
@@ -279,6 +310,10 @@ class GraphQLExecutor:
     def _get_root(self, root: Field, variables) -> dict:
         out = {}
         for cls_field in root.selections:
+            if isinstance(cls_field, InlineFragment):
+                raise GraphQLError(
+                    "inline fragments are only supported inside "
+                    "reference-property selections")
             out[cls_field.alias] = self._get_class(cls_field, variables)
         return out
 
@@ -380,7 +415,8 @@ class GraphQLExecutor:
                 sort=[{"path": s.get("path"), "order": s.get("order", "asc")}
                       for s in sort] if sort else None,
                 where=where, after=args.get("after"))
-            return [self._render_object(f, col, o, None) for o in objs]
+            return [self._render_object(f, col, o, None, tenant)
+                    for o in objs]
 
         results = results[offset:offset + limit]
         rerank_field = None
@@ -389,7 +425,8 @@ class GraphQLExecutor:
             rerank_field = add.sel("rerank")
         if rerank_field is not None:
             results = self._apply_rerank(col, results, rerank_field.args)
-        return [self._render_result(f, col, r) for r in results]
+        return [self._render_result(f, col, r, tenant)
+                for r in results]
 
     def _apply_rerank(self, col, results, rr_args):
         if self.modules is None:
@@ -406,24 +443,73 @@ class GraphQLExecutor:
         results.sort(key=lambda r: -(r.rerank_score or 0.0))
         return results
 
-    def _render_result(self, f: Field, col, r) -> dict:
-        obj = r.object or col.get_object(r.uuid)
-        return self._render_object(f, col, obj, r)
+    def _render_result(self, f: Field, col, r, tenant=None) -> dict:
+        obj = r.object or col.get_object(r.uuid, tenant=tenant)
+        return self._render_object(f, col, obj, r, tenant)
 
-    def _render_object(self, f: Field, col, obj, result) -> dict:
+    def _render_object(self, f: Field, col, obj, result,
+                       tenant=None) -> dict:
         out = {}
         for sel in f.selections:
+            if isinstance(sel, InlineFragment):
+                continue  # fragments only make sense under a ref property
             if sel.name == "_additional":
                 out[sel.alias] = self._additional(sel, col, obj, result)
             elif obj is not None:
-                out[sel.alias] = obj.properties.get(sel.name)
+                value = obj.properties.get(sel.name)
+                if sel.selections and isinstance(value, list):
+                    out[sel.alias] = self._render_refs(sel, value, tenant)
+                else:
+                    out[sel.alias] = value
             else:
                 out[sel.alias] = None
+        return out
+
+    def _render_refs(self, sel: Field, beacons: list,
+                     tenant=None) -> list[dict]:
+        """Resolve cross-reference beacons and render each target through
+        the matching inline fragment (reference: ref-property fields are
+        GraphQL union types over the target classes)."""
+        out = []
+        frags = {fr.type_name: fr for fr in sel.fragments()}
+        for ref in beacons:
+            beacon = ref.get("beacon", "") if isinstance(ref, dict) \
+                else str(ref)
+            parts = [p for p in beacon.split("/") if p]
+            if len(parts) < 2:
+                continue
+            uid = parts[-1]
+            cls_name = parts[-2] if len(parts) >= 3 and \
+                parts[-2][0:1].isupper() else None
+            candidates = [cls_name] if cls_name else \
+                self.db.list_collections()
+            for cname in candidates:
+                try:
+                    target_col = self.db.get_collection(cname)
+                    # MT targets resolve within the query's tenant; a
+                    # tenant-less lookup at an MT class is skipped, not
+                    # fatal (ValueError from _check_tenant)
+                    target = target_col.get_object(uid, tenant=tenant)
+                except (KeyError, ValueError):
+                    continue
+                if target is None:
+                    continue
+                frag = frags.get(cname)
+                if frag is None:
+                    break  # resolved, but the query doesn't want this type
+                row = self._render_object(
+                    Field(sel.name, selections=frag.selections),
+                    target_col, target, None, tenant)
+                row["__typename"] = cname
+                out.append(row)
+                break
         return out
 
     def _additional(self, add: Field, col, obj, result) -> dict:
         out = {}
         for sel in add.selections:
+            if isinstance(sel, InlineFragment):
+                continue
             n = sel.name
             if n == "id":
                 out[sel.alias] = obj.uuid if obj else (
@@ -451,9 +537,52 @@ class GraphQLExecutor:
                 out[sel.alias] = str(obj.last_update_time_ms) if obj else None
             elif n == "generate":
                 out[sel.alias] = self._generate(sel, col, obj)
+            elif n == "answer":
+                out[sel.alias] = self._answer(sel, col, obj)
+            elif n == "tokens":
+                out[sel.alias] = self._tokens(sel, col, obj)
+            elif n == "summary":
+                out[sel.alias] = self._summary(sel, col, obj)
             else:
                 out[sel.alias] = None
         return out
+
+    def _obj_text(self, col, obj, properties=None) -> str:
+        props = obj.properties if obj is not None else {}
+        keys = properties or [p.name for p in col.config.properties
+                              if p.data_type in ("text", "text[]")]
+        parts = []
+        for key in keys:
+            v = props.get(key)
+            if isinstance(v, str):
+                parts.append(v)
+            elif isinstance(v, list):
+                parts.extend(x for x in v if isinstance(x, str))
+        return " ".join(parts)
+
+    def _answer(self, sel: Field, col, obj) -> dict:
+        if self.modules is None:
+            raise GraphQLError("answer requires a qna module")
+        question = sel.args.get("question", "")
+        props = sel.args.get("properties")
+        text = self._obj_text(col, obj, props)
+        ans = self.modules.answer(col.config, text, question)
+        ans.setdefault("result", ans.get("answer"))
+        return ans
+
+    def _tokens(self, sel: Field, col, obj) -> list[dict]:
+        if self.modules is None:
+            raise GraphQLError("tokens requires a ner module")
+        props = sel.args.get("properties")
+        return self.modules.ner(col.config,
+                                self._obj_text(col, obj, props))
+
+    def _summary(self, sel: Field, col, obj) -> list[dict]:
+        if self.modules is None:
+            raise GraphQLError("summary requires a sum module")
+        props = sel.args.get("properties")
+        return self.modules.summarize(col.config,
+                                      self._obj_text(col, obj, props))
 
     def _generate(self, sel: Field, col, obj) -> dict:
         if self.modules is None:
@@ -477,6 +606,10 @@ class GraphQLExecutor:
     def _aggregate_root(self, root: Field, variables) -> dict:
         out = {}
         for cls_field in root.selections:
+            if isinstance(cls_field, InlineFragment):
+                raise GraphQLError(
+                    "inline fragments are only supported inside "
+                    "reference-property selections")
             out[cls_field.alias] = self._aggregate_class(cls_field, variables)
         return out
 

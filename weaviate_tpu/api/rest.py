@@ -385,6 +385,8 @@ class RestServer:
             return self._objects(method, seg[1:], params, body)
         if seg == ["batch", "objects"] and method == "POST":
             return self._batch_objects(body or {})
+        if seg == ["batch", "references"] and method == "POST":
+            return self._batch_references(body or [])
         if seg[:1] == ["backups"]:
             return self._backups(method, seg[1:], body)
         if seg[:1] == ["classifications"]:
@@ -427,6 +429,95 @@ class RestServer:
         except ClassificationError as e:
             raise ApiError(422, str(e))
         raise KeyError("/v1/classifications/" + "/".join(seg))
+
+    def _references(self, method: str, class_name: str, uuid: str,
+                    prop: str, body, tenant):
+        """Cross-reference CRUD (reference: handlers_objects.go
+        /v1/objects/{class}/{id}/references/{prop}): POST appends a
+        beacon, PUT replaces all, DELETE removes one."""
+        col = self.db.get_collection(class_name)
+        if col.config.property(prop) is None or \
+                col.config.property(prop).data_type != "cref":
+            raise ApiError(422, f"property {prop!r} of {class_name} is not "
+                           "a reference property")
+        def beacon_of(b):
+            beacon = b.get("beacon") if isinstance(b, dict) else b
+            if not isinstance(beacon, str) or not beacon:
+                raise ApiError(422, "reference payload needs a 'beacon' "
+                               "string")
+            return beacon
+
+        # read-modify-write under the collection lock: two concurrent
+        # reference additions must not lose each other's append
+        with col._lock:
+            obj = col.get_object(uuid, tenant=tenant)
+            if obj is None:
+                raise ApiError(404, f"object {uuid} not found")
+            refs = list(obj.properties.get(prop) or [])
+            if method == "POST":
+                refs.append({"beacon": beacon_of(body or {})})
+            elif method == "PUT":
+                items = body if isinstance(body, list) else [body or {}]
+                refs = [{"beacon": beacon_of(b)} for b in items]
+            elif method == "DELETE":
+                want = beacon_of(body or {})
+                refs = [r for r in refs
+                        if (r.get("beacon") if isinstance(r, dict)
+                            else str(r)) != want]
+            else:
+                raise KeyError("references")
+            props = dict(obj.properties)
+            props[prop] = refs
+            col.put_object(props, vector=obj.vector,
+                           vectors=obj.vectors or None, uuid=uuid,
+                           tenant=tenant,
+                           creation_time_ms=obj.creation_time_ms)
+        return 200, None
+
+    def _batch_references(self, body: list):
+        """POST /v1/batch/references (reference: handlers_batch —
+        [{from: weaviate://localhost/Class/uuid/prop, to: beacon}])."""
+        if not isinstance(body, list):
+            raise ApiError(422, "batch references payload must be a list")
+        results = []
+        for item in body:
+            try:
+                if not isinstance(item, dict):
+                    raise ValueError("each reference must be an object "
+                                     "with 'from' and 'to'")
+                src = item.get("from", "")
+                parts = [p for p in src.split("/") if p]
+                # weaviate:, localhost, Class, uuid, prop
+                if len(parts) < 4:
+                    raise ValueError(f"malformed 'from' beacon {src!r}")
+                cls, uid, prop = parts[-3], parts[-2], parts[-1]
+                to = item.get("to")
+                if not isinstance(to, str) or not to:
+                    raise ValueError("'to' must be a beacon string")
+                col = self.db.get_collection(cls)
+                pcfg = col.config.property(prop)
+                if pcfg is None or pcfg.data_type != "cref":
+                    raise ValueError(
+                        f"property {prop!r} of {cls} is not a reference "
+                        "property")
+                with col._lock:  # see _references: appends must not race
+                    obj = col.get_object(uid, tenant=item.get("tenant"))
+                    if obj is None:
+                        raise ValueError(f"source object {uid} not found")
+                    refs = list(obj.properties.get(prop) or [])
+                    refs.append({"beacon": to})
+                    props = dict(obj.properties)
+                    props[prop] = refs
+                    col.put_object(props, vector=obj.vector,
+                                   vectors=obj.vectors or None, uuid=uid,
+                                   tenant=item.get("tenant"),
+                                   creation_time_ms=obj.creation_time_ms)
+                results.append({"result": {"status": "SUCCESS"}})
+            except (KeyError, ValueError) as e:
+                results.append({"result": {
+                    "status": "FAILED",
+                    "errors": {"error": [{"message": str(e)}]}}})
+        return 200, results
 
     def _backups(self, method: str, seg: list[str], body):
         """Reference routes (handlers_backup.go):
@@ -525,6 +616,9 @@ class RestServer:
                 return self._list_objects(params)
             if method == "POST":
                 return self._put_object(body or {}, tenant)
+        elif len(seg) == 4 and seg[2] == "references":
+            return self._references(method, seg[0], seg[1], seg[3], body,
+                                    tenant)
         elif len(seg) == 2:
             class_name, uuid = seg
             col = self.db.get_collection(class_name)

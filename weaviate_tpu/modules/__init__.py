@@ -21,22 +21,51 @@ from weaviate_tpu.modules.text2vec_hash import HashVectorizer
 def default_provider(db=None, enabled: list[str] | None = None) -> Provider:
     from weaviate_tpu.modules import backup_backends as bb
     from weaviate_tpu.modules import http_modules as hm
+    from weaviate_tpu.modules import http_modules_extra as hx
 
     provider = Provider(db)
     mods = [
         HashVectorizer(),
         RefVectorizer(),
+        # text2vec
         hm.TransformersVectorizer(),
         hm.OpenAIVectorizer(),
         hm.CohereVectorizer(),
         hm.HuggingFaceVectorizer(),
         hm.OllamaVectorizer(),
+        hx.ContextionaryVectorizer(),
+        hx.PalmVectorizer(),
+        hx.AWSVectorizer(),
+        hx.JinaAIVectorizer(),
+        hx.VoyageAIVectorizer(),
+        hx.OctoAIVectorizer(),
+        hx.GPT4AllVectorizer(),
+        hx.BigramVectorizer(),
+        # multi2vec / img2vec
         hm.ClipVectorizer(),
+        hx.BindVectorizer(),
+        hx.PalmMultiVectorizer(),
+        hx.Img2VecNeural(),
+        # rerankers
         hm.TransformersReranker(),
         hm.CohereReranker(),
+        hx.VoyageAIReranker(),
+        # generative
         hm.OpenAIGenerative(),
         hm.OllamaGenerative(),
         hm.CohereGenerative(),
+        hx.AnyscaleGenerative(),
+        hx.MistralGenerative(),
+        hx.OctoAIGenerative(),
+        hx.PalmGenerative(),
+        hx.AWSGenerative(),
+        # readers
+        hx.QnATransformers(),
+        hx.QnAOpenAI(),
+        hx.NERTransformers(),
+        hx.SumTransformers(),
+        hx.TextSpellCheck(),
+        # backup backends
         bb.FilesystemBackend(),
         bb.S3Backend(),
         bb.GCSBackend(),

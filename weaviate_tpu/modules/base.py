@@ -105,3 +105,39 @@ class BackupBackend(Module):
 
     def home_dir(self, backup_id: str) -> str:
         raise NotImplementedError
+
+
+class QnA(Module):
+    """qna-* capability (reference: modules/qna-{openai,transformers} —
+    extractive question answering over a result's text)."""
+
+    def answer(self, text: str, question: str, config: dict) -> dict:
+        """-> {"answer": str|None, "certainty": float|None,
+        "startPosition": int, "endPosition": int, "hasAnswer": bool}"""
+        raise NotImplementedError
+
+
+class NER(Module):
+    """ner-transformers capability: token classification over a text."""
+
+    def recognize(self, text: str, config: dict) -> list[dict]:
+        """-> [{"entity", "word", "certainty", "startPosition",
+        "endPosition"}]"""
+        raise NotImplementedError
+
+
+class Summarizer(Module):
+    """sum-transformers capability: abstractive summaries of a text."""
+
+    def summarize(self, text: str, config: dict) -> list[dict]:
+        """-> [{"property", "result"}]"""
+        raise NotImplementedError
+
+
+class SpellCheck(Module):
+    """text-spellcheck capability: check/correct query text."""
+
+    def check(self, text: str, config: dict) -> dict:
+        """-> {"originalText", "correctedText", "didYouMean",
+        "numberOfCorrections"}"""
+        raise NotImplementedError

@@ -229,6 +229,37 @@ class Provider:
         prompt = f"{task}\n\n{json.dumps(all_props, default=str)}"
         return mod.generate(prompt, settings)
 
+    def answer(self, config, text: str, question: str,
+               module_name: str | None = None) -> dict:
+        """qna-* extractive answer (reference: _additional{answer})."""
+        from weaviate_tpu.modules.base import QnA
+
+        mod, settings = self._class_module(config, QnA, "qna-", module_name)
+        return mod.answer(text, question, settings)
+
+    def ner(self, config, text: str,
+            module_name: str | None = None) -> list[dict]:
+        from weaviate_tpu.modules.base import NER
+
+        mod, settings = self._class_module(config, NER, "ner-", module_name)
+        return mod.recognize(text, settings)
+
+    def summarize(self, config, text: str,
+                  module_name: str | None = None) -> list[dict]:
+        from weaviate_tpu.modules.base import Summarizer
+
+        mod, settings = self._class_module(config, Summarizer, "sum-",
+                                           module_name)
+        return mod.summarize(text, settings)
+
+    def spellcheck(self, config, text: str,
+                   module_name: str | None = None) -> dict:
+        from weaviate_tpu.modules.base import SpellCheck
+
+        mod, settings = self._class_module(config, SpellCheck, "text-spell",
+                                           module_name)
+        return mod.check(text, settings)
+
     def backup_backend(self, name: str) -> BackupBackend:
         mod = self._modules.get(f"backup-{name}", self._modules.get(name))
         if not isinstance(mod, BackupBackend):
