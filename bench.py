@@ -87,7 +87,9 @@ def main():
     dev = jax.devices()[0]
     log(f"device: {dev}, platform: {dev.platform}")
     store_dtype = jnp.bfloat16 if os.environ.get("BENCH_DTYPE", "bf16") == "bf16" else jnp.float32
-    chunk = 65536
+    # chunk size is latency-neutral on this rig (the host<->device link
+    # dominates); BENCH_CHUNK overrides for other topologies
+    chunk = int(os.environ.get("BENCH_CHUNK", "65536"))
     n_pad = -(-n // chunk) * chunk  # pad corpus to a chunk multiple once
     padded = np.zeros((n_pad, dim), dtype=np.float32)
     padded[:n] = corpus

@@ -298,3 +298,31 @@ def test_patch_revectorizes_changed_text(tmp_path):
     finally:
         srv.stop()
         db.close()
+
+
+def test_batch_delete_rest(client):
+    client.create_class({"class": "BD", "properties": [
+        {"name": "n", "data_type": "int"}]})
+    for i in range(10):
+        client.create_object("BD", {"n": i}, vector=[float(i), 1.0])
+    # dry run counts without deleting
+    out = client.request("DELETE", "/v1/batch/objects", body={
+        "match": {"class": "BD",
+                  "where": {"path": ["n"], "operator": "GreaterThanEqual",
+                            "valueInt": 5}},
+        "dryRun": True})
+    assert out["results"]["matches"] == 5
+    assert len(client.list_objects("BD", limit=25)["objects"]) == 10
+    # real delete
+    out = client.request("DELETE", "/v1/batch/objects", body={
+        "match": {"class": "BD",
+                  "where": {"path": ["n"], "operator": "GreaterThanEqual",
+                            "valueInt": 5}},
+        "output": "verbose"})
+    assert out["results"]["successful"] == 5
+    assert len(out["results"]["objects"]) == 5
+    assert len(client.list_objects("BD", limit=25)["objects"]) == 5
+    from weaviate_tpu.api.client import RestError
+    with pytest.raises(RestError) as e:
+        client.request("DELETE", "/v1/batch/objects", body={"match": {}})
+    assert e.value.status == 422

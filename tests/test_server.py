@@ -149,3 +149,55 @@ def test_server_restart_preserves_data(tmp_path):
         assert len(out["objects"]) == 10
     finally:
         srv2.stop()
+
+
+def test_cluster_statistics_standalone(tmp_path):
+    from weaviate_tpu.api.client import Client
+    from weaviate_tpu.api.rest import RestServer
+    from weaviate_tpu.db.database import Database
+
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    try:
+        out = Client(srv.address).request("GET", "/v1/cluster/statistics")
+        assert out["synchronized"] is True
+        assert out["statistics"][0]["standalone"] is True
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_slow_query_logging(tmp_path, monkeypatch, caplog):
+    import logging
+
+    import weaviate_tpu.db.collection as collection_mod
+
+    # parser unit checks
+    monkeypatch.setenv("QUERY_SLOW_LOG_ENABLED", "enabled")
+    monkeypatch.setenv("QUERY_SLOW_LOG_THRESHOLD", "250ms")
+    assert collection_mod._slow_query_threshold() == pytest.approx(0.25)
+    monkeypatch.setenv("QUERY_SLOW_LOG_THRESHOLD", "3s")
+    assert collection_mod._slow_query_threshold() == pytest.approx(3.0)
+    monkeypatch.setenv("QUERY_SLOW_LOG_ENABLED", "false")
+    assert collection_mod._slow_query_threshold() == 0.0
+    # env set AFTER import still applies (threshold is lazily cached)
+    monkeypatch.setenv("QUERY_SLOW_LOG_ENABLED", "true")
+    monkeypatch.setenv("QUERY_SLOW_LOG_THRESHOLD", "0.0001")
+    monkeypatch.setattr(collection_mod, "_SLOW_THRESHOLD", None)
+    from weaviate_tpu.api.rest import config_from_json
+    from weaviate_tpu.db.database import Database
+
+    db = Database(str(tmp_path))
+    try:
+        db.create_collection(config_from_json({
+            "class": "Doc", "properties": [
+                {"name": "t", "dataType": ["text"]}]}))
+        col = db.get_collection("Doc")
+        col.put_object({"t": "x"}, vector=[1.0, 2.0])
+        with caplog.at_level(logging.WARNING, "weaviate_tpu.slow_query"):
+            col.near_vector(np.asarray([1.0, 2.0]), k=1)
+        assert any("slow vector query" in r.getMessage()
+                   for r in caplog.records)
+    finally:
+        db.close()

@@ -366,6 +366,28 @@ class RestServer:
             return 200, {"text": registry.expose()}
         if seg == ["nodes"]:
             return 200, {"nodes": self._nodes_payload()}
+        if seg == ["cluster", "statistics"]:
+            # Raft/cluster introspection (reference: /v1/cluster/statistics,
+            # handlers for cluster statistics over the raft Store)
+            if self.node is None:
+                return 200, {"statistics": [{
+                    "name": self.db.local_node, "status": "HEALTHY",
+                    "raft": None, "standalone": True}],
+                    "synchronized": True}
+            raft = self.node.raft
+            return 200, {"statistics": [{
+                "name": self.node.name,
+                "status": "HEALTHY",
+                "leaderId": raft.leader_id,
+                "raft": {"state": raft.role, "term": raft.current_term,
+                         "commitIndex": raft.commit_index,
+                         "appliedIndex": raft.commit_index,
+                         "numPeers": len(raft.peers) - 1},
+                "open": True, "bootstrapped": True,
+                "dbLoaded": True,
+                "isVoter": True,
+                "candidates": {n: True for n in raft.peers},
+            }], "synchronized": raft.leader_id is not None}
         if seg == ["tenant-activity"]:
             # hot/cold tenant usage (reference:
             # rest/tenantactivity/handler.go)
@@ -385,6 +407,8 @@ class RestServer:
             return self._objects(method, seg[1:], params, body)
         if seg == ["batch", "objects"] and method == "POST":
             return self._batch_objects(body or {})
+        if seg == ["batch", "objects"] and method == "DELETE":
+            return self._batch_delete(body or {}, params)
         if seg == ["batch", "references"] and method == "POST":
             return self._batch_references(body or [])
         if seg[:1] == ["backups"]:
@@ -473,6 +497,40 @@ class RestServer:
                            tenant=tenant,
                            creation_time_ms=obj.creation_time_ms)
         return 200, None
+
+    def _batch_delete(self, body: dict, params: dict):
+        """DELETE /v1/batch/objects (reference: handlers_batch_delete —
+        {"match": {"class", "where"}, "dryRun", "output"})."""
+        from weaviate_tpu.filters.filters import Filter
+
+        match = body.get("match") or {}
+        class_name = match.get("class", "")
+        where = match.get("where")
+        if not class_name or where is None:
+            raise ApiError(422, "batch delete needs match.class and "
+                           "match.where")
+        col = self.db.get_collection(class_name)
+        try:
+            where_f = Filter.from_dict(where)
+        except (KeyError, ValueError, TypeError) as e:
+            raise ApiError(422, f"invalid match.where filter: {e}")
+        result = col.batch_delete(
+            where_f,
+            tenant=params.get("tenant") or body.get("tenant"),
+            dry_run=bool(body.get("dryRun")),
+            verbose=body.get("output") == "verbose",
+            consistency=params.get("consistency_level", "QUORUM"))
+        return 200, {
+            "match": match,
+            "output": body.get("output", "minimal"),
+            "dryRun": bool(body.get("dryRun")),
+            "results": {
+                "matches": result["matches"],
+                "successful": result["successful"],
+                "failed": result["failed"],
+                "objects": result.get("objects"),
+            },
+        }
 
     def _batch_references(self, body: list):
         """POST /v1/batch/references (reference: handlers_batch —

@@ -47,16 +47,60 @@ def _remote_result(item: dict, shard_name: str) -> "SearchResult":
         object=StorageObject.from_bytes(raw) if raw else None)
 
 
+def _slow_query_threshold() -> float:
+    """Slow-query logging (reference: helpers/slow_queries.go — env
+    QUERY_SLOW_LOG_ENABLED + QUERY_SLOW_LOG_THRESHOLD). 0 = disabled."""
+    import os
+
+    from weaviate_tpu.config import _flag
+
+    if not _flag(os.environ, "QUERY_SLOW_LOG_ENABLED"):
+        return 0.0
+    raw = os.environ.get("QUERY_SLOW_LOG_THRESHOLD", "2s").strip()
+    try:
+        if raw.endswith("ms"):
+            return float(raw[:-2]) / 1000.0
+        if raw.endswith("s"):
+            return float(raw[:-1])
+        return float(raw)
+    except ValueError:
+        return 2.0
+
+
+# lazily cached on first query so env set after import still applies;
+# None = not yet computed
+_SLOW_THRESHOLD: float | None = None
+
+
+def _get_slow_threshold() -> float:
+    global _SLOW_THRESHOLD
+    if _SLOW_THRESHOLD is None:
+        _SLOW_THRESHOLD = _slow_query_threshold()
+    return _SLOW_THRESHOLD
+
+
 def _timed(query_type: str):
     """Record query latency per collection (reference: monitoring
-    query-duration metric vecs, usecases/monitoring/prometheus.go)."""
+    query-duration metric vecs, usecases/monitoring/prometheus.go) and
+    log queries slower than the configured threshold."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, *args, **kwargs):
+            t0 = time.perf_counter()
             with monitoring.query_duration.labels(self.config.name,
                                                   query_type).time():
-                return fn(self, *args, **kwargs)
+                out = fn(self, *args, **kwargs)
+            threshold = _get_slow_threshold()
+            if threshold > 0:
+                took = time.perf_counter() - t0
+                if took >= threshold:
+                    import logging
+
+                    logging.getLogger("weaviate_tpu.slow_query").warning(
+                        "slow %s query on %s: %.3fs (threshold %.3fs)",
+                        query_type, self.config.name, took, threshold)
+            return out
 
         return wrapper
 
