@@ -219,3 +219,43 @@ def test_unknown_class_reports_error(gql):
 def test_unknown_root_reports_error(gql):
     out = gql("{ Borked { x } }")
     assert out["errors"]
+
+
+def test_get_group_by(gql):
+    """Get-level groupBy: one entry per group, hits under
+    _additional.group (reference: groupBy arg + group additional)."""
+    out = gql("""
+    { Get { Article(limit: 40,
+                    nearText: {concepts: ["article"]},
+                    groupBy: {path: ["title"], groups: 3,
+                              objectsPerGroup: 2}) {
+        title
+        _additional { group { id count groupedBy { value }
+                              minDistance maxDistance
+                              hits { wordCount _additional { id } } } }
+    } } }""")
+    assert "errors" not in out, out
+    rows = out["data"]["Get"]["Article"]
+    assert 1 <= len(rows) <= 3
+    for row in rows:
+        g = row["_additional"]["group"]
+        assert 1 <= g["count"] <= 2
+        assert len(g["hits"]) == g["count"]
+        assert g["groupedBy"]["value"]
+        assert g["hits"][0]["_additional"]["id"]
+
+
+def test_near_media_requires_module(gql):
+    out = gql("""
+    { Get { Article(limit: 1, nearImage: {image: "AAAA"}) { title } } }""")
+    assert out["errors"]  # hash vectorizer is not a multi2vec module
+
+
+def test_aggregate_near_text_object_limit(gql):
+    out = gql("""
+    { Aggregate { Article(nearText: {concepts: ["alpha"]},
+                          objectLimit: 8) {
+        meta { count }
+    } } }""")
+    assert "errors" not in out, out
+    assert out["data"]["Aggregate"]["Article"][0]["meta"]["count"] == 8

@@ -19,6 +19,8 @@ import re
 
 import numpy as np
 
+from weaviate_tpu.modules.base import ModuleError
+
 # ---------------------------------------------------------------------------
 # Lexer / parser (GraphQL spec subset)
 # ---------------------------------------------------------------------------
@@ -301,7 +303,8 @@ class GraphQLExecutor:
                 else:
                     raise GraphQLError(f"unknown root field {root.name!r}")
             return {"data": data}
-        except (GraphQLError, KeyError, ValueError, TypeError) as e:
+        except (GraphQLError, ModuleError, KeyError, ValueError,
+                TypeError) as e:
             msg = str(e) if str(e) else repr(e)
             return {"data": None, "errors": [{"message": msg}]}
 
@@ -378,6 +381,26 @@ class GraphQLExecutor:
             search = "bm25"
         elif "hybrid" in args:
             search = "hybrid"
+        else:
+            # near<Media> (nearImage/nearAudio/...): vectorize through the
+            # class's multi2vec module (reference: near<Media> GraphQL args)
+            for arg_name, kind in (("nearImage", "image"),
+                                   ("nearAudio", "audio"),
+                                   ("nearVideo", "video"),
+                                   ("nearThermal", "thermal"),
+                                   ("nearDepth", "depth"),
+                                   ("nearIMU", "imu")):
+                if arg_name in args:
+                    if self.modules is None:
+                        raise GraphQLError(
+                            f"{arg_name} requires a multi2vec module")
+                    d = args[arg_name]
+                    vec_name = _target(d)
+                    near_vec = self.modules.vectorize_media(
+                        col.config, kind, d.get(kind, ""), vec_name)
+                    max_distance = _max_dist(d)
+                    search = "vector"
+                    break
 
         if search == "vector":
             results = col.near_vector(
@@ -425,8 +448,65 @@ class GraphQLExecutor:
             rerank_field = add.sel("rerank")
         if rerank_field is not None:
             results = self._apply_rerank(col, results, rerank_field.args)
+        if "groupBy" in args:
+            return self._render_grouped(f, col, results, args["groupBy"],
+                                        tenant)
         return [self._render_result(f, col, r, tenant)
                 for r in results]
+
+    def _render_grouped(self, f: Field, col, results, group_by,
+                        tenant) -> list[dict]:
+        """Get-level groupBy (reference: groupBy{path groups
+        objectsPerGroup} + _additional{group{...}}): one returned entry
+        per group, hits nested under _additional.group."""
+        path = group_by.get("path")
+        prop = path[0] if isinstance(path, list) else path
+        max_groups = max(int(group_by.get("groups", 5)), 1)
+        per_group = max(int(group_by.get("objectsPerGroup", 5)), 1)
+        groups: dict = {}
+        order: list = []
+        for r in results:
+            obj = r.object or col.get_object(r.uuid, tenant=tenant)
+            if obj is None:
+                continue
+            value = obj.properties.get(prop)
+            key = tuple(value) if isinstance(value, list) else value
+            try:
+                hash(key)
+            except TypeError:  # dict-typed / nested values
+                key = repr(value)
+            if key not in groups:
+                if len(groups) >= max_groups:
+                    continue
+                groups[key] = []
+                order.append(key)
+            if len(groups[key]) < per_group:
+                r.object = obj
+                groups[key].append(r)
+        out = []
+        for gid, key in enumerate(order):
+            hits = groups[key]
+            best = hits[0]
+            row = self._render_result(f, col, best, tenant)
+            dists = [h.distance for h in hits if h.distance is not None]
+            add = row.setdefault("_additional", {})
+            add["group"] = {
+                "id": gid,
+                "groupedBy": {"value": key if not isinstance(key, tuple)
+                              else list(key),
+                              "path": [prop]},
+                "count": len(hits),
+                "minDistance": min(dists) if dists else None,
+                "maxDistance": max(dists) if dists else None,
+                "hits": [
+                    {**(h.object.properties if h.object else {}),
+                     "_additional": {"id": h.uuid,
+                                     "distance": h.distance}}
+                    for h in hits
+                ],
+            }
+            out.append(row)
+        return out
 
     def _apply_rerank(self, col, results, rr_args):
         if self.modules is None:
@@ -625,6 +705,24 @@ class GraphQLExecutor:
         if "nearVector" in args:
             near_vec = np.asarray(args["nearVector"]["vector"],
                                   dtype=np.float32)
+        elif "nearObject" in args:
+            d = args["nearObject"]
+            uid = d.get("id") or d.get("beacon", "").split("/")[-1]
+            anchor = col.get_object(uid, tenant=tenant)
+            tv = d.get("targetVectors")
+            vec_name = tv[0] if tv else ""
+            near_vec = None if anchor is None else (
+                anchor.vectors.get(vec_name) if vec_name else anchor.vector)
+            if near_vec is None:
+                raise GraphQLError(f"nearObject anchor {uid} has no vector")
+        elif "nearText" in args:
+            if self.modules is None:
+                raise GraphQLError("nearText requires a vectorizer module")
+            d = args["nearText"]
+            tv = d.get("targetVectors")
+            near_vec = self.modules.vectorize_query(
+                col.config, " ".join(d.get("concepts") or []),
+                tv[0] if tv else "")
 
         props, requested = [], {}
         wants_grouped = False

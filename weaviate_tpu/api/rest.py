@@ -528,7 +528,9 @@ class RestServer:
                 "matches": result["matches"],
                 "successful": result["successful"],
                 "failed": result["failed"],
-                "objects": result.get("objects"),
+                # reference shape: null unless output=verbose
+                "objects": result.get("objects")
+                if body.get("output") == "verbose" else None,
             },
         }
 
