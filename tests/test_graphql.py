@@ -259,3 +259,37 @@ def test_aggregate_near_text_object_limit(gql):
     } } }""")
     assert "errors" not in out, out
     assert out["data"]["Aggregate"]["Article"][0]["meta"]["count"] == 8
+
+
+def test_aggregate_near_respects_distance_threshold(gql):
+    """distance on an Aggregate near-arg restricts the aggregation set
+    (reference: certainty/distance restrict the object set)."""
+    out = gql("""
+    { Aggregate { Article(nearText: {concepts: ["alpha article 0"],
+                                     distance: 0.0001}) {
+        meta { count }
+    } } }""")
+    assert "errors" not in out, out
+    # only near-identical objects pass the tight threshold
+    assert out["data"]["Aggregate"]["Article"][0]["meta"]["count"] <= 2
+
+
+def test_group_by_hits_respect_selection(gql):
+    out = gql("""
+    { Get { Article(limit: 40, nearText: {concepts: ["article"]},
+                    groupBy: {path: ["title"], groups: 2,
+                              objectsPerGroup: 2}) {
+        title
+        _additional { group { hits { wordCount } } }
+    } } }""")
+    assert "errors" not in out, out
+    hit = out["data"]["Get"]["Article"][0]["_additional"]["group"]["hits"][0]
+    assert "wordCount" in hit
+    assert "title" not in hit  # only requested fields are rendered
+
+
+def test_group_by_without_search_is_clean_error(gql):
+    out = gql("""
+    { Get { Article(groupBy: {path: ["title"]}) { title } } }""")
+    assert out["errors"]
+    assert "groupBy requires" in out["errors"][0]["message"]

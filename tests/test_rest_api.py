@@ -156,7 +156,7 @@ def test_rest_over_cluster(tmp_path):
             assert c.get_object("Multi", ids[5])["properties"]["i"] == 5
             assert len(c.list_objects("Multi", limit=50)["objects"]) == 12
         statuses = {n["name"]: n["status"] for n in clients[2].nodes()}
-        assert statuses == {"n0": "ALIVE", "n1": "ALIVE", "n2": "ALIVE"}
+        assert statuses == {"n0": "HEALTHY", "n1": "HEALTHY", "n2": "HEALTHY"}
     finally:
         for n in nodes:
             try:

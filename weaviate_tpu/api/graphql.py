@@ -330,77 +330,16 @@ class GraphQLExecutor:
         where = self._parse_where(args.get("where"))
         k = limit + offset
 
-        near_vec = None
-        vec_name = ""
-        max_distance = None
-        search = None
-
-        def _target(d):
-            tv = d.get("targetVectors")
-            return tv[0] if tv else ""
-
-        def _max_dist(d):
-            if "distance" in d:
-                return float(d["distance"])
-            if "certainty" in d:
-                return _certainty_to_distance(d["certainty"])
-            return None
-
-        if "nearVector" in args:
-            d = args["nearVector"]
-            near_vec = np.asarray(d["vector"], dtype=np.float32)
-            vec_name = _target(d)
-            max_distance = _max_dist(d)
-            search = "vector"
-        elif "nearObject" in args:
-            d = args["nearObject"]
-            uid = d.get("id") or d.get("beacon", "").split("/")[-1]
-            anchor = col.get_object(uid, tenant=tenant)
-            if anchor is None:
-                raise GraphQLError(f"nearObject anchor {uid} not found")
-            vec_name = _target(d)
-            near_vec = (anchor.vectors.get(vec_name) if vec_name
-                        else anchor.vector)
-            if near_vec is None:
-                raise GraphQLError(f"anchor {uid} has no vector")
-            max_distance = _max_dist(d)
-            search = "vector"
-        elif "nearText" in args:
-            d = args["nearText"]
-            if self.modules is None:
-                raise GraphQLError("nearText requires a vectorizer module")
-            vec_name = _target(d)
-            concepts = d.get("concepts") or []
-            near_vec = self.modules.vectorize_query(
-                col.config, " ".join(concepts), vec_name)
-            near_vec = self.modules.apply_moves(
-                col, near_vec, _NearTextShim(d), vec_name)
-            max_distance = _max_dist(d)
+        near_vec, vec_name, max_distance = self._resolve_near(
+            col, args, tenant)
+        if near_vec is not None:
             search = "vector"
         elif "bm25" in args:
             search = "bm25"
         elif "hybrid" in args:
             search = "hybrid"
         else:
-            # near<Media> (nearImage/nearAudio/...): vectorize through the
-            # class's multi2vec module (reference: near<Media> GraphQL args)
-            for arg_name, kind in (("nearImage", "image"),
-                                   ("nearAudio", "audio"),
-                                   ("nearVideo", "video"),
-                                   ("nearThermal", "thermal"),
-                                   ("nearDepth", "depth"),
-                                   ("nearIMU", "imu")):
-                if arg_name in args:
-                    if self.modules is None:
-                        raise GraphQLError(
-                            f"{arg_name} requires a multi2vec module")
-                    d = args[arg_name]
-                    vec_name = _target(d)
-                    near_vec = self.modules.vectorize_media(
-                        col.config, kind, d.get(kind, ""), vec_name)
-                    max_distance = _max_dist(d)
-                    search = "vector"
-                    break
+            search = None
 
         if search == "vector":
             results = col.near_vector(
@@ -430,6 +369,10 @@ class GraphQLExecutor:
                 fusion=fusion, where=where, autocut=autocut)
         else:
             # plain listing (with optional sort / cursor)
+            if "groupBy" in args:
+                raise GraphQLError(
+                    "groupBy requires a search argument (nearVector/"
+                    "nearText/bm25/hybrid/...)")
             sort = args.get("sort")
             if sort is not None and not isinstance(sort, list):
                 sort = [sort]
@@ -453,6 +396,78 @@ class GraphQLExecutor:
                                         tenant)
         return [self._render_result(f, col, r, tenant)
                 for r in results]
+
+    def _render_hit(self, f: Field, col, r, tenant) -> dict:
+        """One groupBy hit, rendered through the query's
+        group{hits{...}} selection set (falls back to id+distance when
+        the query names no hit fields)."""
+        add = f.sel("_additional")
+        group_f = add.sel("group") if add is not None else None
+        hits_f = group_f.sel("hits") if group_f is not None else None
+        if hits_f is not None and hits_f.selections:
+            return self._render_result(hits_f, col, r, tenant)
+        return {"_additional": {"id": r.uuid, "distance": r.distance}}
+
+    def _resolve_near(self, col, args: dict, tenant=None):
+        """Resolve any near* argument to (vector, vec_name, max_distance);
+        (None, "", None) when no near arg is present. One resolver for the
+        Get and Aggregate roots so their semantics (named vectors,
+        distance/certainty thresholds, nearText moves) cannot drift."""
+
+        def _target(d):
+            tv = d.get("targetVectors")
+            return tv[0] if tv else ""
+
+        def _max_dist(d):
+            if "distance" in d:
+                return float(d["distance"])
+            if "certainty" in d:
+                return _certainty_to_distance(d["certainty"])
+            return None
+
+        if "nearVector" in args:
+            d = args["nearVector"]
+            return (np.asarray(d["vector"], dtype=np.float32),
+                    _target(d), _max_dist(d))
+        if "nearObject" in args:
+            d = args["nearObject"]
+            uid = d.get("id") or d.get("beacon", "").split("/")[-1]
+            anchor = col.get_object(uid, tenant=tenant)
+            if anchor is None:
+                raise GraphQLError(f"nearObject anchor {uid} not found")
+            vec_name = _target(d)
+            vec = (anchor.vectors.get(vec_name) if vec_name
+                   else anchor.vector)
+            if vec is None:
+                raise GraphQLError(f"anchor {uid} has no vector")
+            return vec, vec_name, _max_dist(d)
+        if "nearText" in args:
+            d = args["nearText"]
+            if self.modules is None:
+                raise GraphQLError("nearText requires a vectorizer module")
+            vec_name = _target(d)
+            vec = self.modules.vectorize_query(
+                col.config, " ".join(d.get("concepts") or []), vec_name)
+            vec = self.modules.apply_moves(
+                col, vec, _NearTextShim(d), vec_name)
+            return vec, vec_name, _max_dist(d)
+        # near<Media>: vectorize through the class's multi2vec module
+        for arg_name, kind in (("nearImage", "image"),
+                               ("nearAudio", "audio"),
+                               ("nearVideo", "video"),
+                               ("nearThermal", "thermal"),
+                               ("nearDepth", "depth"),
+                               ("nearIMU", "imu")):
+            if arg_name in args:
+                if self.modules is None:
+                    raise GraphQLError(
+                        f"{arg_name} requires a multi2vec module")
+                d = args[arg_name]
+                vec_name = _target(d)
+                vec = self.modules.vectorize_media(
+                    col.config, kind, d.get(kind, ""), vec_name)
+                return vec, vec_name, _max_dist(d)
+        return None, "", None
 
     def _render_grouped(self, f: Field, col, results, group_by,
                         tenant) -> list[dict]:
@@ -498,12 +513,8 @@ class GraphQLExecutor:
                 "count": len(hits),
                 "minDistance": min(dists) if dists else None,
                 "maxDistance": max(dists) if dists else None,
-                "hits": [
-                    {**(h.object.properties if h.object else {}),
-                     "_additional": {"id": h.uuid,
-                                     "distance": h.distance}}
-                    for h in hits
-                ],
+                "hits": [self._render_hit(f, col, h, tenant)
+                         for h in hits],
             }
             out.append(row)
         return out
@@ -701,28 +712,8 @@ class GraphQLExecutor:
         group_by = args.get("groupBy")
         if isinstance(group_by, list):
             group_by = group_by[0] if group_by else None
-        near_vec = None
-        if "nearVector" in args:
-            near_vec = np.asarray(args["nearVector"]["vector"],
-                                  dtype=np.float32)
-        elif "nearObject" in args:
-            d = args["nearObject"]
-            uid = d.get("id") or d.get("beacon", "").split("/")[-1]
-            anchor = col.get_object(uid, tenant=tenant)
-            tv = d.get("targetVectors")
-            vec_name = tv[0] if tv else ""
-            near_vec = None if anchor is None else (
-                anchor.vectors.get(vec_name) if vec_name else anchor.vector)
-            if near_vec is None:
-                raise GraphQLError(f"nearObject anchor {uid} has no vector")
-        elif "nearText" in args:
-            if self.modules is None:
-                raise GraphQLError("nearText requires a vectorizer module")
-            d = args["nearText"]
-            tv = d.get("targetVectors")
-            near_vec = self.modules.vectorize_query(
-                col.config, " ".join(d.get("concepts") or []),
-                tv[0] if tv else "")
+        near_vec, near_vec_name, near_max_dist = self._resolve_near(
+            col, args, tenant)
 
         props, requested = [], {}
         wants_grouped = False
@@ -739,6 +730,8 @@ class GraphQLExecutor:
         agg = col.aggregate(properties=props or None, group_by=group_by,
                             where=where, tenant=tenant, requested=requested,
                             near_vector=near_vec,
+                            near_vec_name=near_vec_name,
+                            near_max_distance=near_max_dist,
                             object_limit=args.get("objectLimit"))
 
         def render(meta_count, properties, grouped_value=None):

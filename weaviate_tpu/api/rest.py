@@ -615,8 +615,14 @@ class RestServer:
     def _nodes_payload(self) -> list[dict]:
         if self.node is not None:
             infos = self.node.membership.nodes()
+            # gossip states → the reference's node-status vocabulary
+            # (entities/models.NodeStatus: HEALTHY/UNHEALTHY/UNAVAILABLE)
+            status_map = {"alive": "HEALTHY", "suspect": "UNHEALTHY",
+                          "dead": "UNAVAILABLE", "left": "UNAVAILABLE"}
             return [{
-                "name": i.name, "status": i.status.upper(),
+                "name": i.name,
+                "status": status_map.get(i.status.lower(),
+                                         i.status.upper()),
                 "version": VERSION,
                 "stats": i.meta,
             } for i in sorted(infos.values(), key=lambda x: x.name)]

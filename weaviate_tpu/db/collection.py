@@ -536,7 +536,9 @@ class Collection:
                   group_by: str | None = None, where=None,
                   tenant: str | None = None,
                   requested: dict[str, list[str]] | None = None,
-                  near_vector=None, object_limit: int | None = None,
+                  near_vector=None, near_vec_name: str = "",
+                  near_max_distance: float | None = None,
+                  object_limit: int | None = None,
                   top_occurrences_limit: int = 5) -> dict:
         """Scatter-gather aggregation (reference: aggregator/aggregator.go →
         per-shard fold, shard_combiner.go merge). With ``near_vector`` +
@@ -551,7 +553,9 @@ class Collection:
         if near_vector is not None:
             k = object_limit or 100
             hits = self.near_vector(near_vector, k=k, tenant=tenant,
-                                    include_objects=True, where=where)
+                                    vec_name=near_vec_name,
+                                    include_objects=True, where=where,
+                                    max_distance=near_max_distance)
             partials = [aggregate_objects((r.object for r in hits if r.object),
                                           properties, group_by)]
         else:
