@@ -326,3 +326,78 @@ def test_batch_delete_rest(client):
     with pytest.raises(RestError) as e:
         client.request("DELETE", "/v1/batch/objects", body={"match": {}})
     assert e.value.status == 422
+
+
+def test_update_class_config(client):
+    client.create_class({"class": "UC", "properties": [
+        {"name": "t", "data_type": "text"}]})
+    # mutable: bm25 params, description, replication factor stays 1
+    out = client.request("PUT", "/v1/schema/UC", body={
+        "class": "UC",
+        "description": "updated",
+        "invertedIndexConfig": {"bm25": {"k1": 1.5, "b": 0.5}},
+    })
+    assert out["description"] == "updated"
+    assert out["inverted"]["bm25_k1"] == 1.5
+    # immutable: vectorizer change rejected
+    from weaviate_tpu.api.client import RestError
+    with pytest.raises(RestError) as e:
+        client.request("PUT", "/v1/schema/UC", body={
+            "class": "UC", "vectorizer": "text2vec-hash"})
+    assert e.value.status == 422
+
+
+def test_shard_status_endpoints(client):
+    client.create_class({"class": "SH", "properties": [
+        {"name": "n", "data_type": "int"}]})
+    client.create_object("SH", {"n": 1}, vector=[1.0, 2.0])
+    shards = client.request("GET", "/v1/schema/SH/shards")
+    assert shards[0]["status"] == "READY"
+    name = shards[0]["name"]
+    client.request("PUT", f"/v1/schema/SH/shards/{name}",
+                   body={"status": "READONLY"})
+    from weaviate_tpu.api.client import RestError
+    with pytest.raises(RestError):  # writes refused while readonly
+        client.create_object("SH", {"n": 2}, vector=[1.0, 2.0])
+    # reads still work
+    assert client.list_objects("SH", limit=5)["objects"]
+    client.request("PUT", f"/v1/schema/SH/shards/{name}",
+                   body={"status": "READY"})
+    client.create_object("SH", {"n": 3}, vector=[3.0, 4.0])
+
+
+def test_shard_readonly_survives_restart(tmp_path):
+    from weaviate_tpu.db.database import Database
+
+    db = Database(str(tmp_path))
+    db.create_collection(config_from_json_for_test := __import__(
+        "weaviate_tpu.api.rest", fromlist=["config_from_json"]
+    ).config_from_json({"class": "RS", "properties": [
+        {"name": "n", "dataType": ["int"]}]}))
+    col = db.get_collection("RS")
+    col.put_object({"n": 1}, vector=[1.0])
+    col._load_shard("shard-0").set_read_only(True)
+    db.close()
+
+    db2 = Database(str(tmp_path))
+    col2 = db2.get_collection("RS")
+    assert col2._load_shard("shard-0").read_only is True
+    import pytest as _pytest
+    from weaviate_tpu.db.shard import ShardReadOnlyError
+
+    with _pytest.raises(ShardReadOnlyError):
+        col2.put_object({"n": 2}, vector=[2.0])
+    db2.close()
+
+
+def test_update_class_runtime_knobs_reach_live_objects(client, server):
+    client.create_class({"class": "RT", "properties": [
+        {"name": "t", "data_type": "text"}]})
+    client.create_object("RT", {"t": "x"}, vector=[1.0])
+    col = server.db.get_collection("RT")
+    shard = col._load_shard("shard-0")
+    assert shard._inverted.k1 == 1.2
+    client.request("PUT", "/v1/schema/RT", body={
+        "invertedIndexConfig": {"bm25": {"k1": 1.7, "b": 0.4}}})
+    assert shard._inverted.k1 == 1.7
+    assert shard._inverted.b == 0.4

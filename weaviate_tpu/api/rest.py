@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from weaviate_tpu import __version__ as VERSION
+from weaviate_tpu.db.shard import ShardReadOnlyError
 from weaviate_tpu.filters.filters import Filter
 from weaviate_tpu.schema.config import CollectionConfig, Property
 
@@ -285,6 +286,8 @@ class RestServer:
                 except (KeyError, FileNotFoundError) as e:
                     status, payload = 404, {"error": [{"message": str(e)}]}
                 except ValueError as e:
+                    status, payload = 422, {"error": [{"message": str(e)}]}
+                except ShardReadOnlyError as e:
                     status, payload = 422, {"error": [{"message": str(e)}]}
                 except Exception as e:
                     logger.exception("REST %s %s failed", method, self.path)
@@ -651,9 +654,47 @@ class RestServer:
             name = seg[0]
             if method == "GET":
                 return 200, self.db.get_collection(name).config.to_dict()
+            if method == "PUT":
+                # update mutable class config (reference: PUT /v1/schema/{c})
+                d = dict(body or {})
+                d.setdefault("class", name)
+                cfg = config_from_json(d)
+                if cfg.name != name:
+                    raise ApiError(422, "class name in body does not match "
+                                   "the path")
+                self.schema_target.update_collection(cfg)
+                return 200, self.db.get_collection(name).config.to_dict()
             if method == "DELETE":
                 self.schema_target.delete_collection(name)
                 return 200, None
+        elif len(seg) == 2 and seg[1] == "shards" and method == "GET":
+            col = self.db.get_collection(seg[0])
+            out = []
+            for shard_name in col.sharding.shard_names:
+                if not col._is_local(shard_name):
+                    out.append({"name": shard_name, "status": "REMOTE",
+                                "vectorQueueSize": 0})
+                    continue
+                # locally-owned but unloaded (cold tenant) shards load
+                # lazily here — status must not misreport them as remote
+                shard = col._load_shard(shard_name)
+                qsize = sum(q.size() for q in shard._index_queues.values())
+                out.append({
+                    "name": shard_name,
+                    "status": "READONLY" if shard.read_only else "READY",
+                    "vectorQueueSize": qsize,
+                })
+            return 200, out
+        elif len(seg) == 3 and seg[1] == "shards" and method == "PUT":
+            col = self.db.get_collection(seg[0])
+            status = (body or {}).get("status", "").upper()
+            if status not in ("READY", "READONLY"):
+                raise ApiError(422, "shard status must be READY or READONLY")
+            if seg[2] not in col.sharding.shard_names or \
+                    not col._is_local(seg[2]):
+                raise ApiError(404, f"shard {seg[2]!r} is not local")
+            col._load_shard(seg[2]).set_read_only(status == "READONLY")
+            return 200, {"status": status}
         elif len(seg) == 2 and seg[1] == "properties" and method == "POST":
             prop = property_from_json(body or {})
             self.schema_target.add_property(seg[0], prop)

@@ -41,11 +41,15 @@ class SchemaFSM:
                 pass  # duplicate on replay
         elif t == "update_class":
             cfg = CollectionConfig.from_dict(op["config"])
-
-            def overwrite(c):
-                c.__dict__.update(cfg.__dict__)
-
-            self.db.update_collection_config(cfg.name, overwrite)
+            try:
+                # merge only the mutable surface + push runtime knobs into
+                # live objects (NOT a wholesale overwrite: the proposed
+                # config may carry defaults for fields the proposer's
+                # client omitted)
+                self.db.update_collection(cfg)
+            except (KeyError, ValueError) as e:
+                # replay tolerance: class deleted later in the log etc.
+                logger.warning("update_class %s skipped: %s", cfg.name, e)
         elif t == "add_tenants":
             col = self.db.get_collection(op["class"])
             for tenant in op["tenants"]:

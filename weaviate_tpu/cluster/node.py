@@ -126,6 +126,14 @@ class ClusterNode:
     def delete_collection(self, name: str) -> None:
         self.raft.propose({"type": "delete_class", "name": name})
 
+    def update_collection(self, new_cfg: CollectionConfig) -> None:
+        # validate WITHOUT mutating, then replicate — the FSM applies the
+        # update on every node including this one; mutating before a
+        # successful propose would diverge this node from its peers
+        self.db.validate_collection_update(new_cfg)
+        self.raft.propose({"type": "update_class",
+                           "config": new_cfg.to_dict()})
+
     def add_property(self, collection: str, prop: Property) -> None:
         self.raft.propose({"type": "add_property", "class": collection,
                            "prop": dataclasses.asdict(prop)})

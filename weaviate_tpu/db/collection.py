@@ -170,6 +170,30 @@ class Collection:
         with self._lock:
             return {t: dict(v) for t, v in self.tenant_activity.items()}
 
+    def apply_runtime_config(self) -> None:
+        """Propagate runtime-mutable config (reference: UpdateUserConfig →
+        hnsw/config_update.go) into LIVE shard objects, which copied
+        config values at construction: BM25 k1/b and per-index search
+        knobs (ef / nprobe / rescore / upgrade threshold)."""
+        with self._lock:
+            shards = list(self.shards.values())
+        for shard in shards:
+            inv = shard._inverted
+            inv.k1 = self.config.inverted.bm25_k1
+            inv.b = self.config.inverted.bm25_b
+            for vec_name, idx in shard.vector_indexes.items():
+                vc = self.config.vector_config(vec_name)
+                if idx is None or vc is None:
+                    continue
+                for attr, value in (
+                    ("ef", vc.index.ef),
+                    ("rescore_limit", vc.index.rescore_limit),
+                    ("nprobe", vc.index.ivf_nprobe),
+                    ("threshold", vc.index.flat_to_ann_threshold),
+                ):
+                    if hasattr(idx, attr) and value:
+                        setattr(idx, attr, value)
+
     # -- shard management ----------------------------------------------------
 
     def _load_shard(self, name: str) -> Shard:

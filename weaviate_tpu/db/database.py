@@ -133,6 +133,62 @@ class Database:
             col.config.properties.append(prop)
             self._persist(col)
 
+    # mutable-at-runtime config surface (reference: UpdateUserConfig /
+    # update-class validation — vectorizer, index type, sharding and
+    # multi-tenancy are immutable after creation)
+    def validate_collection_update(self, new_cfg: CollectionConfig) -> None:
+        """Immutability checks only — NO mutation (the cluster path
+        validates first, then replicates through Raft; applying before a
+        successful propose would diverge this node from its peers)."""
+        cur = self.get_collection(new_cfg.name).config
+        for vc_new in new_cfg.vectors:
+            vc_cur = cur.vector_config(vc_new.name)
+            if vc_cur is None:
+                raise ValueError(
+                    f"cannot add vector space {vc_new.name!r} via update")
+            if vc_new.vectorizer != vc_cur.vectorizer:
+                raise ValueError("vectorizer is immutable")
+            if vc_new.index.index_type != vc_cur.index.index_type:
+                raise ValueError("vectorIndexType is immutable")
+            if vc_new.index.metric != vc_cur.index.metric:
+                raise ValueError("distance metric is immutable")
+        if new_cfg.sharding.desired_count != cur.sharding.desired_count:
+            raise ValueError("shard count is immutable (resharding "
+                             "is not supported)")
+        if new_cfg.multi_tenancy.enabled != cur.multi_tenancy.enabled:
+            raise ValueError("multiTenancy.enabled is immutable")
+
+    def update_collection(self, new_cfg: CollectionConfig) -> None:
+        with self._lock:
+            self.validate_collection_update(new_cfg)
+
+            def apply(cfg):
+                cfg.description = new_cfg.description
+                cfg.inverted = new_cfg.inverted
+                cfg.module_config = new_cfg.module_config
+                cfg.replication.factor = new_cfg.replication.factor
+                cfg.multi_tenancy.auto_tenant_creation = \
+                    new_cfg.multi_tenancy.auto_tenant_creation
+                cfg.multi_tenancy.auto_tenant_activation = \
+                    new_cfg.multi_tenancy.auto_tenant_activation
+                for vc_new in new_cfg.vectors:
+                    vc = cfg.vector_config(vc_new.name)
+                    # runtime-tunable index knobs (reference:
+                    # hnsw/config_update.go — ef, rescore, thresholds)
+                    vc.index.ef = vc_new.index.ef
+                    vc.index.ef_construction = vc_new.index.ef_construction
+                    vc.index.rescore_limit = vc_new.index.rescore_limit
+                    vc.index.flat_to_ann_threshold = \
+                        vc_new.index.flat_to_ann_threshold
+                    vc.index.ivf_nprobe = vc_new.index.ivf_nprobe
+                    vc.module_config = vc_new.module_config
+
+            self.update_collection_config(new_cfg.name, apply)
+            # push runtime knobs into LIVE objects — they copied config
+            # values at construction and would otherwise only pick the
+            # update up after a restart
+            self.get_collection(new_cfg.name).apply_runtime_config()
+
     def update_collection_config(self, name: str, mutate) -> None:
         """Runtime-mutable config path (reference: UpdateUserConfig,
         vector_index.go:33). ``mutate(config)`` edits in place; validation

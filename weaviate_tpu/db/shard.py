@@ -15,12 +15,17 @@ import threading
 
 import numpy as np
 
-logger = logging.getLogger(__name__)
-
 from weaviate_tpu.engine.flat import FlatIndex
 from weaviate_tpu.schema.config import CollectionConfig, VectorConfig
 from weaviate_tpu.storage.kv import KVStore
 from weaviate_tpu.storage.objects import StorageObject
+
+logger = logging.getLogger(__name__)
+
+
+class ShardReadOnlyError(RuntimeError):
+    """Write refused: shard status is READONLY
+    (PUT /v1/schema/{class}/shards/{shard})."""
 
 # bucket names (reference: helpers/helpers.go:22-25)
 BUCKET_OBJECTS = "objects"
@@ -125,6 +130,10 @@ class Shard:
                                                   "enabled")
         self.async_indexing = async_indexing
         self._index_queues: dict[str, "IndexQueue"] = {}
+        # READONLY shard status (reference: PUT /v1/schema/{c}/shards/{s}
+        # — schema_shards handlers flip writes off per shard); persisted
+        # below once the meta bucket is open so restarts keep the freeze
+        self.read_only = False
         self.collection_name = collection.name
         self.config = collection
         # exact-case directory: two collections differing only in case are
@@ -142,6 +151,7 @@ class Shard:
         # staged 2PC batches: request id -> ("put", [objs]) | ("delete", uuid)
         self._staged: dict[str, tuple] = {}
         self._counter = self.meta.get(b"doc_counter") or 0
+        self.read_only = bool(self.meta.get(b"read_only") or False)
         self.mesh = mesh
         # named vector indexes, built lazily at first insert (dim inference)
         self.vector_indexes: dict[str, FlatIndex] = {}
@@ -248,6 +258,9 @@ class Shard:
             objs = [objs[i] for i in sorted(last.values())]
         doc_ids: list[int] = []
         with self._lock:
+            if self.read_only:
+                raise ShardReadOnlyError(
+                    f"shard {self.name!r} is read-only (status READONLY)")
             self._validate_vectors(objs)
             if self.memwatch is not None:
                 # refuse BEFORE mutating anything (reference memwatch
@@ -306,6 +319,9 @@ class Shard:
         import time as _time
 
         with self._lock:
+            if self.read_only:
+                raise ShardReadOnlyError(
+                    f"shard {self.name!r} is read-only (status READONLY)")
             raw = self.docid.get(uuid.encode())
             if raw is None:
                 return False
@@ -378,16 +394,28 @@ class Shard:
         with self._lock:
             return compute_allow_mask(where, self._inverted, self.doc_id_space)
 
+    def set_read_only(self, value: bool) -> None:
+        """Persisted so a restart keeps the freeze (reference persists
+        shard status)."""
+        with self._lock:
+            self.read_only = bool(value)
+            self.meta.put(b"read_only", bool(value))
+
     # -- replication support -------------------------------------------------
 
     STAGED_TTL_S = 120.0
 
     def stage(self, request_id: str, task: tuple) -> None:
         """2PC prepare: hold a write until commit/abort
-        (reference: replica store staging before commit)."""
+        (reference: replica store staging before commit). A READONLY
+        shard votes NO here — failing at prepare keeps all replicas
+        consistent instead of silently diverging at commit."""
         import time as _time
 
         with self._lock:
+            if self.read_only:
+                raise ShardReadOnlyError(
+                    f"shard {self.name!r} is read-only (status READONLY)")
             self._staged[request_id] = (_time.monotonic(), task)
 
     def gc_staged(self) -> int:
