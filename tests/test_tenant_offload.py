@@ -1,0 +1,182 @@
+"""Tenant HOT/COLD offload tests (reference: tenant activityStatus +
+autoTenantActivation + lazy shard loading)."""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.client import Client, RestError
+from weaviate_tpu.api.rest import RestServer, config_from_json
+from weaviate_tpu.db.database import Database
+
+
+def _mt_config(auto_activation=False):
+    return config_from_json({
+        "class": "MT",
+        "multiTenancyConfig": {"enabled": True,
+                               "autoTenantActivation": auto_activation},
+        "properties": [{"name": "p", "dataType": ["text"]}]})
+
+
+def test_cold_tenant_unloads_and_rejects(tmp_path):
+    db = Database(str(tmp_path))
+    try:
+        db.create_collection(_mt_config())
+        db.add_tenants("MT", ["acme"])
+        col = db.get_collection("MT")
+        col.put_object({"p": "x"}, vector=[1.0, 0.0], tenant="acme")
+        assert "acme" in col.shards  # loaded
+        col.set_tenant_status("acme", "COLD")
+        db._persist(col)
+        assert "acme" not in col.shards  # unloaded from memory/HBM
+        with pytest.raises(ValueError):
+            col.near_vector(np.asarray([1.0, 0.0]), k=1, tenant="acme")
+        with pytest.raises(ValueError):
+            col.put_object({"p": "y"}, vector=[0.0, 1.0], tenant="acme")
+        # reactivate: data intact
+        col.set_tenant_status("acme", "HOT")
+        res = col.near_vector(np.asarray([1.0, 0.0]), k=1, tenant="acme")
+        assert len(res) == 1
+    finally:
+        db.close()
+
+
+def test_cold_survives_restart_and_stays_unloaded(tmp_path):
+    db = Database(str(tmp_path))
+    db.create_collection(_mt_config())
+    db.add_tenants("MT", ["a", "b"])
+    col = db.get_collection("MT")
+    col.put_object({"p": "x"}, vector=[1.0], tenant="a")
+    col.put_object({"p": "y"}, vector=[2.0], tenant="b")
+    col.set_tenant_status("b", "COLD")
+    db._persist(col)
+    db.close()
+
+    db2 = Database(str(tmp_path))
+    try:
+        col2 = db2.get_collection("MT")
+        assert "a" in col2.shards
+        assert "b" not in col2.shards  # COLD stays unloaded at startup
+        assert col2.sharding.status_of("b") == "COLD"
+    finally:
+        db2.close()
+
+
+def test_auto_tenant_activation(tmp_path):
+    db = Database(str(tmp_path))
+    try:
+        db.create_collection(_mt_config(auto_activation=True))
+        db.add_tenants("MT", ["acme"])
+        col = db.get_collection("MT")
+        col.put_object({"p": "x"}, vector=[1.0], tenant="acme")
+        col.set_tenant_status("acme", "COLD")
+        # access auto-activates instead of failing
+        res = col.near_vector(np.asarray([1.0]), k=1, tenant="acme")
+        assert len(res) == 1
+        assert col.sharding.status_of("acme") == "HOT"
+    finally:
+        db.close()
+
+
+def test_tenant_status_rest(tmp_path):
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    try:
+        c = Client(srv.address)
+        c.create_class({"class": "MT",
+                        "multiTenancyConfig": {"enabled": True},
+                        "properties": [{"name": "p", "dataType": ["text"]}]})
+        c.add_tenants("MT", ["t1", "t2"])
+        out = c.request("GET", "/v1/schema/MT/tenants")
+        assert {t["name"]: t["activityStatus"] for t in out} == \
+            {"t1": "HOT", "t2": "HOT"}
+        out = c.request("PUT", "/v1/schema/MT/tenants", body=[
+            {"name": "t2", "activityStatus": "COLD"}])
+        assert out[0]["activityStatus"] == "COLD"
+        with pytest.raises(RestError) as e:
+            c.create_object("MT", {"p": "x"}, vector=[1.0], tenant="t2")
+        assert e.value.status == 422
+        with pytest.raises(RestError):
+            c.request("PUT", "/v1/schema/MT/tenants", body=[
+                {"name": "t2", "activityStatus": "LUKEWARM"}])
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_objects_validate_endpoint(tmp_path):
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    try:
+        c = Client(srv.address)
+        c.create_class({"class": "V", "properties": [
+            {"name": "t", "dataType": ["text"]}]})
+        c.request("POST", "/v1/objects/validate",
+                  body={"class": "V", "properties": {"t": "ok"},
+                        "vector": [1.0, 2.0]})
+        with pytest.raises(RestError) as e:
+            c.request("POST", "/v1/objects/validate",
+                      body={"class": "V", "properties": {"nope": 1}})
+        assert e.value.status == 422
+        with pytest.raises(RestError) as e2:
+            c.request("POST", "/v1/objects/validate",
+                      body={"class": "Missing", "properties": {}})
+        assert e2.value.status == 404
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_partial_class_update_preserves_omitted_fields(tmp_path):
+    """PUT with only description must NOT reset replication factor, bm25
+    params, or the vector config to defaults."""
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    try:
+        c = Client(srv.address)
+        c.create_class({
+            "class": "PU", "vectorizer": "text2vec-bigram",
+            "moduleConfig": {"text2vec-bigram": {"dim": 64}},
+            "invertedIndexConfig": {"bm25": {"k1": 1.9, "b": 0.2}},
+            "properties": [{"name": "t", "dataType": ["text"]}]})
+        out = c.request("PUT", "/v1/schema/PU",
+                        body={"description": "updated"})
+        assert out["description"] == "updated"
+        assert out["inverted"]["bm25_k1"] == 1.9  # untouched
+        vc = next(v for v in out["vectors"] if v["name"] == "")
+        assert vc["vectorizer"] == "text2vec-bigram"  # untouched
+        assert vc["module_config"] == {"dim": 64}
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_shards_listing_does_not_load_cold_tenants(tmp_path):
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    try:
+        c = Client(srv.address)
+        c.create_class({"class": "MT",
+                        "multiTenancyConfig": {"enabled": True},
+                        "properties": [{"name": "p", "dataType": ["text"]}]})
+        c.add_tenants("MT", ["hot1", "cold1"])
+        c.create_object("MT", {"p": "x"}, vector=[1.0], tenant="cold1")
+        c.request("PUT", "/v1/schema/MT/tenants", body=[
+            {"name": "cold1", "activityStatus": "COLD"}])
+        col = db.get_collection("MT")
+        assert "cold1" not in col.shards
+        shards = c.request("GET", "/v1/schema/MT/shards")
+        by_name = {s["name"]: s["status"] for s in shards}
+        assert by_name["cold1"] == "COLD"
+        assert by_name["hot1"] == "READY"
+        assert "cold1" not in col.shards  # listing did NOT load it
+        with pytest.raises(RestError) as e:
+            c.request("PUT", "/v1/schema/MT/shards/cold1",
+                      body={"status": "READONLY"})
+        assert e.value.status == 422
+    finally:
+        srv.stop()
+        db.close()

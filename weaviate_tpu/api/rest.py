@@ -655,14 +655,36 @@ class RestServer:
             if method == "GET":
                 return 200, self.db.get_collection(name).config.to_dict()
             if method == "PUT":
-                # update mutable class config (reference: PUT /v1/schema/{c})
+                # update mutable class config (reference: PUT /v1/schema/{c}).
+                # PARTIAL update semantics: only sections present in the
+                # body overlay the current config — parsing the body alone
+                # would fill omitted fields with defaults and silently
+                # reset them (e.g. replication factor back to 1).
+                import copy
+
                 d = dict(body or {})
                 d.setdefault("class", name)
-                cfg = config_from_json(d)
-                if cfg.name != name:
+                parsed = config_from_json(d)
+                if parsed.name != name:
                     raise ApiError(422, "class name in body does not match "
                                    "the path")
-                self.schema_target.update_collection(cfg)
+                merged = copy.deepcopy(
+                    self.db.get_collection(name).config)
+                if "description" in d:
+                    merged.description = parsed.description
+                if "invertedIndexConfig" in d or "inverted" in d:
+                    merged.inverted = parsed.inverted
+                if "replicationConfig" in d or "replication" in d:
+                    merged.replication = parsed.replication
+                if "moduleConfig" in d or "module_config" in d:
+                    merged.module_config = parsed.module_config
+                if "multiTenancyConfig" in d or "multi_tenancy" in d:
+                    merged.multi_tenancy = parsed.multi_tenancy
+                if any(k in d for k in ("vectorizer", "vectorIndexType",
+                                        "vectorIndexConfig",
+                                        "vectorConfig", "vectors")):
+                    merged.vectors = parsed.vectors
+                self.schema_target.update_collection(merged)
                 return 200, self.db.get_collection(name).config.to_dict()
             if method == "DELETE":
                 self.schema_target.delete_collection(name)
@@ -675,8 +697,12 @@ class RestServer:
                     out.append({"name": shard_name, "status": "REMOTE",
                                 "vectorQueueSize": 0})
                     continue
-                # locally-owned but unloaded (cold tenant) shards load
-                # lazily here — status must not misreport them as remote
+                if col.sharding.status_of(shard_name) == "COLD":
+                    # deactivated tenants stay on disk — loading them for
+                    # a status listing would defeat the offload
+                    out.append({"name": shard_name, "status": "COLD",
+                                "vectorQueueSize": 0})
+                    continue
                 shard = col._load_shard(shard_name)
                 qsize = sum(q.size() for q in shard._index_queues.values())
                 out.append({
@@ -693,6 +719,9 @@ class RestServer:
             if seg[2] not in col.sharding.shard_names or \
                     not col._is_local(seg[2]):
                 raise ApiError(404, f"shard {seg[2]!r} is not local")
+            if col.sharding.status_of(seg[2]) == "COLD":
+                raise ApiError(422, f"tenant shard {seg[2]!r} is COLD; "
+                               "activate it before changing shard status")
             col._load_shard(seg[2]).set_read_only(status == "READONLY")
             return 200, {"status": status}
         elif len(seg) == 2 and seg[1] == "properties" and method == "POST":
@@ -703,7 +732,20 @@ class RestServer:
             name = seg[0]
             col = self.db.get_collection(name)
             if method == "GET":
-                return 200, [{"name": t} for t in col.tenants()]
+                return 200, [
+                    {"name": t,
+                     "activityStatus": col.sharding.status_of(t)}
+                    for t in col.tenants()]
+            if method == "PUT":
+                # HOT/COLD offload (reference: PUT tenants with
+                # activityStatus)
+                tenants = [t if isinstance(t, dict) else {"name": t}
+                           for t in (body or [])]
+                self.schema_target.update_tenant_status(name, tenants)
+                return 200, [
+                    {"name": t["name"],
+                     "activityStatus": col.sharding.status_of(t["name"])}
+                    for t in tenants]
             tenants = [t["name"] if isinstance(t, dict) else t
                        for t in (body or [])]
             if method == "POST":
@@ -723,6 +765,20 @@ class RestServer:
                 return self._list_objects(params)
             if method == "POST":
                 return self._put_object(body or {}, tenant)
+        elif seg == ["validate"] and method == "POST":
+            # dry-run validation (reference: POST /v1/objects/validate)
+            b = dict(body or {})
+            cls = b.get("class", "")
+            col = self.db.get_collection(cls)
+            props = b.get("properties") or {}
+            for key in props:
+                if col.config.property(key) is None:
+                    raise ApiError(422, f"property {key!r} is not part of "
+                                   f"class {cls}")
+            vec = b.get("vector")
+            if vec is not None and not isinstance(vec, list):
+                raise ApiError(422, "vector must be a number array")
+            return 200, None
         elif len(seg) == 4 and seg[2] == "references":
             return self._references(method, seg[0], seg[1], seg[3], body,
                                     tenant)

@@ -61,6 +61,12 @@ class SchemaFSM:
             for name in op["tenants"]:
                 col.remove_tenant(name)
             self.db._persist(col)
+        elif t == "set_tenant_status":
+            try:
+                self.db.update_tenant_status(op["class"], op["tenants"])
+            except (KeyError, ValueError) as e:
+                # replay tolerance (tenant removed later in the log)
+                logger.warning("set_tenant_status skipped: %s", e)
         elif t == "update_sharding":
             # replica scale-out/in (usecases/scaler): every node applies
             # the same placement + factor; nodes that just became owners

@@ -138,6 +138,22 @@ class ClusterNode:
         self.raft.propose({"type": "add_property", "class": collection,
                            "prop": dataclasses.asdict(prop)})
 
+    def update_tenant_status(self, collection: str,
+                             tenants: list[dict]) -> None:
+        # validate BEFORE proposing: a garbage op would commit to the
+        # replicated log, fail on every node's apply, and re-fail on
+        # every replay — while the client saw a 200
+        col = self.db.get_collection(collection)
+        for t in tenants:
+            if t.get("name") not in col.sharding.shard_names:
+                raise KeyError(f"tenant {t.get('name')!r} does not exist")
+            if t.get("activityStatus", "HOT").upper() not in ("HOT",
+                                                              "COLD"):
+                raise ValueError("tenant activityStatus must be HOT or "
+                                 "COLD")
+        self.raft.propose({"type": "set_tenant_status",
+                           "class": collection, "tenants": tenants})
+
     def add_tenants(self, collection: str, tenants: list[str]) -> None:
         col = self.db.get_collection(collection)
         nodes = self.membership.alive_nodes()

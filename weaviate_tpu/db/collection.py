@@ -143,8 +143,9 @@ class Collection:
         self._on_sharding_change = on_sharding_change or (lambda col: None)
         self.shards: dict[str, Shard] = {}
         for name in self.sharding.shard_names:
-            if self.local_node in self.sharding.nodes_for(name):
-                self._load_shard(name)
+            if self.local_node in self.sharding.nodes_for(name) and \
+                    self.sharding.status_of(name) != "COLD":
+                self._load_shard(name)  # COLD tenants stay unloaded
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix=f"{config.name}-search")
         # hot/cold tenant tracking (reference: entities/tenantactivity +
@@ -191,7 +192,8 @@ class Collection:
                     ("nprobe", vc.index.ivf_nprobe),
                     ("threshold", vc.index.flat_to_ann_threshold),
                 ):
-                    if hasattr(idx, attr) and value:
+                    # 0 is meaningful (= auto); only skip absent values
+                    if hasattr(idx, attr) and value is not None:
                         setattr(idx, attr, value)
 
     # -- shard management ----------------------------------------------------
@@ -208,12 +210,24 @@ class Collection:
                     async_indexing=self.async_indexing)
             return self.shards[name]
 
+    def _require_active(self, tenant: str) -> None:
+        """COLD tenants reject access unless auto-activation is on
+        (reference: tenant activityStatus + autoTenantActivation)."""
+        if self.sharding.status_of(tenant) == "COLD":
+            if self.config.multi_tenancy.auto_tenant_activation:
+                self.set_tenant_status(tenant, "HOT")
+            else:
+                raise ValueError(
+                    f"tenant {tenant!r} is not active (activityStatus "
+                    "COLD); activate it or enable autoTenantActivation")
+
     def _check_tenant(self, tenant: str | None, kind: str = "read") -> None:
         if self.config.multi_tenancy.enabled:
             if not tenant:
                 raise ValueError("multi-tenant collection requires a tenant")
             if tenant not in self.sharding.shard_names:
                 raise KeyError(f"tenant {tenant!r} does not exist")
+            self._require_active(tenant)
             self._record_tenant(tenant, kind)
 
     def _ensure_tenant_shard(self, tenant: str | None) -> None:
@@ -221,6 +235,7 @@ class Collection:
             return
         with self._lock:
             if tenant in self.sharding.shard_names:
+                self._require_active(tenant)
                 self._record_tenant(tenant, "write")
                 return
             if not self.config.multi_tenancy.auto_tenant_creation:
@@ -270,6 +285,7 @@ class Collection:
                 raise ValueError("multi-tenant collection requires a tenant")
             if tenant not in self.sharding.shard_names:
                 raise KeyError(f"tenant {tenant!r} does not exist")
+            self._require_active(tenant)
             self._record_tenant(tenant, kind)
             return [tenant]
         return list(self.sharding.shard_names)
@@ -300,6 +316,25 @@ class Collection:
 
     def tenants(self) -> list[str]:
         return list(self.sharding.shard_names) if self.config.multi_tenancy.enabled else []
+
+    def set_tenant_status(self, tenant: str, status: str) -> None:
+        """HOT/COLD tenant offload (reference: PUT tenants with
+        activityStatus; COLD unloads the shard from memory/HBM, files
+        stay on disk; HOT loads it back — shard_lazyloader analog)."""
+        status = status.upper()
+        if status not in ("HOT", "COLD"):
+            raise ValueError("tenant activityStatus must be HOT or COLD")
+        if tenant not in self.sharding.shard_names:
+            raise KeyError(f"tenant {tenant!r} does not exist")
+        with self._lock:
+            self.sharding.tenant_status[tenant] = status
+            if status == "COLD":
+                shard = self.shards.pop(tenant, None)
+                if shard is not None:
+                    shard.close()
+            elif self._is_local(tenant):
+                self._load_shard(tenant)
+            self._on_sharding_change(self)
 
     # -- object CRUD ---------------------------------------------------------
 

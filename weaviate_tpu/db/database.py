@@ -231,6 +231,17 @@ class Database:
         with self._lock:
             self._persist(col)
 
+    def update_tenant_status(self, collection: str,
+                             tenants: list[dict]) -> None:
+        """[{name, activityStatus}] — HOT/COLD offload (reference: PUT
+        tenants)."""
+        col = self.get_collection(collection)
+        for t in tenants:
+            col.set_tenant_status(t["name"],
+                                  t.get("activityStatus", "HOT"))
+        with self._lock:
+            self._persist(col)
+
     def remove_tenants(self, collection: str, tenants: list[str]):
         col = self.get_collection(collection)
         for t in tenants:

@@ -27,6 +27,9 @@ class ShardingState:
     partitioning_enabled: bool = False  # multi-tenancy
     # node placement: shard name -> list of node names (replication)
     placement: dict[str, list[str]] = field(default_factory=dict)
+    # tenant activity status (reference: HOT/COLD tenant offload,
+    # models.TenantActivityStatus); absent = HOT
+    tenant_status: dict[str, str] = field(default_factory=dict)
 
     @classmethod
     def create(cls, shard_count: int, nodes: list[str] | None = None,
@@ -72,15 +75,20 @@ class ShardingState:
         if tenant in self.shard_names:
             self.shard_names.remove(tenant)
             self.placement.pop(tenant, None)
+            self.tenant_status.pop(tenant, None)
 
     def nodes_for(self, shard: str) -> list[str]:
         return self.placement.get(shard, ["node-0"])
+
+    def status_of(self, tenant: str) -> str:
+        return self.tenant_status.get(tenant, "HOT")
 
     def to_dict(self) -> dict:
         return {
             "shard_names": list(self.shard_names),
             "partitioning_enabled": self.partitioning_enabled,
             "placement": {k: list(v) for k, v in self.placement.items()},
+            "tenant_status": dict(self.tenant_status),
         }
 
     @classmethod
@@ -89,4 +97,5 @@ class ShardingState:
             shard_names=list(d.get("shard_names", [])),
             partitioning_enabled=d.get("partitioning_enabled", False),
             placement={k: list(v) for k, v in d.get("placement", {}).items()},
+            tenant_status=dict(d.get("tenant_status", {})),
         )
