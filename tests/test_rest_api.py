@@ -401,3 +401,15 @@ def test_update_class_runtime_knobs_reach_live_objects(client, server):
         "invertedIndexConfig": {"bm25": {"k1": 1.7, "b": 0.4}}})
     assert shard._inverted.k1 == 1.7
     assert shard._inverted.b == 0.4
+
+
+def test_nodes_verbose_shard_details(client):
+    client.create_class({"class": "NV", "properties": [
+        {"name": "n", "data_type": "int"}]})
+    client.create_object("NV", {"n": 1}, vector=[1.0])
+    out = client.request("GET", "/v1/nodes", params={"output": "verbose"})
+    node = out["nodes"][0]
+    assert "shards" in node
+    sh = [s for s in node["shards"] if s["class"] == "NV"]
+    assert sh and sh[0]["objectCount"] == 1
+    assert sh[0]["vectorIndexingStatus"] == "READY"

@@ -195,3 +195,75 @@ def test_flush_after_compaction_keeps_newest_wins(tmp_path):
     b.close()
     b2 = Bucket(str(tmp_path), "objects", "replace")
     assert b2.get(b"k") == "new"
+
+
+def test_corrupt_segment_quarantined_not_fatal(tmp_path):
+    """A truncated/bit-flipped segment must not brick the bucket on open
+    (reference: corrupt commit-log handling) — it is quarantined and the
+    rest of the data still serves."""
+    import os
+
+    from weaviate_tpu.storage.kv import KVStore
+
+    store = KVStore(str(tmp_path))
+    b = store.bucket("objs", "replace")
+    b.put(b"k1", {"v": 1})
+    b.flush()  # segment-0
+    b.put(b"k2", {"v": 2})
+    b.flush()  # segment-1
+    store.close()
+
+    seg_dir = tmp_path / "objs"
+    segs = sorted(f for f in os.listdir(seg_dir)
+                  if f.startswith("segment-") and f.endswith(".db"))
+    assert len(segs) >= 2
+    # truncate the first segment mid-file
+    victim = seg_dir / segs[0]
+    data = victim.read_bytes()
+    victim.write_bytes(data[: len(data) // 2])
+
+    store2 = KVStore(str(tmp_path))
+    b2 = store2.bucket("objs", "replace")
+    # surviving segment still serves; corrupt one is quarantined
+    assert b2.get(b"k2") == {"v": 2}
+    assert b2.get(b"k1") is None
+    assert any(f.endswith(".corrupt") for f in os.listdir(seg_dir))
+    # bucket remains writable
+    b2.put(b"k3", {"v": 3})
+    b2.flush()
+    assert b2.get(b"k3") == {"v": 3}
+    store2.close()
+
+
+def test_bitflipped_footer_offsets_quarantined(tmp_path):
+    """A footer that PARSES but points outside the record region must be
+    caught at open (quarantine), not crash every later read."""
+    import os
+    import struct
+
+    import msgpack
+
+    from weaviate_tpu.storage.kv import KVStore
+
+    store = KVStore(str(tmp_path))
+    b = store.bucket("objs", "replace")
+    b.put(b"k1", {"v": 1})
+    b.flush()
+    store.close()
+    seg_dir = tmp_path / "objs"
+    seg = next(f for f in os.listdir(seg_dir)
+               if f.startswith("segment-") and f.endswith(".db"))
+    path = seg_dir / seg
+    raw = path.read_bytes()
+    (foot_off,) = struct.unpack("<Q", raw[-8:])
+    footer = msgpack.unpackb(raw[foot_off:-8], raw=False)
+    footer["offs"] = [10**9]  # parseable, out of range
+    new_footer = msgpack.packb(footer, use_bin_type=True)
+    path.write_bytes(raw[:foot_off] + new_footer
+                     + struct.pack("<Q", foot_off))
+
+    store2 = KVStore(str(tmp_path))
+    b2 = store2.bucket("objs", "replace")
+    assert b2.get(b"k1") is None  # quarantined, not crashing
+    assert any(f.endswith(".corrupt") for f in os.listdir(seg_dir))
+    store2.close()

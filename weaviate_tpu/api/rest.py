@@ -368,7 +368,8 @@ class RestServer:
 
             return 200, {"text": registry.expose()}
         if seg == ["nodes"]:
-            return 200, {"nodes": self._nodes_payload()}
+            verbose = params.get("output") == "verbose"
+            return 200, {"nodes": self._nodes_payload(verbose=verbose)}
         if seg == ["cluster", "statistics"]:
             # Raft/cluster introspection (reference: /v1/cluster/statistics,
             # handlers for cluster statistics over the raft Store)
@@ -615,28 +616,58 @@ class RestServer:
             raise ApiError(422, str(e))
         raise KeyError("/v1/backups/" + "/".join(seg))
 
-    def _nodes_payload(self) -> list[dict]:
+    def _local_shard_details(self) -> list[dict]:
+        """Per-shard breakdown for ?output=verbose (reference:
+        nodes/handler.go verbose output with shard object counts)."""
+        out = []
+        for cname in self.db.list_collections():
+            col = self.db.get_collection(cname)
+            with col._lock:  # writers load shards concurrently
+                items = sorted(col.shards.items())
+            for sname, shard in items:
+                out.append({
+                    "name": sname, "class": cname,
+                    "objectCount": shard.object_count(),
+                    "vectorIndexingStatus": "READONLY"
+                    if shard.read_only else "READY",
+                    "vectorQueueLength": sum(
+                        q.size() for q in shard._index_queues.values()),
+                })
+        return out
+
+    def _nodes_payload(self, verbose: bool = False) -> list[dict]:
         if self.node is not None:
             infos = self.node.membership.nodes()
             # gossip states → the reference's node-status vocabulary
             # (entities/models.NodeStatus: HEALTHY/UNHEALTHY/UNAVAILABLE)
             status_map = {"alive": "HEALTHY", "suspect": "UNHEALTHY",
                           "dead": "UNAVAILABLE", "left": "UNAVAILABLE"}
-            return [{
+            nodes = [{
                 "name": i.name,
                 "status": status_map.get(i.status.lower(),
                                          i.status.upper()),
                 "version": VERSION,
                 "stats": i.meta,
             } for i in sorted(infos.values(), key=lambda x: x.name)]
+            if verbose:
+                # shard details are known for THIS node (remote breakdowns
+                # would need an extra RPC fan-out, as in the reference)
+                local = self._local_shard_details()
+                for n in nodes:
+                    if n["name"] == self.db.local_node:
+                        n["shards"] = local
+            return nodes
         shard_count = sum(len(c.shards) for c in self.db.collections.values())
         object_count = sum(
             s.object_count() for c in self.db.collections.values()
             for s in c.shards.values())
-        return [{"name": self.db.local_node, "status": "HEALTHY",
-                 "version": VERSION,
-                 "stats": {"shardCount": shard_count,
-                           "objectCount": object_count}}]
+        node = {"name": self.db.local_node, "status": "HEALTHY",
+                "version": VERSION,
+                "stats": {"shardCount": shard_count,
+                          "objectCount": object_count}}
+        if verbose:
+            node["shards"] = self._local_shard_details()
+        return [node]
 
     # -- /v1/schema -----------------------------------------------------------
 

@@ -130,15 +130,32 @@ class _Segment:
     def __init__(self, path: str):
         self.path = path
         with open(path, "rb") as f:
+            size = f.seek(0, os.SEEK_END)
+            if size < 8:
+                raise ValueError("segment shorter than its footer pointer")
             f.seek(-8, os.SEEK_END)
             (foot_off,) = struct.unpack("<Q", f.read(8))
-            f.seek(foot_off)
-            size = f.seek(0, os.SEEK_END)
+            if foot_off > size - 8:
+                raise ValueError("segment footer offset out of range")
             f.seek(foot_off)
             footer = msgpack.unpackb(f.read(size - 8 - foot_off), raw=False)
-        self.keys: list[bytes] = footer["keys"]
-        self.offs: list[int] = footer["offs"]
-        self.lens: list[int] = footer["lens"]
+        keys, offs, lens = (footer.get("keys"), footer.get("offs"),
+                            footer.get("lens")) if isinstance(footer, dict) \
+            else (None, None, None)
+        if not (isinstance(keys, list) and isinstance(offs, list)
+                and isinstance(lens, list)
+                and len(keys) == len(offs) == len(lens)):
+            raise ValueError("segment footer malformed")
+        # a bit-flipped footer can parse yet point outside the record
+        # region — catch it at open (quarantine) instead of crashing
+        # every later read that touches the segment
+        for off, ln in zip(offs, lens):
+            if not (isinstance(off, int) and isinstance(ln, int)
+                    and 0 <= off and 0 <= ln and off + ln <= foot_off):
+                raise ValueError("segment footer offsets out of range")
+        self.keys: list[bytes] = keys
+        self.offs: list[int] = offs
+        self.lens: list[int] = lens
 
     @classmethod
     def write(cls, path: str, items: list[tuple[bytes, bytes]]) -> "_Segment":
@@ -203,7 +220,29 @@ class Bucket:
             f for f in os.listdir(self.dir)
             if f.startswith("segment-") and f.endswith(".db")
         )
-        self._segments = [_Segment(os.path.join(self.dir, s)) for s in segs]
+        self._segments = []
+        for s in segs:
+            path = os.path.join(self.dir, s)
+            try:
+                self._segments.append(_Segment(path))
+            except (ValueError, struct.error, KeyError, TypeError,
+                    msgpack.exceptions.UnpackException) as e:
+                # parse-shaped failures only: a transient OSError (fd
+                # limit, momentary EACCES) must propagate — renaming a
+                # HEALTHY segment to .corrupt would silently lose it
+                # a truncated/bit-flipped segment must not brick the whole
+                # bucket (reference: corrupt_commit_logs_fixer.go skips
+                # unreadable tail entries) — quarantine it and continue;
+                # anti-entropy or reimport restores the lost range
+                import logging
+
+                logging.getLogger(__name__).error(
+                    "bucket %s: segment %s is corrupt (%s) — quarantined "
+                    "as .corrupt, its records are lost", self.name, s, e)
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:
+                    pass
         # monotonic segment sequence — never reuse or go below an existing
         # number, or newest-wins ordering breaks after compaction
         self._next_seq = (
