@@ -1,0 +1,148 @@
+"""End-to-end server benchmark: import + query through the real APIs.
+
+Reference: test/benchmark/benchmark_sift.go — imports a SIFT-shaped corpus
+through the batch API against a running server, then times nearVector
+queries and checks the results against brute force (import success rate
+and 10-NN correctness are the pass criteria, :34-57).
+
+Usage:
+    python tools/bench_e2e.py [--n 100000] [--dim 128] [--queries 200]
+                              [--url host:port]   # default: in-process
+
+Prints a JSON summary line. Unlike bench.py (kernel-level headline), this
+measures the full serving path: REST batch import -> gRPC Search.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=100_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--queries", type=int, default=200)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=500)
+    ap.add_argument(
+        "--url", default="",
+        help="REST address of a running server; requires --grpc-port")
+    ap.add_argument("--grpc-port", type=int, default=0)
+    args = ap.parse_args()
+    if args.url and not args.grpc_port:
+        ap.error("--url mode also needs --grpc-port (queries run over "
+                 "gRPC)")
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    corpus = rng.standard_normal((args.n, args.dim)).astype(np.float32)
+    queries = rng.standard_normal((args.queries, args.dim)).astype(np.float32)
+
+    server = None
+    if args.url:
+        rest_addr = args.url
+        grpc_port = args.grpc_port
+    else:
+        import tempfile
+
+        from weaviate_tpu.config import ServerConfig
+        from weaviate_tpu.server import Server
+
+        server = Server(ServerConfig(
+            data_path=tempfile.mkdtemp(prefix="bench-e2e-"),
+            rest_port=0, grpc_port=0, disable_telemetry=True)).start()
+        rest_addr = server.rest.address
+        grpc_port = server.grpc.port
+
+    from weaviate_tpu.api.client import Client
+
+    client = Client(rest_addr, timeout=300.0)
+    client.create_class({
+        "class": "Bench",
+        "vectorIndexType": "flat",
+        "vectorIndexConfig": {"distance": "l2-squared",
+                              "storage_dtype": "bfloat16"},
+        "properties": [{"name": "seq", "dataType": ["int"]}]})
+
+    # ---- import through REST batch (reference: batch import pass) --------
+    t0 = time.perf_counter()
+    ok = 0
+    for start in range(0, args.n, args.batch):
+        chunk = corpus[start:start + args.batch]
+        results = client.batch_objects([
+            {"class": "Bench", "properties": {"seq": start + i},
+             "vector": row.tolist()}
+            for i, row in enumerate(chunk)])
+        ok += sum(1 for r in results
+                  if r["result"]["status"] == "SUCCESS")
+    import_s = time.perf_counter() - t0
+    success_rate = ok / args.n
+    log(f"import: {args.n} objects in {import_s:.1f}s "
+        f"({args.n/import_s:.0f} obj/s), success {success_rate:.3%}")
+
+    # ---- query through gRPC (the latency-critical path) -------------------
+    import grpc as grpc_lib
+
+    from weaviate_tpu.api.grpc import v1_pb2 as pb
+    from weaviate_tpu.api.grpc.server import _SERVICE
+
+    chan = grpc_lib.insecure_channel(f"127.0.0.1:{grpc_port}")
+    search = chan.unary_unary(
+        f"/{_SERVICE}/Search",
+        request_serializer=pb.SearchRequest.SerializeToString,
+        response_deserializer=pb.SearchReply.FromString)
+
+    def query(vec):
+        req = pb.SearchRequest(collection="Bench", limit=args.k)
+        req.near_vector.vector.extend(vec.tolist())
+        return search(req)
+
+    query(queries[0])  # warm (compile)
+    lat = []
+    hits_by_query = []
+    for q in queries:
+        t0 = time.perf_counter()
+        reply = query(q)
+        lat.append(time.perf_counter() - t0)
+        hits_by_query.append([
+            int(r.properties.non_ref_props.fields["seq"].int_value)
+            for r in reply.results])
+    lat = np.asarray(lat)
+
+    # ---- correctness vs brute force (reference: nrSearchResults check) ----
+    qn = (queries ** 2).sum(-1)[:, None]
+    cn = (corpus ** 2).sum(-1)[None, :]
+    recall_n = 0
+    for i in range(args.queries):
+        d = qn[i] - 2 * queries[i] @ corpus.T + cn[0]
+        gt = set(np.argpartition(d, args.k)[: args.k].tolist())
+        recall_n += len(gt & set(hits_by_query[i]))
+    recall = recall_n / (args.queries * args.k)
+
+    print(json.dumps({
+        "metric": "e2e_server_knn",
+        "n": args.n, "dim": args.dim, "k": args.k,
+        "import_objects_per_s": round(args.n / import_s, 1),
+        "import_success_rate": round(success_rate, 4),
+        "query_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "query_p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 2),
+        "qps_single_stream": round(1.0 / float(np.median(lat)), 1),
+        "recall_at_k": round(recall, 4),
+    }), flush=True)
+
+    chan.close()
+    if server is not None:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -153,6 +153,15 @@ class _Segment:
             if not (isinstance(off, int) and isinstance(ln, int)
                     and 0 <= off and 0 <= ln and off + ln <= foot_off):
                 raise ValueError("segment footer offsets out of range")
+        # keys feed bisect on every read: non-bytes or out-of-order
+        # entries would crash or silently miss lookups later
+        prev = None
+        for k in keys:
+            if not isinstance(k, bytes):
+                raise ValueError("segment footer key is not bytes")
+            if prev is not None and k < prev:
+                raise ValueError("segment footer keys out of order")
+            prev = k
         self.keys: list[bytes] = keys
         self.offs: list[int] = offs
         self.lens: list[int] = lens
