@@ -124,3 +124,65 @@ def test_delete_propagates(cluster):
             return True
 
     _wait(gone)
+
+
+def test_cluster_wide_backup_restore(cluster, tmp_path_factory):
+    """Backup coordinates across owners: every node streams its shards to
+    the shared backend; restore routes files back and re-creates the
+    class through Raft (reference: backup coordinator 2-phase flow)."""
+    nodes, clients = cluster
+    backups = tmp_path_factory.mktemp("shared-backups")
+
+    # give every node a provider with the SHARED filesystem backend and
+    # re-serve REST with modules enabled (registers the transfer handlers)
+    from weaviate_tpu.api.client import Client
+    from weaviate_tpu.modules import Provider
+    from weaviate_tpu.modules.backup_backends import FilesystemBackend
+
+    mclients = []
+    for n in nodes:
+        p = Provider(n.db)
+        p.register(FilesystemBackend(), {"path": str(backups)})
+        n.rest.stop()
+        mclients.append(Client(n.serve_rest(modules=p).address))
+    c0, c1, c2 = mclients
+
+    c0.create_class({"class": "BK", "shardingConfig": {"desiredCount": 3},
+                     "properties": [{"name": "n", "dataType": ["int"]}]})
+    _wait(lambda: c2.get_class("BK"))
+    import numpy as np
+
+    rng = np.random.default_rng(4)
+    c1.batch_objects([{"class": "BK", "properties": {"n": i},
+                       "vector": rng.standard_normal(8).tolist()}
+                      for i in range(45)])
+    before = c2.graphql("{ Aggregate { BK { meta { count } } } }")
+    assert before["data"]["Aggregate"]["BK"][0]["meta"]["count"] == 45
+
+    # backup via node 0 (it fans out to the shard owners)
+    c0.request("POST", "/v1/backups/filesystem",
+               body={"id": "cb1", "include": ["BK"]})
+    st = _wait(lambda: (
+        lambda s: s if s["status"] in ("SUCCESS", "FAILED") else None
+    )(c0.request("GET", "/v1/backups/filesystem/cb1")), timeout=30)
+    assert st["status"] == "SUCCESS", st
+
+    c0.delete_class("BK")
+    _wait(lambda: "BK" not in [cl["name"] for cl in
+                               c1.get_schema()["classes"]])
+
+    c0.request("POST", "/v1/backups/filesystem/cb1/restore",
+               body={"include": ["BK"]})
+    st = _wait(lambda: (
+        lambda s: s if s["status"] in ("SUCCESS", "FAILED") else None
+    )(c0.request("GET", "/v1/backups/filesystem/cb1/restore")), timeout=30)
+    assert st["status"] == "SUCCESS", st
+
+    def count():
+        out = c2.graphql("{ Aggregate { BK { meta { count } } } }")
+        if "errors" in out:
+            return None
+        n = out["data"]["Aggregate"]["BK"][0]["meta"]["count"]
+        return n if n == 45 else None
+
+    assert _wait(count, timeout=20) == 45

@@ -240,8 +240,8 @@ class RestServer:
 
             self.backup_manager = BackupManager(
                 db, modules,
-                node_name=getattr(node, "name", None) or "node-0",
-                schema_target=self.schema_target)
+                node_name=getattr(node, "name", None) or db.local_node,
+                schema_target=self.schema_target, node=node)
         else:
             self.backup_manager = None
         self.classification_manager = None  # built lazily on first use

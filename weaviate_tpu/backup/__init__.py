@@ -53,14 +53,37 @@ class BackupManager:
     ClusterNode (Raft path), same seam the REST schema routes use."""
 
     def __init__(self, db, modules, node_name: str = "node-0",
-                 schema_target=None):
+                 schema_target=None, node=None):
         self.db = db
         self.modules = modules
         self.node_name = node_name
         self.schema_target = schema_target or db
+        # ClusterNode handle: when present and shards live on other nodes,
+        # the coordinator fans the transfer out over the internal
+        # transport (reference: backup coordinator over clusterapi)
+        self.node = node
         self._lock = threading.Lock()
         self._backups: dict[tuple[str, str], dict] = {}
         self._restores: dict[tuple[str, str], dict] = {}
+
+    # -- cluster fan-out helpers --------------------------------------------
+
+    def _owner_map(self, classes: list[str]) -> dict[str, dict[str, list[str]]]:
+        """node -> {class: [its shards]} (primary replica owns the copy)."""
+        owners: dict[str, dict[str, list[str]]] = {}
+        for cls in classes:
+            col = self.db.get_collection(cls)
+            for shard in col.sharding.shard_names:
+                primary = col.sharding.nodes_for(shard)[0]
+                owners.setdefault(primary, {}).setdefault(cls, []).append(
+                    shard)
+        return owners
+
+    def _rpc(self, node: str, path: str, payload: dict) -> dict:
+        from weaviate_tpu.cluster.transport import rpc
+
+        return rpc(self.node.membership.resolve(node), path, payload,
+                   timeout=600.0)
 
     # -- backup --------------------------------------------------------------
 
@@ -104,27 +127,72 @@ class BackupManager:
                     "version": "1",
                     "classes": [],
                 }
-                # pause background compaction/flush cycles for a consistent
-                # file set (reference: Shard.BeginBackup pauses compaction
-                # + commit-log switching, shard_backup.go)
-                with self.db.cycles.pause():
-                    self.db.flush()
+                owners = self._owner_map(classes)
+                cluster = self.node is not None and (
+                    set(owners) - {self.node_name})
+                if cluster:
+                    # fan the transfer out: every owning node streams ITS
+                    # shards to the shared backend (reference: coordinator
+                    # over clusterapi, coordinator.go:133)
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    from weaviate_tpu.backup.cluster import (
+                        backup_local_shards,
+                    )
+
+                    def one_owner(item):
+                        owner, class_shards = item
+                        if owner == self.node_name:
+                            return owner, backup_local_shards(
+                                self.db, self.modules, backend_name,
+                                backup_id, class_shards)
+                        reply = self._rpc(
+                            owner, "/backups/shards:backup",
+                            {"backend": backend_name, "id": backup_id,
+                             "class_shards": class_shards})
+                        return owner, reply["files"]
+
+                    # owners transfer concurrently — wall clock is the
+                    # slowest node, not the sum (reference coordinator
+                    # runs participants in parallel)
+                    with ThreadPoolExecutor(len(owners)) as pool:
+                        files_by_node = dict(
+                            pool.map(one_owner, owners.items()))
                     for cls in classes:
                         col = self.db.get_collection(cls)
-                        root = os.path.join(self.db.data_dir, cls)
-                        files = _walk_files(root) if os.path.isdir(root) \
-                            else []
-                        for rel in files:
-                            # streamed: multi-GB segment files never
-                            # materialize in memory
-                            backend.put_file(backup_id, f"{cls}/{rel}",
-                                             os.path.join(root, rel))
+                        per_node = {n: fl.get(cls, [])
+                                    for n, fl in files_by_node.items()
+                                    if fl.get(cls)}
                         descriptor["classes"].append({
                             "name": cls,
                             "config": col.config.to_dict(),
                             "sharding": col.sharding.to_dict(),
-                            "files": files,
+                            "files": [f for fl in per_node.values()
+                                      for f in fl],
+                            "files_by_node": per_node,
                         })
+                else:
+                    # single node: pause background compaction/flush cycles
+                    # for a consistent file set (reference: BeginBackup
+                    # pauses compaction + commit-log switching)
+                    with self.db.cycles.pause():
+                        self.db.flush()
+                        for cls in classes:
+                            col = self.db.get_collection(cls)
+                            root = os.path.join(self.db.data_dir, cls)
+                            files = _walk_files(root) \
+                                if os.path.isdir(root) else []
+                            for rel in files:
+                                # streamed: multi-GB segment files never
+                                # materialize in memory
+                                backend.put_file(backup_id, f"{cls}/{rel}",
+                                                 os.path.join(root, rel))
+                            descriptor["classes"].append({
+                                "name": cls,
+                                "config": col.config.to_dict(),
+                                "sharding": col.sharding.to_dict(),
+                                "files": files,
+                            })
                 status["status"] = TRANSFERRED
                 descriptor["completedAt"] = time.time()
                 backend.put(backup_id, DESCRIPTOR,
@@ -198,13 +266,66 @@ class BackupManager:
                         raise BackupError(
                             f"descriptor class name {cls!r} escapes the "
                             "data directory")
-                    for rel in entry["files"]:
-                        dst = os.path.abspath(os.path.join(root, rel))
-                        if not dst.startswith(root + os.sep):
+                    from weaviate_tpu.backup.cluster import (
+                        restore_local_files,
+                    )
+
+                    by_node = entry.get("files_by_node")
+                    if by_node and self.node is not None:
+                        # cluster restore: each original owner pulls ITS
+                        # shard files back before the class exists, so
+                        # the Raft add_class below loads them in place
+                        alive = set(
+                            self.node.membership.alive_nodes())
+                        missing = set(by_node) - alive
+                        if missing:
                             raise BackupError(
-                                f"descriptor file path {rel!r} escapes "
-                                "the class directory")
-                        backend.get_file(backup_id, f"{cls}/{rel}", dst)
+                                f"restore of {cls!r} needs nodes "
+                                f"{sorted(missing)} which are not in the "
+                                "cluster (reference: topology must cover "
+                                "the backup's owners)")
+
+                        def one_owner(item):
+                            owner, files = item
+                            # a follower may lag on the delete_class
+                            # entry: its handler refuses while the class
+                            # still exists locally — retry briefly
+                            last = None
+                            for _ in range(20):
+                                try:
+                                    if owner == self.node_name:
+                                        restore_local_files(
+                                            self.db, self.modules,
+                                            backend_name, backup_id,
+                                            {cls: files})
+                                    else:
+                                        self._rpc(
+                                            owner,
+                                            "/backups/shards:restore",
+                                            {"backend": backend_name,
+                                             "id": backup_id,
+                                             "class_files": {cls: files}})
+                                    return
+                                except Exception as e:
+                                    last = e
+                                    if "still exists" not in str(e):
+                                        raise
+                                    time.sleep(0.25)
+                            raise BackupError(
+                                f"restore on {owner!r} kept failing: "
+                                f"{last}")
+
+                        from concurrent.futures import ThreadPoolExecutor
+
+                        with ThreadPoolExecutor(len(by_node)) as pool:
+                            list(pool.map(one_owner, by_node.items()))
+                    else:
+                        try:
+                            restore_local_files(
+                                self.db, self.modules, backend_name,
+                                backup_id, {cls: entry["files"]})
+                        except ValueError as e:
+                            raise BackupError(str(e))
                     cfg = CollectionConfig.from_dict(entry["config"])
                     state = ShardingState.from_dict(entry["sharding"])
                     # through the schema seam so cluster nodes take the

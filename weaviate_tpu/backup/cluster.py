@@ -1,0 +1,88 @@
+"""Cluster-wide backup: per-node shard transfer handlers + helpers.
+
+Reference: usecases/backup/coordinator.go (:133 Backup, :199 Restore) runs
+a two-phase protocol over clusterapi (/backups/can-commit, /backups/commit,
+serve.go:45-48); each participant's backupper pauses compaction, lists its
+local shard files, and streams them to the shared module backend.
+
+Here the coordinator (backup/__init__.py BackupManager) asks every owning
+node to move ITS shards' files to/from the backend over the internal
+transport; the descriptor records which node produced which files so
+restore routes them back to the right owners.
+"""
+
+from __future__ import annotations
+
+import os
+
+from weaviate_tpu.modules.backup_backends import walk_files
+
+
+def backup_local_shards(db, modules, backend_name: str, backup_id: str,
+                        class_shards: dict[str, list[str]]) -> dict:
+    """Stream the given local shards' files to the backend. Returns
+    {cls: [relative paths from the class dir]} — the descriptor fragment
+    this node contributes."""
+    backend = modules.backup_backend(backend_name)
+    out: dict[str, list[str]] = {}
+    with db.cycles.pause():
+        # flushes every LOADED shard; COLD tenant shards were flushed at
+        # offload and are backed up straight from their files — loading
+        # them here would defeat the offload and leave them resident
+        db.flush()
+        for cls, shards in class_shards.items():
+            files: list[str] = []
+            for shard_name in shards:
+                sh_dir = os.path.join(db.data_dir, cls, shard_name)
+                if not os.path.isdir(sh_dir):
+                    continue  # shard never wrote anything
+                for rel in walk_files(sh_dir):
+                    rel_cls = os.path.join(shard_name, rel)
+                    backend.put_file(backup_id, f"{cls}/{rel_cls}",
+                                     os.path.join(sh_dir, rel))
+                    files.append(rel_cls)
+            out[cls] = files
+    return out
+
+
+def restore_local_files(db, modules, backend_name: str, backup_id: str,
+                        class_files: dict[str, list[str]]) -> None:
+    """Pull the given files from the backend into this node's data dir
+    (descriptor content is UNTRUSTED: paths must stay inside the class
+    directory)."""
+    backend = modules.backup_backend(backend_name)
+    data_root = os.path.abspath(db.data_dir)
+    for cls, files in class_files.items():
+        if cls in db.list_collections():
+            # a lagging delete_class Raft entry would rmtree the class
+            # dir AFTER these files land — silent shard loss. Refuse;
+            # the coordinator retries once the delete has applied here.
+            raise ValueError(
+                f"class {cls!r} still exists on this node (schema delete "
+                "not yet applied) — retry restore shortly")
+        root = os.path.abspath(os.path.join(db.data_dir, cls))
+        if os.path.dirname(root) != data_root:
+            raise ValueError(f"class name {cls!r} escapes the data dir")
+        for rel in files:
+            dst = os.path.abspath(os.path.join(root, rel))
+            if not dst.startswith(root + os.sep):
+                raise ValueError(f"file path {rel!r} escapes the class dir")
+            backend.get_file(backup_id, f"{cls}/{rel}", dst)
+
+
+def register_backup_handlers(server, db, get_modules) -> None:
+    """Mount the participant side on a node's internal transport
+    (reference: clusterapi /backups/* routes, serve.go:45-48)."""
+
+    def do_backup(payload: dict) -> dict:
+        return {"files": backup_local_shards(
+            db, get_modules(), payload["backend"], payload["id"],
+            payload["class_shards"]), "node": db.local_node}
+
+    def do_restore(payload: dict) -> dict:
+        restore_local_files(db, get_modules(), payload["backend"],
+                            payload["id"], payload["class_files"])
+        return {"ok": True, "node": db.local_node}
+
+    server.route("/backups/shards:backup", do_backup)
+    server.route("/backups/shards:restore", do_restore)

@@ -87,6 +87,12 @@ class ClusterNode:
         surface as standalone ones."""
         from weaviate_tpu.api.rest import RestServer
 
+        if modules is not None:
+            # participant side of cluster-wide backups (reference:
+            # clusterapi /backups/* routes on the internal port)
+            from weaviate_tpu.backup.cluster import register_backup_handlers
+
+            register_backup_handlers(self.server, self.db, lambda: modules)
         self.rest = RestServer(self.db, host=host, port=port,
                                schema_target=self, node=self,
                                modules=modules, auth=auth)
