@@ -649,22 +649,31 @@ class RestServer:
                 "version": VERSION,
                 "stats": i.meta,
             } for i in sorted(infos.values(), key=lambda x: x.name)]
-            if verbose:
-                # shard details are known for THIS node (remote breakdowns
-                # would need an extra RPC fan-out, as in the reference)
-                local = self._local_shard_details()
-                for n in nodes:
-                    if n["name"] == self.db.local_node:
-                        n["shards"] = local
+            from weaviate_tpu.runtime.memwatch import (
+                device_memory_stats,
+            )
+
+            for n in nodes:
+                if n["name"] == self.db.local_node:
+                    n["stats"] = {**(n.get("stats") or {}),
+                                  "deviceMemory": device_memory_stats()}
+                    if verbose:
+                        # shard details are known for THIS node (remote
+                        # breakdowns would need an RPC fan-out, as in the
+                        # reference)
+                        n["shards"] = self._local_shard_details()
             return nodes
         shard_count = sum(len(c.shards) for c in self.db.collections.values())
         object_count = sum(
             s.object_count() for c in self.db.collections.values()
             for s in c.shards.values())
+        from weaviate_tpu.runtime.memwatch import device_memory_stats
+
         node = {"name": self.db.local_node, "status": "HEALTHY",
                 "version": VERSION,
                 "stats": {"shardCount": shard_count,
-                          "objectCount": object_count}}
+                          "objectCount": object_count,
+                          "deviceMemory": device_memory_stats()}}
         if verbose:
             node["shards"] = self._local_shard_details()
         return [node]

@@ -58,6 +58,7 @@ class ServerConfig:
     # observability
     prometheus_enabled: bool = False
     prometheus_port: int = 2112
+    profiling_port: int = 0  # 0 = profiler server off (PROFILING_PORT)
     log_level: str = "info"
     log_format: str = "text"
     disable_telemetry: bool = False
@@ -88,6 +89,7 @@ class ServerConfig:
             auto_schema_enabled=_flag(env, "AUTOSCHEMA_ENABLED", True),
             prometheus_enabled=_flag(env, "PROMETHEUS_MONITORING_ENABLED"),
             prometheus_port=_int(env, "PROMETHEUS_MONITORING_PORT", 2112),
+            profiling_port=_int(env, "PROFILING_PORT", 0),
             log_level=env.get("LOG_LEVEL", "info"),
             log_format=env.get("LOG_FORMAT", "text"),
             disable_telemetry=_flag(env, "DISABLE_TELEMETRY"),

@@ -91,3 +91,36 @@ class MemoryMonitor:
     @property
     def tracked_host(self) -> int:
         return self._tracked_host
+
+
+_DEVICE_STATS_UNAVAILABLE = False
+
+
+def device_memory_stats() -> dict:
+    """Per-device HBM usage (the GOMEMLIMIT analog for device memory).
+
+    Returns {} when the backend does not expose allocator stats (e.g.
+    CPU mesh, or a remote-tunnel device). Unavailability is cached so a
+    polled status endpoint doesn't re-probe (the first probe may pay
+    full JAX backend init)."""
+    global _DEVICE_STATS_UNAVAILABLE
+    if _DEVICE_STATS_UNAVAILABLE:
+        return {}
+    try:
+        import jax
+
+        out = {}
+        for i, dev in enumerate(jax.devices()):
+            stats = dev.memory_stats()
+            if stats:
+                out[f"{dev.platform}:{i}"] = {
+                    "bytesInUse": stats.get("bytes_in_use"),
+                    "bytesLimit": stats.get("bytes_limit"),
+                    "peakBytesInUse": stats.get("peak_bytes_in_use"),
+                }
+        if not out:
+            _DEVICE_STATS_UNAVAILABLE = True
+        return out
+    except Exception:
+        _DEVICE_STATS_UNAVAILABLE = True
+        return {}

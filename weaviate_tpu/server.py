@@ -93,6 +93,19 @@ class Server:
         self.grpc = GrpcServer(self.db, host=cfg.host, port=cfg.grpc_port,
                                modules=modules, auth=auth).start()
 
+        if cfg.profiling_port:
+            # reference: setupGoProfiling serves pprof on PROFILING_PORT
+            # (configure_api.go:1094); the JAX profiler server is the TPU
+            # analog — point TensorBoard/xprof at it for device traces
+            try:
+                import jax
+
+                jax.profiler.start_server(cfg.profiling_port)
+                logger.info("JAX profiler server on :%s",
+                            cfg.profiling_port)
+            except Exception as e:
+                logger.warning("profiler server failed to start: %s", e)
+
         if cfg.prometheus_enabled:
             from weaviate_tpu.runtime.metrics import serve_metrics
 
