@@ -176,3 +176,43 @@ def test_tenant_activity_rest(tmp_path):
     finally:
         srv.stop()
         db.close()
+
+
+def test_async_search_is_read_your_writes(tmp_path):
+    """With async indexing on, a search must see queued (not-yet-indexed)
+    vectors (reference: the index queue's brute-force search over the
+    unindexed tail). The worker is DISABLED so the merge path is pinned —
+    with it running, a fast drain would hide a broken merge."""
+    from weaviate_tpu.runtime.index_queue import IndexQueue
+
+    db = Database(str(tmp_path))
+    try:
+        db.create_collection(config_from_json({
+            "class": "RW", "properties": [{"name": "n", "dataType": ["int"]}]}))
+        col = db.get_collection("RW")
+        shard = col._load_shard("shard-0")
+        shard.async_indexing = True
+        rng = np.random.default_rng(0)
+        vecs = rng.standard_normal((30, 8)).astype(np.float32)
+        uids = [col.put_object({"n": 0}, vector=vecs[0])]
+        # replace the auto-started queue with a worker-less one and
+        # re-push: everything stays queued until we say so
+        idx = shard.vector_indexes[""]
+        pinned = IndexQueue(idx, start_worker=False)
+        shard._index_queues[""].stop()
+        shard._index_queues[""] = pinned
+        for i in range(1, 30):
+            uids.append(col.put_object({"n": i}, vector=vecs[i]))
+        assert pinned.size() > 0  # genuinely unindexed
+        res = col.near_vector(vecs[11], k=1)
+        assert res[0].uuid == uids[11]
+        # delete before drain: must not surface
+        col.delete_object(uids[11])
+        res2 = col.near_vector(vecs[11], k=1)
+        assert res2[0].uuid != uids[11]
+        # drain and verify again through the index path
+        pinned.drain()
+        res3 = col.near_vector(vecs[12], k=1)
+        assert res3[0].uuid == uids[12]
+    finally:
+        db.close()

@@ -1,0 +1,138 @@
+"""Bounded mixed-workload soak: concurrent imports, searches, deletes,
+schema reads, and a backup against one live server — no 500s allowed,
+and the final state must be consistent.
+
+Reference pattern: test/acceptance/stress_tests + `go test -race`
+discipline (SURVEY §4/§5): races here surface as 500s, lost writes, or
+crashed worker threads.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.client import Client, RestError
+from weaviate_tpu.api.rest import RestServer
+from weaviate_tpu.db.database import Database
+from weaviate_tpu.modules import Provider
+from weaviate_tpu.modules.backup_backends import FilesystemBackend
+
+
+def test_mixed_workload_soak(tmp_path):
+    db = Database(str(tmp_path / "data"))
+    provider = Provider(db)
+    provider.register(FilesystemBackend(),
+                      {"path": str(tmp_path / "backups")})
+    srv = RestServer(db, modules=provider)
+    srv.start()
+    errors: list[str] = []
+    stop = threading.Event()
+    try:
+        _run_soak(srv, errors, stop)
+    finally:
+        stop.set()
+        srv.stop()
+        db.close()
+
+
+def _run_soak(srv, errors, stop):
+    c0 = Client(srv.address)
+    c0.create_class({"class": "Soak", "properties": [
+        {"name": "n", "dataType": ["int"]},
+        {"name": "tag", "dataType": ["text"]}]})
+
+    N_WRITERS, PER_WRITER = 4, 120
+    written: list[list[str]] = [[] for _ in range(N_WRITERS)]
+    deleted: list[set] = [set() for _ in range(N_WRITERS)]
+
+    def writer(wid: int):
+        c = Client(srv.address)
+        rng = np.random.default_rng(wid)
+        try:
+            for i in range(0, PER_WRITER, 20):
+                results = c.batch_objects([
+                    {"class": "Soak",
+                     "properties": {"n": wid * 10_000 + i + j,
+                                    "tag": f"w{wid}"},
+                     "vector": rng.standard_normal(16).tolist()}
+                    for j in range(20)])
+                for r in results:
+                    if r["result"]["status"] != "SUCCESS":
+                        errors.append(f"writer {wid}: {r}")
+                    else:
+                        written[wid].append(r["id"])
+                # delete a few of our own
+                if len(written[wid]) > 30 and i % 40 == 0:
+                    victim = written[wid][5]
+                    if victim not in deleted[wid]:
+                        try:
+                            c.delete_object("Soak", victim)
+                            deleted[wid].add(victim)
+                        except RestError as e:
+                            if e.status != 404:
+                                errors.append(f"delete {e.status}")
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"writer {wid}: {e!r}")
+
+    def searcher():
+        c = Client(srv.address)
+        rng = np.random.default_rng(99)
+        try:
+            while not stop.is_set():
+                q = rng.standard_normal(16).tolist()
+                out = c.graphql("""
+                query Q($v: [Float]) {
+                  Get { Soak(limit: 5, nearVector: {vector: $v}) {
+                    n _additional { id distance } } }
+                }""", {"v": q})
+                if "errors" in out and out["errors"]:
+                    errors.append(f"search: {out['errors']}")
+                c.graphql('{ Aggregate { Soak { meta { count } } } }')
+                c.request("GET", "/v1/nodes",
+                          params={"output": "verbose"})
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"searcher: {e!r}")
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(N_WRITERS)]
+    threads += [threading.Thread(target=searcher) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads[:N_WRITERS]:
+        t.join(90)
+        assert not t.is_alive(), "writer did not finish within 90s"
+
+
+    # a backup while searches still run
+    c0.request("POST", "/v1/backups/filesystem", body={"id": "soak"})
+    import time
+
+    for _ in range(200):
+        st = c0.request("GET", "/v1/backups/filesystem/soak")
+        if st["status"] in ("SUCCESS", "FAILED"):
+            break
+        time.sleep(0.05)
+    assert st["status"] == "SUCCESS", st
+
+    stop.set()
+    for t in threads[N_WRITERS:]:
+        t.join(30)
+
+    assert not errors, errors[:10]
+    expected = sum(len(w) for w in written) - sum(len(d) for d in deleted)
+    out = c0.graphql('{ Aggregate { Soak { meta { count } } } }')
+    assert out["data"]["Aggregate"]["Soak"][0]["meta"]["count"] == expected
+
+    # every non-deleted uuid is retrievable
+    rng = np.random.default_rng(1)
+    for wid in range(N_WRITERS):
+        sample = rng.choice(len(written[wid]), size=5, replace=False)
+        for idx in sample:
+            uid = written[wid][idx]
+            if uid in deleted[wid]:
+                continue
+            got = c0.get_object("Soak", uid)
+            assert got["properties"]["tag"] == f"w{wid}"
+
+

@@ -358,11 +358,84 @@ class Shard:
     def vector_search(self, query: np.ndarray, k: int, vec_name: str = "",
                       allow_list: np.ndarray | None = None):
         """(doc_ids, dists) for the shard-local search (reference:
-        shard_read.go ObjectVectorSearch)."""
+        shard_read.go ObjectVectorSearch). With async indexing on, queued
+        (not-yet-indexed) vectors are brute-forced and merged so the path
+        stays read-your-writes (reference: index queue search over the
+        unindexed tail)."""
         idx = self.vector_indexes.get(vec_name)
         if idx is None:
             return np.empty(0, np.int64), np.empty(0, np.float32)
-        return idx.search_by_vector(query, k, allow_list=allow_list)
+        # snapshot BEFORE the index search: every queued vector is either
+        # in the snapshot or already drained into the index by the time
+        # the index search runs — the union misses nothing (the reverse
+        # order races a drain finishing between the two reads)
+        queued = self._queued_candidates(vec_name, query, allow_list)
+        ids, dists = idx.search_by_vector(query, k, allow_list=allow_list)
+        if queued is None:
+            return ids, dists
+        q_ids, q_dists = queued
+        cat_ids = np.concatenate([np.asarray(ids, np.int64), q_ids])
+        cat_d = np.concatenate([np.asarray(dists, np.float32), q_dists])
+        order = np.argsort(cat_d, kind="stable")
+        # dedup (a drain may have landed an in-flight vector in the index
+        # between the index search and the snapshot), best distance first
+        seen: set = set()
+        out_ids, out_d = [], []
+        for j in order:
+            did = int(cat_ids[j])
+            if did in seen:
+                continue
+            seen.add(did)
+            out_ids.append(did)
+            out_d.append(float(cat_d[j]))
+            if len(out_ids) == k:
+                break
+        return (np.asarray(out_ids, np.int64),
+                np.asarray(out_d, np.float32))
+
+    def _queued_candidates(self, vec_name: str, query: np.ndarray,
+                           allow_list: np.ndarray | None):
+        queue = self._index_queues.get(vec_name)
+        if queue is None:
+            return None
+        pending = queue.snapshot()
+        if not pending:
+            return None
+        ids = np.asarray([d for d, _ in pending], dtype=np.int64)
+        vecs = np.stack([v for _, v in pending]).astype(np.float32)
+        if allow_list is not None:
+            allow = np.asarray(allow_list)
+            if allow.dtype == np.bool_:
+                keep = (ids < len(allow)) & allow[
+                    np.clip(ids, 0, len(allow) - 1)]
+            else:
+                keep = np.isin(ids, allow.astype(np.int64))
+            ids, vecs = ids[keep], vecs[keep]
+            if not len(ids):
+                return None
+        metric = getattr(self.vector_indexes.get(vec_name), "metric",
+                         "l2-squared")
+        # plain numpy: the pending set's length changes every drain tick,
+        # and a jitted path would recompile per distinct length (the
+        # device store pads to buckets for exactly this reason) — the
+        # queue is small, host BLAS is plenty
+        q = np.asarray(query, np.float32)
+        if metric in ("cosine", "cosine-dot"):
+            def unit(a):
+                n = np.linalg.norm(a, axis=-1, keepdims=True)
+                return a / np.where(n > 1e-30, n, 1.0)
+
+            d = 1.0 - unit(vecs) @ unit(q[None, :])[0]
+        elif metric == "dot":
+            d = -(vecs @ q)
+        elif metric == "hamming":
+            d = (vecs != q[None, :]).sum(axis=1).astype(np.float32)
+        elif metric == "manhattan":
+            d = np.abs(vecs - q[None, :]).sum(axis=1)
+        else:  # l2-squared
+            diff = vecs - q[None, :]
+            d = np.einsum("nd,nd->n", diff, diff)
+        return ids, d.astype(np.float32)
 
     def bm25_search(self, query: str, k: int = 10,
                     properties: list[str] | None = None,

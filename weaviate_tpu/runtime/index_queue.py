@@ -43,6 +43,9 @@ class IndexQueue:
         # would let one finishing drain clear tombstones out from under
         # the other's in-flight batch
         self._in_flight = 0
+        # the actual items of in-flight batches, still searchable via
+        # snapshot() until the index visibly holds them
+        self._in_flight_items: list = []
         self._thread = None
         if start_worker:
             self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -71,7 +74,18 @@ class IndexQueue:
 
     def size(self) -> int:
         with self._lock:
-            return len(self._pending)
+            return len(self._pending) + len(self._in_flight_items)
+
+    def snapshot(self) -> list:
+        """(doc_id, vector) pairs not yet visible in the index — pending
+        plus the in-flight drain batch, minus tombstoned ids. Searches
+        brute-force these so async indexing stays read-your-writes
+        (reference: index queue search over unindexed vectors)."""
+        with self._lock:
+            dead = self._deleted
+            return [(d, v) for d, v in
+                    list(self._pending) + self._in_flight_items
+                    if d not in dead]
 
     @property
     def flushed(self) -> int:
@@ -99,12 +113,15 @@ class IndexQueue:
                                         len(self._pending)))]
             dead = set(self._deleted)
             self._in_flight += 1
+            self._in_flight_items.extend(batch)
+        applied = False
         try:
             live = [(d, v) for d, v in batch if d not in dead]
             if live:
                 ids = np.asarray([d for d, _ in live], dtype=np.int64)
                 vecs = np.stack([v for _, v in live])
                 self.index.add_batch(ids, vecs)
+            applied = True
             with self._lock:
                 self._flushed += len(live)
             # a delete may have raced the add_batch above: its idx.delete
@@ -117,9 +134,22 @@ class IndexQueue:
         finally:
             with self._lock:
                 self._in_flight -= 1
+                batch_ids = {d for d, _ in batch}
+                self._in_flight_items = [
+                    (d, v) for d, v in self._in_flight_items
+                    if d not in batch_ids]
+                if not applied:
+                    # add_batch failed (device OOM etc.): the batch was
+                    # already popped — requeue it or the acknowledged
+                    # vectors silently vanish from index AND snapshot
+                    self._pending.extendleft(reversed(batch))
+                    self._idle.clear()
                 if not self._pending and not self._in_flight:
                     self._deleted.clear()
                     self._idle.set()
+        # on add_batch failure the exception propagates (ending this drain
+        # round — no hot retry loop); the worker's next wake tick retries
+        # the requeued batch
         return True
 
     def wait_idle(self, timeout: float | None = None) -> bool:
