@@ -146,6 +146,53 @@ def main():
         "baseline_cpu_qps": round(cpu_qps, 1),
     }), flush=True)
 
+    # --- diagnostics: compressed scans (stderr only; the headline JSON
+    # above is already emitted, so a hang here can't cost the result) ----
+    if os.environ.get("BENCH_EXTRA", "1") != "0":
+        # NOTE: i.i.d. gaussian data is adversarial for quantization (no
+        # cluster structure, concentrated distances) — candidate recall
+        # here is a floor, not what SIFT/real embeddings give. The win of
+        # compressed scans is CAPACITY (32x more vectors per HBM byte),
+        # not speed at 1M scale.
+        try:
+            from weaviate_tpu.ops import bq as bq_ops
+            from weaviate_tpu.ops import pq as pq_ops
+
+            def time_and_recall(topk_fn, label):
+                d_, i_ = topk_fn()
+                jax.block_until_ready((d_, i_))  # warm/compile
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    d_, i_ = topk_fn()
+                    jax.block_until_ready((d_, i_))
+                    ts.append(time.perf_counter() - t0)
+                cand = np.asarray(i_)[:, :100]
+                rec = np.mean([
+                    len(set(cand[r]) & set(gt_i[r])) / k
+                    for r in range(batch)])
+                med = float(np.median(ts))
+                log(f"[extra] {label}: {med*1e3:.1f} ms/batch -> "
+                    f"{batch/med:.0f} QPS, candidate recall@{k} "
+                    f"{rec:.3f} (pre-rescore)")
+
+            xw = bq_ops.bq_encode(jnp.asarray(padded, dtype=jnp.float32))
+            qw = bq_ops.bq_encode(q0)
+            time_and_recall(
+                lambda: bq_ops.bq_topk(qw, xw, k=100, chunk_size=chunk,
+                                       valid=valid),
+                "BQ scan (32x compressed, top-100 candidates)")
+
+            book = pq_ops.pq_fit(corpus[:100_000], m=16, k=256, iters=5)
+            codes = pq_ops.pq_encode(book, padded)
+            time_and_recall(
+                lambda: pq_ops.pq_topk(q0, codes, book.centroids, k=100,
+                                       chunk_size=chunk,
+                                       metric="l2-squared", valid=valid),
+                "PQ m=16 scan (32x compressed, top-100)")
+        except Exception as e:  # diagnostics only
+            log(f"[extra] compressed-scan diagnostics failed: {e}")
+
 
 if __name__ == "__main__":
     main()
