@@ -16,22 +16,30 @@ from weaviate_tpu.api.client import Client
 from weaviate_tpu.cluster.node import ClusterNode
 
 
+def _boot_cluster(tmp, names, **node_kwargs):
+    """Start N in-process nodes and wait for gossip + a Raft leader;
+    raises on non-convergence instead of proceeding silently."""
+    nodes = [ClusterNode(n, str(tmp / n), raft_peers=names, **node_kwargs)
+             for n in names]
+    for node in nodes:
+        node.start(seed_addrs=None if node is nodes[0]
+                   else [nodes[0].address])
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if all(len(n.membership.alive_nodes()) == len(names)
+               for n in nodes) and \
+                any(n.raft.role == "leader" for n in nodes):
+            return nodes
+        time.sleep(0.05)
+    for n in nodes:
+        n.close()
+    raise AssertionError("cluster did not converge (gossip/leader)")
+
+
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("acceptance")
-    names = ["n0", "n1", "n2"]
-    nodes = [ClusterNode(n, str(tmp / n), raft_peers=names)
-             for n in names]
-    seeds = [nodes[0].address]
-    for node in nodes:
-        node.start(seed_addrs=None if node is nodes[0] else seeds)
-    # wait for gossip + a raft leader
-    deadline = time.time() + 15
-    while time.time() < deadline:
-        if all(len(n.membership.alive_nodes()) == 3 for n in nodes) and \
-                any(n.raft.role == "leader" for n in nodes):
-            break
-        time.sleep(0.05)
+    nodes = _boot_cluster(tmp, ["n0", "n1", "n2"])
     clients = [Client(n.serve_rest().address) for n in nodes]
     yield nodes, clients
     for n in nodes:
@@ -186,3 +194,74 @@ def test_cluster_wide_backup_restore(cluster, tmp_path_factory):
         return n if n == 45 else None
 
     assert _wait(count, timeout=20) == 45
+
+
+def test_node_failure_detection_and_quorum(tmp_path_factory):
+    """Kill one node of three: gossip marks it dead, the survivors keep
+    serving, QUORUM writes to a replicated class still succeed, and
+    Raft re-elects so schema writes keep working (reference: memberlist
+    NotifyLeave + consistency levels + leader re-election)."""
+    tmp = tmp_path_factory.mktemp("failure")
+    nodes = _boot_cluster(tmp, ["f0", "f1", "f2"], gossip_interval=0.15)
+    victim_name = None
+    try:
+        clients = [Client(n.serve_rest().address) for n in nodes]
+        c0, c1, c2 = clients
+        c0.create_class({"class": "HA",
+                         "shardingConfig": {"desiredCount": 2},
+                         "replicationConfig": {"factor": 3},
+                         "properties": [{"name": "n",
+                                         "dataType": ["int"]}]})
+        _wait(lambda: c2.get_class("HA"))
+        rng = np.random.default_rng(0)
+        res = c0.batch_objects([
+            {"class": "HA", "properties": {"n": i},
+             "vector": rng.standard_normal(8).tolist()}
+            for i in range(20)])
+        assert all(r["result"]["status"] == "SUCCESS" for r in res)
+
+        # kill a NON-leader, NON-coordinator node
+        leader = next(n for n in nodes if n.raft.role == "leader")
+        victim = next(n for n in nodes
+                      if n is not leader and n is not nodes[0])
+        victim_name = victim.name
+        victim.close()
+
+        # survivors notice the death
+        survivors = [n for n in nodes if n.name != victim_name]
+        _wait(lambda: all(victim_name not in s.membership.alive_nodes()
+                          for s in survivors), timeout=20)
+        _wait(lambda: any(
+            n["name"] == victim_name and n["status"] != "HEALTHY"
+            for n in clients[0].nodes()), timeout=20)
+
+        # QUORUM writes (2 of 3) still succeed with one replica down
+        res = c0.batch_objects([
+            {"class": "HA", "properties": {"n": 100 + i},
+             "vector": rng.standard_normal(8).tolist()}
+            for i in range(10)])
+        assert all(r["result"]["status"] == "SUCCESS" for r in res), res
+
+        # reads through a survivor see all live data
+        def full_count():
+            o = c0.graphql("{ Aggregate { HA { meta { count } } } }")
+            if "errors" in o:
+                return None
+            n = o["data"]["Aggregate"]["HA"][0]["meta"]["count"]
+            return o if n == 30 else None
+
+        _wait(full_count, timeout=20)
+
+        # schema writes still work (raft majority of 2 holds; leader
+        # re-election covered when the victim WAS about to lead)
+        c0.create_class({"class": "PostFailure", "properties": [
+            {"name": "x", "dataType": ["text"]}]})
+        _wait(lambda: "PostFailure" in [
+            c["name"] for c in c0.get_schema()["classes"]])
+    finally:
+        for n in nodes:
+            if n.name != victim_name:
+                try:
+                    n.close()
+                except Exception:
+                    pass
