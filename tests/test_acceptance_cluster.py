@@ -172,7 +172,7 @@ def test_cluster_wide_backup_restore(cluster, tmp_path_factory):
                body={"id": "cb1", "include": ["BK"]})
     st = _wait(lambda: (
         lambda s: s if s["status"] in ("SUCCESS", "FAILED") else None
-    )(c0.request("GET", "/v1/backups/filesystem/cb1")), timeout=30)
+    )(c0.request("GET", "/v1/backups/filesystem/cb1")), timeout=60)
     assert st["status"] == "SUCCESS", st
 
     c0.delete_class("BK")
@@ -183,7 +183,7 @@ def test_cluster_wide_backup_restore(cluster, tmp_path_factory):
                body={"include": ["BK"]})
     st = _wait(lambda: (
         lambda s: s if s["status"] in ("SUCCESS", "FAILED") else None
-    )(c0.request("GET", "/v1/backups/filesystem/cb1/restore")), timeout=30)
+    )(c0.request("GET", "/v1/backups/filesystem/cb1/restore")), timeout=60)
     assert st["status"] == "SUCCESS", st
 
     def count():
@@ -193,7 +193,7 @@ def test_cluster_wide_backup_restore(cluster, tmp_path_factory):
         n = out["data"]["Aggregate"]["BK"][0]["meta"]["count"]
         return n if n == 45 else None
 
-    assert _wait(count, timeout=20) == 45
+    assert _wait(count, timeout=40) == 45
 
 
 def test_node_failure_detection_and_quorum(tmp_path_factory):

@@ -413,3 +413,26 @@ def test_nodes_verbose_shard_details(client):
     sh = [s for s in node["shards"] if s["class"] == "NV"]
     assert sh and sh[0]["objectCount"] == 1
     assert sh[0]["vectorIndexingStatus"] == "READY"
+
+
+def test_legacy_classless_object_routes(client):
+    client.create_class({"class": "LG", "properties": [
+        {"name": "t", "data_type": "text"}]})
+    uid = client.create_object("LG", {"t": "x"}, vector=[1.0])["id"]
+    # deprecated GET /v1/objects/{id} (no class) still resolves
+    got = client.request("GET", f"/v1/objects/{uid}")
+    assert got["class"] == "LG" and got["id"] == uid
+    client.request("DELETE", f"/v1/objects/{uid}")
+    from weaviate_tpu.api.client import RestError
+    with pytest.raises(RestError) as e:
+        client.request("GET", f"/v1/objects/{uid}")
+    assert e.value.status == 404
+
+
+def test_legacy_classless_patch(client):
+    client.create_class({"class": "LP", "properties": [
+        {"name": "t", "data_type": "text"}]})
+    uid = client.create_object("LP", {"t": "x"}, vector=[1.0])["id"]
+    out = client.request("PATCH", f"/v1/objects/{uid}",
+                         body={"properties": {"extra": "y"}})
+    assert out["properties"] == {"t": "x", "extra": "y"}

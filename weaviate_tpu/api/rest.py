@@ -805,6 +805,27 @@ class RestServer:
                 return self._list_objects(params)
             if method == "POST":
                 return self._put_object(body or {}, tenant)
+        elif len(seg) == 1 and seg[0] != "validate":
+            # deprecated class-less route (reference: /v1/objects/{id}
+            # scans classes; kept for old clients)
+            uuid = seg[0]
+            consistency = params.get("consistency_level")
+            for cname in self.db.list_collections():
+                col = self.db.get_collection(cname)
+                if col.config.multi_tenancy.enabled:
+                    continue  # tenant-less lookup cannot address MT data
+                try:
+                    obj = col.get_object(uuid, consistency=consistency)
+                except Exception:
+                    # one unhealthy, unrelated class must not break the
+                    # scan for an object living elsewhere
+                    continue
+                if obj is None:
+                    continue
+                # resolve the class, delegate to the modern class-scoped
+                # handler so consistency/result semantics stay identical
+                return self._objects(method, [cname, uuid], params, body)
+            raise ApiError(404, f"object {uuid} not found in any class")
         elif seg == ["validate"] and method == "POST":
             # dry-run validation (reference: POST /v1/objects/validate)
             b = dict(body or {})

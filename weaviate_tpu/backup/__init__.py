@@ -291,7 +291,8 @@ class BackupManager:
                             # entry: its handler refuses while the class
                             # still exists locally — retry briefly
                             last = None
-                            for _ in range(20):
+                            for _ in range(60):  # 15s: schema deletes
+                                # can lag under load
                                 try:
                                     if owner == self.node_name:
                                         restore_local_files(

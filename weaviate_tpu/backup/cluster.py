@@ -53,10 +53,15 @@ def restore_local_files(db, modules, backend_name: str, backup_id: str,
     backend = modules.backup_backend(backend_name)
     data_root = os.path.abspath(db.data_dir)
     for cls, files in class_files.items():
-        if cls in db.list_collections():
-            # a lagging delete_class Raft entry would rmtree the class
-            # dir AFTER these files land — silent shard loss. Refuse;
-            # the coordinator retries once the delete has applied here.
+        # a lagging delete_class Raft entry would rmtree the class dir
+        # AFTER these files land — silent shard loss. Refuse and let the
+        # coordinator retry once the delete has applied here. The check
+        # MUST hold the schema lock: delete_collection holds it through
+        # its rmtree, so a lock-free check can pass mid-wipe and have
+        # the just-restored files deleted underneath it.
+        with db._lock:
+            exists = cls in db.collections
+        if exists:
             raise ValueError(
                 f"class {cls!r} still exists on this node (schema delete "
                 "not yet applied) — retry restore shortly")
