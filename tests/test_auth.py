@@ -37,12 +37,17 @@ def test_anonymous_disabled_requires_key():
 
 
 def test_api_key_user_mapping():
-    a = Authenticator(AuthConfig(api_keys=["k1", "k2", "k3"],
+    a = Authenticator(AuthConfig(api_keys=["k1", "k2"],
                                  api_users=["alice", "bob"]))
     assert a.authenticate("Bearer k1").username == "alice"
     assert a.authenticate("Bearer k2").username == "bob"
-    # more keys than users: last user catches the tail (reference semantics)
-    assert a.authenticate("Bearer k3").username == "bob"
+    # one user covers all keys (reference semantics)
+    a1 = Authenticator(AuthConfig(api_keys=["k1", "k2"], api_users=["solo"]))
+    assert a1.authenticate("Bearer k2").username == "solo"
+    # mismatched counts with >1 user: fail fast at startup — otherwise a
+    # surplus key would silently authenticate as the LAST listed user
+    with pytest.raises(ValueError):
+        AuthConfig(api_keys=["k1", "k2", "k3"], api_users=["alice", "bob"])
 
 
 def test_admin_list():

@@ -15,6 +15,7 @@ generated schema.
 
 from __future__ import annotations
 
+import json
 import re
 
 import numpy as np
@@ -38,6 +39,15 @@ _TOKEN_RE = re.compile(
     """,
     re.VERBOSE,
 )
+
+_JSON_ESCAPE_RE = re.compile(r"\\u([0-9a-fA-F]{4})|\\(.)")
+_JSON_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f"}
+
+
+def _unescape_fallback(m: re.Match) -> str:
+    if m.group(1) is not None:
+        return chr(int(m.group(1), 16))
+    return _JSON_ESCAPES.get(m.group(2), m.group(2))
 
 
 class GraphQLError(Exception):
@@ -209,7 +219,14 @@ class _Parser:
         if kind == "float":
             return float(v)
         if kind == "string":
-            return v[1:-1].encode().decode("unicode_escape")
+            # The string grammar (see _TOKEN_RE) is JSON-compatible; json.loads
+            # handles \uXXXX and backslash escapes without re-interpreting
+            # UTF-8 bytes as Latin-1 the way unicode_escape would.
+            try:
+                return json.loads(v)
+            except ValueError:
+                # Literal control characters are legal for us but not JSON.
+                return _JSON_ESCAPE_RE.sub(_unescape_fallback, v[1:-1])
         if kind == "name":
             if v == "true":
                 return True

@@ -458,6 +458,42 @@ class RestServer:
             raise ApiError(422, str(e))
         raise KeyError("/v1/classifications/" + "/".join(seg))
 
+    def _patch_merge(self, col, uuid: str, body: dict, tenant):
+        """PATCH /v1/objects/{class}/{id} merge semantics (reference:
+        usecases/objects/merge.go). Caller holds col.uuid_lock(uuid)."""
+        existing = col.get_object(uuid, tenant=tenant)
+        if existing is None:
+            raise ApiError(404, f"object {uuid} not found")
+        merged = dict(existing.properties)
+        merged.update(body.get("properties", {}))
+        body["properties"] = merged
+
+        # Carry existing vectors forward for spaces with no vectorizer —
+        # vectorizer-backed spaces are left absent so _put_object re-embeds
+        # the merged properties (reference re-vectorizes on merge; a copied
+        # vector would pin the pre-edit embedding forever). If this server
+        # CANNOT re-embed (no module provider, or the module isn't
+        # registered), keep the existing vector: stale beats silently
+        # dropping the object from vector search.
+        def _keeps(vec_name):
+            vc = col.config.vector_config(vec_name)
+            if vc is None or vc.vectorizer in ("", "none"):
+                return True
+            return (self.modules is None
+                    or self.modules.get(vc.vectorizer) is None)
+
+        if "vector" not in body and existing.vector is not None \
+                and _keeps(""):
+            body["vector"] = np.asarray(existing.vector).tolist()
+        if "vectors" not in body:
+            named = {k: np.asarray(v).tolist()
+                     for k, v in existing.vectors.items()
+                     if k and _keeps(k)}
+            if named:
+                body["vectors"] = named
+        body["creationTimeUnix"] = existing.creation_time_ms
+        return self._put_object(body, tenant)
+
     def _references(self, method: str, class_name: str, uuid: str,
                     prop: str, body, tenant):
         """Cross-reference CRUD (reference: handlers_objects.go
@@ -475,9 +511,11 @@ class RestServer:
                                "string")
             return beacon
 
-        # read-modify-write under the collection lock: two concurrent
-        # reference additions must not lose each other's append
-        with col._lock:
+        # read-modify-write under a per-uuid lock: two concurrent reference
+        # additions to the same object must not lose each other's append,
+        # but a slow replica in the replicated put must not block the whole
+        # collection (see Collection.uuid_lock)
+        with col.uuid_lock(uuid):
             obj = col.get_object(uuid, tenant=tenant)
             if obj is None:
                 raise ApiError(404, f"object {uuid} not found")
@@ -564,7 +602,7 @@ class RestServer:
                     raise ValueError(
                         f"property {prop!r} of {cls} is not a reference "
                         "property")
-                with col._lock:  # see _references: appends must not race
+                with col.uuid_lock(uid):  # see _references: no lost appends
                     obj = col.get_object(uid, tenant=item.get("tenant"))
                     if obj is None:
                         raise ValueError(f"source object {uid} not found")
@@ -858,38 +896,11 @@ class RestServer:
                 body.setdefault("class", class_name)
                 body["id"] = uuid
                 if method == "PATCH":
-                    existing = col.get_object(uuid, tenant=tenant)
-                    if existing is None:
-                        raise ApiError(404, f"object {uuid} not found")
-                    merged = dict(existing.properties)
-                    merged.update(body.get("properties", {}))
-                    body["properties"] = merged
-                    # Carry existing vectors forward for spaces with no
-                    # vectorizer — vectorizer-backed spaces are left absent
-                    # so _put_object re-embeds the merged properties
-                    # (reference re-vectorizes on merge; a copied vector
-                    # would pin the pre-edit embedding forever). If this
-                    # server CANNOT re-embed (no module provider, or the
-                    # module isn't registered), keep the existing vector:
-                    # stale beats silently dropping the object from
-                    # vector search.
-                    def _keeps(vec_name):
-                        vc = col.config.vector_config(vec_name)
-                        if vc is None or vc.vectorizer in ("", "none"):
-                            return True
-                        return (self.modules is None
-                                or self.modules.get(vc.vectorizer) is None)
-
-                    if "vector" not in body and existing.vector is not None \
-                            and _keeps(""):
-                        body["vector"] = np.asarray(existing.vector).tolist()
-                    if "vectors" not in body:
-                        named = {k: np.asarray(v).tolist()
-                                 for k, v in existing.vectors.items()
-                                 if k and _keeps(k)}
-                        if named:
-                            body["vectors"] = named
-                    body["creationTimeUnix"] = existing.creation_time_ms
+                    # merge is a read-modify-write: serialize against
+                    # concurrent reference appends / PATCHes of the same
+                    # object (same per-uuid lock as _references)
+                    with col.uuid_lock(uuid):
+                        return self._patch_merge(col, uuid, body, tenant)
                 return self._put_object(body, tenant)
             if method == "DELETE":
                 deleted = col.delete_object(

@@ -55,6 +55,18 @@ class AuthConfig:
     admin_users: list[str] = field(default_factory=list)
     readonly_users: list[str] = field(default_factory=list)
 
+    def __post_init__(self):
+        # Reference rejects this misconfiguration at startup
+        # (usecases/config: keys and users must align, or a single user
+        # covers all keys). Without this check, surplus keys silently
+        # authenticate as the LAST listed user.
+        if len(self.api_users) > 1 and len(self.api_keys) != len(self.api_users):
+            raise ValueError(
+                "AUTHENTICATION_APIKEY_ALLOWED_KEYS and "
+                "AUTHENTICATION_APIKEY_USERS must have the same length "
+                f"(got {len(self.api_keys)} keys, {len(self.api_users)} users) "
+                "unless at most one user is configured")
+
     @classmethod
     def from_env(cls, env=os.environ) -> "AuthConfig":
         """Reference env surface (usecases/config/environment.go)."""

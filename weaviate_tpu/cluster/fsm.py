@@ -46,7 +46,10 @@ class SchemaFSM:
                 # live objects (NOT a wholesale overwrite: the proposed
                 # config may carry defaults for fields the proposer's
                 # client omitted)
-                self.db.update_collection(cfg)
+                # allow_scale=False: a stale factor in a concurrent
+                # update_class must not trigger per-node scaler runs inside
+                # FSM apply — factor only changes via "update_sharding"
+                self.db.update_collection(cfg, allow_scale=False)
             except (KeyError, ValueError) as e:
                 # replay tolerance: class deleted later in the log etc.
                 logger.warning("update_class %s skipped: %s", cfg.name, e)

@@ -137,6 +137,16 @@ class ClusterNode:
         # update on every node including this one; mutating before a
         # successful propose would diverge this node from its peers
         self.db.validate_collection_update(new_cfg)
+        cur = self.db.get_collection(new_cfg.name).config
+        if new_cfg.replication.factor != cur.replication.factor:
+            # factor changes ship shard data first (usecases/scaler) and
+            # raft-commit placement+factor via "update_sharding"; by the
+            # time update_class applies, the factor already matches, so
+            # no node re-runs the scaler during FSM apply
+            from weaviate_tpu.cluster.scaler import Scaler
+
+            Scaler(self.db, propose=self.raft.propose).scale(
+                new_cfg.name, new_cfg.replication.factor)
         self.raft.propose({"type": "update_class",
                            "config": new_cfg.to_dict()})
 

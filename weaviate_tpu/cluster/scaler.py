@@ -12,8 +12,8 @@ from __future__ import annotations
 from weaviate_tpu.storage.objects import StorageObject
 
 
-class ScaleError(Exception):
-    pass
+class ScaleError(ValueError):
+    """ValueError so the REST layer maps it to 422, not 500."""
 
 
 class Scaler:

@@ -128,6 +128,12 @@ class Collection:
         # creation through Raft; None = apply locally (single node)
         self._auto_tenant_hook = None
         self._lock = threading.RLock()
+        # Sharded per-uuid write locks for read-modify-write flows
+        # (reference appends, PATCH) — the RMW must be atomic per object but
+        # must not hold the collection-wide lock across a replicated put,
+        # where one slow replica's 2PC RPC would block every unrelated
+        # request (reference analog: vector/common/sharded_locks.go).
+        self._uuid_locks = [threading.RLock() for _ in range(64)]
         if sharding_state is None:
             if config.multi_tenancy.enabled:
                 sharding_state = ShardingState.create_partitioned()
@@ -151,6 +157,12 @@ class Collection:
         # hot/cold tenant tracking (reference: entities/tenantactivity +
         # rest/tenantactivity/handler.go): tenant -> last access stamps
         self.tenant_activity: dict[str, dict] = {}
+
+    def uuid_lock(self, uuid: str) -> threading.RLock:
+        """Lock guarding read-modify-write of one object (sharded by uuid
+        hash; collisions just serialize two unrelated RMWs, never deadlock
+        since callers take at most one)."""
+        return self._uuid_locks[hash(uuid) % len(self._uuid_locks)]
 
     def _record_tenant(self, tenant: str | None, kind: str) -> None:
         if not tenant or not self.config.multi_tenancy.enabled:

@@ -158,15 +158,31 @@ class Database:
         if new_cfg.multi_tenancy.enabled != cur.multi_tenancy.enabled:
             raise ValueError("multiTenancy.enabled is immutable")
 
-    def update_collection(self, new_cfg: CollectionConfig) -> None:
+    def update_collection(self, new_cfg: CollectionConfig,
+                          allow_scale: bool = True) -> None:
+        """``allow_scale=False`` is the Raft-FSM apply path: factor changes
+        are IGNORED there (they only ever commit via the deterministic
+        "update_sharding" op) — running the Scaler inside FSM apply would
+        make log application network-dependent and non-deterministic
+        across nodes."""
         with self._lock:
             self.validate_collection_update(new_cfg)
+            cur = self.get_collection(new_cfg.name).config
+            if allow_scale and \
+                    new_cfg.replication.factor != cur.replication.factor:
+                # Factor changes move shard data (reference routes them
+                # through usecases/scaler) — recording the new number
+                # without copying would leave phantom replicas that hold
+                # nothing, so reads routed there miss data.
+                from weaviate_tpu.cluster.scaler import Scaler
+
+                Scaler(self).scale(new_cfg.name,
+                                   new_cfg.replication.factor)
 
             def apply(cfg):
                 cfg.description = new_cfg.description
                 cfg.inverted = new_cfg.inverted
                 cfg.module_config = new_cfg.module_config
-                cfg.replication.factor = new_cfg.replication.factor
                 cfg.multi_tenancy.auto_tenant_creation = \
                     new_cfg.multi_tenancy.auto_tenant_creation
                 cfg.multi_tenancy.auto_tenant_activation = \

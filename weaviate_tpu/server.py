@@ -45,7 +45,8 @@ class Server:
         auth_cfg = AuthConfig.from_env()
         auth = None
         if not auth_cfg.anonymous_enabled or auth_cfg.api_keys or \
-                auth_cfg.oidc_enabled or auth_cfg.admin_users:
+                auth_cfg.oidc_enabled or auth_cfg.admin_users or \
+                auth_cfg.readonly_users:
             auth = AuthStack(auth_cfg)
 
         memwatch = None
