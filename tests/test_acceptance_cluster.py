@@ -157,13 +157,19 @@ def test_cluster_wide_backup_restore(cluster, tmp_path_factory):
 
     c0.create_class({"class": "BK", "shardingConfig": {"desiredCount": 3},
                      "properties": [{"name": "n", "dataType": ["int"]}]})
+    # the writer (c1) AND the reader (c2) must both see the class — Raft
+    # apply is eventually consistent per node
+    _wait(lambda: c1.get_class("BK"))
     _wait(lambda: c2.get_class("BK"))
     import numpy as np
 
     rng = np.random.default_rng(4)
-    c1.batch_objects([{"class": "BK", "properties": {"n": i},
-                       "vector": rng.standard_normal(8).tolist()}
-                      for i in range(45)])
+    results = c1.batch_objects([{"class": "BK", "properties": {"n": i},
+                                 "vector": rng.standard_normal(8).tolist()}
+                                for i in range(45)])
+    errs = [r for r in results
+            if (r.get("result") or {}).get("status") not in (None, "SUCCESS")]
+    assert not errs, f"batch errors: {errs[:3]}"
     before = c2.graphql("{ Aggregate { BK { meta { count } } } }")
     assert before["data"]["Aggregate"]["BK"][0]["meta"]["count"] == 45
 

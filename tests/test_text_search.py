@@ -371,3 +371,51 @@ def test_bm25_multi_shard(tmp_path):
     res = col.bm25("common", k=50)
     assert len(res) == 40
     db.close()
+
+
+def test_inverted_index_persists_across_reopen(tmp_path):
+    """VERDICT r1 item 4: shard reopen must serve BM25/filters from the
+    persisted inv_* buckets with NO rebuild from objects (reopen is
+    O(segments), not O(objects))."""
+    import numpy as np
+
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.filters.filters import Filter, Operator
+    from weaviate_tpu.schema.config import (CollectionConfig, DataType,
+                                            Property)
+    from weaviate_tpu.text.inverted import InvertedIndex
+
+    db = Database(str(tmp_path))
+    col = db.create_collection(CollectionConfig(
+        name="Doc",
+        properties=[Property(name="body", data_type=DataType.TEXT),
+                    Property(name="n", data_type=DataType.INT)]))
+    for i in range(30):
+        col.put_object({"body": f"persistent postings number {i}", "n": i},
+                       vector=np.random.randn(8).astype(np.float32))
+    shard = list(col.shards.values())[0]
+    ids, scores = shard.bm25_search("persistent", 5)
+    assert len(ids) == 5
+    db.close()
+
+    # any rebuild attempt at reopen must explode
+    def boom(self, obj):
+        raise AssertionError("inverted index rebuilt from objects at reopen")
+
+    orig = InvertedIndex.index_object
+    InvertedIndex.index_object = boom
+    try:
+        db2 = Database(str(tmp_path))
+        col2 = db2.collections["Doc"]
+        shard2 = list(col2.shards.values())[0]
+        ids2, _ = shard2.bm25_search("persistent", 5)
+        assert len(ids2) == 5
+        from weaviate_tpu.filters.filters import compute_allow_mask
+
+        mask = compute_allow_mask(
+            Filter.where("n", Operator.GREATER_THAN_EQUAL, 20),
+            shard2._inverted, shard2.doc_id_space)
+        assert int(mask.sum()) == 10
+        db2.close()
+    finally:
+        InvertedIndex.index_object = orig

@@ -157,7 +157,10 @@ class Shard:
         self.vector_indexes: dict[str, FlatIndex] = {}
         from weaviate_tpu.text.inverted import InvertedIndex
 
-        self._inverted = InvertedIndex(collection)
+        # persistent inverted index: postings/filterables write through the
+        # shard's own LSM store and are read on demand — NOT rebuilt from
+        # objects at open (reference: inverted/ lsmkv buckets)
+        self._inverted = InvertedIndex(collection, store=self.store)
         # doc_id -> uuid, rebuilt at startup; the object-resolution hot path
         # after a vector search (reference: docid bucket, adapters/repos/db/docid)
         self._doc_to_uuid: dict[int, str] = {}
@@ -170,14 +173,34 @@ class Shard:
         hnsw/startup.go:57 replays the commit log; we replay the objects
         bucket — the vectors ARE the log)."""
         batch: dict[str, tuple[list[int], list[np.ndarray]]] = {}
+        # one-time migration: a shard written before the inverted index was
+        # persistent has objects but empty inv_* buckets — rebuild postings
+        # from objects once so pre-upgrade data stays searchable
+        migrate_inverted = self._inverted.doc_count == 0
+        migrated = 0
+        migrate_chunk: list[StorageObject] = []
         for key, raw in self.objects.iter_items():
             obj = StorageObject.from_bytes(raw)
             self._doc_to_uuid[obj.doc_id] = obj.uuid
-            self._inverted.index_object(obj)
+            if migrate_inverted:
+                migrate_chunk.append(obj)
+                if len(migrate_chunk) >= 2000:  # batched WAL frames
+                    self._inverted.index_objects(migrate_chunk)
+                    migrated += len(migrate_chunk)
+                    migrate_chunk = []
             for vec_name, vec in obj.vectors.items():
                 ids, vecs = batch.setdefault(vec_name, ([], []))
                 ids.append(obj.doc_id)
                 vecs.append(vec)
+        if migrate_chunk:
+            self._inverted.index_objects(migrate_chunk)
+            migrated += len(migrate_chunk)
+        if migrated:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "shard %s: migrated %d objects into the persistent "
+                "inverted index", self.name, migrated)
         for vec_name, (ids, vecs) in batch.items():
             # tolerate poisoned rows (dim drift from old bugs/corruption)
             # instead of refusing to start — reference analog:
@@ -269,21 +292,35 @@ class Shard:
                              for o in objs for v in o.vectors.values())
                 self.memwatch.check_device_alloc(nbytes)
             vec_batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
-            for obj in objs:
+            # doc ids for the whole batch come from one counter bump (one
+            # meta write instead of len(objs))
+            first_id = self._counter
+            self._counter += len(objs)
+            self.meta.put(b"doc_counter", self._counter)
+            docid_puts: list[tuple[bytes, object]] = []
+            object_puts: list[tuple[bytes, object]] = []
+            for i, obj in enumerate(objs):
                 old_raw = self.docid.get(obj.uuid.encode())
                 if old_raw is not None:
                     self._delete_doc(int(old_raw), obj.uuid)
-                obj.doc_id = self._next_doc_id()
+                obj.doc_id = first_id + i
                 self.tombstones.delete(obj.uuid.encode())
-                self.docid.put(obj.uuid.encode(), obj.doc_id)
+                docid_puts.append((obj.uuid.encode(), obj.doc_id))
                 self._doc_to_uuid[obj.doc_id] = obj.uuid
-                self.objects.put(obj.uuid.encode(), obj.to_bytes())
+                object_puts.append((obj.uuid.encode(), obj.to_bytes()))
                 for vec_name, vec in obj.vectors.items():
                     ids, vecs = vec_batches.setdefault(vec_name, ([], []))
                     ids.append(obj.doc_id)
                     vecs.append(np.asarray(vec, dtype=np.float32))
-                self._inverted.index_object(obj)
                 doc_ids.append(obj.doc_id)
+            # ordering invariant: inverted postings land BEFORE the objects
+            # bucket. A crash in between leaves ghost postings (doc ids the
+            # object replay never resurrects — filters mask them out and
+            # result resolution drops them), never missing postings for a
+            # visible object. The objects-bucket WAL is the commit point.
+            self._inverted.index_objects(objs)
+            self.docid.put_many(docid_puts)
+            self.objects.put_many(object_puts)
             for vec_name, (ids, vecs) in vec_batches.items():
                 idx = self._ensure_vector_index(vec_name, len(vecs[0]))
                 if idx is None:
@@ -304,13 +341,14 @@ class Shard:
             self._index_queues[vec_name] = q
         return q
 
-    def _delete_doc(self, doc_id: int, uuid: str):
+    def _delete_doc(self, doc_id: int, uuid: str, old=None):
         for q in self._index_queues.values():
             q.delete(doc_id)  # drop any queued insert for this doc
         for idx in self.vector_indexes.values():
             if idx is not None:
                 idx.delete(doc_id)
-        old = self.get_object(uuid)
+        if old is None:
+            old = self.get_object(uuid)
         if old is not None:
             self._inverted.unindex_object(old)
         self._doc_to_uuid.pop(doc_id, None)
@@ -325,11 +363,16 @@ class Shard:
             raw = self.docid.get(uuid.encode())
             if raw is None:
                 return False
-            self._delete_doc(int(raw), uuid)
+            # same ordering invariant as the put path: the object/docid
+            # deletes commit FIRST, the inverted unindex follows — a crash
+            # in between leaves benign ghost postings, never a visible
+            # object invisible to filters/BM25
+            old = self.get_object(uuid)
             self.docid.delete(uuid.encode())
             self.objects.delete(uuid.encode())
             self.tombstones.put(uuid.encode(),
                                 tombstone_ms or int(_time.time() * 1000))
+            self._delete_doc(int(raw), uuid, old=old)
             return True
 
     # -- read path -----------------------------------------------------------
@@ -627,11 +670,9 @@ class Shard:
         if self.gc_staged():
             did = True
         for b in self.store.buckets():
-            if b.dirty:
-                b.flush()
-                did = True
-            if b.segment_count > compact_above:
-                b.compact()
+            # sealed-memtable flush + threshold compaction, all off the
+            # write path (reference: store_cyclecallbacks.go)
+            if b.maintain(compact_above=compact_above):
                 did = True
             lsm_segment_count.labels(f"{self.collection_name}/{self.name}/{b.name}"
                                      ).set(b.segment_count)

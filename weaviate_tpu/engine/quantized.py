@@ -52,7 +52,7 @@ class QuantizedVectorStore:
         capacity: int = _DEFAULT_CHUNK,
         chunk_size: int = _DEFAULT_CHUNK,
         pq_segments: int | None = None,
-        pq_centroids: int = 256,
+        pq_centroids: int = 16,
         # oversampling multiplier: the compressed scan returns
         # rescore_limit*k candidates for exact rescore (reference keeps an
         # absolute rescoreLimit, flat/index.go:301; 16x measures ~0.99
@@ -68,7 +68,15 @@ class QuantizedVectorStore:
         self.quantization = quantization
         self.chunk_size = chunk_size
         self.rescore_limit = rescore_limit
-        self.pq_segments = pq_segments or max(1, dim // 8)
+        if pq_segments:
+            self.pq_segments = pq_segments
+        else:
+            # 4-bit codes default to 1 bit/dim (m = d/4), 8-bit to 1 byte
+            # per 8 dims; m must divide d for the orthogonal-segment ADC
+            target = max(1, dim // (4 if pq_centroids <= 16 else 8))
+            while dim % target:
+                target -= 1
+            self.pq_segments = target
         self.pq_centroids = pq_centroids
         self.codebook = codebook
         self.normalize_on_add = (
@@ -237,10 +245,18 @@ class QuantizedVectorStore:
             cs = min(self.chunk_size, capacity)
             metric = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
             if self.quantization == "pq":
-                d, i = pq_ops.pq_topk(
-                    jnp.asarray(queries), codes, self.codebook.centroids,
-                    k=k_cand, chunk_size=cs, metric=metric, valid=valid,
-                )
+                if self.pq_centroids <= 16:
+                    # 4-bit path: ADC LUT as one MXU matmul per tile
+                    # (ops/pallas_kernels.pq4_lut_block)
+                    d, i = pq_ops.pq4_topk(
+                        jnp.asarray(queries), codes, self.codebook.centroids,
+                        k=k_cand, chunk_size=cs, metric=metric, valid=valid,
+                    )
+                else:
+                    d, i = pq_ops.pq_topk(
+                        jnp.asarray(queries), codes, self.codebook.centroids,
+                        k=k_cand, chunk_size=cs, metric=metric, valid=valid,
+                    )
             else:
                 from weaviate_tpu.ops.pallas_kernels import recommended
 

@@ -123,17 +123,16 @@ def compute_allow_mask(f: Filter, inv: InvertedIndex, size: int) -> np.ndarray:
 
 
 def _full(inv: InvertedIndex, size: int) -> np.ndarray:
-    mask = np.zeros(size, dtype=bool)
-    ids = [d for d in inv._docs if d < size]
-    if ids:
-        mask[np.fromiter(ids, dtype=np.int64, count=len(ids))] = True
-    return mask
+    return _from_ids(inv.all_docs(), size)
 
 
-def _from_set(docs, size: int) -> np.ndarray:
+def _from_ids(ids, size: int) -> np.ndarray:
+    """Sorted id array (or any iterable of ids) -> dense bool mask."""
     mask = np.zeros(size, dtype=bool)
-    if docs:
-        arr = np.fromiter((d for d in docs if d < size), dtype=np.int64)
+    arr = np.asarray(ids, dtype=np.int64) if not isinstance(ids, np.ndarray) \
+        else ids.astype(np.int64, copy=False)
+    if len(arr):
+        arr = arr[arr < size]
         if len(arr):
             mask[arr] = True
     return mask
@@ -166,23 +165,18 @@ def _eval(f: Filter, inv: InvertedIndex, size: int) -> np.ndarray:
         raise ValueError(f"filter {op} requires a path")
 
     if op == Operator.IS_NULL:
-        null_mask = _from_set(inv.nulls.get(prop, ()), size)
+        null_mask = _from_ids(inv.null_ids(prop), size)
         if f.value:
             return null_mask
         return _full(inv, size) & ~null_mask
 
     if op == Operator.WITHIN_GEO_RANGE:
-        coords = inv.geo.get(prop)
-        if not coords:
+        ids, lats, lons = inv.geo_arrays(prop)
+        if not len(ids):
             return np.zeros(size, dtype=bool)
         spec = f.value  # {"geoCoordinates": {latitude, longitude}, "distance": {"max": m}}
         center = spec.get("geoCoordinates", spec)
         max_m = spec["distance"]["max"] if "distance" in spec else spec["max"]
-        ids = np.fromiter(coords.keys(), dtype=np.int64, count=len(coords))
-        lats = np.fromiter((v[0] for v in coords.values()), dtype=np.float64,
-                           count=len(coords))
-        lons = np.fromiter((v[1] for v in coords.values()), dtype=np.float64,
-                           count=len(coords))
         d = _geo_distance_m(float(center["latitude"]), float(center["longitude"]),
                             lats, lons)
         mask = np.zeros(size, dtype=bool)
@@ -195,48 +189,29 @@ def _eval(f: Filter, inv: InvertedIndex, size: int) -> np.ndarray:
         if isinstance(threshold, str):
             threshold = parse_date(threshold)
         threshold = float(threshold)
-
-        def cmp(vv):
-            if op == Operator.GREATER_THAN:
-                return vv > threshold
-            if op == Operator.GREATER_THAN_EQUAL:
-                return vv >= threshold
-            if op == Operator.LESS_THAN:
-                return vv < threshold
-            return vv <= threshold
-
-        mask = np.zeros(size, dtype=bool)
-        # scalar path (also covers _creationTimeUnix/_lastUpdateTimeUnix,
-        # which only live in the numeric index)
-        vals = inv.numeric.get(prop)
-        if vals:
-            ids = np.fromiter(vals.keys(), dtype=np.int64, count=len(vals))
-            vv = np.fromiter(vals.values(), dtype=np.float64, count=len(vals))
-            sel = ids[cmp(vv) & (ids < size)]
-            mask[sel] = True
-        # per-value keys: any-element semantics for numeric/date arrays
-        # (a doc is listed under every element value); scalar props are
-        # already fully answered by the numeric map above
-        table = inv.filterable.get(prop) if prop in inv.array_props else None
-        if table:
-            for key, docs in table.items():
-                if isinstance(key, bool) or not isinstance(key, (int, float)):
-                    continue
-                if cmp(np.float64(key)):
-                    mask |= _from_set(docs, size)
-        return mask
+        # LSM range scan over order-preserving numeric keys; array props
+        # index every element, giving any-element semantics for free
+        # (reference: searcher.go range row readers)
+        if op == Operator.GREATER_THAN:
+            ids = inv.numeric_range_ids(prop, threshold, None, lo_incl=False)
+        elif op == Operator.GREATER_THAN_EQUAL:
+            ids = inv.numeric_range_ids(prop, threshold, None, lo_incl=True)
+        elif op == Operator.LESS_THAN:
+            ids = inv.numeric_range_ids(prop, None, threshold, hi_incl=False)
+        else:
+            ids = inv.numeric_range_ids(prop, None, threshold, hi_incl=True)
+        return _from_ids(ids, size)
 
     if op == Operator.LIKE:
-        # ?/* wildcards over the filterable vocabulary
+        # ?/* wildcards range-scanned over the text vocabulary
         # (reference: inverted/like_regexp.go)
-        table = inv.filterable.get(prop, {})
         pattern = str(f.value).lower()
         rx = re.compile(fnmatch.translate(pattern))
-        docs: set[int] = set()
-        for key, s in table.items():
-            if isinstance(key, str) and rx.match(key.lower()):
-                docs |= s
-        return _from_set(docs, size)
+        mask = np.zeros(size, dtype=bool)
+        for token, ids in inv.text_vocab(prop):
+            if rx.match(token.lower()):
+                mask |= _from_ids(ids, size)
+        return mask
 
     if op in (Operator.EQUAL, Operator.NOT_EQUAL,
               Operator.CONTAINS_ANY, Operator.CONTAINS_ALL):
@@ -261,27 +236,26 @@ def _match_value(inv: InvertedIndex, prop: str, value, size: int) -> np.ndarray:
     """Exact-match a single value against the filterable index. Text values
     tokenize; multi-token text matches docs containing ALL tokens
     (reference Equal-on-text semantics)."""
-    table = inv.filterable.get(prop, {})
     if isinstance(value, bool):
-        return _from_set(table.get(value, ()), size)
+        return _from_ids(inv.filterable_ids(prop, value), size)
     if isinstance(value, (int, float)):
-        return _from_set(table.get(float(value), ()), size)
+        return _from_ids(inv.filterable_ids(prop, float(value)), size)
     if isinstance(value, str):
         # date-valued? keys are floats for date props
         sch = inv.config.property(prop)
         if sch is not None and sch.data_type in (DataType.DATE, DataType.DATE_ARRAY):
             try:
-                return _from_set(table.get(parse_date(value), ()), size)
+                return _from_ids(inv.filterable_ids(prop, parse_date(value)), size)
             except ValueError:
                 return np.zeros(size, dtype=bool)
         if sch is not None and sch.data_type in (DataType.UUID, DataType.UUID_ARRAY):
-            return _from_set(table.get(value, ()), size)
+            return _from_ids(inv.filterable_ids(prop, value), size)
         tokenization = sch.tokenization if sch is not None else "word"
         tokens = tokenize(value, tokenization)
         if not tokens:
             return np.zeros(size, dtype=bool)
-        out = _from_set(table.get(tokens[0], ()), size)
+        out = _from_ids(inv.filterable_ids(prop, tokens[0]), size)
         for t in tokens[1:]:
-            out = out & _from_set(table.get(t, ()), size)
+            out = out & _from_ids(inv.filterable_ids(prop, t), size)
         return out
     return np.zeros(size, dtype=bool)

@@ -72,13 +72,28 @@ def bq_topk(
     init_d = jnp.full((b, k), MASKED_DISTANCE, dtype=jnp.float32)
     init_i = jnp.full((b, k), -1, dtype=jnp.int32)
 
+    if use_pallas:
+        # hoist the loop-invariant query unpack out of the scan body —
+        # XLA does not lift computation out of while-loop bodies
+        from weaviate_tpu.ops.pallas_kernels import (_SUBLANE, _pad_to,
+                                                     bq_queries_to_planes)
+
+        pb = _pad_to(max(b, 1), _SUBLANE)
+        q_padded = jnp.pad(q_words, ((0, pb - b), (0, 0))) if pb != b else q_words
+        q_planes = bq_queries_to_planes(q_padded, w)
+        q_pop = jnp.sum(q_planes.astype(jnp.float32), axis=1, keepdims=True)
+
     def body(carry, inp):
         best_d, best_i = carry
         chunk_idx, xc, vc = inp
         if use_pallas:
-            from weaviate_tpu.ops.pallas_kernels import bq_hamming_block
+            # MXU path: unpack-in-VMEM + bf16 matmul (pallas_kernels
+            # bq_mxu_block) — the VPU popcount kernel loses to the MXU by
+            # ~2 orders of magnitude on TPU
+            from weaviate_tpu.ops.pallas_kernels import bq_mxu_block
 
-            d = bq_hamming_block(q_words, xc, interpret=None)
+            d = bq_mxu_block(q_words, xc, valid=None, interpret=None,
+                             q_planes=q_planes, q_pop=q_pop)
         else:
             x_or = jax.lax.bitwise_xor(q_words[:, None, :], xc[None, :, :])
             d = jnp.sum(

@@ -71,7 +71,10 @@ class VectorIndexConfig:
     # quantization
     quantization: str | None = None  # None | pq | bq
     pq_segments: int | None = None
-    pq_centroids: int = 256
+    # TPU-first default: 16 centroids = 4-bit codes whose ADC lookup is one
+    # MXU matmul (ops/pallas_kernels.pq4_lut_block); 256 selects the
+    # reference-style 8-bit codebook (reconstruct-matmul scan)
+    pq_centroids: int = 16
     rescore_limit: int = 16
     # hnsw-ish knobs (used by graph/ivf indexes)
     ef: int = -1

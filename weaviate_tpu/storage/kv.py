@@ -10,20 +10,33 @@ Four value strategies (strategies.go:21-25):
 - ``map``         key -> {mapKey: mapValue} with per-mapKey deletes
 - ``roaringset``  key -> bitmap of doc ids (additions/removals sets)
 
-This implementation keeps the same shapes — memtable + WAL + sorted
-segment files + strategy-aware merge/compaction — with a Python core:
-segments store a sorted key index in a footer (loaded at open) and values
-read on demand, standing in for the reference's mmap'd segments with
-bloom filters. doc-id bitmaps are sorted numpy uint64 arrays, the dense
-analog of the reference's roaring bitmaps (sroar).
+Segment files are mmap'd with an on-disk binary-searchable key index and a
+per-segment bloom filter (reference: segment.go:28 mmap, segmentindex/,
+segment_bloom_filters.go) — a get-miss costs k bloom probes per segment,
+not a footer scan, and opening a segment reads only its footer, O(1) RAM.
+
+The write path never writes segments: a full memtable is *sealed* (memtable
++ its WAL move to a pending list, a fresh WAL starts) and background
+maintenance turns sealed memtables into segments (reference: flush cycle in
+store_cyclecallbacks.go keeps flushes off the user write path). Batched
+writes share one WAL frame and one lock acquisition (``put_many`` /
+``map_set_many`` / ``bitmap_add_many``).
+
+doc-id bitmaps are sorted numpy uint64 arrays varint-delta-coded on disk,
+the dense analog of the reference's roaring bitmaps (sroar).
 """
 
 from __future__ import annotations
 
+import hashlib
+import heapq
+import io
+import logging
+import mmap
 import os
 import struct
 import threading
-from typing import Iterator
+from typing import Iterable, Iterator
 
 import msgpack
 import numpy as np
@@ -31,8 +44,13 @@ import numpy as np
 from weaviate_tpu import native
 from weaviate_tpu.storage.wal import WriteAheadLog
 
+logger = logging.getLogger(__name__)
+
 STRATEGIES = ("replace", "set", "map", "roaringset")
 _TOMBSTONE = "__tomb__"
+_MAGIC_V2 = b"WVS2"
+_BLOOM_K = 6
+_BLOOM_BITS_PER_KEY = 10
 
 
 def _merge_values(strategy: str, older, newer):
@@ -120,12 +138,202 @@ def _unpack_value(strategy: str, raw: bytes):
     }
 
 
-class _Segment:
-    """Immutable sorted segment file.
+def _is_tomb_record(raw: bytes) -> bool:
+    obj = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+    return isinstance(obj, dict) and obj.get("__tomb__") is True
 
-    Layout: [records...][footer msgpack][u64 footer_off]
-    footer = {"keys": [...], "offs": [...], "lens": [...]}
+
+def _bloom_hashes(key: bytes) -> tuple[int, int]:
+    """Two independent 64-bit hashes (double hashing drives k probes)."""
+    d = hashlib.blake2b(key, digest_size=16).digest()
+    return (
+        int.from_bytes(d[:8], "little"),
+        int.from_bytes(d[8:], "little") | 1,  # odd => full cycle mod 2^m
+    )
+
+
+class _Segment:
+    """Immutable sorted segment file, mmap'd (format v2).
+
+    Layout (little-endian):
+
+        "WVS2"
+        [record bytes...]            each value written at its recorded offset
+        [keys blob]                  concatenated key bytes
+        [index]                      n entries x (koff u64, klen u32, voff u64, vlen u32)
+        [bloom]                      u64 words
+        footer msgpack {n, keys_off, idx_off, bloom_off, bloom_words}
+        u64 footer_off
+
+    Only the footer is parsed at open; key lookups binary-search the on-disk
+    index through the mmap (reference: segmentindex/ on-disk b-tree-ish
+    index + segment.go:28 mmap) after a bloom-filter check
+    (segment_bloom_filters.go).
     """
+
+    _IDX = np.dtype([("koff", "<u8"), ("klen", "<u4"),
+                     ("voff", "<u8"), ("vlen", "<u4")])
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        size = os.path.getsize(path)
+        if size < 16:
+            raise ValueError("segment shorter than header+footer")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        if self._mm[:4] != _MAGIC_V2:
+            raise ValueError("segment is not WVS2 format")
+        (foot_off,) = struct.unpack_from("<Q", self._mm, size - 8)
+        if not 4 <= foot_off <= size - 8:
+            raise ValueError("segment footer offset out of range")
+        footer = msgpack.unpackb(self._mm[foot_off : size - 8], raw=False)
+        try:
+            self.n = int(footer["n"])
+            keys_off = int(footer["keys_off"])
+            idx_off = int(footer["idx_off"])
+            bloom_off = int(footer["bloom_off"])
+            bloom_words = int(footer["bloom_words"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"segment footer malformed: {e}") from e
+        if not (4 <= keys_off <= idx_off <= bloom_off <= foot_off):
+            raise ValueError("segment footer offsets out of range")
+        if idx_off + self.n * self._IDX.itemsize > bloom_off:
+            raise ValueError("segment index truncated")
+        if bloom_off + bloom_words * 8 > foot_off:
+            raise ValueError("segment bloom truncated")
+        # zero-copy views into the mmap — O(1) RAM per open segment
+        self._idx = np.frombuffer(self._mm, dtype=self._IDX, count=self.n,
+                                  offset=idx_off)
+        self._bloom = np.frombuffer(self._mm, dtype="<u8", count=bloom_words,
+                                    offset=bloom_off)
+        self._bloom_bits = bloom_words * 64
+        self._keys_off = keys_off
+        # validate extremes once so a bit-flipped index can't point outside
+        # the file on later reads
+        if self.n:
+            e0, e1 = self._idx[0], self._idx[self.n - 1]
+            for e in (e0, e1):
+                if int(e["koff"]) + int(e["klen"]) > idx_off or \
+                   int(e["voff"]) + int(e["vlen"]) > keys_off:
+                    raise ValueError("segment index offsets out of range")
+
+    # -- key access ----------------------------------------------------------
+
+    def _key_at(self, i: int) -> bytes:
+        e = self._idx[i]
+        off = int(e["koff"])
+        return self._mm[off : off + int(e["klen"])]
+
+    def _value_at(self, i: int) -> bytes:
+        e = self._idx[i]
+        off = int(e["voff"])
+        return self._mm[off : off + int(e["vlen"])]
+
+    def _maybe_contains(self, key: bytes) -> bool:
+        if self._bloom_bits == 0:
+            return self.n > 0
+        h1, h2 = _bloom_hashes(key)
+        m = self._bloom_bits
+        bloom = self._bloom
+        for i in range(_BLOOM_K):
+            bit = (h1 + i * h2) % m
+            if not (int(bloom[bit >> 6]) >> (bit & 63)) & 1:
+                return False
+        return True
+
+    def get(self, key: bytes) -> bytes | None:
+        if self.n == 0 or not self._maybe_contains(key):
+            return None
+        lo, hi = 0, self.n
+        while lo < hi:  # binary search over the on-disk index
+            mid = (lo + hi) // 2
+            k = self._key_at(mid)
+            if k < key:
+                lo = mid + 1
+            elif k > key:
+                hi = mid
+            else:
+                return self._value_at(mid)
+        return None
+
+    def iter_items(self, start: bytes | None = None
+                   ) -> Iterator[tuple[bytes, bytes]]:
+        lo = 0
+        if start is not None:  # binary search the first key >= start
+            hi = self.n
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if self._key_at(mid) < start:
+                    lo = mid + 1
+                else:
+                    hi = mid
+        for i in range(lo, self.n):
+            yield self._key_at(i), self._value_at(i)
+
+    def iter_keys(self) -> Iterator[bytes]:
+        for i in range(self.n):
+            yield self._key_at(i)
+
+    def close(self) -> None:
+        # numpy views pin the mmap buffer — drop them before closing
+        self._idx = None
+        self._bloom = None
+        try:
+            self._mm.close()
+            self._f.close()
+        except (OSError, BufferError):
+            pass
+
+    @classmethod
+    def write(cls, path: str, items: Iterable[tuple[bytes, bytes]]) -> "_Segment":
+        """Write a segment from key-sorted (key, value_bytes) pairs."""
+        tmp = path + ".tmp"
+        keys: list[bytes] = []
+        idx_rows: list[tuple[int, int, int, int]] = []
+        with open(tmp, "wb") as f:
+            f.write(_MAGIC_V2)
+            for k, v in items:
+                idx_rows.append((0, len(k), f.tell(), len(v)))
+                keys.append(k)
+                f.write(v)
+            keys_off = f.tell()
+            off = keys_off
+            for i, k in enumerate(keys):
+                koff, klen, voff, vlen = idx_rows[i]
+                idx_rows[i] = (off, klen, voff, vlen)
+                off += len(k)
+                f.write(k)
+            idx_off = f.tell()
+            idx = np.array(idx_rows, dtype=cls._IDX) if idx_rows else \
+                np.empty(0, dtype=cls._IDX)
+            f.write(idx.tobytes())
+            bloom_off = f.tell()
+            n = len(keys)
+            bloom_words = max((n * _BLOOM_BITS_PER_KEY + 63) // 64, 1) if n else 0
+            bloom = np.zeros(bloom_words, dtype=np.uint64)
+            if n:
+                m = bloom_words * 64
+                for k in keys:
+                    h1, h2 = _bloom_hashes(k)
+                    for i in range(_BLOOM_K):
+                        bit = (h1 + i * h2) % m
+                        bloom[bit >> 6] |= np.uint64(1 << (bit & 63))
+            f.write(bloom.tobytes())
+            foot_off = f.tell()
+            f.write(msgpack.packb({
+                "n": n, "keys_off": keys_off, "idx_off": idx_off,
+                "bloom_off": bloom_off, "bloom_words": bloom_words,
+            }, use_bin_type=True))
+            f.write(struct.pack("<Q", foot_off))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return cls(path)
+
+
+class _SegmentV1:
+    """Round-1 segment format reader (footer key list in RAM) — kept so
+    restores of old backup fileset still open."""
 
     def __init__(self, path: str):
         self.path = path
@@ -146,15 +354,10 @@ class _Segment:
                 and isinstance(lens, list)
                 and len(keys) == len(offs) == len(lens)):
             raise ValueError("segment footer malformed")
-        # a bit-flipped footer can parse yet point outside the record
-        # region — catch it at open (quarantine) instead of crashing
-        # every later read that touches the segment
         for off, ln in zip(offs, lens):
             if not (isinstance(off, int) and isinstance(ln, int)
                     and 0 <= off and 0 <= ln and off + ln <= foot_off):
                 raise ValueError("segment footer offsets out of range")
-        # keys feed bisect on every read: non-bytes or out-of-order
-        # entries would crash or silently miss lookups later
         prev = None
         for k in keys:
             if not isinstance(k, bytes):
@@ -162,28 +365,13 @@ class _Segment:
             if prev is not None and k < prev:
                 raise ValueError("segment footer keys out of order")
             prev = k
+        self.n = len(keys)
         self.keys: list[bytes] = keys
         self.offs: list[int] = offs
         self.lens: list[int] = lens
 
-    @classmethod
-    def write(cls, path: str, items: list[tuple[bytes, bytes]]) -> "_Segment":
-        tmp = path + ".tmp"
-        keys, offs, lens = [], [], []
-        with open(tmp, "wb") as f:
-            for k, v in items:  # items must be key-sorted
-                keys.append(k)
-                offs.append(f.tell())
-                lens.append(len(v))
-                f.write(v)
-            foot_off = f.tell()
-            f.write(msgpack.packb({"keys": keys, "offs": offs, "lens": lens},
-                                  use_bin_type=True))
-            f.write(struct.pack("<Q", foot_off))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        return cls(path)
+    def _maybe_contains(self, key: bytes) -> bool:
+        return True
 
     def get(self, key: bytes) -> bytes | None:
         import bisect
@@ -195,15 +383,69 @@ class _Segment:
                 return f.read(self.lens[i])
         return None
 
-    def iter_items(self) -> Iterator[tuple[bytes, bytes]]:
+    def iter_items(self, start: bytes | None = None
+                   ) -> Iterator[tuple[bytes, bytes]]:
+        import bisect
+
+        lo = 0 if start is None else bisect.bisect_left(self.keys, start)
         with open(self.path, "rb") as f:
-            for k, off, ln in zip(self.keys, self.offs, self.lens):
-                f.seek(off)
-                yield k, f.read(ln)
+            for i in range(lo, self.n):
+                f.seek(self.offs[i])
+                yield self.keys[i], f.read(self.lens[i])
+
+    def iter_keys(self) -> Iterator[bytes]:
+        yield from self.keys
+
+    def close(self) -> None:
+        pass
+
+
+def _open_segment(path: str):
+    with open(path, "rb") as f:
+        magic = f.read(4)
+    if magic == _MAGIC_V2:
+        return _Segment(path)
+    return _SegmentV1(path)
+
+
+class _Memtable:
+    """In-RAM sorted-on-demand write buffer backed by one WAL file."""
+
+    __slots__ = ("data", "bytes", "wal")
+
+    def __init__(self, wal: WriteAheadLog | None):
+        self.data: dict[bytes, object] = {}
+        self.bytes = 0
+        self.wal = wal
+
+    def apply(self, strategy: str, key: bytes, value) -> None:
+        cur = self.data.get(key)
+        if value is _TOMBSTONE or cur is _TOMBSTONE or cur is None:
+            self.data[key] = value
+        else:
+            self.data[key] = _merge_values(strategy, cur, value)
+        self.bytes += len(key) + 64
+
+    def packed_items(self, strategy: str) -> Iterator[tuple[bytes, bytes]]:
+        for k in sorted(self.data):
+            v = self.data[k]
+            if v is _TOMBSTONE:
+                yield k, msgpack.packb({"__tomb__": True}, use_bin_type=True)
+            else:
+                yield k, _pack_value(strategy, v)
 
 
 class Bucket:
-    """Named bucket: memtable + WAL + segment stack (reference bucket.go:45)."""
+    """Named bucket: memtable + WAL + segment stack (reference bucket.go:45).
+
+    Lock discipline: ``_lock`` guards the memtable trio (active, sealed
+    list, segment list) and WAL handoff — all O(1) or O(batch) work.
+    Segment writes and compaction run outside the lock on immutable
+    snapshots; they re-acquire only to swap list entries.
+    """
+
+    #: sealed memtables allowed before writers must flush inline
+    MAX_SEALED = 4
 
     def __init__(self, dir_path: str, name: str, strategy: str = "replace",
                  memtable_limit: int = 4 * 1024 * 1024, sync_wal: bool = False):
@@ -214,13 +456,19 @@ class Bucket:
         self.dir = os.path.join(dir_path, name)
         os.makedirs(self.dir, exist_ok=True)
         self.memtable_limit = memtable_limit
+        self.sync_wal = sync_wal
         self._lock = threading.RLock()
-        self._mem: dict[bytes, object] = {}
-        self._mem_bytes = 0
-        self._segments: list[_Segment] = []  # oldest -> newest
+        self._flush_lock = threading.Lock()  # serializes segment writers
+        self._segments: list = []  # oldest -> newest
+        self._sealed: list[_Memtable] = []  # oldest -> newest
         self._load_segments()
-        self._wal = WriteAheadLog(os.path.join(self.dir, "wal.bin"), sync=sync_wal)
-        self._replay_wal()
+        self._wal_seq = 0
+        self._write_gen = 0
+        self._maintain_gen = -1
+        self._mem = _Memtable(None)
+        self._recover_wals()
+        if self._mem.wal is None:
+            self._mem.wal = self._new_wal()
 
     # -- startup -------------------------------------------------------------
 
@@ -233,19 +481,17 @@ class Bucket:
         for s in segs:
             path = os.path.join(self.dir, s)
             try:
-                self._segments.append(_Segment(path))
+                self._segments.append(_open_segment(path))
             except (ValueError, struct.error, KeyError, TypeError,
                     msgpack.exceptions.UnpackException) as e:
                 # parse-shaped failures only: a transient OSError (fd
                 # limit, momentary EACCES) must propagate — renaming a
-                # HEALTHY segment to .corrupt would silently lose it
-                # a truncated/bit-flipped segment must not brick the whole
+                # HEALTHY segment to .corrupt would silently lose it.
+                # A truncated/bit-flipped segment must not brick the whole
                 # bucket (reference: corrupt_commit_logs_fixer.go skips
                 # unreadable tail entries) — quarantine it and continue;
                 # anti-entropy or reimport restores the lost range
-                import logging
-
-                logging.getLogger(__name__).error(
+                logger.error(
                     "bucket %s: segment %s is corrupt (%s) — quarantined "
                     "as .corrupt, its records are lost", self.name, s, e)
                 try:
@@ -258,59 +504,157 @@ class Bucket:
             max((int(s.split("-")[1].split(".")[0]) for s in segs), default=-1) + 1
         )
 
-    def _replay_wal(self):
-        for payload in WriteAheadLog.replay(self._wal.path):
-            rec = msgpack.unpackb(payload, raw=False, strict_map_key=False)
-            self._apply_mem(rec["k"], _unpack_value(self.strategy, rec["v"])
-                            if rec["v"] is not None else _TOMBSTONE)
+    def _new_wal(self) -> WriteAheadLog:
+        path = os.path.join(self.dir, f"wal-{self._wal_seq:06d}.bin")
+        self._wal_seq += 1
+        return WriteAheadLog(path, sync=self.sync_wal)
+
+    def _recover_wals(self) -> None:
+        """Replay every WAL (sealed-but-unflushed + active) into the active
+        memtable, oldest first; a single round-1 ``wal.bin`` replays too."""
+        names = sorted(
+            f for f in os.listdir(self.dir)
+            if (f.startswith("wal-") or f == "wal.bin") and f.endswith(".bin")
+        )
+        replayed_paths = []
+        for nm in names:
+            path = os.path.join(self.dir, nm)
+            for payload in WriteAheadLog.replay(path):
+                rec = msgpack.unpackb(payload, raw=False, strict_map_key=False)
+                if "b" in rec:  # batch frame
+                    for k, v in rec["b"]:
+                        self._mem.apply(
+                            self.strategy, k,
+                            _unpack_value(self.strategy, v)
+                            if v is not None else _TOMBSTONE)
+                else:
+                    self._mem.apply(
+                        self.strategy, rec["k"],
+                        _unpack_value(self.strategy, rec["v"])
+                        if rec["v"] is not None else _TOMBSTONE)
+            replayed_paths.append(path)
+            if nm.startswith("wal-"):
+                seq = int(nm.split("-")[1].split(".")[0])
+                self._wal_seq = max(self._wal_seq, seq + 1)
+        if self._mem.data:
+            # recovered state becomes one segment; stale WALs then delete
+            items = list(self._mem.packed_items(self.strategy))
+            seg = self._write_segment(items)
+            self._segments.append(seg)
+            self._mem = _Memtable(None)
+        for path in replayed_paths:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     # -- write path ----------------------------------------------------------
 
     def _log_and_apply(self, key: bytes, value) -> None:
         packed = None if value is _TOMBSTONE else _pack_value(self.strategy, value)
-        self._wal.append(msgpack.packb({"k": key, "v": packed}, use_bin_type=True))
-        self._apply_mem(key, value)
-        if self._mem_bytes >= self.memtable_limit:
-            self.flush()
+        self._mem.wal.append(
+            msgpack.packb({"k": key, "v": packed}, use_bin_type=True))
+        self._mem.apply(self.strategy, key, value)
+        self._write_gen += 1
+        if self._mem.bytes >= self.memtable_limit:
+            self._seal()
 
-    def _apply_mem(self, key: bytes, value) -> None:
-        cur = self._mem.get(key)
-        if value is _TOMBSTONE or cur is _TOMBSTONE or cur is None:
-            self._mem[key] = value
-        else:
-            self._mem[key] = _merge_values(self.strategy, cur, value)
-        self._mem_bytes += len(key) + 64
+    def _log_and_apply_many(self, pairs: list[tuple[bytes, object]]) -> None:
+        """One WAL frame + one memtable pass for a whole batch."""
+        frame = [
+            [k, None if v is _TOMBSTONE else _pack_value(self.strategy, v)]
+            for k, v in pairs
+        ]
+        self._mem.wal.append(msgpack.packb({"b": frame}, use_bin_type=True))
+        for k, v in pairs:
+            self._mem.apply(self.strategy, k, v)
+        self._write_gen += 1
+        if self._mem.bytes >= self.memtable_limit:
+            self._seal()
+
+    def _seal(self) -> None:
+        """Active memtable -> sealed list; fresh memtable + WAL. O(1): the
+        segment write happens in background maintenance (flush_pending).
+        Never flushes inline — the writer applies backpressure AFTER
+        releasing ``_lock`` (lock order is _flush_lock -> _lock; flushing
+        from under _lock would ABBA-deadlock against maintenance)."""
+        if not self._mem.data:
+            return
+        self._sealed.append(self._mem)
+        self._mem = _Memtable(self._new_wal())
+
+    def _backpressure(self) -> None:
+        """Writer-side valve, called WITHOUT ``_lock``: when sealed
+        memtables back up past MAX_SEALED, the writer pays for one flush
+        instead of RAM growing without bound (reference: memtable flush
+        blocks the put when the flushing queue backs up)."""
+        if len(self._sealed) > self.MAX_SEALED:
+            self.flush_pending(max_tables=1)
 
     def put(self, key: bytes, value) -> None:
         """replace strategy: store value (any msgpack-able object)."""
         assert self.strategy == "replace"
         with self._lock:
             self._log_and_apply(key, value)
+        self._backpressure()
+
+    def put_many(self, pairs: Iterable[tuple[bytes, object]]) -> None:
+        assert self.strategy == "replace"
+        pairs = list(pairs)
+        if not pairs:
+            return
+        with self._lock:
+            self._log_and_apply_many(pairs)
+        self._backpressure()
 
     def delete(self, key: bytes) -> None:
         assert self.strategy == "replace"
         with self._lock:
             self._log_and_apply(key, _TOMBSTONE)
+        self._backpressure()
 
     def set_add(self, key: bytes, values) -> None:
         assert self.strategy == "set"
         with self._lock:
             self._log_and_apply(key, {"add": set(values), "del": set()})
+        self._backpressure()
 
     def set_remove(self, key: bytes, values) -> None:
         assert self.strategy == "set"
         with self._lock:
             self._log_and_apply(key, {"add": set(), "del": set(values)})
+        self._backpressure()
 
     def map_set(self, key: bytes, mapping: dict) -> None:
         assert self.strategy == "map"
         with self._lock:
             self._log_and_apply(key, {"set": dict(mapping), "del": set()})
+        self._backpressure()
+
+    def map_set_many(self, pairs: Iterable[tuple[bytes, dict]]) -> None:
+        """Batch of (key, mapping) updates in one WAL frame."""
+        assert self.strategy == "map"
+        pairs = [(k, {"set": dict(m), "del": set()}) for k, m in pairs]
+        if not pairs:
+            return
+        with self._lock:
+            self._log_and_apply_many(pairs)
+        self._backpressure()
 
     def map_delete(self, key: bytes, map_keys) -> None:
         assert self.strategy == "map"
         with self._lock:
             self._log_and_apply(key, {"set": {}, "del": set(map_keys)})
+        self._backpressure()
+
+    def map_delete_many(self, pairs: Iterable[tuple[bytes, Iterable]]) -> None:
+        assert self.strategy == "map"
+        pairs = [(k, {"set": {}, "del": set(mks)}) for k, mks in pairs]
+        if not pairs:
+            return
+        with self._lock:
+            self._log_and_apply_many(pairs)
+        self._backpressure()
 
     def bitmap_add(self, key: bytes, ids) -> None:
         assert self.strategy == "roaringset"
@@ -320,6 +664,20 @@ class Bucket:
                 {"add": np.unique(np.asarray(list(ids), np.uint64)),
                  "del": np.empty(0, np.uint64)},
             )
+        self._backpressure()
+
+    def bitmap_add_many(self, pairs: Iterable[tuple[bytes, Iterable]]) -> None:
+        assert self.strategy == "roaringset"
+        pairs = [
+            (k, {"add": np.unique(np.asarray(list(ids), np.uint64)),
+                 "del": np.empty(0, np.uint64)})
+            for k, ids in pairs
+        ]
+        if not pairs:
+            return
+        with self._lock:
+            self._log_and_apply_many(pairs)
+        self._backpressure()
 
     def bitmap_remove(self, key: bytes, ids) -> None:
         assert self.strategy == "roaringset"
@@ -329,43 +687,62 @@ class Bucket:
                 {"add": np.empty(0, np.uint64),
                  "del": np.unique(np.asarray(list(ids), np.uint64))},
             )
+        self._backpressure()
+
+    def bitmap_remove_many(self, pairs: Iterable[tuple[bytes, Iterable]]) -> None:
+        assert self.strategy == "roaringset"
+        pairs = [
+            (k, {"add": np.empty(0, np.uint64),
+                 "del": np.unique(np.asarray(list(ids), np.uint64))})
+            for k, ids in pairs
+        ]
+        if not pairs:
+            return
+        with self._lock:
+            self._log_and_apply_many(pairs)
+        self._backpressure()
 
     # -- read path -----------------------------------------------------------
 
-    @staticmethod
-    def _is_tomb_record(raw: bytes) -> bool:
-        obj = msgpack.unpackb(raw, raw=False, strict_map_key=False)
-        return isinstance(obj, dict) and obj.get("__tomb__") is True
-
     def get(self, key: bytes):
-        """Merged view across memtable + segments (newest wins)."""
+        """Merged view across memtable + sealed + segments (newest wins).
+
+        ``replace`` walks newest -> oldest and stops at the first hit;
+        merge strategies fold oldest -> newest."""
         with self._lock:
-            layers = []
-            for seg in self._segments:
+            mem_layers = [m.data.get(key) for m in self._sealed]
+            mem_layers.append(self._mem.data.get(key))
+            segments = list(self._segments)
+        if self.strategy == "replace":
+            for v in reversed(mem_layers):
+                if v is not None:
+                    return None if v is _TOMBSTONE else v
+            for seg in reversed(segments):
                 raw = seg.get(key)
                 if raw is not None:
-                    if self._is_tomb_record(raw):
-                        layers.append(_TOMBSTONE)
-                    else:
-                        layers.append(_unpack_value(self.strategy, raw))
-            mem = self._mem.get(key)
-            if mem is not None:
-                layers.append(mem)
-            if not layers:
-                return None
-            if self.strategy == "replace":
-                last = layers[-1]
-                return None if last is _TOMBSTONE else last
-            out = _empty_value(self.strategy)
-            seen_any = False
-            for layer in layers:
-                if layer is _TOMBSTONE:
-                    out = _empty_value(self.strategy)  # wipes prior layers
-                    seen_any = False
-                else:
-                    out = _merge_values(self.strategy, out, layer)
-                    seen_any = True
-            return out if seen_any else None
+                    if _is_tomb_record(raw):
+                        return None
+                    return _unpack_value(self.strategy, raw)
+            return None
+        layers = []
+        for seg in segments:
+            raw = seg.get(key)
+            if raw is not None:
+                layers.append(_TOMBSTONE if _is_tomb_record(raw)
+                              else _unpack_value(self.strategy, raw))
+        layers.extend(v for v in mem_layers if v is not None)
+        if not layers:
+            return None
+        out = _empty_value(self.strategy)
+        seen_any = False
+        for layer in layers:
+            if layer is _TOMBSTONE:
+                out = _empty_value(self.strategy)  # wipes prior layers
+                seen_any = False
+            else:
+                out = _merge_values(self.strategy, out, layer)
+                seen_any = True
+        return out if seen_any else None
 
     def get_set(self, key: bytes) -> set:
         v = self.get(key)
@@ -381,112 +758,233 @@ class Bucket:
             return np.empty(0, np.uint64)
         return native.difference_sorted(v["add"], v["del"])
 
-    def keys(self) -> list[bytes]:
+    def _merged_layers(self):
+        """Snapshot of (segments, memtables oldest->newest) for iteration."""
         with self._lock:
-            out = set()
-            for seg in self._segments:
-                out.update(seg.keys)
-            for k, v in self._mem.items():
-                out.add(k)
-            live = []
-            for k in sorted(out):
-                val = self.get(k)
-                if self.strategy == "replace":
-                    if val is not None:
-                        live.append(k)
+            return list(self._segments), [m.data for m in self._sealed] + \
+                [self._mem.data]
+
+    def iter_merged(self, start: bytes | None = None,
+                    stop: bytes | None = None
+                    ) -> Iterator[tuple[bytes, object]]:
+        """Streaming key-ordered cursor over merged layers, tombstones
+        included (value is _TOMBSTONE) — the compaction/scan primitive
+        (reference: segment cursors, lsmkv/cursor.go). ``start``/``stop``
+        bound the key range [start, stop) — segments seek via their on-disk
+        index, so a range scan costs O(log n + range)."""
+        segments, mems = self._merged_layers()
+
+        def seg_iter(seg, rank):
+            for k, raw in seg.iter_items(start=start):
+                if stop is not None and k >= stop:
+                    return
+                v = _TOMBSTONE if _is_tomb_record(raw) else \
+                    _unpack_value(self.strategy, raw)
+                yield k, rank, v
+
+        def mem_iter(data, rank):
+            for k in sorted(data):
+                if start is not None and k < start:
+                    continue
+                if stop is not None and k >= stop:
+                    return
+                yield k, rank, data[k]
+
+        iters = [seg_iter(s, i) for i, s in enumerate(segments)]
+        iters += [mem_iter(d, len(segments) + i) for i, d in enumerate(mems)]
+        merged = heapq.merge(*iters, key=lambda t: (t[0], t[1]))
+        cur_key: bytes | None = None
+        cur_val = None
+        for k, _rank, v in merged:
+            if k != cur_key:
+                if cur_key is not None:
+                    yield cur_key, cur_val
+                cur_key, cur_val = k, v
+            else:
+                if v is _TOMBSTONE or cur_val is _TOMBSTONE:
+                    cur_val = v
                 else:
-                    live.append(k)
-            return live
+                    cur_val = _merge_values(self.strategy, cur_val, v)
+        if cur_key is not None:
+            yield cur_key, cur_val
+
+    def keys(self) -> list[bytes]:
+        return [k for k, v in self.iter_merged() if v is not _TOMBSTONE]
 
     def iter_items(self) -> Iterator[tuple[bytes, object]]:
         """Cursor over merged live items in key order (reference: segment
         cursors used by the flat index full scan)."""
-        for k in self.keys():
-            v = self.get(k)
-            if v is not None:
+        for k, v in self.iter_merged():
+            if v is not _TOMBSTONE:
+                yield k, v
+
+    def iter_range(self, start: bytes | None = None,
+                   stop: bytes | None = None
+                   ) -> Iterator[tuple[bytes, object]]:
+        """Live merged items with keys in [start, stop)."""
+        for k, v in self.iter_merged(start, stop):
+            if v is not _TOMBSTONE:
                 yield k, v
 
     def __len__(self) -> int:
-        return len(self.keys())
+        n = 0
+        for _ in self.iter_items():
+            n += 1
+        return n
 
     # -- flush / compaction --------------------------------------------------
 
     @property
     def dirty(self) -> bool:
-        """True when the memtable holds unflushed entries."""
-        return bool(self._mem)
+        """True when unflushed entries exist (active or sealed memtables)."""
+        return bool(self._mem.data) or bool(self._sealed)
 
     @property
     def segment_count(self) -> int:
         return len(self._segments)
 
+    def _write_segment(self, items: list[tuple[bytes, bytes]]):
+        path = os.path.join(self.dir, f"segment-{self._next_seq:06d}.db")
+        self._next_seq += 1
+        return _Segment.write(path, items)
+
+    def flush_pending(self, max_tables: int | None = None) -> bool:
+        """Turn sealed memtables into segments (background work; reference:
+        store_cyclecallbacks.go flush cycle). Returns True if flushed any."""
+        did = False
+        with self._flush_lock:
+            while True:
+                with self._lock:
+                    if not self._sealed:
+                        break
+                    if max_tables is not None and max_tables <= 0:
+                        break
+                    mt = self._sealed[0]
+                    seq_path = os.path.join(
+                        self.dir, f"segment-{self._next_seq:06d}.db")
+                    self._next_seq += 1
+                    items = list(mt.packed_items(self.strategy))
+                # segment write happens outside the bucket lock
+                seg = _Segment.write(seq_path, items)
+                with self._lock:
+                    self._segments.append(seg)
+                    self._sealed.pop(0)
+                if mt.wal is not None:
+                    mt.wal.close()
+                    try:
+                        os.remove(mt.wal.path)
+                    except OSError:
+                        pass
+                did = True
+                if max_tables is not None:
+                    max_tables -= 1
+        return did
+
     def flush(self) -> None:
-        """Memtable -> new segment; WAL truncates (reference: flush cycle,
-        store_cyclecallbacks.go)."""
+        """Force: seal the active memtable and write every pending segment
+        (close/backup; reference bucket.FlushMemtable)."""
         with self._lock:
-            if not self._mem:
-                return
-            items = []
-            for k in sorted(self._mem):
-                v = self._mem[k]
-                if v is _TOMBSTONE:
-                    packed = msgpack.packb({"__tomb__": True}, use_bin_type=True)
-                else:
-                    packed = _pack_value(self.strategy, v)
-                items.append((k, packed))
-            path = os.path.join(self.dir, f"segment-{self._next_seq:06d}.db")
-            self._next_seq += 1
-            self._segments.append(_Segment.write(path, items))
-            self._mem.clear()
-            self._mem_bytes = 0
-            self._wal.reset()
+            self._seal()
+        self.flush_pending()
+
+    def maintain(self, compact_above: int = 4) -> bool:
+        """One background cycle: flush sealed memtables; compact when the
+        segment stack grows past the threshold. Seals the active memtable
+        only when it is IDLE (no writes since the previous cycle) — a
+        steady trickle of small writes must not become one tiny segment
+        per cycle plus recurring full-bucket compactions."""
+        did = self.flush_pending()
+        with self._lock:
+            idle = self._write_gen == self._maintain_gen
+            self._maintain_gen = self._write_gen
+            if self._mem.data and not self._sealed and idle:
+                self._seal()
+        did = self.flush_pending() or did
+        if self.segment_count > compact_above:
+            self.compact()
+            did = True
+        return did
 
     def compact(self) -> None:
-        """Full compaction: merge all segments strategy-aware, drop
-        tombstones (reference: segment_group_compaction.go +
-        compactor_{replace,set,map}.go)."""
-        with self._lock:
-            self.flush()
-            if len(self._segments) <= 1:
+        """Merge the current segment stack into one, strategy-aware,
+        dropping tombstones (reference: segment_group_compaction.go +
+        compactor_{replace,set,map}.go). Streams through a k-way merge —
+        peak RAM is O(1) records, not the whole bucket."""
+        with self._flush_lock:
+            with self._lock:
+                snapshot = list(self._segments)
+            if len(snapshot) <= 1:
                 return
-            merged: dict[bytes, object] = {}
-            for seg in self._segments:  # oldest -> newest
+
+            def seg_iter(seg, rank):
                 for k, raw in seg.iter_items():
-                    obj = msgpack.unpackb(raw, raw=False, strict_map_key=False)
-                    if isinstance(obj, dict) and obj.get("__tomb__"):
-                        merged[k] = _TOMBSTONE
-                        continue
-                    val = _unpack_value(self.strategy, raw)
-                    cur = merged.get(k)
-                    if cur is None or cur is _TOMBSTONE:
-                        merged[k] = val
+                    v = _TOMBSTONE if _is_tomb_record(raw) else \
+                        _unpack_value(self.strategy, raw)
+                    yield k, rank, v
+
+            merged = heapq.merge(
+                *[seg_iter(s, i) for i, s in enumerate(snapshot)],
+                key=lambda t: (t[0], t[1]))
+
+            def live_items():
+                cur_key: bytes | None = None
+                cur_val = None
+                for k, _rank, v in merged:
+                    if k != cur_key:
+                        if cur_key is not None and cur_val is not _TOMBSTONE:
+                            yield cur_key, _pack_value(self.strategy, cur_val)
+                        cur_key, cur_val = k, v
                     else:
-                        merged[k] = _merge_values(self.strategy, cur, val)
-            items = []
-            for k in sorted(merged):
-                v = merged[k]
-                if v is _TOMBSTONE:
-                    continue  # tombstones die in full compaction
-                items.append((k, _pack_value(self.strategy, v)))
+                        if v is _TOMBSTONE or cur_val is _TOMBSTONE:
+                            cur_val = v
+                        else:
+                            cur_val = _merge_values(self.strategy, cur_val, v)
+                if cur_key is not None and cur_val is not _TOMBSTONE:
+                    yield cur_key, _pack_value(self.strategy, cur_val)
+
             # Crash safety: write the merged segment as a NEW higher-seq
             # segment first, then delete the old ones. A crash in between
             # leaves old + merged coexisting, which replays consistently
             # (merge is idempotent; replace takes the newest layer).
-            old_segments = self._segments
-            if items:
-                path = os.path.join(self.dir, f"segment-{self._next_seq:06d}.db")
+            with self._lock:
+                path = os.path.join(
+                    self.dir, f"segment-{self._next_seq:06d}.db")
                 self._next_seq += 1
-                merged_seg = _Segment.write(path, items)
-                self._segments = [merged_seg]
-            else:
-                self._segments = []
-            for seg in old_segments:
-                os.remove(seg.path)
+            # stream the merge straight into the segment writer — peak RAM
+            # stays O(1) records even for multi-GB buckets
+            merged_seg = _Segment.write(path, live_items())
+            if merged_seg.n == 0:
+                merged_seg.close()
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                merged_seg = None
+            with self._lock:
+                tail = self._segments[len(snapshot):]  # flushed meanwhile
+                self._segments = ([merged_seg] if merged_seg else []) + tail
+            # unlink only — concurrent readers may still hold the old list
+            # snapshot; the inode stays alive until their references drop
+            # and GC closes the mmap (POSIX unlink-while-open semantics)
+            for seg in snapshot:
+                try:
+                    os.remove(seg.path)
+                except OSError:
+                    pass
 
     def close(self) -> None:
+        self.flush()
         with self._lock:
-            self.flush()
-            self._wal.close()
+            if self._mem.wal is not None:
+                self._mem.wal.close()
+                # an empty active WAL leaves no recovery work behind
+                try:
+                    if os.path.getsize(self._mem.wal.path) == 0:
+                        os.remove(self._mem.wal.path)
+                except OSError:
+                    pass
+            for seg in self._segments:
+                seg.close()
 
 
 class KVStore:

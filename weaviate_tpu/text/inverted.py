@@ -3,10 +3,23 @@
 Reference: adapters/repos/db/inverted/ — the analyzer feeds three LSM bucket
 families (mapcollection postings with term frequencies for BM25,
 roaringset bitmaps for filterable props, prop-length tracker for BM25
-normalization). Here the same three structures are host-RAM resident and
-rebuilt from the objects bucket at startup (the shard replays objects the
-same way it replays vectors into HBM); scoring is vectorized numpy — the
-sparse-gather half of the hybrid pipeline whose dense half runs on TPU.
+normalization). This implementation writes through the same three bucket
+shapes at put time (reference: updateInvertedIndexLSM,
+shard_write_put.go:454):
+
+- ``inv_search``  (map)        key = prop\\x00term -> {doc: [tf, prop_len]}
+                               (reference MapPair packs tf + propLength the
+                               same way for BM25, inverted/bm25_searcher.go)
+- ``inv_filter``  (roaringset) key = prop\\x00 + typed value key
+- ``inv_numeric`` (roaringset) key = prop\\x00 + order-preserving f64 —
+                               range filters are LSM range scans
+- ``inv_geo``     (replace)    key = prop\\x00 + be64 doc -> (lat, lon)
+- ``inv_null``    (roaringset) key = prop (reference IndexNullState)
+- ``inv_meta``    (replace)    per-prop length aggregates + doc count
+
+Opening a shard therefore does NOT replay objects into RAM: postings are
+read (and LRU-cached) on demand at query time, merged across segments by
+the LSM read path — reopen cost is O(segments), not O(objects).
 
 Scoring is **whole-posting vectorized** rather than WAND-pruned
 (bm25_searcher.go:100 `wand`): gather the union of candidate doc ids with
@@ -19,8 +32,9 @@ unit prefers scoring everything in one pass.
 from __future__ import annotations
 
 import math
+import struct
 import threading
-from collections import defaultdict
+from collections import OrderedDict
 from datetime import datetime, timezone
 
 import numpy as np
@@ -28,6 +42,16 @@ import numpy as np
 from weaviate_tpu.schema.config import CollectionConfig, DataType, Property
 from weaviate_tpu.text.stopwords import StopwordDetector
 from weaviate_tpu.text.tokenizer import tokenize
+
+B_SEARCH = "inv_search"
+B_FILTER = "inv_filter"
+B_NUMERIC = "inv_numeric"
+B_GEO = "inv_geo"
+B_NULL = "inv_null"
+B_META = "inv_meta"
+
+_ALL_DOCS = b"\x00__all__"
+_SEP = b"\x00"
 
 
 def parse_date(value) -> float:
@@ -43,35 +67,38 @@ def parse_date(value) -> float:
     return dt.timestamp()
 
 
-class _Postings:
-    """Postings list for one (property, term): doc_id -> tf, with a cached
-    numpy view for scoring (invalidated on mutation)."""
+def _enc_f64(x: float) -> bytes:
+    """Order-preserving float64 encoding: byte order == numeric order."""
+    x = float(x)
+    if x == 0.0:
+        x = 0.0  # -0.0 and +0.0 must share a key (dict semantics: -0.0 == 0.0)
+    (u,) = struct.unpack(">Q", struct.pack(">d", x))
+    if u & 0x8000000000000000:
+        u = ~u & 0xFFFFFFFFFFFFFFFF
+    else:
+        u |= 0x8000000000000000
+    return struct.pack(">Q", u)
 
-    __slots__ = ("tf", "_ids", "_tfs")
 
-    def __init__(self):
-        self.tf: dict[int, int] = {}
-        self._ids = None
-        self._tfs = None
+def _dec_f64(b: bytes) -> float:
+    (u,) = struct.unpack(">Q", b)
+    if u & 0x8000000000000000:
+        u &= 0x7FFFFFFFFFFFFFFF
+    else:
+        u = ~u & 0xFFFFFFFFFFFFFFFF
+    return struct.unpack(">d", struct.pack(">Q", u))[0]
 
-    def add(self, doc_id: int, count: int):
-        self.tf[doc_id] = self.tf.get(doc_id, 0) + count
-        self._ids = None
 
-    def remove(self, doc_id: int):
-        if self.tf.pop(doc_id, None) is not None:
-            self._ids = None
-
-    def arrays(self):
-        if self._ids is None:
-            self._ids = np.fromiter(self.tf.keys(), dtype=np.int64,
-                                    count=len(self.tf))
-            self._tfs = np.fromiter(self.tf.values(), dtype=np.float32,
-                                    count=len(self.tf))
-        return self._ids, self._tfs
-
-    def __len__(self):
-        return len(self.tf)
+def _value_key(value) -> bytes | None:
+    """Typed exact-match key for one filterable value (text tokens keyed
+    as 't'+utf8 so LIKE can range-scan the text vocabulary)."""
+    if isinstance(value, bool):
+        return b"b\x01" if value else b"b\x00"
+    if isinstance(value, (int, float)):
+        return b"f" + _enc_f64(float(value))
+    if isinstance(value, str):
+        return b"t" + value.encode()
+    return None
 
 
 def _infer_type(value) -> str | None:
@@ -98,12 +125,40 @@ _NUMERIC_TYPES = {DataType.INT, DataType.NUMBER, DataType.DATE,
                   DataType.INT_ARRAY, DataType.NUMBER_ARRAY, DataType.DATE_ARRAY}
 
 
-class InvertedIndex:
-    """All three index families for one shard. Thread-safety: guarded by a
-    single RLock (mutations come in under the shard lock anyway; queries
-    take it only to snapshot postings references)."""
+class _LRU:
+    """Tiny LRU for decoded posting/bitmap arrays (hot query terms)."""
 
-    def __init__(self, config: CollectionConfig):
+    def __init__(self, cap: int = 65536):
+        self.cap = cap
+        self.d: OrderedDict = OrderedDict()
+
+    def get(self, key):
+        v = self.d.get(key)
+        if v is not None:
+            self.d.move_to_end(key)
+        return v
+
+    def put(self, key, value):
+        self.d[key] = value
+        self.d.move_to_end(key)
+        if len(self.d) > self.cap:
+            self.d.popitem(last=False)
+
+    def pop(self, key):
+        self.d.pop(key, None)
+
+    def clear(self):
+        self.d.clear()
+
+
+class InvertedIndex:
+    """All six bucket families for one shard, with RAM LRU caches in front.
+
+    Thread-safety: a single RLock guards cache + meta mutations (writes
+    come in under the shard lock anyway; queries take it to snapshot).
+    """
+
+    def __init__(self, config: CollectionConfig, store=None):
         self.config = config
         inv = config.inverted
         self.stopwords = StopwordDetector(inv.stopwords_preset,
@@ -112,28 +167,35 @@ class InvertedIndex:
         self.k1 = inv.bm25_k1
         self.b = inv.bm25_b
         self._lock = threading.RLock()
-        # searchable text postings: prop -> term -> _Postings
-        self.searchable: dict[str, dict[str, _Postings]] = defaultdict(dict)
-        # per-prop token counts for BM25 length normalization
-        # (reference: new_prop_length_tracker.go JsonShardMetaData)
-        self.doc_len: dict[str, dict[int, int]] = defaultdict(dict)
-        self.total_len: dict[str, int] = defaultdict(int)
-        # filterable exact-value sets: prop -> value_key -> set(doc_id)
-        # (reference: roaringset strategy buckets)
-        self.filterable: dict[str, dict[object, set[int]]] = defaultdict(
-            lambda: defaultdict(set))
-        # numeric/date values for range filters: prop -> doc_id -> float
-        self.numeric: dict[str, dict[int, float]] = defaultdict(dict)
-        # numeric/date ARRAY props: range filters need the per-value keys
-        # for any-element semantics; scalar props are fully covered by
-        # the numeric map
-        self.array_props: set[str] = set()
-        # geo coordinates: prop -> doc_id -> (lat, lon)
-        self.geo: dict[str, dict[int, tuple[float, float]]] = defaultdict(dict)
-        # null tracking (reference: IndexNullState)
-        self.nulls: dict[str, set[int]] = defaultdict(set)
-        self.doc_count = 0
-        self._docs: set[int] = set()
+        if store is None:
+            # tests construct an index without a shard store: back it with
+            # an in-RAM KVStore in a temp dir? No — a throwaway tmpdir.
+            import tempfile
+
+            from weaviate_tpu.storage.kv import KVStore
+
+            self._own_dir = tempfile.TemporaryDirectory(prefix="inv-")
+            store = KVStore(self._own_dir.name)
+        self._store = store
+        self.searchable_bucket = store.bucket(B_SEARCH, "map")
+        self.filter_bucket = store.bucket(B_FILTER, "roaringset")
+        self.numeric_bucket = store.bucket(B_NUMERIC, "roaringset")
+        self.geo_bucket = store.bucket(B_GEO, "replace")
+        self.null_bucket = store.bucket(B_NULL, "roaringset")
+        self.meta_bucket = store.bucket(B_META, "replace")
+        self._post_cache = _LRU()
+        self._bitmap_cache = _LRU()
+        self._geo_cache: dict[str, tuple] = {}
+        # bumped under _lock on every mutation; readers capture it before
+        # the (unlocked) bucket read and only cache if unchanged — a
+        # concurrent write's invalidation can never be overwritten by a
+        # stale fill
+        self._version = 0
+        self._meta = self.meta_bucket.get(b"__aggregates__") or {
+            "doc_count": 0, "props": {}}
+        # props that hold numeric/date ARRAYS: range semantics are
+        # any-element, answered by the per-element numeric keys
+        self.array_props: set[str] = set(self._meta.get("arrays", []))
 
     # -- schema helpers -------------------------------------------------------
 
@@ -146,97 +208,205 @@ class InvertedIndex:
             return None
         return Property(name=name, data_type=dt)
 
+    @property
+    def doc_count(self) -> int:
+        return int(self._meta.get("doc_count", 0))
+
+    def _save_meta(self):
+        self._meta["arrays"] = sorted(self.array_props)
+        self.meta_bucket.put(b"__aggregates__", self._meta)
+
     # -- mutation -------------------------------------------------------------
 
     def index_object(self, obj) -> None:
-        with self._lock:
-            if obj.doc_id in self._docs:
-                return
-            self._docs.add(obj.doc_id)
-            self.doc_count += 1
-            for name, value in obj.properties.items():
-                self._index_prop(obj.doc_id, name, value)
-            if self.config.inverted.index_timestamps:
-                self.numeric["_creationTimeUnix"][obj.doc_id] = obj.creation_time_ms
-                self.numeric["_lastUpdateTimeUnix"][obj.doc_id] = obj.last_update_time_ms
+        self.index_objects([obj])
 
-    def unindex_object(self, obj) -> None:
-        with self._lock:
-            if obj.doc_id not in self._docs:
-                return
-            self._docs.discard(obj.doc_id)
-            self.doc_count -= 1
+    def index_objects(self, objs) -> None:
+        """Batch insert: one WAL frame per bucket family per batch
+        (reference: updateInvertedIndexLSM per put, shard_write_put.go:454)."""
+        search_upd: dict[bytes, dict] = {}
+        filter_add: dict[bytes, set] = {}
+        numeric_add: dict[bytes, set] = {}
+        null_add: dict[bytes, set] = {}
+        geo_puts: list[tuple[bytes, object]] = []
+        all_docs: set[int] = set()
+        prop_len_delta: dict[str, list] = {}  # prop -> [total_delta, count_delta]
+
+        for obj in objs:
             doc = obj.doc_id
+            all_docs.add(doc)
             for name, value in obj.properties.items():
-                prop = self._prop_schema(name, value)
-                if prop is None:
-                    continue
-                if prop.index_searchable and prop.data_type in (
-                        DataType.TEXT, DataType.TEXT_ARRAY):
-                    terms = self.searchable.get(name, {})
-                    for term in set(tokenize(value, prop.tokenization)):
-                        p = terms.get(term)
-                        if p is not None:
-                            p.remove(doc)
-                            if not p.tf:
-                                del terms[term]
-                    ln = self.doc_len[name].pop(doc, 0)
-                    self.total_len[name] -= ln
-                for vk in self._filter_keys(prop, value):
-                    s = self.filterable[name].get(vk)
-                    if s is not None:
-                        s.discard(doc)
-                        if not s:
-                            del self.filterable[name][vk]
-                self.numeric[name].pop(doc, None)
-                self.geo[name].pop(doc, None)
-            for s in self.nulls.values():
-                s.discard(doc)
+                self._collect_index_prop(
+                    doc, name, value, search_upd, filter_add, numeric_add,
+                    null_add, geo_puts, prop_len_delta)
             if self.config.inverted.index_timestamps:
-                self.numeric["_creationTimeUnix"].pop(doc, None)
-                self.numeric["_lastUpdateTimeUnix"].pop(doc, None)
+                for tname, tval in (
+                        ("_creationTimeUnix", obj.creation_time_ms),
+                        ("_lastUpdateTimeUnix", obj.last_update_time_ms)):
+                    nk = tname.encode() + _SEP + _enc_f64(float(tval))
+                    numeric_add.setdefault(nk, set()).add(doc)
 
-    def _index_prop(self, doc: int, name: str, value) -> None:
+        with self._lock:
+            if search_upd:
+                self.searchable_bucket.map_set_many(search_upd.items())
+            filter_add.setdefault(_ALL_DOCS, set()).update(all_docs)
+            self.filter_bucket.bitmap_add_many(filter_add.items())
+            if numeric_add:
+                self.numeric_bucket.bitmap_add_many(numeric_add.items())
+            if null_add:
+                self.null_bucket.bitmap_add_many(null_add.items())
+            if geo_puts:
+                self.geo_bucket.put_many(geo_puts)
+            self._meta["doc_count"] = self.doc_count + len(objs)
+            props_meta = self._meta.setdefault("props", {})
+            for prop, (dl, dc) in prop_len_delta.items():
+                pm = props_meta.setdefault(prop, {"total_len": 0, "len_count": 0})
+                pm["total_len"] += dl
+                pm["len_count"] += dc
+            self._save_meta()
+            self._version += 1
+            # cache invalidation for every touched key
+            for k in search_upd:
+                self._post_cache.pop(k)
+            for k in filter_add:
+                self._bitmap_cache.pop((B_FILTER, k))
+            for k in numeric_add:
+                self._bitmap_cache.pop((B_NUMERIC, k))
+            for k in null_add:
+                self._bitmap_cache.pop((B_NULL, k))
+            for k, _ in geo_puts:
+                self._geo_cache.pop(k.split(_SEP, 1)[0].decode(), None)
+
+    def _collect_index_prop(self, doc, name, value, search_upd, filter_add,
+                            numeric_add, null_add, geo_puts, prop_len_delta):
         prop = self._prop_schema(name, value)
         if prop is None:
             return
+        pfx = name.encode() + _SEP
         if value is None:
             if self.config.inverted.index_null_state:
-                self.nulls[name].add(doc)
+                null_add.setdefault(name.encode(), set()).add(doc)
             return
         if prop.index_searchable and prop.data_type in (
                 DataType.TEXT, DataType.TEXT_ARRAY):
             tokens = tokenize(value, prop.tokenization)
-            terms = self.searchable[name]
             counts: dict[str, int] = {}
             for t in tokens:
                 counts[t] = counts.get(t, 0) + 1
+            n_tok = len(tokens)
             for t, c in counts.items():
-                terms.setdefault(t, _Postings()).add(doc, c)
-            self.doc_len[name][doc] = len(tokens)
-            self.total_len[name] += len(tokens)
+                search_upd.setdefault(pfx + t.encode(), {})[doc] = [c, n_tok]
+            d = prop_len_delta.setdefault(name, [0, 0])
+            d[0] += n_tok
+            d[1] += 1
         if not prop.index_filterable:
             return
         for vk in self._filter_keys(prop, value):
-            self.filterable[name][vk].add(doc)
+            bk = _value_key(vk)
+            if bk is not None:
+                filter_add.setdefault(pfx + bk, set()).add(doc)
         dt = prop.data_type
         if dt in (DataType.INT, DataType.NUMBER):
-            self.numeric[name][doc] = float(value)
+            numeric_add.setdefault(pfx + _enc_f64(float(value)), set()).add(doc)
         elif dt == DataType.DATE:
-            self.numeric[name][doc] = parse_date(value)
+            numeric_add.setdefault(pfx + _enc_f64(parse_date(value)),
+                                   set()).add(doc)
         elif dt in (DataType.INT_ARRAY, DataType.NUMBER_ARRAY):
             self.array_props.add(name)
-            if value:
-                # scalar index keeps min (for sorting); range filters use the
-                # per-value filterable keys for any-element semantics
-                self.numeric[name][doc] = float(min(value))
+            for v in set(value):
+                numeric_add.setdefault(pfx + _enc_f64(float(v)), set()).add(doc)
         elif dt == DataType.DATE_ARRAY:
             self.array_props.add(name)
-            if value:
-                self.numeric[name][doc] = min(parse_date(v) for v in value)
+            for v in set(value):
+                numeric_add.setdefault(pfx + _enc_f64(parse_date(v)),
+                                       set()).add(doc)
         elif dt == DataType.GEO:
-            self.geo[name][doc] = (float(value["latitude"]),
-                                   float(value["longitude"]))
+            geo_puts.append((pfx + struct.pack(">Q", doc),
+                             [float(value["latitude"]),
+                              float(value["longitude"])]))
+
+    def unindex_object(self, obj) -> None:
+        doc = obj.doc_id
+        search_del: dict[bytes, set] = {}
+        filter_del: dict[bytes, set] = {}
+        numeric_del: dict[bytes, set] = {}
+        null_del: dict[bytes, set] = {}
+        geo_del: list[bytes] = []
+        prop_len_delta: dict[str, list] = {}
+
+        for name, value in obj.properties.items():
+            prop = self._prop_schema(name, value)
+            if prop is None:
+                continue
+            pfx = name.encode() + _SEP
+            if value is None:
+                null_del.setdefault(name.encode(), set()).add(doc)
+                continue
+            if prop.index_searchable and prop.data_type in (
+                    DataType.TEXT, DataType.TEXT_ARRAY):
+                tokens = tokenize(value, prop.tokenization)
+                for term in set(tokens):
+                    search_del.setdefault(pfx + term.encode(), set()).add(doc)
+                d = prop_len_delta.setdefault(name, [0, 0])
+                d[0] -= len(tokens)
+                d[1] -= 1
+            for vk in self._filter_keys(prop, value):
+                bk = _value_key(vk)
+                if bk is not None:
+                    filter_del.setdefault(pfx + bk, set()).add(doc)
+            dt = prop.data_type
+            if dt in (DataType.INT, DataType.NUMBER):
+                numeric_del.setdefault(pfx + _enc_f64(float(value)),
+                                       set()).add(doc)
+            elif dt == DataType.DATE:
+                numeric_del.setdefault(pfx + _enc_f64(parse_date(value)),
+                                       set()).add(doc)
+            elif dt in (DataType.INT_ARRAY, DataType.NUMBER_ARRAY):
+                for v in set(value):
+                    numeric_del.setdefault(pfx + _enc_f64(float(v)),
+                                           set()).add(doc)
+            elif dt == DataType.DATE_ARRAY:
+                for v in set(value):
+                    numeric_del.setdefault(pfx + _enc_f64(parse_date(v)),
+                                           set()).add(doc)
+            elif dt == DataType.GEO:
+                geo_del.append(pfx + struct.pack(">Q", doc))
+
+        if self.config.inverted.index_timestamps:
+            for tname, tval in (("_creationTimeUnix", obj.creation_time_ms),
+                                ("_lastUpdateTimeUnix", obj.last_update_time_ms)):
+                nk = tname.encode() + _SEP + _enc_f64(float(tval))
+                numeric_del.setdefault(nk, set()).add(doc)
+
+        with self._lock:
+            if search_del:
+                self.searchable_bucket.map_delete_many(search_del.items())
+            filter_del.setdefault(_ALL_DOCS, set()).add(doc)
+            self.filter_bucket.bitmap_remove_many(filter_del.items())
+            if numeric_del:
+                self.numeric_bucket.bitmap_remove_many(numeric_del.items())
+            if null_del:
+                self.null_bucket.bitmap_remove_many(null_del.items())
+            for k in geo_del:
+                self.geo_bucket.delete(k)
+            self._meta["doc_count"] = max(self.doc_count - 1, 0)
+            props_meta = self._meta.setdefault("props", {})
+            for prop, (dl, dc) in prop_len_delta.items():
+                pm = props_meta.setdefault(prop, {"total_len": 0, "len_count": 0})
+                pm["total_len"] += dl
+                pm["len_count"] += dc
+            self._save_meta()
+            self._version += 1
+            for k in search_del:
+                self._post_cache.pop(k)
+            for k in filter_del:
+                self._bitmap_cache.pop((B_FILTER, k))
+            for k in numeric_del:
+                self._bitmap_cache.pop((B_NUMERIC, k))
+            for k in null_del:
+                self._bitmap_cache.pop((B_NULL, k))
+            for k in geo_del:
+                self._geo_cache.pop(k.split(_SEP, 1)[0].decode(), None)
 
     def _filter_keys(self, prop: Property, value) -> list:
         """Exact-match keys under which a value is filterable (text values
@@ -260,13 +430,141 @@ class InvertedIndex:
             return [parse_date(v) for v in value]
         return []
 
+    # -- read accessors (filters.py + BM25 consume these) ---------------------
+
+    def postings(self, prop: str, term: str):
+        """(ids int64 sorted, tfs f32, lens f32) for one (prop, term)."""
+        key = prop.encode() + _SEP + term.encode()
+        with self._lock:
+            hit = self._post_cache.get(key)
+            if hit is not None:
+                return hit
+            version = self._version
+        m = self.searchable_bucket.get_map(key)
+        if not m:
+            out = (np.empty(0, np.int64), np.empty(0, np.float32),
+                   np.empty(0, np.float32))
+        else:
+            ids = np.fromiter(m.keys(), dtype=np.int64, count=len(m))
+            order = np.argsort(ids)
+            ids = ids[order]
+            tfs = np.fromiter((v[0] for v in m.values()), dtype=np.float32,
+                              count=len(m))[order]
+            lens = np.fromiter((v[1] for v in m.values()), dtype=np.float32,
+                               count=len(m))[order]
+            out = (ids, tfs, lens)
+        with self._lock:
+            if self._version == version:
+                self._post_cache.put(key, out)
+        return out
+
+    def _bitmap(self, bucket_name: str, bucket, key: bytes) -> np.ndarray:
+        ck = (bucket_name, key)
+        with self._lock:
+            hit = self._bitmap_cache.get(ck)
+            if hit is not None:
+                return hit
+            version = self._version
+        arr = bucket.get_bitmap(key)
+        with self._lock:
+            if self._version == version:
+                self._bitmap_cache.put(ck, arr)
+        return arr
+
+    def all_docs(self) -> np.ndarray:
+        """Sorted uint64 ids of live docs."""
+        return self._bitmap(B_FILTER, self.filter_bucket, _ALL_DOCS)
+
+    def filterable_ids(self, prop: str, value) -> np.ndarray:
+        bk = _value_key(value)
+        if bk is None:
+            return np.empty(0, np.uint64)
+        return self._bitmap(B_FILTER, self.filter_bucket,
+                            prop.encode() + _SEP + bk)
+
+    def null_ids(self, prop: str) -> np.ndarray:
+        return self._bitmap(B_NULL, self.null_bucket, prop.encode())
+
+    def text_vocab(self, prop: str):
+        """Iterate (token, ids) over the text vocabulary of a prop (LIKE)."""
+        pfx = prop.encode() + _SEP + b"t"
+        for k, v in self.filter_bucket.iter_range(pfx, pfx + b"\xff" * 4):
+            from weaviate_tpu import native
+
+            ids = native.difference_sorted(v["add"], v["del"])
+            if len(ids):
+                yield k[len(pfx):].decode(), ids
+
+    def numeric_range_ids(self, prop: str, lo: float | None, hi: float | None,
+                          lo_incl: bool = True, hi_incl: bool = False):
+        """Union of doc bitmaps for values in the given range — an LSM
+        range scan over order-preserving keys (reference: searcher.go
+        range row readers over roaringset)."""
+        from weaviate_tpu import native
+
+        pfx = prop.encode() + _SEP
+        if lo is None:
+            start = pfx
+        else:
+            start = pfx + _enc_f64(lo)
+            if not lo_incl:
+                start += b"\x00"
+        if hi is None:
+            stop = pfx + b"\xff" * 9
+        else:
+            stop = pfx + _enc_f64(hi)
+            if hi_incl:
+                stop += b"\x00"
+        parts = []
+        for _k, v in self.numeric_bucket.iter_range(start, stop):
+            ids = native.difference_sorted(v["add"], v["del"])
+            if len(ids):
+                parts.append(ids)
+        if not parts:
+            return np.empty(0, np.uint64)
+        # one concatenate+unique instead of repeated pairwise unions —
+        # a wide range over mostly-unique values would otherwise go
+        # quadratic in the number of distinct keys
+        return np.unique(np.concatenate(parts))
+
+    def geo_arrays(self, prop: str):
+        """(ids int64, lats f64, lons f64) for every doc with a geo value
+        on ``prop`` — materialized from the geo bucket once and cached."""
+        with self._lock:
+            hit = self._geo_cache.get(prop)
+            if hit is not None:
+                return hit
+            version = self._version
+        pfx = prop.encode() + _SEP
+        ids, lats, lons = [], [], []
+        for k, v in self.geo_bucket.iter_range(pfx, pfx + b"\xff" * 9):
+            (doc,) = struct.unpack(">Q", k[len(pfx):])
+            ids.append(doc)
+            lats.append(v[0])
+            lons.append(v[1])
+        out = (np.asarray(ids, np.int64), np.asarray(lats, np.float64),
+               np.asarray(lons, np.float64))
+        with self._lock:
+            if self._version == version:
+                self._geo_cache[prop] = out
+        return out
+
+    def avg_len(self, prop: str) -> float:
+        pm = self._meta.get("props", {}).get(prop)
+        if not pm or not pm.get("len_count"):
+            return 1.0
+        return max(pm["total_len"] / pm["len_count"], 1e-9)
+
     # -- BM25F scoring --------------------------------------------------------
 
     def searchable_props(self) -> list[str]:
-        return [p.name for p in self.config.properties
-                if p.index_searchable and p.data_type in (
-                    DataType.TEXT, DataType.TEXT_ARRAY)] or \
-               list(self.searchable.keys())
+        props = [p.name for p in self.config.properties
+                 if p.index_searchable and p.data_type in (
+                     DataType.TEXT, DataType.TEXT_ARRAY)]
+        if props:
+            return props
+        # fall back to every prop with length aggregates (auto-schema'd)
+        return sorted(self._meta.get("props", {}).keys())
 
     def bm25_search(self, query: str, k: int = 10,
                     properties: list[str] | None = None,
@@ -277,86 +575,75 @@ class InvertedIndex:
         Reference: inverted/bm25_searcher.go:73 (BM25F), boosts parsed the
         same way (bm25_searcher.go propertyBoosts).
         """
-        with self._lock:
-            props: list[tuple[str, float]] = []
-            for spec in (properties or self.searchable_props()):
-                name, _, boost = spec.partition("^")
-                props.append((name, float(boost) if boost else 1.0))
-            n = max(self.doc_count, 1)
+        props: list[tuple[str, float]] = []
+        for spec in (properties or self.searchable_props()):
+            name, _, boost = spec.partition("^")
+            props.append((name, float(boost) if boost else 1.0))
+        n = max(self.doc_count, 1)
+        avg_len = {name: self.avg_len(name) for name, _ in props}
 
-            # per-prop average length for the normalization term
-            avg_len = {
-                name: (self.total_len[name] / max(len(self.doc_len[name]), 1))
-                or 1.0
-                for name, _ in props
-            }
+        # the query analyzes per-property with THAT property's
+        # tokenization (reference: bm25_searcher analyzes per field);
+        # a term's df = docs containing it in ANY searched property
+        # (BM25F treats props as fields of one doc)
+        term_fields: dict[str, list] = {}
+        for name, boost in props:
+            sch = self.config.property(name)
+            tok = sch.tokenization if sch is not None else "word"
+            for term in self.stopwords.filter(
+                    sorted(set(tokenize(query, tok)))):
+                term_fields.setdefault(term, []).append((name, boost))
+        if not term_fields:
+            return np.empty(0, np.int64), np.empty(0, np.float32)
 
-            # the query analyzes per-property with THAT property's
-            # tokenization (reference: bm25_searcher analyzes per field);
-            # a term's df = docs containing it in ANY searched property
-            # (BM25F treats props as fields of one doc)
-            term_fields: dict[str, list] = {}
-            for name, boost in props:
-                sch = self.config.property(name)
-                tok = sch.tokenization if sch is not None else "word"
-                for term in self.stopwords.filter(
-                        sorted(set(tokenize(query, tok)))):
-                    term_fields.setdefault(term, []).append((name, boost))
-            if not term_fields:
-                return np.empty(0, np.int64), np.empty(0, np.float32)
-
-            term_rows = []  # (idf, [(ids, tfs, boost, prop_name)])
-            for term, tf_props in sorted(term_fields.items()):
-                fields = []
-                df_docs: set[int] = set()
-                for name, boost in tf_props:
-                    p = self.searchable.get(name, {}).get(term)
-                    if p is None or not len(p):
-                        continue
-                    ids, tfs = p.arrays()
-                    fields.append((ids, tfs, boost, name))
-                    df_docs.update(p.tf.keys())
-                if not fields:
+        term_rows = []  # (idf, [(ids, tfs, lens, boost, prop_name)])
+        for term, tf_props in sorted(term_fields.items()):
+            fields = []
+            df_union = None
+            for name, boost in tf_props:
+                ids, tfs, lens = self.postings(name, term)
+                if not len(ids):
                     continue
-                df = len(df_docs)
-                idf = math.log(1.0 + (n - df + 0.5) / (df + 0.5))
-                term_rows.append((idf, fields))
-            if not term_rows:
-                return np.empty(0, np.int64), np.empty(0, np.float32)
+                fields.append((ids, tfs, lens, boost, name))
+                df_union = ids if df_union is None else \
+                    np.union1d(df_union, ids)
+            if not fields:
+                continue
+            df = len(df_union)
+            idf = math.log(1.0 + (n - df + 0.5) / (df + 0.5))
+            term_rows.append((idf, fields))
+        if not term_rows:
+            return np.empty(0, np.int64), np.empty(0, np.float32)
 
-            # candidate universe = union of all postings
-            all_ids = np.unique(np.concatenate(
-                [ids for _, fields in term_rows for ids, *_ in fields]))
-            if allow_mask is not None:
-                keep = all_ids[(all_ids < len(allow_mask))]
-                keep = keep[allow_mask[keep]]
-                all_ids = keep
-            if len(all_ids) == 0:
-                return np.empty(0, np.int64), np.empty(0, np.float32)
+        # candidate universe = union of all postings
+        all_ids = np.unique(np.concatenate(
+            [ids for _, fields in term_rows for ids, *_ in fields]))
+        if allow_mask is not None:
+            keep = all_ids[(all_ids < len(allow_mask))]
+            keep = keep[allow_mask[keep]]
+            all_ids = keep
+        if len(all_ids) == 0:
+            return np.empty(0, np.int64), np.empty(0, np.float32)
 
-            scores = np.zeros(len(all_ids), dtype=np.float32)
-            k1, b = self.k1, self.b
-            for idf, fields in term_rows:
-                # BM25F: per-field length-normalized tf, weighted-summed
-                # across fields, then saturated once
-                tf_acc = np.zeros(len(all_ids), dtype=np.float32)
-                for ids, tfs, boost, name in fields:
-                    pos = np.searchsorted(all_ids, ids)
-                    inb = (pos < len(all_ids))
-                    pos_c = np.clip(pos, 0, len(all_ids) - 1)
-                    hit = inb & (all_ids[pos_c] == ids)
-                    if not hit.any():
-                        continue
-                    dl = self.doc_len[name]
-                    lens = np.fromiter(
-                        (dl.get(int(d), 0) for d in ids[hit]),
-                        dtype=np.float32, count=int(hit.sum()))
-                    norm = 1.0 - b + b * lens / avg_len[name]
-                    np.add.at(tf_acc, pos_c[hit],
-                              boost * tfs[hit] / np.maximum(norm, 1e-9))
-                scores += idf * tf_acc / (k1 + tf_acc)
+        scores = np.zeros(len(all_ids), dtype=np.float32)
+        k1, b = self.k1, self.b
+        for idf, fields in term_rows:
+            # BM25F: per-field length-normalized tf, weighted-summed
+            # across fields, then saturated once
+            tf_acc = np.zeros(len(all_ids), dtype=np.float32)
+            for ids, tfs, lens, boost, name in fields:
+                pos = np.searchsorted(all_ids, ids)
+                inb = (pos < len(all_ids))
+                pos_c = np.clip(pos, 0, len(all_ids) - 1)
+                hit = inb & (all_ids[pos_c] == ids)
+                if not hit.any():
+                    continue
+                norm = 1.0 - b + b * lens[hit] / avg_len[name]
+                np.add.at(tf_acc, pos_c[hit],
+                          boost * tfs[hit] / np.maximum(norm, 1e-9))
+            scores += idf * tf_acc / (k1 + tf_acc)
 
-            k_eff = min(k, len(all_ids))
-            top = np.argpartition(-scores, k_eff - 1)[:k_eff]
-            order = top[np.argsort(-scores[top], kind="stable")]
-            return all_ids[order], scores[order]
+        k_eff = min(k, len(all_ids))
+        top = np.argpartition(-scores, k_eff - 1)[:k_eff]
+        order = top[np.argsort(-scores[top], kind="stable")]
+        return all_ids[order], scores[order]
