@@ -85,3 +85,27 @@ class SchemaFSM:
             self.db._persist(col)
         else:
             logger.warning("unknown FSM op type %r", t)
+
+    # -- snapshot / restore (reference: cluster/store_snapshot.go -----------
+    # Persist()/Restore() marshal the schema FSM state; ours is the full
+    # class set + sharding placements + tenant statuses)
+
+    def snapshot(self) -> dict:
+        classes = []
+        for name, col in self.db.collections.items():
+            classes.append({
+                "config": col.config.to_dict(),
+                "sharding": col.sharding.to_dict(),
+            })
+        return {"classes": classes}
+
+    def restore(self, state: dict) -> None:
+        """Bring the local DB to the snapshot's schema. Existing classes
+        are kept (the DB persists schema itself; apply is idempotent) —
+        this fills in what a joining node has never seen."""
+        for entry in state.get("classes", []):
+            cfg = CollectionConfig.from_dict(entry["config"])
+            if cfg.name in self.db.collections:
+                continue
+            self.db.create_collection(
+                cfg, sharding_state=ShardingState.from_dict(entry["sharding"]))

@@ -48,7 +48,9 @@ class ClusterNode:
         self.raft = RaftNode(name, raft_peers, self.membership.resolve,
                              self.server, self.fsm.apply,
                              store_bucket=raft_bucket,
-                             election_timeout=election_timeout)
+                             election_timeout=election_timeout,
+                             snapshot_fn=self.fsm.snapshot,
+                             restore_fn=self.fsm.restore)
         # auto tenant creation must take the Raft path in a cluster
         self.db.set_auto_tenant_hook(self.add_tenants)
         self.server.start()
@@ -58,11 +60,17 @@ class ClusterNode:
     def address(self) -> str:
         return self.server.address
 
-    def start(self, seed_addrs: list[str] | None = None) -> None:
+    def start(self, seed_addrs: list[str] | None = None,
+              join: str | None = None) -> None:
+        """``join``: internal address of any existing cluster member —
+        this node gossips in AND submits a Raft conf change to become a
+        voter (reference: cluster/bootstrap/bootstrap.go:33 joiner)."""
         if seed_addrs:
             self.membership.join(seed_addrs)
         self.membership.start()
         self.raft.start()
+        if join:
+            self.raft.request_join(join)
         # anti-entropy beat over all replicated collections
         # (reference: shard_hashbeater launched per shard at shard load)
         self.db.cycles.register("hashbeat", self._hashbeat_cycle,

@@ -2,15 +2,30 @@
 
 Reference: cluster/store.go (hashicorp/raft + boltdb log store),
 store_apply.go (FSM ops ADD_CLASS...DELETE_TENANT), raft.go:26 (leader
-forwarding from followers). Scope parity: only schema/tenant METADATA
-goes through Raft — object data takes the replication data plane.
+forwarding from followers), store_snapshot.go (FSM snapshot persist/
+restore), cluster/bootstrap/bootstrap.go:33 (joining an existing
+cluster). Scope parity: only schema/tenant METADATA goes through Raft —
+object data takes the replication data plane.
 
 This is a compact Raft: leader election with randomized timeouts,
 AppendEntries log replication with the log-matching backtrack, majority
-commit, persisted (term, votedFor, log) so a restarted node rejoins with
-its history. Schema-op volume is tiny, so the log persists as one KV
-record per entry and snapshotting is simply the applied FSM state
-(the schema store itself).
+commit, persisted (term, votedFor, log). Three §7/§6 features beyond the
+round-1 core:
+
+- **Snapshots + log compaction**: once the applied log grows past
+  ``snapshot_threshold`` entries, the FSM state (``snapshot_fn``) is
+  persisted and the covered log prefix dropped — restart restores from
+  the snapshot instead of replaying every schema op ever
+  (reference store_snapshot.go). Log indices are ABSOLUTE; the in-RAM
+  list holds [log_start, ...).
+- **InstallSnapshot RPC**: a follower whose next entry was compacted
+  away receives the snapshot + trailing log instead of an append.
+- **Dynamic membership**: ``raft_conf`` add/remove entries flow through
+  the log itself; each node recomputes its peer set from
+  (snapshot peers + conf entries in the log) so the set is consistent
+  with whatever log prefix a node has (single-server changes, Raft §6).
+  A new node calls ``request_join`` against any member (reference
+  bootstrap joiner) and suppresses elections until a leader contacts it.
 """
 
 from __future__ import annotations
@@ -39,15 +54,22 @@ class RaftNode:
     def __init__(self, name: str, peers: list[str], resolver, server,
                  apply_fn, store_bucket=None,
                  election_timeout: tuple[float, float] = (0.3, 0.6),
-                 heartbeat_interval: float = 0.08):
-        """``peers``: all member names incl. self (static bootstrap set,
-        reference cluster/bootstrap). ``resolver(name) -> addr``.
-        ``apply_fn(op: dict)`` applies a committed entry to the FSM.
-        ``store_bucket``: KV bucket for persistence (term/vote/log)."""
+                 heartbeat_interval: float = 0.08,
+                 snapshot_fn=None, restore_fn=None,
+                 snapshot_threshold: int = 256):
+        """``peers``: bootstrap member names incl. self (later changed via
+        conf entries). ``resolver(name) -> addr``. ``apply_fn(op)``
+        applies a committed entry to the FSM. ``snapshot_fn() -> dict`` /
+        ``restore_fn(state)`` serialize/install FSM state for compaction
+        and joiner catch-up. ``store_bucket``: KV bucket for persistence."""
         self.name = name
-        self.peers = sorted(set(peers) | {name})
+        self.bootstrap_peers = sorted(set(peers) | {name})
+        self.peers = list(self.bootstrap_peers)
         self.resolver = resolver
         self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.snapshot_threshold = snapshot_threshold
         self._bucket = store_bucket
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
@@ -57,13 +79,14 @@ class RaftNode:
         self.role = FOLLOWER
         self.current_term = 0
         self.voted_for: str | None = None
-        self.log: list[dict] = []  # {"term": int, "op": dict}
+        self.log: list[dict] = []  # {"term": int, "op": dict}; log[0] is
+        self.log_start = 0  # ...absolute index ``log_start``
+        self.snap_last_term = 0  # term of entry log_start-1 (snapshot tail)
         self.commit_index = -1
         self.last_applied = -1
         self.leader_id: str | None = None
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
-        self._last_heard = time.monotonic()
         self._deadline = self._new_deadline()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -72,6 +95,25 @@ class RaftNode:
         server.route("/raft/vote", self._handle_vote)
         server.route("/raft/append", self._handle_append)
         server.route("/raft/propose", self._handle_propose)
+        server.route("/raft/snapshot", self._handle_install_snapshot)
+        server.route("/raft/join", self._handle_join)
+        server.route("/raft/leave", self._handle_leave)
+
+    # -- absolute-index helpers ----------------------------------------------
+
+    def _abs_last(self) -> int:
+        return self.log_start + len(self.log) - 1
+
+    def _entry(self, i: int) -> dict:
+        return self.log[i - self.log_start]
+
+    def _term_at(self, i: int) -> int:
+        """Term of absolute index i; the snapshot remembers its tail term."""
+        if i < self.log_start - 1:
+            return -1  # compacted away (only valid to ask about the tail)
+        if i == self.log_start - 1:
+            return self.snap_last_term
+        return self._entry(i)["term"]
 
     # -- persistence ---------------------------------------------------------
 
@@ -80,16 +122,33 @@ class RaftNode:
             self._bucket.put(b"meta", {"term": self.current_term,
                                        "voted_for": self.voted_for})
 
-    def _persist_log(self, start: int = 0) -> None:
-        if self._bucket is not None:
-            for i in range(start, len(self.log)):
-                self._bucket.put(f"log-{i:012d}".encode(), self.log[i])
-            self._bucket.put(b"log_len", len(self.log))
+    def _persist_log(self, start_abs: int | None = None) -> None:
+        if self._bucket is None:
+            return
+        start_abs = self.log_start if start_abs is None else start_abs
+        for i in range(max(start_abs, self.log_start),
+                       self.log_start + len(self.log)):
+            self._bucket.put(f"log-{i:012d}".encode(), self._entry(i))
+        self._bucket.put(b"log_span", {"start": self.log_start,
+                                       "len": len(self.log),
+                                       "snap_last_term": self.snap_last_term})
 
-    def _truncate_log(self, new_len: int) -> None:
+    def _persist_snapshot(self, state: dict, last_index: int,
+                          last_term: int, peers: list[str]) -> None:
         if self._bucket is not None:
-            self._bucket.put(b"log_len", new_len)
-        del self.log[new_len:]
+            self._bucket.put(b"snapshot", {"state": state,
+                                           "last_index": last_index,
+                                           "last_term": last_term,
+                                           "peers": peers})
+
+    def _truncate_log_from(self, abs_i: int) -> None:
+        """Drop entries >= abs_i (conflict truncation)."""
+        del self.log[abs_i - self.log_start:]
+        if self._bucket is not None:
+            self._bucket.put(b"log_span", {"start": self.log_start,
+                                           "len": len(self.log),
+                                           "snap_last_term": self.snap_last_term})
+        self._recompute_peers()
 
     def _restore(self) -> None:
         if self._bucket is None:
@@ -98,9 +157,124 @@ class RaftNode:
         if meta:
             self.current_term = meta["term"]
             self.voted_for = meta.get("voted_for")
-        n = self._bucket.get(b"log_len") or 0
-        self.log = [self._bucket.get(f"log-{i:012d}".encode())
-                    for i in range(n)]
+        snap = self._bucket.get(b"snapshot")
+        snap_peers = None
+        if snap:
+            self.log_start = snap["last_index"] + 1
+            self.snap_last_term = snap["last_term"]
+            self.commit_index = snap["last_index"]
+            self.last_applied = snap["last_index"]
+            snap_peers = list(snap.get("peers") or [])
+            if self.restore_fn is not None:
+                try:
+                    self.restore_fn(snap["state"])
+                except Exception:
+                    logger.exception("raft %s: snapshot restore failed",
+                                     self.name)
+        span = self._bucket.get(b"log_span")
+        if span:
+            start, n = span["start"], span["len"]
+            # tolerate a snapshot taken after the last log persist
+            start = max(start, self.log_start)
+            self.log = [self._bucket.get(f"log-{i:012d}".encode())
+                        for i in range(start, span["start"] + n)]
+            self.log_start = start
+            self.snap_last_term = span.get("snap_last_term",
+                                           self.snap_last_term)
+        else:
+            n = self._bucket.get(b"log_len") or 0  # round-1 format
+            self.log = [self._bucket.get(f"log-{i:012d}".encode())
+                        for i in range(n)]
+            self.log_start = 0
+        if snap_peers is not None:
+            self.bootstrap_peers = sorted(set(snap_peers) | {self.name})
+        self._recompute_peers()
+
+    # -- membership ----------------------------------------------------------
+
+    def _recompute_peers(self) -> None:
+        """Peer set = snapshot/bootstrap peers + conf entries in the log.
+        Deterministic in the log prefix, so truncation reverts cleanly and
+        conf changes take effect at APPEND time (Raft §6)."""
+        peers = set(self.bootstrap_peers)
+        for e in self.log:
+            op = e.get("op") or {}
+            if op.get("type") == "raft_conf":
+                if op.get("add"):
+                    peers.add(op["add"])
+                if op.get("remove"):
+                    peers.discard(op["remove"])
+        self.peers = sorted(peers | {self.name})
+        self._next_index = {p: self._next_index.get(p, self._abs_last() + 1)
+                            for p in self.peers if p != self.name}
+        self._match_index = {p: self._match_index.get(p, -1)
+                             for p in self.peers if p != self.name}
+
+    def request_join(self, member_addr: str, timeout: float = 15.0) -> None:
+        """Join a running cluster through any member (reference
+        cluster/bootstrap/bootstrap.go:33). Blocks until the conf entry
+        commits and this node has been contacted by the leader."""
+        with self._lock:
+            # don't elect ourselves while joining a real cluster
+            self._deadline = time.monotonic() + timeout
+        deadline = time.time() + timeout
+        last: Exception | None = None
+        while time.time() < deadline:
+            try:
+                reply = rpc(member_addr, "/raft/join", {"name": self.name},
+                            timeout=min(5.0, deadline - time.time()))
+                with self._lock:
+                    # learn the existing membership from the reply — the
+                    # original members predate any conf entry in the log
+                    self.bootstrap_peers = sorted(
+                        set(reply.get("peers") or []) | {self.name})
+                    self._recompute_peers()
+                    self._deadline = time.monotonic() + 5.0
+                # wait until the leader's appends reach us
+                while time.time() < deadline:
+                    with self._lock:
+                        if self.leader_id is not None and \
+                                self.name in self.peers:
+                            return
+                    time.sleep(0.05)
+            except (RpcError, KeyError) as e:
+                last = e
+                time.sleep(0.2)
+        raise TimeoutError(f"raft join via {member_addr} timed out: {last}")
+
+    def _handle_join(self, payload: dict) -> dict:
+        """Any member accepts a join request; non-leaders forward."""
+        name = payload["name"]
+        with self._lock:
+            role, leader = self.role, self.leader_id
+            already = name in self.peers
+            peers = list(self.peers)
+        if already:
+            return {"ok": True, "peers": peers}
+        if role != LEADER:
+            if leader is None or leader == self.name:
+                raise NotLeaderError(leader)
+            return rpc(self.resolver(leader), "/raft/join", payload,
+                       timeout=5.0)
+        self.propose_local({"type": "raft_conf", "add": name})
+        with self._lock:
+            peers = list(self.peers)
+        return {"ok": True, "peers": peers}
+
+    def _handle_leave(self, payload: dict) -> dict:
+        name = payload["name"]
+        with self._lock:
+            role, leader = self.role, self.leader_id
+            present = name in self.peers
+        if not present:
+            return {"ok": True}
+        if role != LEADER:
+            if leader is None or leader == self.name:
+                raise NotLeaderError(leader)
+            return rpc(self.resolver(leader), "/raft/leave", payload,
+                       timeout=5.0)
+        self.propose_local({"type": "raft_conf", "remove": name})
+        return {"ok": True}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -137,9 +311,8 @@ class RaftNode:
     # -- election ------------------------------------------------------------
 
     def _last_log(self) -> tuple[int, int]:
-        if not self.log:
-            return (-1, 0)
-        return (len(self.log) - 1, self.log[-1]["term"])
+        last = self._abs_last()
+        return (last, self._term_at(last) if last >= 0 else 0)
 
     def _run_election(self) -> None:
         with self._lock:
@@ -149,10 +322,11 @@ class RaftNode:
             self.leader_id = None
             term = self.current_term
             last_index, last_term = self._last_log()
+            peers = list(self.peers)
             self._persist_meta()
             self._deadline = self._new_deadline()
         votes = 1
-        for peer in self.peers:
+        for peer in peers:
             if peer == self.name:
                 continue
             try:
@@ -171,14 +345,14 @@ class RaftNode:
                     votes += 1
         with self._lock:
             if self.role == CANDIDATE and self.current_term == term \
-                    and votes > len(self.peers) // 2:
+                    and votes > len(peers) // 2:
                 self._become_leader()
 
     def _become_leader(self) -> None:
         logger.info("raft %s: leader for term %d", self.name, self.current_term)
         self.role = LEADER
         self.leader_id = self.name
-        n = len(self.log)
+        n = self._abs_last() + 1
         self._next_index = {p: n for p in self.peers if p != self.name}
         self._match_index = {p: -1 for p in self.peers if p != self.name}
         # no-op barrier entry so the new leader can commit prior-term
@@ -197,22 +371,48 @@ class RaftNode:
     # -- replication (leader side) -------------------------------------------
 
     def _replicate_all(self) -> None:
-        for peer in self.peers:
+        with self._lock:
+            peers = list(self.peers)
+        for peer in peers:
             if peer != self.name:
                 self._replicate_one(peer)
         self._advance_commit()
+        self._maybe_snapshot()
 
     def _replicate_one(self, peer: str) -> None:
         with self._lock:
             if self.role != LEADER:
                 return
             term = self.current_term
-            next_i = self._next_index.get(peer, len(self.log))
-            prev_i = next_i - 1
-            prev_t = self.log[prev_i]["term"] if prev_i >= 0 else 0
-            entries = self.log[next_i:]
-            commit = self.commit_index
+            next_i = self._next_index.get(peer, self._abs_last() + 1)
+            if next_i < self.log_start:
+                # the entries this follower needs were compacted away —
+                # ship the snapshot instead (InstallSnapshot, Raft §7)
+                snap = (self._bucket.get(b"snapshot")
+                        if self._bucket is not None else None)
+                if snap is None and self.snapshot_fn is not None:
+                    snap = {"state": self.snapshot_fn(),
+                            "last_index": self.log_start - 1,
+                            "last_term": self.snap_last_term,
+                            "peers": list(self.peers)}
+                payload = dict(snap or {}, term=term, leader=self.name)
+            else:
+                payload = None
+                prev_i = next_i - 1
+                prev_t = self._term_at(prev_i) if prev_i >= 0 else 0
+                entries = self.log[next_i - self.log_start:]
+                commit = self.commit_index
         try:
+            if payload is not None:
+                reply = rpc(self.resolver(peer), "/raft/snapshot", payload,
+                            timeout=5.0)
+                with self._lock:
+                    if reply["term"] > self.current_term:
+                        self._become_follower(reply["term"])
+                        return
+                    self._match_index[peer] = payload["last_index"]
+                    self._next_index[peer] = payload["last_index"] + 1
+                return
             reply = rpc(self.resolver(peer), "/raft/append",
                         {"term": term, "leader": self.name,
                          "prev_index": prev_i, "prev_term": prev_t,
@@ -231,14 +431,14 @@ class RaftNode:
                 self._next_index[peer] = self._match_index[peer] + 1
             else:
                 # log-matching backtrack
-                self._next_index[peer] = max(0, next_i - 1)
+                self._next_index[peer] = max(self.log_start - 1, next_i - 1)
 
     def _advance_commit(self) -> None:
         with self._lock:
             if self.role != LEADER:
                 return
-            for n in range(len(self.log) - 1, self.commit_index, -1):
-                if self.log[n]["term"] != self.current_term:
+            for n in range(self._abs_last(), self.commit_index, -1):
+                if self._term_at(n) != self.current_term:
                     break  # only current-term entries commit by counting
                 replicas = 1 + sum(1 for m in self._match_index.values()
                                    if m >= n)
@@ -251,14 +451,87 @@ class RaftNode:
         # caller holds the lock
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            entry = self.log[self.last_applied]
-            if entry["op"].get("type") != "noop":
-                try:
-                    self.apply_fn(entry["op"])
-                except Exception:
-                    logger.exception("raft %s: FSM apply failed at %d",
-                                     self.name, self.last_applied)
+            entry = self._entry(self.last_applied)
+            op_type = entry["op"].get("type")
+            if op_type in ("noop", "raft_conf"):
+                continue  # conf changes applied at append time
+            try:
+                self.apply_fn(entry["op"])
+            except Exception:
+                logger.exception("raft %s: FSM apply failed at %d",
+                                 self.name, self.last_applied)
         self._applied_cv.notify_all()
+
+    # -- snapshot / compaction -----------------------------------------------
+
+    def _maybe_snapshot(self) -> None:
+        """Compact the applied log prefix into an FSM snapshot
+        (reference: store_snapshot.go + raft's SnapshotThreshold)."""
+        if self.snapshot_fn is None:
+            return
+        with self._lock:
+            applied_in_log = self.last_applied - self.log_start + 1
+            if applied_in_log < self.snapshot_threshold:
+                return
+            self.take_snapshot()
+
+    def take_snapshot(self) -> int:
+        """Snapshot now; returns the covered last index."""
+        with self._lock:
+            if self.last_applied < self.log_start:
+                return self.log_start - 1
+            state = self.snapshot_fn() if self.snapshot_fn else {}
+            last = self.last_applied
+            last_term = self._term_at(last)
+            self._persist_snapshot(state, last, last_term, list(self.peers))
+            # bootstrap_peers absorbs conf entries covered by the snapshot
+            # so _recompute_peers stays correct over the shorter log
+            self.bootstrap_peers = list(self.peers)
+            drop = last - self.log_start + 1
+            del self.log[:drop]
+            self.log_start = last + 1
+            self.snap_last_term = last_term
+            self._persist_log()
+            if self._bucket is not None:
+                # drop compacted entry records
+                for i in range(self.log_start - drop, self.log_start):
+                    self._bucket.delete(f"log-{i:012d}".encode())
+            logger.info("raft %s: snapshot through index %d (log now %d "
+                        "entries)", self.name, last, len(self.log))
+            return last
+
+    def _handle_install_snapshot(self, payload: dict) -> dict:
+        with self._lock:
+            term = payload["term"]
+            if term < self.current_term:
+                return {"term": self.current_term}
+            if term > self.current_term or self.role != FOLLOWER:
+                self._become_follower(term)
+            self.leader_id = payload["leader"]
+            self._deadline = self._new_deadline()
+            last = payload["last_index"]
+            if last <= self.last_applied:
+                return {"term": self.current_term}
+            if self.restore_fn is not None:
+                try:
+                    self.restore_fn(payload["state"])
+                except Exception:
+                    logger.exception("raft %s: snapshot install failed",
+                                     self.name)
+            self.log = []
+            self.log_start = last + 1
+            self.snap_last_term = payload["last_term"]
+            self.commit_index = last
+            self.last_applied = last
+            self.bootstrap_peers = sorted(
+                set(payload.get("peers") or []) | {self.name})
+            self._persist_snapshot(payload["state"], last,
+                                   payload["last_term"],
+                                   list(payload.get("peers") or []))
+            self._persist_log()
+            self._recompute_peers()
+            self._applied_cv.notify_all()
+            return {"term": self.current_term}
 
     # -- RPC handlers (follower side) -----------------------------------------
 
@@ -291,26 +564,37 @@ class RaftNode:
             self._deadline = self._new_deadline()
 
             prev_i = payload["prev_index"]
-            if prev_i >= 0 and (prev_i >= len(self.log)
-                                or self.log[prev_i]["term"] != payload["prev_term"]):
-                return {"term": self.current_term, "success": False}
+            if prev_i >= self.log_start - 1:
+                if prev_i > self._abs_last() or \
+                        (prev_i >= self.log_start - 1 and prev_i >= 0
+                         and self._term_at(prev_i) != payload["prev_term"]):
+                    return {"term": self.current_term, "success": False}
+            # prev_i < log_start-1: covered by our snapshot — entries
+            # overlapping the snapshot are already applied; skip them below
             entries = payload["entries"]
             insert = prev_i + 1
+            appended = False
             for k, e in enumerate(entries):
                 i = insert + k
-                if i < len(self.log):
-                    if self.log[i]["term"] != e["term"]:
-                        self._truncate_log(i)
+                if i < self.log_start:
+                    continue  # snapshot already covers it
+                if i <= self._abs_last():
+                    if self._term_at(i) != e["term"]:
+                        self._truncate_log_from(i)
                         self.log.extend(entries[k:])
                         self._persist_log(i)
+                        appended = True
                         break
                 else:
                     self.log.extend(entries[k:])
                     self._persist_log(i)
+                    appended = True
                     break
+            if appended:
+                self._recompute_peers()
             if payload["leader_commit"] > self.commit_index:
                 self.commit_index = min(payload["leader_commit"],
-                                        len(self.log) - 1)
+                                        self._abs_last())
                 self._apply_committed()
             return {"term": self.current_term, "success": True}
 
@@ -364,9 +648,11 @@ class RaftNode:
         with self._lock:
             if self.role != LEADER:
                 raise NotLeaderError(self.leader_id)
-            index = len(self.log)
+            index = self._abs_last() + 1
             self.log.append({"term": self.current_term, "op": op})
             self._persist_log(index)
+            if op.get("type") == "raft_conf":
+                self._recompute_peers()  # conf effective at append (§6)
         # replicate eagerly rather than waiting a heartbeat
         self._replicate_all()
         deadline = time.time() + timeout
