@@ -1,13 +1,22 @@
-"""Benchmark: flat brute-force kNN on TPU vs host-CPU BLAS baseline.
+"""Benchmark: flat brute-force kNN on TPU + quantized scans + device-side
+steady-state timing + compiled-kernel conformance.
 
 North-star config #1 (BASELINE.md): flat index, l2-squared, SIFT1M-shaped
-synthetic corpus (1M x 128), k=10. The reference's flat index is also an
-exact scan (CPU, lsmkv cursor + SIMD distance), so CPU exact scan is the
-apples-to-apples baseline; numpy/BLAS is a *generous* stand-in for it.
+corpus (1M x 128), k=10. Measurements this emits (VERDICT r1 items 1/2/9):
+
+- headline: flat kNN QPS at the batched operating point (tunnel-inclusive)
+- ``device_batch_ms``: per-batch DEVICE time with R dispatches in flight
+  (async dispatch pipeline, block at the end) for bf16 / f32-exact / BQ /
+  PQ4 scans at several batch sizes, plus achieved HBM GB/s — so kernel
+  regressions are visible through rig noise
+- quantized scans measured on CLUSTERED data (mixture of gaussians — the
+  shape real embeddings have) with exact-rescore recall@10
+- ``kernel_conformance``: compiled (Mosaic, not interpret) Pallas kernels
+  checked bit-exact against numpy on the chip
 
 Prints ONE JSON line:
-  {"metric": ..., "value": QPS, "unit": "qps", "vs_baseline": x}
-plus recall/latency detail on stderr.
+  {"metric": ..., "value": QPS, "unit": "qps", "vs_baseline": x, ...}
+detail on stderr.
 """
 
 from __future__ import annotations
@@ -38,17 +47,28 @@ def _watchdog(seconds: float):
     return t
 
 
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def clustered_corpus(rng, n, dim, n_clusters=1024, spread=0.15):
+    """Mixture of gaussians — quantization-representative data (real
+    embeddings cluster; i.i.d. gaussian is the adversarial floor)."""
+    import numpy as np
+
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
+    assign = rng.integers(0, n_clusters, n)
+    out = centers[assign] + spread * rng.standard_normal((n, dim)).astype(np.float32)
+    return out.astype(np.float32)
+
+
 def main():
-    wd = _watchdog(float(os.environ.get("BENCH_WATCHDOG_S", "900")))
+    wd = _watchdog(float(os.environ.get("BENCH_WATCHDOG_S", "1500")))
     import numpy as np
 
     n, dim, k = 1_000_000, 128, 10
-    # batched serving is the TPU-idiomatic operating point: one dispatch
-    # amortizes the host<->device round trip over the whole query block
-    # (QPS scales near-linearly with batch until compute saturates)
     batch = int(os.environ.get("BENCH_BATCH", "1024"))
     n_query_batches = 8
-    log = lambda *a: print(*a, file=sys.stderr, flush=True)
 
     rng = np.random.default_rng(0)
     corpus = rng.standard_normal((n, dim)).astype(np.float32)
@@ -90,10 +110,8 @@ def main():
     dev = jax.devices()[0]
     log(f"device: {dev}, platform: {dev.platform}")
     store_dtype = jnp.bfloat16 if os.environ.get("BENCH_DTYPE", "bf16") == "bf16" else jnp.float32
-    # chunk size is latency-neutral on this rig (the host<->device link
-    # dominates); BENCH_CHUNK overrides for other topologies
     chunk = int(os.environ.get("BENCH_CHUNK", "65536"))
-    n_pad = -(-n // chunk) * chunk  # pad corpus to a chunk multiple once
+    n_pad = -(-n // chunk) * chunk
     padded = np.zeros((n_pad, dim), dtype=np.float32)
     padded[:n] = corpus
     x = jax.device_put(jnp.asarray(padded, dtype=store_dtype), dev)
@@ -112,14 +130,13 @@ def main():
     jax.block_until_ready((d, i))
     log(f"first call (incl compile): {time.perf_counter()-t0:.1f}s")
 
-    # recall@10 vs CPU exact ground truth (bf16 storage drifts slightly)
     ids = np.asarray(i)
     recall = np.mean([
         len(set(ids[r]) & set(gt_i[r])) / k for r in range(batch)
     ])
     log(f"recall@{k} vs exact f32: {recall:.4f}")
 
-    # timed runs
+    # timed runs (tunnel-inclusive, the round-1 headline methodology)
     times = []
     for rep in range(3):
         for bi in range(n_query_batches):
@@ -128,11 +145,173 @@ def main():
             d, i = step(qb)
             jax.block_until_ready((d, i))
             times.append(time.perf_counter() - t0)
-    times = np.asarray(times[1:])  # drop first timed (cache effects)
+    times = np.asarray(times[1:])
     per_batch = float(np.median(times))
     qps = batch / per_batch
     log(f"median {per_batch*1e3:.2f} ms/batch of {batch} -> {qps:.0f} QPS; "
         f"p95 {np.percentile(times,95)*1e3:.2f} ms")
+
+    # --- device-side steady state: R dispatches in flight -------------------
+    # Dispatch is async; queueing R programs back-to-back amortizes the
+    # host<->device tunnel RTT, so (t_total/R) converges on DEVICE time.
+    def pipelined_ms(fn, reps=12):
+        out = fn()
+        jax.block_until_ready(out)  # compile + warm
+        t0 = time.perf_counter()
+        outs = [fn() for _ in range(reps)]
+        jax.block_until_ready(outs)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    device_stats = {}
+    bytes_bf16 = n_pad * dim * (2 if store_dtype == jnp.bfloat16 else 4)
+    for b_dev in (64, 256, 1024):
+        qd = jax.device_put(jnp.asarray(queries[0][:b_dev]), dev)
+        ms = pipelined_ms(lambda: step(qd))
+        gbps = bytes_bf16 / (ms / 1e3) / 1e9
+        flops = 2.0 * b_dev * n_pad * dim / (ms / 1e3)
+        device_stats[f"flat_{'bf16' if store_dtype==jnp.bfloat16 else 'f32'}_b{b_dev}"] = {
+            "device_batch_ms": round(ms, 3),
+            "qps": round(b_dev / (ms / 1e3)),
+            "hbm_gbps": round(gbps, 1),
+            "tflops": round(flops / 1e12, 2),
+        }
+        log(f"[device] flat b={b_dev}: {ms:.2f} ms -> "
+            f"{b_dev/(ms/1e3):.0f} qps, {gbps:.0f} GB/s, {flops/1e12:.1f} TFLOP/s")
+
+    # --- quantized scans on clustered data + exact rescore ------------------
+    from weaviate_tpu.ops import bq as bq_ops
+    from weaviate_tpu.ops import pq as pq_ops
+
+    cl = clustered_corpus(rng, n, dim)
+    cl_pad = np.zeros((n_pad, dim), dtype=np.float32)
+    cl_pad[:n] = cl
+    # queries: near-duplicates of corpus points (realistic lookups)
+    qcl = (cl[rng.integers(0, n, batch)]
+           + 0.05 * rng.standard_normal((batch, dim))).astype(np.float32)
+    # ground truth on clustered corpus
+    def cpu_scan_cl(qb):
+        cn = (cl ** 2).sum(-1)
+        qn = (qb ** 2).sum(-1)[:, None]
+        best_d = np.full((len(qb), k), np.inf, np.float32)
+        best_i = np.zeros((len(qb), k), np.int64)
+        step_n = 131072
+        for s in range(0, n, step_n):
+            dmat = qn - 2.0 * qb @ cl[s:s+step_n].T + cn[None, s:s+step_n]
+            idx = np.argpartition(dmat, k, axis=1)[:, :k]
+            dd = np.take_along_axis(dmat, idx, axis=1)
+            cat_d = np.concatenate([best_d, dd], 1)
+            cat_i = np.concatenate([best_i, idx + s], 1)
+            sel = np.argpartition(cat_d, k, axis=1)[:, :k]
+            best_d = np.take_along_axis(cat_d, sel, 1)
+            best_i = np.take_along_axis(cat_i, sel, 1)
+        return best_i
+    gt_cl = cpu_scan_cl(qcl)
+
+    x_cl = jax.device_put(jnp.asarray(cl_pad, dtype=jnp.bfloat16), dev)
+    norms_cl = jnp.sum(jnp.asarray(x_cl, dtype=jnp.float32) ** 2, axis=-1)
+    q_cl_dev = jax.device_put(jnp.asarray(qcl), dev)
+
+    quant = {}
+
+    def rescore_recall(cand_ids, k_eff=k):
+        """Exact f32 rescore of candidates on host, then recall@k."""
+        cand = np.asarray(cand_ids)
+        out = np.empty((len(cand), k_eff), np.int64)
+        for r in range(len(cand)):
+            c = cand[r][cand[r] >= 0]
+            c = c[c < n]
+            dd = ((qcl[r][None] - cl[c]) ** 2).sum(-1)
+            out[r] = c[np.argsort(dd)[:k_eff]]
+        return np.mean([len(set(out[r]) & set(gt_cl[r])) / k_eff
+                        for r in range(len(cand))])
+
+    # bf16 flat on clustered (reference point for QPS comparisons)
+    def step_cl(qb):
+        return chunked_topk_distances(
+            qb, x_cl, k=k, chunk_size=chunk, metric="l2-squared",
+            valid=valid, x_sq_norms=norms_cl)
+    ms_bf16_cl = pipelined_ms(lambda: step_cl(q_cl_dev))
+    quant["bf16_flat"] = {"device_batch_ms": round(ms_bf16_cl, 3),
+                          "qps": round(batch / (ms_bf16_cl / 1e3))}
+    # f32 HIGHEST flat (the reference-exact path — the bar to beat)
+    x_f32 = jax.device_put(jnp.asarray(cl_pad, dtype=jnp.float32), dev)
+    def step_f32(qb):
+        return chunked_topk_distances(
+            qb, x_f32, k=k, chunk_size=chunk, metric="l2-squared",
+            valid=valid, x_sq_norms=norms_cl)
+    ms_f32_cl = pipelined_ms(lambda: step_f32(q_cl_dev))
+    quant["f32_flat"] = {"device_batch_ms": round(ms_f32_cl, 3),
+                         "qps": round(batch / (ms_f32_cl / 1e3))}
+    del x_f32
+
+    # BQ (MXU): packed bits in HBM, 32x compression
+    k_cand = 100
+    xw = bq_ops.bq_encode(jnp.asarray(cl_pad))
+    qw = bq_ops.bq_encode(q_cl_dev)
+    def bq_step():
+        return bq_ops.bq_topk(qw, xw, k=k_cand, chunk_size=chunk,
+                              valid=valid, use_pallas=True)
+    ms_bq = pipelined_ms(bq_step)
+    d_, i_ = bq_step()
+    rec_bq = rescore_recall(i_)
+    quant["bq_mxu"] = {"device_batch_ms": round(ms_bq, 3),
+                       "qps": round(batch / (ms_bq / 1e3)),
+                       "recall_at_10_rescored": round(float(rec_bq), 4)}
+    log(f"[quant] BQ: {ms_bq:.2f} ms, {batch/(ms_bq/1e3):.0f} qps, "
+        f"rescored recall@10 {rec_bq:.4f}")
+
+    # PQ4 (16 centroids, m=d/4): LUT-matmul ADC
+    book = pq_ops.pq_fit(cl[:200_000], m=dim // 4, k=16, iters=8)
+    codes = jnp.asarray(pq_ops.pq_encode(book, cl_pad))
+    def pq4_step():
+        return pq_ops.pq4_topk(q_cl_dev, codes, book.centroids, k=k_cand,
+                               chunk_size=chunk, metric="l2-squared",
+                               valid=valid)
+    ms_pq4 = pipelined_ms(pq4_step)
+    d_, i_ = pq4_step()
+    rec_pq4 = rescore_recall(i_)
+    quant["pq4_lut"] = {"device_batch_ms": round(ms_pq4, 3),
+                        "qps": round(batch / (ms_pq4 / 1e3)),
+                        "recall_at_10_rescored": round(float(rec_pq4), 4)}
+    log(f"[quant] PQ4: {ms_pq4:.2f} ms, {batch/(ms_pq4/1e3):.0f} qps, "
+        f"rescored recall@10 {rec_pq4:.4f}")
+
+    # --- compiled-kernel conformance on device ------------------------------
+    conformance = "ok"
+    try:
+        from weaviate_tpu.ops.pallas_kernels import (bq_mxu_block,
+                                                     distance_block,
+                                                     pq4_lut_block)
+
+        cq = np.asarray(qcl[:8], np.float32)
+        cx = np.asarray(cl[:512], np.float32)
+        out = np.asarray(distance_block(jnp.asarray(cq), jnp.asarray(cx),
+                                        metric="l2-squared", interpret=False))
+        ref = ((cq[:, None] - cx[None]) ** 2).sum(-1)
+        if not np.allclose(out, ref, rtol=1e-4, atol=1e-3):
+            conformance = f"distance_block mismatch {np.abs(out-ref).max()}"
+        qb_ = bq_ops.bq_encode(jnp.asarray(cq))
+        xb_ = bq_ops.bq_encode(jnp.asarray(cx))
+        out = np.asarray(bq_mxu_block(qb_, xb_, interpret=False))
+        ref = bq_ops.bq_hamming_np(
+            np.ascontiguousarray(np.asarray(qb_)),
+            np.ascontiguousarray(np.asarray(xb_)))
+        if not np.array_equal(out, ref):
+            conformance = f"bq_mxu_block mismatch {np.abs(out-ref).max()}"
+        m4 = dim // 4
+        lut = rng.standard_normal((8, m4, 16)).astype(np.float32)
+        codes4 = rng.integers(0, 16, (512, m4)).astype(np.uint8)
+        out = np.asarray(pq4_lut_block(jnp.asarray(lut), jnp.asarray(codes4),
+                                       interpret=False))
+        lut16 = np.asarray(jnp.asarray(lut, dtype=jnp.bfloat16), np.float32)
+        ref = np.zeros((8, 512), np.float32)
+        for s in range(m4):
+            ref += lut16[:, s, :][:, codes4[:, s]]
+        if not np.allclose(out, ref, rtol=1e-3, atol=1e-3):
+            conformance = f"pq4_lut_block mismatch {np.abs(out-ref).max()}"
+    except Exception as e:  # noqa: BLE001
+        conformance = f"error: {e}"
+    log(f"kernel conformance (compiled, on-device): {conformance}")
 
     wd.cancel()
     print(json.dumps({
@@ -144,69 +323,10 @@ def main():
         "p50_batch_ms": round(per_batch * 1e3, 2),
         "batch": batch,
         "baseline_cpu_qps": round(cpu_qps, 1),
+        "device": device_stats,
+        "quantized_clustered_1M_128d": quant,
+        "kernel_conformance": conformance,
     }), flush=True)
-
-    # --- diagnostics: compressed scans (stderr only; the headline JSON
-    # above is already emitted) ------------------------------------------
-    if os.environ.get("BENCH_EXTRA", "1") != "0":
-        # re-arm a watchdog that exits SUCCESSFULLY: try/except cannot
-        # catch a wedged TPU call, and a hung process would make exit-
-        # waiting harnesses discard the already-printed headline line
-        def _diag_timeout():
-            log("[extra] diagnostics watchdog fired — exiting with the "
-                "headline result intact")
-            os._exit(0)
-
-        diag_wd = threading.Timer(
-            float(os.environ.get("BENCH_EXTRA_WATCHDOG_S", "240")),
-            _diag_timeout)
-        diag_wd.daemon = True
-        diag_wd.start()
-        # NOTE: i.i.d. gaussian data is adversarial for quantization (no
-        # cluster structure, concentrated distances) — candidate recall
-        # here is a floor, not what SIFT/real embeddings give. The win of
-        # compressed scans is CAPACITY (32x more vectors per HBM byte),
-        # not speed at 1M scale.
-        try:
-            from weaviate_tpu.ops import bq as bq_ops
-            from weaviate_tpu.ops import pq as pq_ops
-
-            def time_and_recall(topk_fn, label):
-                d_, i_ = topk_fn()
-                jax.block_until_ready((d_, i_))  # warm/compile
-                ts = []
-                for _ in range(3):
-                    t0 = time.perf_counter()
-                    d_, i_ = topk_fn()
-                    jax.block_until_ready((d_, i_))
-                    ts.append(time.perf_counter() - t0)
-                cand = np.asarray(i_)[:, :100]
-                rec = np.mean([
-                    len(set(cand[r]) & set(gt_i[r])) / k
-                    for r in range(batch)])
-                med = float(np.median(ts))
-                log(f"[extra] {label}: {med*1e3:.1f} ms/batch -> "
-                    f"{batch/med:.0f} QPS, candidate recall@{k} "
-                    f"{rec:.3f} (pre-rescore)")
-
-            xw = bq_ops.bq_encode(jnp.asarray(padded, dtype=jnp.float32))
-            qw = bq_ops.bq_encode(q0)
-            time_and_recall(
-                lambda: bq_ops.bq_topk(qw, xw, k=100, chunk_size=chunk,
-                                       valid=valid),
-                "BQ scan (32x compressed, top-100 candidates)")
-
-            book = pq_ops.pq_fit(corpus[:100_000], m=16, k=256, iters=5)
-            codes = pq_ops.pq_encode(book, padded)
-            time_and_recall(
-                lambda: pq_ops.pq_topk(q0, codes, book.centroids, k=100,
-                                       chunk_size=chunk,
-                                       metric="l2-squared", valid=valid),
-                "PQ m=16 scan (32x compressed, top-100)")
-        except Exception as e:  # diagnostics only
-            log(f"[extra] compressed-scan diagnostics failed: {e}")
-        finally:
-            diag_wd.cancel()
 
 
 if __name__ == "__main__":

@@ -162,3 +162,94 @@ def test_non_ascii_token_is_401_not_500():
                                  api_keys=["secret"]))
     with pytest.raises(AuthError):
         a.authenticate("Bearer kluczé")
+
+
+def _make_rs256_jwt_and_jwks(claims: dict):
+    """Self-signed RS256 JWT + matching JWKS for offline validation."""
+    import base64
+    import json
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    def b64u(b: bytes) -> str:
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    header = {"alg": "RS256", "typ": "JWT", "kid": "k1"}
+    signing = (b64u(json.dumps(header).encode()) + "."
+               + b64u(json.dumps(claims).encode()))
+    sig = key.sign(signing.encode(), padding.PKCS1v15(), hashes.SHA256())
+    token = signing + "." + b64u(sig)
+    pub = key.public_key().public_numbers()
+    jwks = {"keys": [{
+        "kty": "RSA", "kid": "k1", "alg": "RS256",
+        "n": b64u(pub.n.to_bytes((pub.n.bit_length() + 7) // 8, "big")),
+        "e": b64u(pub.e.to_bytes(3, "big")),
+    }]}
+    return token, jwks
+
+
+def test_oidc_jwt_validation_against_static_jwks():
+    """VERDICT r1 item 10: OIDC bearer tokens validate against a
+    configured JWKS (signature, expiry, issuer, audience) without issuer
+    connectivity (reference: configure_api.go:601)."""
+    import time as _time
+
+    from weaviate_tpu.auth import AuthConfig, Authenticator, AuthError
+    from weaviate_tpu.auth.oidc import JwksValidator
+
+    now = _time.time()
+    claims = {"iss": "https://issuer.example", "aud": "wv-client",
+              "sub": "alice", "exp": now + 600, "nbf": now - 10}
+    token, jwks = _make_rs256_jwt_and_jwks(claims)
+
+    v = JwksValidator(issuer="https://issuer.example", client_id="wv-client",
+                      jwks=jwks)
+    auth = Authenticator(
+        AuthConfig(anonymous_enabled=False, oidc_enabled=True,
+                   oidc_issuer="https://issuer.example",
+                   oidc_client_id="wv-client"),
+        oidc_validator=v)
+    p = auth.authenticate(f"Bearer {token}")
+    assert p.username == "alice" and p.auth_method == "oidc"
+
+    # expired token rejected
+    expired, jwks2 = _make_rs256_jwt_and_jwks(
+        dict(claims, exp=now - 3600))
+    v2 = JwksValidator(issuer="https://issuer.example",
+                       client_id="wv-client", jwks=jwks2)
+    auth2 = Authenticator(
+        AuthConfig(anonymous_enabled=False, oidc_enabled=True),
+        oidc_validator=v2)
+    import pytest
+
+    with pytest.raises(AuthError, match="expired"):
+        auth2.authenticate(f"Bearer {expired}")
+
+    # wrong-issuer rejected
+    bad_iss, jwks3 = _make_rs256_jwt_and_jwks(
+        dict(claims, iss="https://evil.example"))
+    v3 = JwksValidator(issuer="https://issuer.example",
+                       client_id="wv-client", jwks=jwks3)
+    with pytest.raises(AuthError, match="issuer"):
+        Authenticator(AuthConfig(anonymous_enabled=False, oidc_enabled=True),
+                      oidc_validator=v3).authenticate(f"Bearer {bad_iss}")
+
+    # wrong audience rejected
+    bad_aud, jwks4 = _make_rs256_jwt_and_jwks(
+        dict(claims, aud="someone-else"))
+    v4 = JwksValidator(issuer="https://issuer.example",
+                       client_id="wv-client", jwks=jwks4)
+    with pytest.raises(AuthError, match="audience"):
+        Authenticator(AuthConfig(anonymous_enabled=False, oidc_enabled=True),
+                      oidc_validator=v4).authenticate(f"Bearer {bad_aud}")
+
+    # tampered signature rejected (sign with key A, verify with key B)
+    tok_a, _ = _make_rs256_jwt_and_jwks(claims)
+    _, jwks_b = _make_rs256_jwt_and_jwks(claims)
+    v5 = JwksValidator(issuer="https://issuer.example",
+                       client_id="wv-client", jwks=jwks_b)
+    with pytest.raises(AuthError, match="signature"):
+        Authenticator(AuthConfig(anonymous_enabled=False, oidc_enabled=True),
+                      oidc_validator=v5).authenticate(f"Bearer {tok_a}")

@@ -180,3 +180,57 @@ def test_shards_listing_does_not_load_cold_tenants(tmp_path):
     finally:
         srv.stop()
         db.close()
+
+
+def test_frozen_tenant_offloads_files_and_unfreezes(tmp_path):
+    """VERDICT r1 item 10: FROZEN ships the tenant's shard files to the
+    offload backend and removes them locally; re-activating pulls them
+    back intact (reference: entities/tenantactivity FROZEN + offload
+    modules)."""
+    import os
+
+    import numpy as np
+
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.modules.backup_backends import FilesystemBackend
+    from weaviate_tpu.schema.config import (CollectionConfig,
+                                            MultiTenancyConfig, Property)
+
+    db = Database(str(tmp_path / "data"))
+    backend = FilesystemBackend()
+    backend.init({"path": str(tmp_path / "offload")})
+    db.set_offload_backend(backend)
+    col = db.create_collection(CollectionConfig(
+        name="FZ",
+        properties=[Property(name="t", data_type="text")],
+        multi_tenancy=MultiTenancyConfig(enabled=True)))
+    col.add_tenant("acme")
+    rng = np.random.default_rng(0)
+    uuids = [col.put_object({"t": f"doc {i}"},
+                            vector=rng.standard_normal(8).astype(np.float32),
+                            tenant="acme") for i in range(20)]
+
+    col.set_tenant_status("acme", "FROZEN")
+    sh_dir = tmp_path / "data" / "FZ" / "acme"
+    assert not sh_dir.exists()  # local files gone
+    assert "acme" not in col.shards
+    # frozen tenants reject access
+    import pytest
+
+    with pytest.raises(ValueError, match="FROZEN"):
+        col.get_object(uuids[0], tenant="acme")
+
+    # offload backend holds the (compressed) files
+    offload_files = []
+    for root, _dirs, files in os.walk(tmp_path / "offload"):
+        offload_files += files
+    assert any(f.endswith(".gz") for f in offload_files)
+
+    # unfreeze: files come back and data is intact
+    col.set_tenant_status("acme", "HOT")
+    obj = col.get_object(uuids[3], tenant="acme")
+    assert obj is not None and obj.properties["t"] == "doc 3"
+    res = col.near_vector(rng.standard_normal(8).astype(np.float32), k=5,
+                          tenant="acme")
+    assert len(res) == 5
+    db.close()

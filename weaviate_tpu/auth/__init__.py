@@ -10,10 +10,10 @@ Reference:
   everything, read-only users get GET/HEAD), composed at
   configure_api.go:468.
 
-OIDC configuration is exposed (/.well-known/openid-configuration, same as
-the reference) but token *validation* requires fetching the issuer's JWKS
-over the network; in this zero-egress environment OIDC bearer tokens are
-rejected with a clear error unless they match a configured API key.
+OIDC bearer tokens validate against the issuer's JWKS (auth/oidc.py —
+RS256/ES256 signature, exp/nbf, issuer, audience), with
+AUTHENTICATION_OIDC_JWKS_FILE providing the key set offline for
+zero-egress deployments (reference: configure_api.go:601).
 """
 
 from __future__ import annotations
@@ -96,8 +96,19 @@ class AuthConfig:
 
 
 class Authenticator:
-    def __init__(self, config: AuthConfig):
+    def __init__(self, config: AuthConfig, oidc_validator=None):
         self.config = config
+        if oidc_validator is None and config.oidc_enabled:
+            from weaviate_tpu.auth.oidc import validator_from_env
+
+            try:
+                oidc_validator = validator_from_env()
+            except (OSError, ValueError) as e:
+                import logging
+
+                logging.getLogger(__name__).error(
+                    "OIDC validator init failed: %s", e)
+        self.oidc_validator = oidc_validator
 
     def authenticate(self, authorization: str | None) -> Principal:
         """``authorization``: the Authorization header value or None."""
@@ -116,9 +127,22 @@ class Authenticator:
                     user = users[min(i, len(users) - 1)] if users else "api-key-user"
                     return Principal(user, "apikey")
             if cfg.oidc_enabled:
-                raise AuthError(
-                    "OIDC token validation requires issuer connectivity; "
-                    "this deployment accepts only configured API keys")
+                # JWT validation against the configured JWKS (reference:
+                # configure_api.go:601). JWTs have two dots; API keys don't
+                # — so key-looking tokens keep the crisp error above.
+                v = self.oidc_validator
+                if v is None or not v.has_keys:
+                    raise AuthError(
+                        "OIDC is enabled but no JWKS is available; set "
+                        "AUTHENTICATION_OIDC_JWKS_FILE or check issuer "
+                        "connectivity")
+                from weaviate_tpu.auth.oidc import OidcError
+
+                try:
+                    username, _groups = v.principal_claims(token)
+                except OidcError as e:
+                    raise AuthError(str(e)) from e
+                return Principal(username, "oidc")
             raise AuthError("invalid api key")
         if cfg.anonymous_enabled:
             return Principal("anonymous", "anonymous")

@@ -177,16 +177,24 @@ class BackupManager:
                     # pauses compaction + commit-log switching)
                     with self.db.cycles.pause():
                         self.db.flush()
+                        from weaviate_tpu.backup.cluster import (
+                            put_file_compressed,
+                        )
+
                         for cls in classes:
                             col = self.db.get_collection(cls)
                             root = os.path.join(self.db.data_dir, cls)
-                            files = _walk_files(root) \
-                                if os.path.isdir(root) else []
-                            for rel in files:
-                                # streamed: multi-GB segment files never
-                                # materialize in memory
-                                backend.put_file(backup_id, f"{cls}/{rel}",
-                                                 os.path.join(root, rel))
+                            files = []
+                            for rel in (_walk_files(root)
+                                        if os.path.isdir(root) else []):
+                                # streamed + gzip'd chunk by chunk:
+                                # multi-GB segment files never
+                                # materialize in memory (reference:
+                                # usecases/backup/zip.go)
+                                stored = put_file_compressed(
+                                    backend, backup_id, f"{cls}/{rel}",
+                                    os.path.join(root, rel))
+                                files.append(stored[len(cls) + 1:])
                             descriptor["classes"].append({
                                 "name": cls,
                                 "config": col.config.to_dict(),

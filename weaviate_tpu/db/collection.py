@@ -9,6 +9,7 @@ shard per tenant.
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time
 import uuid as uuid_mod
@@ -127,6 +128,8 @@ class Collection:
         # cluster hook fn(collection_name, [tenant]) routing auto tenant
         # creation through Raft; None = apply locally (single node)
         self._auto_tenant_hook = None
+        # FROZEN-tier offload target (a backup backend); set by Database
+        self.offload_backend = None
         self._lock = threading.RLock()
         # Sharded per-uuid write locks for read-modify-write flows
         # (reference appends, PATCH) — the RMW must be atomic per object but
@@ -150,8 +153,8 @@ class Collection:
         self.shards: dict[str, Shard] = {}
         for name in self.sharding.shard_names:
             if self.local_node in self.sharding.nodes_for(name) and \
-                    self.sharding.status_of(name) != "COLD":
-                self._load_shard(name)  # COLD tenants stay unloaded
+                    self.sharding.status_of(name) not in ("COLD", "FROZEN"):
+                self._load_shard(name)  # COLD/FROZEN tenants stay unloaded
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix=f"{config.name}-search")
         # hot/cold tenant tracking (reference: entities/tenantactivity +
@@ -223,15 +226,17 @@ class Collection:
             return self.shards[name]
 
     def _require_active(self, tenant: str) -> None:
-        """COLD tenants reject access unless auto-activation is on
+        """COLD/FROZEN tenants reject access unless auto-activation is on
         (reference: tenant activityStatus + autoTenantActivation)."""
-        if self.sharding.status_of(tenant) == "COLD":
+        status = self.sharding.status_of(tenant)
+        if status in ("COLD", "FROZEN"):
             if self.config.multi_tenancy.auto_tenant_activation:
                 self.set_tenant_status(tenant, "HOT")
             else:
                 raise ValueError(
                     f"tenant {tenant!r} is not active (activityStatus "
-                    "COLD); activate it or enable autoTenantActivation")
+                    f"{status}); activate it or enable "
+                    "autoTenantActivation")
 
     def _check_tenant(self, tenant: str | None, kind: str = "read") -> None:
         if self.config.multi_tenancy.enabled:
@@ -330,23 +335,91 @@ class Collection:
         return list(self.sharding.shard_names) if self.config.multi_tenancy.enabled else []
 
     def set_tenant_status(self, tenant: str, status: str) -> None:
-        """HOT/COLD tenant offload (reference: PUT tenants with
+        """HOT/COLD/FROZEN tenant offload (reference: PUT tenants with
         activityStatus; COLD unloads the shard from memory/HBM, files
-        stay on disk; HOT loads it back — shard_lazyloader analog)."""
+        stay on disk; FROZEN ships the files to the offload backend and
+        removes them locally — entities/tenantactivity + offload
+        modules; HOT loads it back)."""
         status = status.upper()
-        if status not in ("HOT", "COLD"):
-            raise ValueError("tenant activityStatus must be HOT or COLD")
+        if status not in ("HOT", "COLD", "FROZEN"):
+            raise ValueError(
+                "tenant activityStatus must be HOT, COLD or FROZEN")
         if tenant not in self.sharding.shard_names:
             raise KeyError(f"tenant {tenant!r} does not exist")
         with self._lock:
+            prev = self.sharding.status_of(tenant)
+            if status == prev:
+                return
+            if prev == "FROZEN" and status in ("HOT", "COLD"):
+                self._unfreeze_tenant(tenant)
             self.sharding.tenant_status[tenant] = status
-            if status == "COLD":
+            if status == "FROZEN":
+                self._freeze_tenant(tenant)
+            elif status == "COLD":
                 shard = self.shards.pop(tenant, None)
                 if shard is not None:
                     shard.close()
             elif self._is_local(tenant):
                 self._load_shard(tenant)
             self._on_sharding_change(self)
+
+    def _offload_backend(self):
+        backend = self.offload_backend
+        if backend is None:
+            raise RuntimeError(
+                "FROZEN tenants need an offload backend: configure a "
+                "backup module and OFFLOAD_BACKEND (reference: offload-s3 "
+                "module + tenant activityStatus FROZEN)")
+        return backend
+
+    def _offload_id(self, tenant: str) -> str:
+        return f"tenant-offload--{self.config.name}--{tenant}"
+
+    def _freeze_tenant(self, tenant: str) -> None:
+        """Stream the tenant's shard files to the offload backend, then
+        delete them locally (reference: FROZEN tier — local resources are
+        released entirely; files live in cloud storage)."""
+        import json as _json
+        import shutil as _shutil
+
+        from weaviate_tpu.backup.cluster import put_file_compressed
+        from weaviate_tpu.modules.backup_backends import walk_files
+
+        backend = self._offload_backend()
+        shard = self.shards.pop(tenant, None)
+        if shard is not None:
+            shard.flush()
+            shard.close()
+        sh_dir = os.path.join(self.data_dir, self.config.name, tenant)
+        if not os.path.isdir(sh_dir):
+            return
+        oid = self._offload_id(tenant)
+        backend.initialize(oid)
+        stored = [put_file_compressed(backend, oid, rel,
+                                      os.path.join(sh_dir, rel))
+                  for rel in walk_files(sh_dir)]
+        backend.put(oid, "manifest.json",
+                    _json.dumps({"files": stored}).encode())
+        _shutil.rmtree(sh_dir, ignore_errors=True)
+
+    def _unfreeze_tenant(self, tenant: str) -> None:
+        import json as _json
+
+        from weaviate_tpu.backup.cluster import (get_file_decompressed,
+                                                 logical_name)
+
+        backend = self._offload_backend()
+        oid = self._offload_id(tenant)
+        manifest = _json.loads(backend.get(oid, "manifest.json"))
+        sh_dir = os.path.abspath(
+            os.path.join(self.data_dir, self.config.name, tenant))
+        for stored in manifest.get("files", []):
+            dst = os.path.abspath(
+                os.path.join(sh_dir, logical_name(stored)))
+            if not dst.startswith(sh_dir + os.sep):
+                raise ValueError(
+                    f"offload manifest path {stored!r} escapes the shard")
+            get_file_decompressed(backend, oid, stored, dst)
 
     # -- object CRUD ---------------------------------------------------------
 

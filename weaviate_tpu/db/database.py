@@ -33,6 +33,9 @@ class Database:
         # cluster hook fn(collection, [tenant]): routes auto tenant
         # creation through Raft (set by ClusterNode); None = local apply
         self.auto_tenant_hook = None
+        # FROZEN-tier offload target (a backup backend); set by the server
+        # when modules are configured (set_offload_backend)
+        self.offload_backend = None
         os.makedirs(data_dir, exist_ok=True)
         self._lock = threading.RLock()
         self._schema_store = KVStore(os.path.join(data_dir, "_schema"))
@@ -71,6 +74,7 @@ class Database:
                 async_indexing=self.async_indexing,
             )
             col._auto_tenant_hook = self.auto_tenant_hook
+            col.offload_backend = self.offload_backend
             self.collections[cfg.name] = col
 
     # -- schema ops (the Raft FSM op set, cluster/store_apply.go:133-160) ----
@@ -93,9 +97,17 @@ class Database:
                              nodes_provider=self.nodes_provider,
                              async_indexing=self.async_indexing)
             col._auto_tenant_hook = self.auto_tenant_hook
+            col.offload_backend = self.offload_backend
             self.collections[config.name] = col
             self._persist(col)
             return col
+
+    def set_offload_backend(self, backend) -> None:
+        """Backup backend receiving FROZEN tenants (reference: offload
+        modules, OFFLOAD_* env). Propagates to every collection."""
+        self.offload_backend = backend
+        for col in self.collections.values():
+            col.offload_backend = backend
 
     def set_auto_tenant_hook(self, hook) -> None:
         with self._lock:

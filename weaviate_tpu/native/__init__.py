@@ -163,21 +163,29 @@ def membership(vals, allow_sorted) -> np.ndarray:
 # ---- varint delta codec ---------------------------------------------------
 
 
+def _varint_encode_py(vals) -> bytes:
+    out = bytearray()
+    prev = 0
+    for v in vals.tolist():
+        d = v - prev
+        prev = v
+        while d >= 0x80:
+            out.append((d & 0x7F) | 0x80)
+            d >>= 7
+        out.append(d)
+    return bytes(out)
+
+
 def varint_encode(vals) -> bytes:
     """Ascending uint64 -> delta + LEB128 bytes (posting-block codec)."""
     vals = _u64(vals)
+    if len(vals) <= 16:
+        # the ctypes FFI round-trip costs ~15us — for the tiny bitmaps the
+        # inverted index writes per unique value, pure Python wins big
+        return _varint_encode_py(vals)
     lib = _load()
     if lib is None:
-        out = bytearray()
-        prev = 0
-        for v in vals.tolist():
-            d = v - prev
-            prev = v
-            while d >= 0x80:
-                out.append((d & 0x7F) | 0x80)
-                d >>= 7
-            out.append(d)
-        return bytes(out)
+        return _varint_encode_py(vals)
     out = np.empty(len(vals) * 10 or 1, dtype=np.uint8)
     n = lib.wn_varint_encode_u64(_ptr(vals, ctypes.c_uint64), len(vals),
                                  _ptr(out, ctypes.c_uint8))
@@ -189,7 +197,7 @@ def varint_decode(buf: bytes, count_hint: int | None = None) -> np.ndarray:
     count from the surrounding record; a block holding MORE values than
     declared raises (corrupt/truncated data) rather than over- or
     under-reading — the count field is untrusted on-disk input."""
-    lib = _load()
+    lib = None if len(buf) <= 32 else _load()  # FFI overhead > tiny decode
     if lib is None:
         out, prev, d, shift = [], 0, 0, 0
         for byte in buf:

@@ -307,6 +307,10 @@ def bq_mxu_block(
         ).astype(jnp.float32)
     else:
         xpop = jnp.pad(x_pop.astype(jnp.float32), (0, pn - n))
+    # Mosaic has no uint32->bf16 cast; the kernel's bit planes convert
+    # from int32 instead (bit extraction is sign-agnostic)
+    if x_bits.dtype == jnp.uint32:
+        x_bits = jax.lax.bitcast_convert_type(x_bits, jnp.int32)
     if valid is None:
         valid_f = (jnp.arange(pn) < n).astype(jnp.float32)
     else:

@@ -1,0 +1,134 @@
+"""Server-side dynamic query batching.
+
+Round-1 gap (VERDICT item 6): each concurrent Search dispatched its own
+device program, so N clients paid N host->device round trips while the
+scan kernel itself amortizes perfectly over a query batch
+(`FlatIndex.search_by_vector_batch` runs one matmul for B queries).
+
+Design (continuous batching, not a fixed window): a request that finds
+the device idle dispatches IMMEDIATELY — zero added latency for a lone
+client. Requests that arrive while a dispatch is in flight queue up; the
+worker drains the whole queue into ONE batched dispatch as soon as the
+device frees up. Under load the batch size self-tunes to the arrival
+rate, exactly like continuous batching in model serving.
+
+Only unfiltered requests coalesce: the scan kernel applies one validity
+mask per dispatch, so a request with an AllowList mask dispatches alone
+(the reference's filtered searches take a different path too —
+flat_search_cutoff). Mixed k's batch together at max(k) and slice.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class _Pending:
+    __slots__ = ("query", "k", "allow", "event", "ids", "dists", "error")
+
+    def __init__(self, query, k, allow):
+        self.query = query
+        self.k = k
+        self.allow = allow
+        self.event = threading.Event()
+        self.ids = None
+        self.dists = None
+        self.error: Exception | None = None
+
+
+class QueryBatcher:
+    """Wraps one vector index's batched search entry point.
+
+    ``batch_fn(queries [B,d], k, allow) -> (ids [B,k], dists [B,k])``.
+    """
+
+    def __init__(self, batch_fn, max_batch: int = 256):
+        self._batch_fn = batch_fn
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: list[_Pending] = []
+        self._worker: threading.Thread | None = None
+        self._stopped = False
+        # observability (tools/bench_e2e asserts coalescing happens)
+        self.dispatches = 0
+        self.batched_queries = 0
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run, name="query-batcher", daemon=True)
+            self._worker.start()
+
+    def stop(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    def search(self, query: np.ndarray, k: int,
+               allow: np.ndarray | None = None):
+        """Blocking per-request entry; coalesces under concurrency."""
+        item = _Pending(np.asarray(query, dtype=np.float32), k, allow)
+        with self._cv:
+            self._queue.append(item)
+            self._ensure_worker()
+            self._cv.notify()
+        item.event.wait()
+        if item.error is not None:
+            raise item.error
+        return item.ids, item.dists
+
+    # -- worker ---------------------------------------------------------------
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopped:
+                    self._cv.wait(timeout=1.0)
+                if self._stopped:
+                    for it in self._queue:
+                        it.error = RuntimeError("query batcher stopped")
+                        it.event.set()
+                    self._queue.clear()
+                    return
+                drained = self._queue[: self.max_batch]
+                del self._queue[: len(drained)]
+            try:
+                self._dispatch(drained)
+            except Exception as e:  # noqa: BLE001 — deliver to every waiter
+                for it in drained:
+                    if not it.event.is_set():
+                        it.error = e
+                        it.event.set()
+
+    def _dispatch(self, drained: list[_Pending]):
+        # filtered requests run alone (one mask per device dispatch);
+        # unfiltered requests coalesce into one batched program
+        plain = [it for it in drained if it.allow is None]
+        masked = [it for it in drained if it.allow is not None]
+        for it in masked:
+            try:
+                ids, dists = self._batch_fn(it.query[None, :], it.k, it.allow)
+                it.ids, it.dists = ids[0], dists[0]
+            except Exception as e:  # noqa: BLE001
+                it.error = e
+            it.event.set()
+        if not plain:
+            return
+        k_max = max(it.k for it in plain)
+        queries = np.stack([it.query for it in plain])
+        self.dispatches += 1
+        self.batched_queries += len(plain)
+        try:
+            ids, dists = self._batch_fn(queries, k_max, None)
+        except Exception as e:  # noqa: BLE001
+            for it in plain:
+                it.error = e
+                it.event.set()
+            return
+        for row, it in enumerate(plain):
+            it.ids = ids[row, : it.k]
+            it.dists = dists[row, : it.k]
+            it.event.set()

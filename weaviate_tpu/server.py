@@ -10,6 +10,7 @@ the reference's startupRoutine ordering.
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import threading
 
@@ -76,6 +77,12 @@ class Server:
                                async_indexing=cfg.async_indexing or None)
 
         modules = default_provider(self.db, enabled=cfg.enabled_modules)
+
+        # FROZEN tenant tier: ship offloaded tenants through a backup
+        # backend (reference: offload-s3 module + tenantactivity FROZEN)
+        offload_name = os.environ.get("OFFLOAD_BACKEND", "")
+        if offload_name:
+            self.db.set_offload_backend(modules.backup_backend(offload_name))
 
         from weaviate_tpu.api.rest import RestServer
 
