@@ -51,9 +51,11 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def clustered_corpus(rng, n, dim, n_clusters=1024, spread=0.15):
+def clustered_corpus(rng, n, dim, n_clusters=65536, spread=0.35):
     """Mixture of gaussians — quantization-representative data (real
-    embeddings cluster; i.i.d. gaussian is the adversarial floor)."""
+    embeddings cluster; i.i.d. gaussian is the adversarial floor). ~15
+    members per cluster with within-cluster spread comparable to the
+    quantization cell size — SIFT-like, not degenerate near-duplicates."""
     import numpy as np
 
     centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
@@ -151,9 +153,29 @@ def main():
     log(f"median {per_batch*1e3:.2f} ms/batch of {batch} -> {qps:.0f} QPS; "
         f"p95 {np.percentile(times,95)*1e3:.2f} ms")
 
-    # --- device-side steady state: R dispatches in flight -------------------
-    # Dispatch is async; queueing R programs back-to-back amortizes the
-    # host<->device tunnel RTT, so (t_total/R) converges on DEVICE time.
+    # --- device-side steady state: R executions chained IN ONE program ------
+    # The tunnel's async dispatch/block_until_ready timing is unreliable;
+    # chaining R scans inside one jit (each iteration's id_offset depends
+    # on the previous result, forcing real sequential execution) and
+    # fetching the final result measures true device time per scan.
+    import functools as _ft
+
+    def chained_ms(step_with_offset, reps=10):
+        """step_with_offset(id_offset) -> (d [B,k'], i); returns ms/scan."""
+        @jax.jit
+        def chained():
+            def body(_i, carry):
+                zero = (carry[0][0, 0] * 0.0).astype(jnp.int32)
+                d_, i_ = step_with_offset(zero)
+                return (d_,)
+            d0, _ = step_with_offset(jnp.int32(0))
+            (d_,) = jax.lax.fori_loop(0, reps, body, (d0,))
+            return d_
+        np.asarray(chained())  # compile + warm
+        t0 = time.perf_counter()
+        np.asarray(chained())
+        return (time.perf_counter() - t0) / (reps + 1) * 1e3
+
     def pipelined_ms(fn, reps=12):
         out = fn()
         jax.block_until_ready(out)  # compile + warm
@@ -166,7 +188,9 @@ def main():
     bytes_bf16 = n_pad * dim * (2 if store_dtype == jnp.bfloat16 else 4)
     for b_dev in (64, 256, 1024):
         qd = jax.device_put(jnp.asarray(queries[0][:b_dev]), dev)
-        ms = pipelined_ms(lambda: step(qd))
+        ms = chained_ms(lambda off: chunked_topk_distances(
+            qd, x, k=k, chunk_size=chunk, metric="l2-squared",
+            valid=valid, x_sq_norms=norms, id_offset=off))
         gbps = bytes_bf16 / (ms / 1e3) / 1e9
         flops = 2.0 * b_dev * n_pad * dim / (ms / 1e3)
         device_stats[f"flat_{'bf16' if store_dtype==jnp.bfloat16 else 'f32'}_b{b_dev}"] = {
@@ -230,7 +254,9 @@ def main():
         return chunked_topk_distances(
             qb, x_cl, k=k, chunk_size=chunk, metric="l2-squared",
             valid=valid, x_sq_norms=norms_cl)
-    ms_bf16_cl = pipelined_ms(lambda: step_cl(q_cl_dev))
+    ms_bf16_cl = chained_ms(lambda off: chunked_topk_distances(
+        q_cl_dev, x_cl, k=k, chunk_size=chunk, metric="l2-squared",
+        valid=valid, x_sq_norms=norms_cl, id_offset=off))
     quant["bf16_flat"] = {"device_batch_ms": round(ms_bf16_cl, 3),
                           "qps": round(batch / (ms_bf16_cl / 1e3))}
     # f32 HIGHEST flat (the reference-exact path — the bar to beat)
@@ -239,7 +265,9 @@ def main():
         return chunked_topk_distances(
             qb, x_f32, k=k, chunk_size=chunk, metric="l2-squared",
             valid=valid, x_sq_norms=norms_cl)
-    ms_f32_cl = pipelined_ms(lambda: step_f32(q_cl_dev))
+    ms_f32_cl = chained_ms(lambda off: chunked_topk_distances(
+        q_cl_dev, x_f32, k=k, chunk_size=chunk, metric="l2-squared",
+        valid=valid, x_sq_norms=norms_cl, id_offset=off))
     quant["f32_flat"] = {"device_batch_ms": round(ms_f32_cl, 3),
                          "qps": round(batch / (ms_f32_cl / 1e3))}
     del x_f32
@@ -251,7 +279,9 @@ def main():
     def bq_step():
         return bq_ops.bq_topk(qw, xw, k=k_cand, chunk_size=chunk,
                               valid=valid, use_pallas=True)
-    ms_bq = pipelined_ms(bq_step)
+    ms_bq = chained_ms(lambda off: bq_ops.bq_topk(
+        qw, xw, k=k_cand, chunk_size=chunk, valid=valid, use_pallas=True,
+        id_offset=off))
     d_, i_ = bq_step()
     rec_bq = rescore_recall(i_)
     quant["bq_mxu"] = {"device_batch_ms": round(ms_bq, 3),
@@ -267,7 +297,9 @@ def main():
         return pq_ops.pq4_topk(q_cl_dev, codes, book.centroids, k=k_cand,
                                chunk_size=chunk, metric="l2-squared",
                                valid=valid)
-    ms_pq4 = pipelined_ms(pq4_step)
+    ms_pq4 = chained_ms(lambda off: pq_ops.pq4_topk(
+        q_cl_dev, codes, book.centroids, k=k_cand, chunk_size=chunk,
+        metric="l2-squared", valid=valid, id_offset=off))
     d_, i_ = pq4_step()
     rec_pq4 = rescore_recall(i_)
     quant["pq4_lut"] = {"device_batch_ms": round(ms_pq4, 3),

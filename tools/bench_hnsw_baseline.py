@@ -1,0 +1,92 @@
+"""CPU HNSW baseline measurement (VERDICT r1 item 7 / BASELINE config #2).
+
+Builds the repo's own HNSW (engine/hnsw.py) on a SIFT-shaped corpus with
+the reference benchmark's construction parameters
+(test/benchmark/benchmark_sift.go:48-54: efConstruction=64,
+maxConnections=64, l2-squared), sweeps ef to the recall@10 >= 0.95
+operating point, and prints QPS there — the honest "CPU ANN" number the
+TPU flat/IVF QPS must beat (hnswlib is not available in this image; the
+repo HNSW is pure Python, so this is a floor for CPU ANN performance and
+is recorded as such in BASELINE.md).
+
+Usage: python tools/bench_hnsw_baseline.py [--n 200000] [--dim 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=200_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--queries", type=int, default=200)
+    ap.add_argument("--k", type=int, default=10)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from weaviate_tpu.engine.hnsw import HNSWIndex
+
+    rng = np.random.default_rng(0)
+    corpus = rng.standard_normal((args.n, args.dim)).astype(np.float32)
+    queries = rng.standard_normal((args.queries, args.dim)).astype(np.float32)
+
+    # ground truth by brute force
+    log("computing ground truth...")
+    gt = np.empty((args.queries, args.k), np.int64)
+    cn = (corpus ** 2).sum(-1)
+    for i, q in enumerate(queries):
+        dist = cn - 2.0 * corpus @ q
+        gt[i] = np.argpartition(dist, args.k)[: args.k]
+
+    idx = HNSWIndex(dim=args.dim, metric="l2-squared",
+                    ef_construction=64, max_connections=64)
+    t0 = time.perf_counter()
+    bs = 2000
+    for s in range(0, args.n, bs):
+        idx.add_batch(np.arange(s, min(s + bs, args.n)),
+                      corpus[s: s + bs])
+        if (s // bs) % 10 == 0:
+            el = time.perf_counter() - t0
+            log(f"  built {s + bs}/{args.n} ({(s + bs)/max(el,1e-9):.0f} vec/s)")
+    build_s = time.perf_counter() - t0
+    log(f"build: {args.n} vectors in {build_s:.1f}s "
+        f"({args.n/build_s:.0f} vec/s)")
+
+    rows = []
+    for ef in (16, 32, 64, 128, 256, 512):
+        idx.ef = ef
+        t0 = time.perf_counter()
+        got = [idx.search_by_vector(q, args.k)[0] for q in queries]
+        dt = time.perf_counter() - t0
+        recall = float(np.mean([
+            len(set(np.asarray(ids).tolist()) & set(gt[i])) / args.k
+            for i, ids in enumerate(got)]))
+        qps = args.queries / dt
+        rows.append({"ef": ef, "recall_at_10": round(recall, 4),
+                     "qps": round(qps, 1)})
+        log(f"ef={ef}: recall@10={recall:.4f} qps={qps:.1f}")
+        if recall >= 0.99:
+            break
+
+    at_95 = next((r for r in rows if r["recall_at_10"] >= 0.95), rows[-1])
+    print(json.dumps({
+        "metric": "cpu_hnsw_qps_at_recall95",
+        "n": args.n, "dim": args.dim,
+        "build_vec_per_s": round(args.n / build_s, 1),
+        "ef_sweep": rows,
+        "operating_point": at_95,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,7 +59,7 @@ def bq_topk(
     XOR + popcount + reduce on the VPU, chunk-scanned like the float path.
     """
     from weaviate_tpu.ops.distances import MASKED_DISTANCE
-    from weaviate_tpu.ops.topk import topk_smallest
+    from weaviate_tpu.ops.topk import approx_topk_smallest, topk_smallest
 
     n, w = x_words.shape
     assert n % chunk_size == 0, f"{n} rows not a multiple of {chunk_size}"
@@ -107,9 +107,14 @@ def bq_topk(
             + jax.lax.broadcasted_iota(jnp.int32, (1, chunk_size), 1)
         )
         ids = jnp.broadcast_to(ids, (b, chunk_size))
+        # two-stage: approx-select within THIS chunk only (one 0.95-recall
+        # invocation per candidate), then EXACT merge of the tiny carried
+        # set — carried winners can never be dropped by the approx op
+        ck_d, ck_i = approx_topk_smallest(d, ids, min(k, chunk_size))
+        ck_d = ck_d.astype(jnp.float32)  # bf16 kernel output -> f32 merge
         new_d, new_i = topk_smallest(
-            jnp.concatenate([best_d, d], axis=1),
-            jnp.concatenate([best_i, ids], axis=1),
+            jnp.concatenate([best_d, ck_d], axis=1),
+            jnp.concatenate([best_i, ck_i], axis=1),
             k,
         )
         return (new_d, new_i), None

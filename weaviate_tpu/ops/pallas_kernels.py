@@ -229,7 +229,10 @@ def _bq_mxu_kernel(q_ref, x_ref, qpop_ref, xpop_ref, valid_ref, out_ref):
         preferred_element_type=jnp.float32,
     )  # [B, TILE]
     d = qpop_ref[:] + xpop_ref[:] - 2.0 * dots
-    out_ref[:] = d + (1.0 - valid_ref[:]) * MASKED_DISTANCE
+    # candidates are exactly rescored downstream — bf16 output halves the
+    # dominant HBM cost (the [B, chunk] distance intermediate)
+    out_ref[:] = (d + (1.0 - valid_ref[:]) * MASKED_DISTANCE
+                  ).astype(jnp.bfloat16)
 
 
 @functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
@@ -247,10 +250,10 @@ def _bq_mxu_tiled(q01, x_packed, qpop, xpop, valid_f, tile_n, interpret):
             pl.BlockSpec((1, tile_n), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((b, tile_n), lambda i: (0, i), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, n), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.bfloat16),
         cost_estimate=pl.CostEstimate(
             flops=2 * b * n * 32 * w,
-            bytes_accessed=q01.size * 2 + x_packed.size * 4 + b * n * 4,
+            bytes_accessed=q01.size * 2 + x_packed.size * 4 + b * n * 2,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -337,7 +340,8 @@ def _pq4_kernel(lut_ref, c_ref, valid_ref, out_ref, *, k, m, interpret):
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [B, TILE]
-    out_ref[:] = d + (1.0 - valid_ref[:]) * MASKED_DISTANCE
+    out_ref[:] = (d + (1.0 - valid_ref[:]) * MASKED_DISTANCE
+                  ).astype(jnp.bfloat16)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "m", "tile_n", "interpret"))
@@ -353,10 +357,10 @@ def _pq4_tiled(lut_cm, codes, valid_f, k, m, tile_n, interpret):
             pl.BlockSpec((1, tile_n), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((b, tile_n), lambda i: (0, i), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b, n), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.bfloat16),
         cost_estimate=pl.CostEstimate(
             flops=2 * b * n * k * m,
-            bytes_accessed=lut_cm.size * 2 + codes.size + b * n * 4,
+            bytes_accessed=lut_cm.size * 2 + codes.size + b * n * 2,
             transcendentals=0,
         ),
         interpret=interpret,
@@ -367,7 +371,7 @@ def pq4_lut_block(
     lut: jnp.ndarray,
     codes: jnp.ndarray,
     valid: jnp.ndarray | None = None,
-    tile_n: int = 256,
+    tile_n: int = 512,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Exact ADC distances for 4-bit PQ codes (reference LUT ``Distance``,
@@ -404,10 +408,125 @@ def pq4_lut_block(
     return out[:b, :n]
 
 
+def _pq4_recon_kernel(q_ref, cflat_ref, c_ref, valid_ref, out_ref,
+                      *, k, m, metric, interpret):
+    """4-bit PQ scan via RECONSTRUCT-matmul: one-hot [TILE, mk] @
+    block-diagonal centroids [mk, d] rebuilds x_hat in VMEM, then the
+    normal distance matmul scores it. Per-row FLOPs 2*mk*d + 2*d*B —
+    beats the LUT formulation's 2*mk*B once B > mk*d/(mk-d) (~170 at
+    d=128), so large serving batches take this path."""
+    c = c_ref[:].astype(jnp.int32)  # [TILE, m]
+    if interpret:
+        rep = jnp.concatenate([c] * k, axis=1)
+    else:
+        rep = pltpu.repeat(c, k, axis=1)  # [TILE, k*m] code-major
+    lane_code = jax.lax.broadcasted_iota(jnp.int32, rep.shape, 1) // m
+    oh = (rep == lane_code).astype(jnp.bfloat16)
+    x_hat = jax.lax.dot_general(
+        oh, cflat_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [TILE, d]
+    xn = jnp.sum(x_hat * x_hat, axis=1)  # [TILE] = ||x_hat||^2 (exact:
+    # segments are disjoint columns, so the reconstruction is exact)
+    dots = jax.lax.dot_general(
+        q_ref[:], x_hat.astype(jnp.bfloat16),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [B, TILE]
+    if metric == "l2-squared":
+        q = q_ref[:].astype(jnp.float32)
+        qn = jnp.sum(q * q, axis=1, keepdims=True)
+        d_ = qn - 2.0 * dots + xn[None, :]
+    elif metric == "dot":
+        d_ = -dots
+    else:  # cosine: stored side normalized upstream; ADC keeps ranking
+        d_ = 1.0 - dots
+    out_ref[:] = (d_ + (1.0 - valid_ref[:]) * MASKED_DISTANCE
+                  ).astype(jnp.bfloat16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "m", "metric", "tile_n", "interpret"))
+def _pq4_recon_tiled(q, cflat, codes, valid_f, k, m, metric, tile_n,
+                     interpret):
+    b = q.shape[0]
+    n = codes.shape[0]
+    d = cflat.shape[1]
+    return pl.pallas_call(
+        functools.partial(_pq4_recon_kernel, k=k, m=m, metric=metric,
+                          interpret=interpret),
+        grid=(n // tile_n,),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k * m, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, m), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b, tile_n), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.bfloat16),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * k * m * d + 2 * b * n * d,
+            bytes_accessed=q.size * 2 + codes.size + b * n * 2,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(q, cflat, codes, valid_f)
+
+
+def pq4_recon_block(
+    q: jnp.ndarray,
+    codes: jnp.ndarray,
+    centroids: jnp.ndarray,
+    metric: str = "l2-squared",
+    valid: jnp.ndarray | None = None,
+    tile_n: int = 512,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """ADC distances for 4-bit PQ via in-VMEM reconstruction (same
+    candidate semantics as pq4_lut_block; cheaper for large B).
+
+    q [B, d] f32/bf16 (cosine: pre-normalized by caller), codes [N, m]
+    uint8, centroids [m, k<=16, ds].
+    """
+    if interpret is None:
+        interpret = not recommended()
+    m, kk, ds = centroids.shape
+    if kk > 16:
+        raise ValueError(f"pq4 kernel requires k <= 16 centroids, got {kk}")
+    k = 16
+    b, d = q.shape
+    n = codes.shape[0]
+    pb = _pad_to(max(b, 1), _SUBLANE)
+    tile_n = min(tile_n, _pad_to(max(n, 1), _LANE))
+    pn = _pad_to(max(n, 1), tile_n)
+    q = q.astype(jnp.bfloat16)
+    if pb != b:
+        q = jnp.pad(q, ((0, pb - b), (0, 0)))
+    if pn != n:
+        codes = jnp.pad(codes, ((0, pn - n), (0, 0)))
+    cent = centroids.astype(jnp.float32)
+    if kk < k:
+        cent = jnp.pad(cent, ((0, 0), (0, k - kk), (0, 0)))
+    # CODE-MAJOR block-diagonal flatten matching pltpu.repeat's one-hot
+    # order: cflat[c*m + s, s*ds:(s+1)*ds] = cent[s, c]
+    eye = jnp.eye(m, dtype=jnp.float32)
+    cflat = jnp.einsum("st,skd->ktsd", eye, cent)  # [k, t, s, ds]
+    cflat = cflat.reshape(k * m, m * ds).astype(jnp.bfloat16)
+    if valid is None:
+        valid_f = (jnp.arange(pn) < n).astype(jnp.float32)
+    else:
+        valid_f = jnp.pad(valid.astype(jnp.float32), (0, pn - n))
+    out = _pq4_recon_tiled(q, cflat, codes, valid_f[None, :], k, m,
+                           metric, tile_n, interpret)
+    return out[:b, :n]
+
+
 def bq_hamming_block(
     q_bits: jnp.ndarray,
     x_bits: jnp.ndarray,
-    tile_n: int = 256,
+    tile_n: int = 512,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Hamming distance between packed sign-bit codes.

@@ -169,7 +169,7 @@ def pq_topk(
     segments). Returns (dists [B,k], ids [B,k]) like chunked_topk.
     """
     from weaviate_tpu.ops.distances import MASKED_DISTANCE, pairwise_distance
-    from weaviate_tpu.ops.topk import topk_smallest
+    from weaviate_tpu.ops.topk import approx_topk_smallest, topk_smallest
 
     m = m or centroids.shape[0]
     n = codes.shape[0]
@@ -196,9 +196,14 @@ def pq_topk(
             + jax.lax.broadcasted_iota(jnp.int32, (1, chunk_size), 1)
         )
         ids = jnp.broadcast_to(ids, (b, chunk_size))
+        # two-stage: approx-select within THIS chunk only (one 0.95-recall
+        # invocation per candidate), then EXACT merge of the tiny carried
+        # set — carried winners can never be dropped by the approx op
+        ck_d, ck_i = approx_topk_smallest(d, ids, min(k, chunk_size))
+        ck_d = ck_d.astype(jnp.float32)  # bf16 kernel output -> f32 merge
         new_d, new_i = topk_smallest(
-            jnp.concatenate([best_d, d], axis=1),
-            jnp.concatenate([best_i, ids], axis=1),
+            jnp.concatenate([best_d, ck_d], axis=1),
+            jnp.concatenate([best_i, ck_i], axis=1),
             k,
         )
         return (new_d, new_i), None
@@ -262,19 +267,28 @@ def pq4_topk(
     id_offset: jnp.ndarray | int = 0,
     m: int | None = None,
 ):
-    """Compressed brute-force top-k over 4-bit codes via the LUT-matmul
-    Pallas kernel. Same contract as pq_topk."""
-    from weaviate_tpu.ops.distances import MASKED_DISTANCE
-    from weaviate_tpu.ops.pallas_kernels import pq4_lut_block
-    from weaviate_tpu.ops.topk import topk_smallest
+    """Compressed brute-force top-k over 4-bit codes via the Pallas ADC
+    kernels. Same contract as pq_topk. Formulation picked by batch size:
+    LUT-matmul costs 2*mk*B FLOPs/row, reconstruct-matmul 2*mk*d + 2*d*B
+    — the crossover sits at B ~ mk*d/(mk-d), so big batches reconstruct."""
+    from weaviate_tpu.ops.distances import MASKED_DISTANCE, normalize
+    from weaviate_tpu.ops.pallas_kernels import (pq4_lut_block,
+                                                 pq4_recon_block)
+    from weaviate_tpu.ops.topk import approx_topk_smallest, topk_smallest
 
     m = m or centroids.shape[0]
     n = codes.shape[0]
     assert n % chunk_size == 0, f"codes rows {n} not a multiple of {chunk_size}"
     num_chunks = n // chunk_size
     b = q.shape[0]
+    d = centroids.shape[0] * centroids.shape[2]
+    mk16 = m * 16
+    use_recon = mk16 > d and b > (mk16 * d) // max(mk16 - d, 1)
+    q_recon = q
+    if use_recon and metric in ("cosine", "cosine-dot"):
+        q_recon = normalize(q.astype(jnp.float32))
 
-    lut = pq_lut(q, centroids, metric, m)  # [B, m, k]
+    lut = None if use_recon else pq_lut(q, centroids, metric, m)  # [B, m, k]
 
     code_chunks = codes.reshape(num_chunks, chunk_size, m)
     valid_chunks = None if valid is None else valid.reshape(num_chunks, chunk_size)
@@ -285,16 +299,25 @@ def pq4_topk(
     def body(carry, inp):
         best_d, best_i = carry
         chunk_idx, cc, vc = inp
-        d = pq4_lut_block(lut, cc, valid=vc)
+        if use_recon:
+            d = pq4_recon_block(q_recon, cc, centroids, metric=metric,
+                                valid=vc)
+        else:
+            d = pq4_lut_block(lut, cc, valid=vc)
         ids = (
             chunk_idx * chunk_size
             + id_offset
             + jax.lax.broadcasted_iota(jnp.int32, (1, chunk_size), 1)
         )
         ids = jnp.broadcast_to(ids, (b, chunk_size))
+        # two-stage: approx-select within THIS chunk only (one 0.95-recall
+        # invocation per candidate), then EXACT merge of the tiny carried
+        # set — carried winners can never be dropped by the approx op
+        ck_d, ck_i = approx_topk_smallest(d, ids, min(k, chunk_size))
+        ck_d = ck_d.astype(jnp.float32)  # bf16 kernel output -> f32 merge
         new_d, new_i = topk_smallest(
-            jnp.concatenate([best_d, d], axis=1),
-            jnp.concatenate([best_i, ids], axis=1),
+            jnp.concatenate([best_d, ck_d], axis=1),
+            jnp.concatenate([best_i, ck_i], axis=1),
             k,
         )
         return (new_d, new_i), None

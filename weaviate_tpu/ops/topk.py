@@ -39,6 +39,22 @@ def topk_smallest(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
+def approx_topk_smallest(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
+    """Approximate smallest-k via the TPU PartialReduce op
+    (jax.lax.approx_min_k — the TPU-KNN paper's bucketed-argmin
+    instruction; recall_target 0.95 per invocation). The right primitive
+    for CANDIDATE generation: exact f32 rescore follows, so a rare
+    dropped candidate costs recall epsilon while the selection itself
+    stays O(N) with a tiny constant — lax.top_k at k~100 costs ~sort."""
+    neg_d, idx = jax.lax.approx_max_k(-dists, k, recall_target=0.95)
+    if ids.ndim == 1:
+        top_ids = ids[idx]
+    else:
+        top_ids = jnp.take_along_axis(ids, idx, axis=-1)
+    return -neg_d, top_ids
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
 def merge_topk(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
     """Merge candidate sets: dists [B, M], ids [B, M] -> top-k of the union.
 
