@@ -32,6 +32,9 @@ def main():
     ap.add_argument("--queries", type=int, default=200)
     ap.add_argument("--k", type=int, default=10)
     ap.add_argument("--batch", type=int, default=500)
+    ap.add_argument("--rest-import", action="store_true",
+                    help="import via REST batch JSON (reference CI harness "
+                         "path) instead of gRPC binary")
     ap.add_argument(
         "--url", default="",
         help="REST address of a running server; requires --grpc-port")
@@ -73,17 +76,50 @@ def main():
                               "storage_dtype": "bfloat16"},
         "properties": [{"name": "seq", "dataType": ["int"]}]})
 
-    # ---- import through REST batch (reference: batch import pass) --------
+    # ---- import ----------------------------------------------------------
+    # default: gRPC BatchObjects with binary vector_bytes — the modern
+    # client path (reference clients v4 import over gRPC; vectors never
+    # round-trip through JSON text). --rest-import forces the REST batch
+    # JSON path of the reference CI harness.
     t0 = time.perf_counter()
     ok = 0
-    for start in range(0, args.n, args.batch):
-        chunk = corpus[start:start + args.batch]
-        results = client.batch_objects([
-            {"class": "Bench", "properties": {"seq": start + i},
-             "vector": row.tolist()}
-            for i, row in enumerate(chunk)])
-        ok += sum(1 for r in results
-                  if r["result"]["status"] == "SUCCESS")
+    if args.rest_import:
+        for start in range(0, args.n, args.batch):
+            chunk = corpus[start:start + args.batch]
+            results = client.batch_objects([
+                {"class": "Bench", "properties": {"seq": start + i},
+                 "vector": row.tolist()}
+                for i, row in enumerate(chunk)])
+            ok += sum(1 for r in results
+                      if r["result"]["status"] == "SUCCESS")
+    else:
+        import uuid as uuid_mod
+
+        import grpc as grpc_lib
+
+        from weaviate_tpu.api.grpc import v1_pb2 as pbi
+        from weaviate_tpu.api.grpc.server import _SERVICE
+
+        chan_i = grpc_lib.insecure_channel(
+            f"127.0.0.1:{grpc_port}",
+            options=[("grpc.max_send_message_length", 64 << 20),
+                     ("grpc.max_receive_message_length", 64 << 20)])
+        batch_rpc = chan_i.unary_unary(
+            f"/{_SERVICE}/BatchObjects",
+            request_serializer=pbi.BatchObjectsRequest.SerializeToString,
+            response_deserializer=pbi.BatchObjectsReply.FromString)
+        for start in range(0, args.n, args.batch):
+            chunk = corpus[start:start + args.batch]
+            req = pbi.BatchObjectsRequest()
+            for i, row in enumerate(chunk):
+                bo = req.objects.add(collection="Bench",
+                                     uuid=str(uuid_mod.uuid4()))
+                bo.vector_bytes = row.astype("<f4").tobytes()
+                bo.properties.non_ref_properties.update(
+                    {"seq": start + i})
+            reply = batch_rpc(req)
+            ok += len(chunk) - len(reply.errors)
+        chan_i.close()
     import_s = time.perf_counter() - t0
     success_rate = ok / args.n
     log(f"import: {args.n} objects in {import_s:.1f}s "
