@@ -206,18 +206,45 @@ class HNSWIndex:
                           m: int) -> list[int]:
         """Keep a candidate only if it is closer to the query than to every
         already-selected neighbor — the diversity heuristic of
-        heuristic.go (selectNeighborsHeuristic)."""
+        heuristic.go (selectNeighborsHeuristic) — then BACKFILL pruned
+        candidates nearest-first up to the budget (hnswlib
+        keepPrunedConnections / reference's returnList top-up): without
+        the backfill the graph ends up far under-connected and recall
+        collapses (round-2 measured 0.60@ef=64 on 200k without it)."""
+        cands = sorted(cands)
+        slots = np.asarray([c for _d, c in cands], dtype=np.int64)
+        if len(slots) <= 1:
+            return [int(s) for s in slots[:m]]
+        # pairwise candidate distances in ONE vectorized pass — the greedy
+        # scan then only indexes the matrix (the per-candidate _dist-call
+        # loop dominated insert time once backfill made graphs dense)
+        rows = self._vecs[slots]
+        if self.metric == "l2-squared":
+            sq = np.einsum("md,md->m", rows, rows)
+            pair = sq[:, None] - 2.0 * (rows @ rows.T) + sq[None, :]
+        elif self.metric == "dot":
+            pair = -(rows @ rows.T)
+        elif self.metric in ("cosine", "cosine-dot"):
+            pair = 1.0 - rows @ rows.T  # rows pre-normalized at insert
+        elif self.metric == "manhattan":
+            pair = np.abs(rows[:, None, :] - rows[None, :, :]).sum(-1)
+        else:  # hamming over float values
+            pair = (rows[:, None, :] != rows[None, :, :]).sum(-1).astype(
+                np.float32)
         selected: list[int] = []
-        for d, c in sorted(cands):
+        pruned: list[int] = []
+        for i, (d, _c) in enumerate(cands):
             if len(selected) >= m:
                 break
-            if not selected:
-                selected.append(c)
+            if selected and not np.all(pair[i, selected] > d):
+                pruned.append(i)
                 continue
-            dists_to_sel = self._dist(self._vecs[c], np.asarray(selected))
-            if np.all(dists_to_sel > d):
-                selected.append(c)
-        return selected
+            selected.append(i)
+        for i in pruned:
+            if len(selected) >= m:
+                break
+            selected.append(i)
+        return [int(slots[i]) for i in selected]
 
     def _set_links(self, slot: int, layer: int, neighbors: list[int]):
         links = self._links[slot]
