@@ -400,3 +400,44 @@ def test_raft_join_catches_up_via_snapshot(tmp_path):
             n.close()
         if joiner is not None:
             joiner.close()
+
+
+def test_snapshot_restore_undoes_compacted_deletes(tmp_path):
+    """A follower caught up via InstallSnapshot must DROP classes whose
+    delete op was compacted into the snapshot — restore makes local
+    schema match the snapshot, not a superset of it."""
+    from weaviate_tpu.cluster.fsm import SchemaFSM
+    from weaviate_tpu.db.database import Database
+
+    db = Database(str(tmp_path / "db"))
+    fsm = SchemaFSM(db)
+    for name in ("Keep", "Drop"):
+        fsm.apply({"type": "add_class",
+                   "config": CollectionConfig(
+                       name=name,
+                       properties=[Property(name="p", data_type="text")]
+                   ).to_dict(),
+                   "sharding": db.collections.get("x", None) or
+                   __import__("weaviate_tpu.db.sharding",
+                              fromlist=["ShardingState"]).ShardingState
+                   .create(1, nodes=["node-0"]).to_dict()})
+    assert set(db.collections) == {"Keep", "Drop"}
+
+    # snapshot from a peer where "Drop" was deleted (and compacted away)
+    db2 = Database(str(tmp_path / "db2"))
+    fsm2 = SchemaFSM(db2)
+    from weaviate_tpu.db.sharding import ShardingState
+
+    fsm2.apply({"type": "add_class",
+                "config": CollectionConfig(
+                    name="Keep",
+                    properties=[Property(name="p", data_type="text")]
+                ).to_dict(),
+                "sharding": ShardingState.create(
+                    1, nodes=["node-0"]).to_dict()})
+    snap = fsm2.snapshot()
+
+    fsm.restore(snap)
+    assert set(db.collections) == {"Keep"}
+    db.close()
+    db2.close()

@@ -73,7 +73,27 @@ def test_dynamic_query_batching_coalesces_concurrent_searches(tmp_path):
         ids, dists = shard.vector_search(q, 5)
         expected.append(list(ids))
 
-    # hammer concurrently; the batcher must coalesce
+    # hammer concurrently; slow the FIRST dispatch down so the rest of
+    # the threads reliably enqueue behind it (without the delay a fast
+    # machine can drain one request per dispatch and the coalescing
+    # assertion would be timing-dependent)
+    b = shard._query_batchers.get("")
+    if b is None:
+        ids, _ = shard.vector_search(queries[0], 5)  # instantiate batcher
+        b = shard._query_batchers[""]
+    real_fn = b._batch_fn
+    import time as _time
+
+    first = threading.Event()
+
+    def slow_first(q, k, allow):
+        if not first.is_set():
+            first.set()
+            _time.sleep(0.15)
+        return real_fn(q, k, allow)
+
+    b._batch_fn = slow_first
+    d0, q0 = b.dispatches, b.batched_queries
     results = [None] * len(queries)
 
     def worker(j):
@@ -86,10 +106,9 @@ def test_dynamic_query_batching_coalesces_concurrent_searches(tmp_path):
         t.start()
     for t in threads:
         t.join()
+    b._batch_fn = real_fn
     assert results == expected
 
-    b = shard._query_batchers.get("")
-    assert b is not None
-    # coalescing happened: strictly fewer dispatches than queries overall
-    assert b.dispatches < b.batched_queries
+    # coalescing happened: the queued-up requests shared dispatches
+    assert (b.dispatches - d0) < (b.batched_queries - q0)
     db.close()

@@ -126,10 +126,11 @@ class Authenticator:
                     users = cfg.api_users
                     user = users[min(i, len(users) - 1)] if users else "api-key-user"
                     return Principal(user, "apikey")
-            if cfg.oidc_enabled:
+            if cfg.oidc_enabled and token.count(".") == 2:
                 # JWT validation against the configured JWKS (reference:
-                # configure_api.go:601). JWTs have two dots; API keys don't
-                # — so key-looking tokens keep the crisp error above.
+                # configure_api.go:601). JWTs have two dots; API keys
+                # don't — a mistyped key keeps the crisp "invalid api
+                # key" below instead of a confusing JWT-parse error.
                 v = self.oidc_validator
                 if v is None or not v.has_keys:
                     raise AuthError(
@@ -143,6 +144,9 @@ class Authenticator:
                 except OidcError as e:
                     raise AuthError(str(e)) from e
                 return Principal(username, "oidc")
+            if cfg.oidc_enabled and not cfg.api_keys:
+                raise AuthError("bearer token is not a JWT and no API "
+                                "keys are configured")
             raise AuthError("invalid api key")
         if cfg.anonymous_enabled:
             return Principal("anonymous", "anonymous")

@@ -188,4 +188,4 @@ def validator_from_env(env=None) -> JwksValidator | None:
             "AUTHENTICATION_OIDC_SKIP_CLIENT_ID_CHECK", "").lower() in (
                 "true", "1", "on"),
     )
-    return v if v.has_keys else v  # keyless validator still rejects clearly
+    return v  # a keyless validator still rejects tokens with a clear error

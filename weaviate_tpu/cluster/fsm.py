@@ -100,12 +100,40 @@ class SchemaFSM:
         return {"classes": classes}
 
     def restore(self, state: dict) -> None:
-        """Bring the local DB to the snapshot's schema. Existing classes
-        are kept (the DB persists schema itself; apply is idempotent) —
-        this fills in what a joining node has never seen."""
-        for entry in state.get("classes", []):
+        """Make the local DB MATCH the snapshot's schema: create missing
+        classes, drop classes the snapshot no longer has (their delete op
+        was compacted away), and overwrite config/placement of existing
+        ones. Log entries after the snapshot index replay on top, so
+        converging to the snapshot state exactly is what keeps a
+        caught-up-via-InstallSnapshot follower consistent."""
+        entries = {CollectionConfig.from_dict(e["config"]).name: e
+                   for e in state.get("classes", [])}
+        for name in list(self.db.collections):
+            if name not in entries:
+                try:
+                    self.db.delete_collection(name)
+                except KeyError:
+                    pass
+        for name, entry in entries.items():
             cfg = CollectionConfig.from_dict(entry["config"])
-            if cfg.name in self.db.collections:
+            sharding = ShardingState.from_dict(entry["sharding"])
+            if name not in self.db.collections:
+                self.db.create_collection(cfg, sharding_state=sharding)
                 continue
-            self.db.create_collection(
-                cfg, sharding_state=ShardingState.from_dict(entry["sharding"]))
+            col = self.db.collections[name]
+            try:
+                self.db.update_collection(cfg, allow_scale=False)
+            except (KeyError, ValueError) as e:
+                logger.warning("snapshot restore: update of %s skipped: %s",
+                               name, e)
+            # placement + tenant statuses follow the snapshot (the same
+            # surface update_sharding owns)
+            col.sharding.placement = dict(sharding.placement)
+            col.sharding.tenant_status = dict(sharding.tenant_status)
+            for shard in col.sharding.shard_names:
+                if self.db.local_node in col.sharding.nodes_for(shard) \
+                        and shard not in col.shards \
+                        and col.sharding.status_of(shard) not in (
+                            "COLD", "FROZEN"):
+                    col._load_shard(shard)
+            self.db._persist(col)

@@ -350,9 +350,11 @@ class Collection:
             prev = self.sharding.status_of(tenant)
             if status == prev:
                 return
+            # the side effect runs BEFORE the status commits: a failed
+            # freeze/thaw (no offload backend, backend error) leaves the
+            # tenant in its previous, working state instead of wedged
             if prev == "FROZEN" and status in ("HOT", "COLD"):
                 self._unfreeze_tenant(tenant)
-            self.sharding.tenant_status[tenant] = status
             if status == "FROZEN":
                 self._freeze_tenant(tenant)
             elif status == "COLD":
@@ -361,6 +363,7 @@ class Collection:
                     shard.close()
             elif self._is_local(tenant):
                 self._load_shard(tenant)
+            self.sharding.tenant_status[tenant] = status
             self._on_sharding_change(self)
 
     def _offload_backend(self):
@@ -391,13 +394,14 @@ class Collection:
             shard.flush()
             shard.close()
         sh_dir = os.path.join(self.data_dir, self.config.name, tenant)
-        if not os.path.isdir(sh_dir):
-            return
         oid = self._offload_id(tenant)
         backend.initialize(oid)
+        # an empty tenant still gets a manifest — thawing must always find
+        # one (a manifest-less freeze would wedge the tenant FROZEN)
         stored = [put_file_compressed(backend, oid, rel,
                                       os.path.join(sh_dir, rel))
-                  for rel in walk_files(sh_dir)]
+                  for rel in (walk_files(sh_dir)
+                              if os.path.isdir(sh_dir) else [])]
         backend.put(oid, "manifest.json",
                     _json.dumps({"files": stored}).encode())
         _shutil.rmtree(sh_dir, ignore_errors=True)
@@ -410,7 +414,12 @@ class Collection:
 
         backend = self._offload_backend()
         oid = self._offload_id(tenant)
-        manifest = _json.loads(backend.get(oid, "manifest.json"))
+        try:
+            manifest = _json.loads(backend.get(oid, "manifest.json"))
+        except KeyError:
+            # tenant frozen by a pre-manifest version or never offloaded
+            # data — nothing to pull back
+            manifest = {"files": []}
         sh_dir = os.path.abspath(
             os.path.join(self.data_dir, self.config.name, tenant))
         for stored in manifest.get("files", []):
