@@ -1,0 +1,30 @@
+# weaviate-tpu server image (reference: Dockerfile + docker-compose
+# multi-node bring-up). The TPU runtime expects the host to expose the
+# accelerator (gVisor/privileged TPU VM); CPU-only serving works out of
+# the box for functional deployments and CI.
+FROM python:3.12-slim AS base
+
+# native toolchain for the C++ host library
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY csrc/ csrc/
+COPY weaviate_tpu/ weaviate_tpu/
+COPY setup.py* pyproject.toml* README.md* ./
+
+# jax pinned CPU by default; TPU deployments install the matching
+# libtpu wheel at runtime (JAX_PLATFORMS=tpu)
+RUN pip install --no-cache-dir \
+        "jax>=0.4.30" numpy msgpack grpcio protobuf && \
+    g++ -O3 -shared -fPIC -o weaviate_tpu/native/libweaviate_native.so \
+        csrc/weaviate_native.cpp || true
+
+ENV PYTHONPATH=/app \
+    PERSISTENCE_DATA_PATH=/var/lib/weaviate \
+    JAX_PLATFORMS=cpu
+
+VOLUME /var/lib/weaviate
+EXPOSE 8080 50051 2112
+
+ENTRYPOINT ["python", "-m", "weaviate_tpu"]

@@ -1,0 +1,104 @@
+"""Config #5 shape: multi-shard nearVector + hybrid BM25 fusion.
+
+BASELINE config #5 pairs an 8-shard collection with hybrid (BM25 +
+dense) queries, MSMARCO-passage-shaped. This drives the real collection
+layer: per-shard BM25 over the persistent inverted index + per-shard
+device vector scan, RRF fusion, parallel shard legs
+(reference: hybrid_fusion.go + Index scatter-gather).
+
+Usage: python tools/bench_hybrid.py [--n 100000] [--shards 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+WORDS = ("system distributed vector search engine database index query "
+         "shard replica tensor matrix kernel memory bandwidth latency "
+         "throughput cluster schema tenant backup module transformer "
+         "embedding semantic ranking fusion inverted posting filter").split()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=100_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--queries", type=int, default=100)
+    ap.add_argument("--k", type=int, default=10)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.schema.config import (CollectionConfig, Property,
+                                            ShardingConfig)
+
+    rng = np.random.default_rng(0)
+    tmp = tempfile.mkdtemp(prefix="bench-hybrid-")
+    db = Database(tmp)
+    col = db.create_collection(CollectionConfig(
+        name="Passages",
+        sharding=ShardingConfig(desired_count=args.shards),
+        properties=[Property(name="body", data_type="text")]))
+
+    corpus = rng.standard_normal((args.n, args.dim)).astype(np.float32)
+    t0 = time.perf_counter()
+    batch = 1000
+    for s in range(0, args.n, batch):
+        objs = []
+        for i in range(s, min(s + batch, args.n)):
+            body = " ".join(rng.choice(WORDS, 12))
+            objs.append({"class": "Passages",
+                         "properties": {"body": body},
+                         "vector": corpus[i]})
+        col.batch_put(objs)
+    import_s = time.perf_counter() - t0
+    log(f"import {args.n} docs across {args.shards} shards in "
+        f"{import_s:.1f}s ({args.n/import_s:.0f} obj/s)")
+
+    # hybrid queries: 3 keywords + a near-duplicate vector
+    qvecs = (corpus[rng.integers(0, args.n, args.queries)]
+             + 0.1 * rng.standard_normal((args.queries, args.dim))
+             ).astype(np.float32)
+    qtexts = [" ".join(rng.choice(WORDS, 3)) for _ in range(args.queries)]
+
+    col.hybrid(qtexts[0], vector=qvecs[0], alpha=0.5, k=args.k)  # warm
+    lat = []
+    n_results = 0
+    t0 = time.perf_counter()
+    for qt, qv in zip(qtexts, qvecs):
+        t1 = time.perf_counter()
+        res = col.hybrid(qt, vector=qv, alpha=0.5, k=args.k)
+        lat.append(time.perf_counter() - t1)
+        n_results += len(res)
+    total = time.perf_counter() - t0
+    lat = np.asarray(lat)
+    out = {
+        "metric": "hybrid_multishard",
+        "n": args.n, "shards": args.shards,
+        "import_objects_per_s": round(args.n / import_s, 1),
+        "qps_single_stream": round(args.queries / total, 1),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        "p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 2),
+        "avg_results": round(n_results / args.queries, 1),
+    }
+    log(f"hybrid p50 {out['p50_ms']} ms, {out['qps_single_stream']} QPS "
+        f"single-stream")
+    print(json.dumps(out), flush=True)
+    db.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,11 +28,14 @@ class ClusterNode:
     def __init__(self, name: str, data_dir: str, raft_peers: list[str],
                  host: str = "127.0.0.1", port: int = 0, mesh=None,
                  gossip_interval: float = 0.3,
-                 election_timeout: tuple[float, float] = (0.3, 0.6)):
+                 election_timeout: tuple[float, float] = (0.3, 0.6),
+                 advertise: str | None = None):
         """``raft_peers``: the static bootstrap member set (node names,
-        incl. this one) — reference: RAFT_JOIN env (cluster/bootstrap)."""
+        incl. this one) — reference: RAFT_JOIN env (cluster/bootstrap).
+        ``advertise``: host:port other nodes reach this one at (container
+        deployments bind 0.0.0.0 and advertise their service name)."""
         self.name = name
-        self.server = InternalServer(host, port)
+        self.server = InternalServer(host, port, advertise=advertise)
         self.membership = Membership(name, self.server,
                                      interval=gossip_interval)
         self.remote = RemoteShardClient(self.membership.resolve)

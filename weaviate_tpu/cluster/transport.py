@@ -75,7 +75,12 @@ class InternalServer:
     Routes are exact paths ("/raft/vote") or prefixes ending in "/"
     ("/indices/" receives (subpath, payload))."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 advertise: str | None = None):
+        """``advertise``: the host:port OTHER nodes reach this one at —
+        required when binding 0.0.0.0 in containers (reference:
+        CLUSTER_ADVERTISE_ADDR/PORT in usecases/cluster config)."""
+        self._advertise = advertise
         self.routes: dict[str, object] = {}
         outer = self
 
@@ -114,6 +119,8 @@ class InternalServer:
 
     @property
     def address(self) -> str:
+        if self._advertise:
+            return self._advertise
         return f"{self.host}:{self.port}"
 
     def route(self, path: str, handler) -> None:

@@ -48,6 +48,7 @@ class ServerConfig:
     enabled_modules: list[str] | None = None
     default_vectorizer_module: str = "none"
     # cluster (CLUSTER_HOSTNAME / RAFT_JOIN / CLUSTER_JOIN ...)
+    cluster_advertise: str = ""
     cluster_hostname: str = "node-0"
     raft_join: list[str] = field(default_factory=list)
     cluster_join: list[str] = field(default_factory=list)
@@ -85,6 +86,7 @@ class ServerConfig:
             raft_join=_csv(env, "RAFT_JOIN"),
             cluster_join=_csv(env, "CLUSTER_JOIN"),
             cluster_data_port=_int(env, "CLUSTER_DATA_BIND_PORT", 0),
+            cluster_advertise=env.get("CLUSTER_ADVERTISE_ADDR", ""),
             async_indexing=_flag(env, "ASYNC_INDEXING"),
             auto_schema_enabled=_flag(env, "AUTOSCHEMA_ENABLED", True),
             prometheus_enabled=_flag(env, "PROMETHEUS_MONITORING_ENABLED"),

@@ -64,7 +64,8 @@ class Server:
             peers = cfg.raft_join or [cfg.cluster_hostname]
             self.node = ClusterNode(cfg.cluster_hostname, cfg.data_path,
                                     raft_peers=peers, host=cfg.host,
-                                    port=cfg.cluster_data_port)
+                                    port=cfg.cluster_data_port,
+                                    advertise=cfg.cluster_advertise or None)
             self.node.start(seed_addrs=cfg.cluster_join or None)
             self.db = self.node.db
         else:
