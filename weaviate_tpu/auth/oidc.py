@@ -53,7 +53,12 @@ class JwksValidator:
         for jwk in (jwks or {}).get("keys", []):
             key = self._load_jwk(jwk)
             if key is not None:
-                self._keys[jwk.get("kid", "")] = (jwk.get("alg"), key)
+                # "alg" is OPTIONAL in a JWK (RFC 7517 §4.4) — infer from
+                # the key type when absent so verification never trusts the
+                # token header's alg
+                alg = jwk.get("alg") or (
+                    "RS256" if jwk.get("kty") == "RSA" else "ES256")
+                self._keys[jwk.get("kid", "")] = (alg, key)
 
     # -- key loading ---------------------------------------------------------
 
@@ -113,14 +118,19 @@ class JwksValidator:
             sig = _b64url(parts[2])
         except (ValueError, json.JSONDecodeError) as e:
             raise OidcError(f"malformed JWT: {e}") from e
-        alg = header.get("alg")
         kid = header.get("kid", "")
         entry = self._keys.get(kid)
         if entry is None and len(self._keys) == 1:
             entry = next(iter(self._keys.values()))  # single-key JWKS
         if entry is None:
             raise OidcError(f"no JWKS key for kid {kid!r}")
-        _jwk_alg, key = entry
+        alg, key = entry
+        # Pin the algorithm to the JWK's declared (or key-type-inferred)
+        # alg — never to the attacker-controlled token header (reference
+        # go-oidc: supported algs come from config).
+        if header.get("alg") != alg:
+            raise OidcError(
+                f"JWT alg {header.get('alg')!r} does not match JWK alg {alg!r}")
         signed = (parts[0] + "." + parts[1]).encode()
         try:
             if alg == "RS256":
@@ -142,9 +152,19 @@ class JwksValidator:
             raise OidcError(f"JWT verification failed: {e}") from e
 
         now = time.time()
-        if "exp" in claims and now >= float(claims["exp"]) + 30:
+        # Missing expiry = invalid (the reference's go-oidc verifier treats
+        # tokens without exp as expired) — otherwise a leaked token without
+        # exp would be accepted forever.
+        if "exp" not in claims:
+            raise OidcError("JWT missing exp claim")
+        try:
+            exp = float(claims["exp"])
+            nbf = float(claims["nbf"]) if "nbf" in claims else None
+        except (TypeError, ValueError) as e:
+            raise OidcError(f"JWT has non-numeric exp/nbf: {e}") from e
+        if now >= exp + 30:
             raise OidcError("JWT expired")
-        if "nbf" in claims and now < float(claims["nbf"]) - 30:
+        if nbf is not None and now < nbf - 30:
             raise OidcError("JWT not yet valid")
         if self.issuer and claims.get("iss", "").rstrip("/") != self.issuer:
             raise OidcError(

@@ -355,6 +355,7 @@ class RaftNode:
         n = self._abs_last() + 1
         self._next_index = {p: n for p in self.peers if p != self.name}
         self._match_index = {p: -1 for p in self.peers if p != self.name}
+        self._reanchor_warned: set[str] = set()
         # no-op barrier entry so the new leader can commit prior-term
         # entries (Raft §5.4.2)
         self.log.append({"term": self.current_term, "op": {"type": "noop"}})
@@ -395,7 +396,23 @@ class RaftNode:
                             "last_index": self.log_start - 1,
                             "last_term": self.snap_last_term,
                             "peers": list(self.peers)}
-                payload = dict(snap or {}, term=term, leader=self.name)
+                if snap is None:
+                    # No persisted snapshot and no snapshot_fn: an incomplete
+                    # payload would KeyError on the follower and retry
+                    # forever. Re-anchor the peer at log_start and serve what
+                    # log remains; warn once per peer — a follower that truly
+                    # needs the compacted prefix cannot catch up in this
+                    # state and an operator has to intervene.
+                    if peer not in self._reanchor_warned:
+                        self._reanchor_warned.add(peer)
+                        logger.warning(
+                            "raft %s: follower %s needs compacted entries "
+                            "(< %d) but no snapshot source exists; "
+                            "re-anchoring at log_start — it may never "
+                            "catch up", self.name, peer, self.log_start)
+                    self._next_index[peer] = self.log_start
+                    return
+                payload = dict(snap, term=term, leader=self.name)
             else:
                 payload = None
                 prev_i = next_i - 1

@@ -202,6 +202,7 @@ class Shard:
         if migrate_chunk:
             self._inverted.index_objects(migrate_chunk)
             migrated += len(migrate_chunk)
+        self._inverted.reconcile_doc_count(len(self._doc_to_uuid))
         if migrated:
             import logging
 

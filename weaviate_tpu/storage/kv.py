@@ -759,10 +759,15 @@ class Bucket:
         return native.difference_sorted(v["add"], v["del"])
 
     def _merged_layers(self):
-        """Snapshot of (segments, memtables oldest->newest) for iteration."""
+        """Snapshot of (segments, memtables oldest->newest) for iteration.
+
+        Sealed memtables are immutable; the ACTIVE memtable keeps mutating
+        under concurrent writers, and iteration sorts its keys lazily, so a
+        shallow dict copy is taken while still holding the lock (otherwise a
+        concurrent put() resizing the dict raises mid-sort)."""
         with self._lock:
             return list(self._segments), [m.data for m in self._sealed] + \
-                [self._mem.data]
+                [dict(self._mem.data)]
 
     def iter_merged(self, start: bytes | None = None,
                     stop: bytes | None = None

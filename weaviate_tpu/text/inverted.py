@@ -216,6 +216,18 @@ class InvertedIndex:
         self._meta["arrays"] = sorted(self.array_props)
         self.meta_bucket.put(b"__aggregates__", self._meta)
 
+    def reconcile_doc_count(self, actual: int) -> None:
+        """Re-anchor doc_count to the objects bucket at shard open: a crash
+        between index_objects and the objects-bucket commit leaves ghost doc
+        ids counted here forever (they're never unindexed), drifting BM25
+        idf/avg-length. Reconciling at open bounds the drift to one crash
+        window."""
+        with self._lock:
+            if self.doc_count != actual:
+                self._meta["doc_count"] = int(actual)
+                self._save_meta()
+                self._version += 1
+
     # -- mutation -------------------------------------------------------------
 
     def index_object(self, obj) -> None:
@@ -326,6 +338,15 @@ class InvertedIndex:
                               float(value["longitude"])]))
 
     def unindex_object(self, obj) -> None:
+        """Remove a doc's postings by re-deriving its keys from the CURRENT
+        schema. Consequently changing a property's tokenization, data type,
+        or the stopword config after objects are indexed leaves stale
+        postings for already-indexed docs on later delete/update (the keys
+        recomputed under the new config differ from those written). The
+        reference forbids mutating tokenization in place for the same
+        reason; stopword-config updates remain allowed for parity with the
+        reference's mutable invertedIndexConfig, at the documented cost
+        that existing docs need a reindex to pick the change up cleanly."""
         doc = obj.doc_id
         search_del: dict[bytes, set] = {}
         filter_del: dict[bytes, set] = {}
