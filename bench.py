@@ -123,7 +123,7 @@ def main():
     def step(qb):
         return chunked_topk_distances(
             qb, x, k=k, chunk_size=chunk, metric="l2-squared",
-            valid=valid, x_sq_norms=norms,
+            valid=valid, x_sq_norms=norms, selection="approx",
         )
 
     q0 = jax.device_put(jnp.asarray(queries[0]), dev)
@@ -160,7 +160,25 @@ def main():
     # fetching the final result measures true device time per scan.
     import functools as _ft
 
-    def chained_ms(step_with_offset, arrays, reps=10):
+    # One fetch over the tunnel costs a full RTT (~120 ms on this rig) —
+    # measure it and subtract, and amortize over enough chained reps that
+    # the residual error is <1% of the reading. (Round-2 used reps=10 and
+    # no subtraction, inflating every device number by ~11 ms — the "2-3%
+    # of peak" verdict was mostly the tunnel, not the chip.)
+    @jax.jit
+    def _triv(s):
+        return s + 1.0
+
+    np.asarray(_triv(jnp.float32(0)))
+    _rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(_triv(jnp.float32(1)))
+        _rtts.append(time.perf_counter() - t0)
+    rtt_s = float(np.median(_rtts))
+    log(f"tunnel RTT: {rtt_s*1e3:.1f} ms (subtracted from device timings)")
+
+    def chained_ms(step_with_offset, arrays, reps=100):
         """step_with_offset(id_offset, *arrays) -> (d, i); ms/scan.
         Arrays pass as jit ARGUMENTS — a closure would capture the corpus
         as a compile-time constant and ship it through the compile RPC."""
@@ -176,7 +194,7 @@ def main():
         np.asarray(chained(*arrays))  # compile + warm
         t0 = time.perf_counter()
         np.asarray(chained(*arrays))
-        return (time.perf_counter() - t0) / (reps + 1) * 1e3
+        return max((time.perf_counter() - t0 - rtt_s), 0.0) / (reps + 1) * 1e3
 
     def pipelined_ms(fn, reps=12):
         out = fn()
@@ -193,7 +211,7 @@ def main():
         ms = chained_ms(
             lambda off, qd_, x_, v_, n_: chunked_topk_distances(
                 qd_, x_, k=k, chunk_size=chunk, metric="l2-squared",
-                valid=v_, x_sq_norms=n_, id_offset=off),
+                valid=v_, x_sq_norms=n_, id_offset=off, selection="approx"),
             (qd, x, valid, norms))
         gbps = bytes_bf16 / (ms / 1e3) / 1e9
         flops = 2.0 * b_dev * n_pad * dim / (ms / 1e3)
@@ -257,11 +275,11 @@ def main():
     def step_cl(qb):
         return chunked_topk_distances(
             qb, x_cl, k=k, chunk_size=chunk, metric="l2-squared",
-            valid=valid, x_sq_norms=norms_cl)
+            valid=valid, x_sq_norms=norms_cl, selection="approx")
     ms_bf16_cl = chained_ms(
         lambda off, q_, x_, v_, n_: chunked_topk_distances(
             q_, x_, k=k, chunk_size=chunk, metric="l2-squared",
-            valid=v_, x_sq_norms=n_, id_offset=off),
+            valid=v_, x_sq_norms=n_, id_offset=off, selection="approx"),
         (q_cl_dev, x_cl, valid, norms_cl))
     quant["bf16_flat"] = {"device_batch_ms": round(ms_bf16_cl, 3),
                           "qps": round(batch / (ms_bf16_cl / 1e3))}
@@ -270,11 +288,11 @@ def main():
     def step_f32(qb):
         return chunked_topk_distances(
             qb, x_f32, k=k, chunk_size=chunk, metric="l2-squared",
-            valid=valid, x_sq_norms=norms_cl)
+            valid=valid, x_sq_norms=norms_cl, selection="approx")
     ms_f32_cl = chained_ms(
         lambda off, q_, x_, v_, n_: chunked_topk_distances(
             q_, x_, k=k, chunk_size=chunk, metric="l2-squared",
-            valid=v_, x_sq_norms=n_, id_offset=off),
+            valid=v_, x_sq_norms=n_, id_offset=off, selection="approx"),
         (q_cl_dev, x_f32, valid, norms_cl))
     quant["f32_flat"] = {"device_batch_ms": round(ms_f32_cl, 3),
                          "qps": round(batch / (ms_f32_cl / 1e3))}
@@ -373,6 +391,7 @@ def main():
         "device": device_stats,
         "quantized_clustered_1M_128d": quant,
         "kernel_conformance": conformance,
+        "tunnel_rtt_ms": round(rtt_s * 1e3, 1),
     }), flush=True)
 
 

@@ -15,12 +15,71 @@ Prints one JSON line with device ms/scan + QPS per config.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def mesh_capacity_demo(n_rows: int = 80_000_000, dim: int = 768):
+    """VERDICT r2 item 1 done-criterion: ≥80M x 768d of BQ codes addressable
+    on the 8-device virtual mesh through the real store path (allocation,
+    row-sharded placement, donated scatter write, SPMD search with ICI
+    merge). Run with --mesh; sets up the virtual CPU mesh itself."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from weaviate_tpu.engine.quantized import QuantizedVectorStore
+    from weaviate_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    t0 = time.perf_counter()
+    store = QuantizedVectorStore(
+        dim=dim, quantization="bq", capacity=n_rows, chunk_size=131072,
+        mesh=mesh, rescore="none",
+    )
+    words = store.codes.shape[1]
+    total_gb = store.capacity * words * 4 / 1e9
+    shards = store.codes.addressable_shards
+    per_dev = {s.device.id: s.data.shape for s in shards}
+    log(f"allocated {store.capacity:,} x {dim}d BQ codes "
+        f"({total_gb:.1f} GB) across {len(per_dev)} devices "
+        f"in {time.perf_counter()-t0:.1f}s; per-device {per_dev[0]}")
+    assert len(per_dev) == 8
+    assert all(shape[0] == store.capacity // 8 for shape in per_dev.values())
+
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((256, dim)).astype(np.float32)
+    t0 = time.perf_counter()
+    slots = store.add(vecs)
+    log(f"scatter-wrote 256 rows in {time.perf_counter()-t0:.1f}s")
+
+    # one SPMD search across the full capacity (CPU-mesh correctness pass,
+    # not a perf number — the perf regime is the single-chip TPU scan below)
+    t0 = time.perf_counter()
+    d, i = store.search(vecs[:2], k=4)
+    dt = time.perf_counter() - t0
+    assert i[0, 0] == slots[0] and i[1, 0] == slots[1], i[:, 0]
+    log(f"SPMD search over {store.capacity:,} rows: {dt:.1f}s "
+        f"(incl compile), self-hit ok")
+    print(json.dumps({
+        "metric": "mesh_capacity_bq",
+        "rows": int(store.capacity),
+        "dim": dim,
+        "hbm_gb_total": round(total_gb, 2),
+        "devices": 8,
+        "self_hit": True,
+    }), flush=True)
 
 
 def main():
@@ -101,4 +160,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--mesh" in sys.argv:
+        mesh_capacity_demo()
+    else:
+        main()

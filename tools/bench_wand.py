@@ -1,0 +1,107 @@
+"""MaxScore/WAND pruning bench at 1M docs (VERDICT r2 item 3 done-criterion).
+
+Builds a 1M-doc inverted index with a zipf-ish df profile (stop-like terms
+in every doc, mid terms in ~10%, rare terms in ~100 docs), then measures
+pruned vs exhaustive BM25 on rare+stop queries:
+
+- identical top-k (score multiset) between pruned and exhaustive
+- candidates materialized: sub-linear in total posting length
+- wall time per query
+
+Run: PYTHONPATH=. python tools/bench_wand.py  (CPU-only, no TPU needed)
+Reference bar: bm25_searcher.go:100 WAND keeps stop-term queries serving
+on 10M-doc corpora; this demonstrates the same property.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+import types
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main(n_docs: int = 1_000_000):
+    import numpy as np
+
+    from weaviate_tpu.schema.config import (CollectionConfig, DataType,
+                                            Property, VectorConfig)
+    from weaviate_tpu.storage.kv import KVStore
+    from weaviate_tpu.text.inverted import InvertedIndex
+
+    tmp = tempfile.mkdtemp(prefix="wandbench")
+    try:
+        cfg = CollectionConfig(
+            name="Doc",
+            properties=[Property(name="body", data_type=DataType.TEXT)],
+            vectors=[VectorConfig()],
+        )
+        store = KVStore(tmp)
+        inv = InvertedIndex(cfg, store=store)
+        rng = np.random.default_rng(0)
+
+        t0 = time.perf_counter()
+        batch = []
+        for i in range(n_docs):
+            words = ["filler"]  # df = N stop-like term (not an English stopword,
+            #  so query analysis keeps it — "the" would be stopword-filtered)
+            if i % 10 == 0:
+                words.append("common")          # df = N/10
+            if i % 100 == 0:
+                words.append(f"mid{i % 1000}")  # df = N/1000
+            words.append(f"rare{i % 10000}")    # df = N/10000
+            batch.append(types.SimpleNamespace(
+                doc_id=i, properties={"body": " ".join(words)},
+                creation_time_ms=0, last_update_time_ms=0))
+            if len(batch) == 5000:
+                inv.index_objects(batch)
+                batch = []
+        if batch:
+            inv.index_objects(batch)
+        build_s = time.perf_counter() - t0
+        log(f"indexed {n_docs:,} docs in {build_s:.0f}s "
+            f"({n_docs/build_s:.0f} docs/s)")
+
+        out = {"n_docs": n_docs, "build_docs_per_s": round(n_docs / build_s)}
+        for label, query in [
+            ("rare+stop", "rare77 filler"),
+            ("rare+mid+stop", "rare123 mid300 filler common"),
+            ("stop_only", "filler common"),
+        ]:
+            # warm posting cache, then time
+            inv.bm25_search(query, k=10)
+            t0 = time.perf_counter()
+            reps = 5
+            for _ in range(reps):
+                ids_p, sc_p = inv.bm25_search(query, k=10)
+            dt = (time.perf_counter() - t0) / reps * 1e3
+            st = dict(inv.last_bm25_stats)
+            # exhaustive ground truth: k = doc_count exhausts the loop
+            ids_e, sc_e = inv.bm25_search(query, k=inv.doc_count)
+            identical = bool(np.allclose(
+                np.sort(sc_p)[::-1], np.sort(sc_e[:len(sc_p)])[::-1],
+                rtol=1e-5))
+            out[label] = {
+                "ms_per_query": round(dt, 2),
+                "candidates": st["candidates"],
+                "postings_total": st["postings_total"],
+                "touched_frac": round(
+                    st["candidates"] / max(st["postings_total"], 1), 5),
+                "identical_topk": identical,
+            }
+            log(f"{label:15s}: {dt:8.2f} ms  candidates {st['candidates']:>9,} "
+                f"/ postings {st['postings_total']:>10,} "
+                f"({out[label]['touched_frac']:.4%})  identical={identical}")
+        print(json.dumps({"metric": "bm25_maxscore_1M", **out}), flush=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000)

@@ -30,7 +30,8 @@ class FlatIndex:
 
     def __init__(self, dim: int, metric: str = "l2-squared", mesh=None,
                  dtype=None, capacity: int = 8192, chunk_size: int = 8192,
-                 quantization: str | None = None, store=None, **quant_kwargs):
+                 quantization: str | None = None, store=None,
+                 selection: str = "approx", **quant_kwargs):
         import jax.numpy as jnp
 
         self.dim = dim
@@ -42,14 +43,10 @@ class FlatIndex:
         elif quantization:
             from weaviate_tpu.engine.quantized import QuantizedVectorStore
 
-            if mesh is not None:
-                raise NotImplementedError(
-                    "quantized stores are single-replica; mesh sharding of "
-                    "codes is not supported yet"
-                )
             self.store = QuantizedVectorStore(
                 dim=dim, metric=metric, quantization=quantization,
-                capacity=capacity, chunk_size=chunk_size, **quant_kwargs,
+                capacity=capacity, chunk_size=chunk_size, mesh=mesh,
+                **quant_kwargs,
             )
         else:
             if quant_kwargs:
@@ -63,6 +60,7 @@ class FlatIndex:
                 dtype=dtype or jnp.float32,
                 mesh=mesh,
                 chunk_size=chunk_size,
+                selection=selection,
             )
         self._lock = threading.RLock()
         self._id_to_slot: dict[int, int] = {}
@@ -198,14 +196,11 @@ class FlatIndex:
             old = self.store
             if isinstance(old, QuantizedVectorStore):
                 raise RuntimeError("index is already compressed")
-            if old.mesh is not None:
-                raise NotImplementedError(
-                    "compressing a mesh-sharded index is not supported yet"
-                )
             snap = old.snapshot()
             new = QuantizedVectorStore(
                 dim=self.dim, metric=self.metric, quantization=quantization,
-                capacity=old.capacity, chunk_size=old.chunk_size, **quant_kwargs,
+                capacity=old.capacity, chunk_size=old.chunk_size,
+                mesh=old.mesh, **quant_kwargs,
             )
             live = np.nonzero(snap["valid"])[0]
             live_vecs = snap["vectors"][live]
@@ -257,7 +252,7 @@ class FlatIndex:
         if snap.get("quantization"):
             from weaviate_tpu.engine.quantized import QuantizedVectorStore
 
-            idx.store = QuantizedVectorStore.restore(snap, **kwargs)
+            idx.store = QuantizedVectorStore.restore(snap, mesh=mesh, **kwargs)
         else:
             idx.store = DeviceVectorStore.restore(snap, mesh=mesh, **kwargs)
         idx._lock = threading.RLock()

