@@ -164,6 +164,25 @@ class Database:
                 raise ValueError("vectorIndexType is immutable")
             if vc_new.index.metric != vc_cur.index.metric:
                 raise ValueError("distance metric is immutable")
+            if (vc_cur.index.quantization
+                    and vc_new.index.quantization != vc_cur.index.quantization):
+                # enabling is a one-way door (reference config_update.go:
+                # compression can be turned ON via update, never off)
+                raise ValueError("quantization cannot be disabled or "
+                                 "changed once enabled")
+            if vc_new.index.quantization and not vc_cur.index.quantization:
+                # compatibility gate BEFORE anything persists — a config
+                # that compress() would reject must not commit (it would
+                # wedge every later update behind the one-way-door check)
+                itype = vc_cur.index.index_type
+                if itype in ("hnsw", "ivf") and \
+                        vc_new.index.quantization != "pq":
+                    raise ValueError(
+                        f"{itype} supports runtime quantization='pq' only")
+                if itype == "hnsw" and vc_cur.index.metric not in (
+                        "l2-squared", "dot", "cosine", "cosine-dot"):
+                    raise ValueError(
+                        f"no ADC form for metric {vc_cur.index.metric!r}")
         if new_cfg.sharding.desired_count != cur.sharding.desired_count:
             raise ValueError("shard count is immutable (resharding "
                              "is not supported)")
@@ -209,6 +228,14 @@ class Database:
                     vc.index.flat_to_ann_threshold = \
                         vc_new.index.flat_to_ann_threshold
                     vc.index.ivf_nprobe = vc_new.index.ivf_nprobe
+                    if vc_new.index.quantization and \
+                            not vc.index.quantization:
+                        # runtime compression enable (compress.go:38 via
+                        # config_update.go) — applied to live indexes in
+                        # apply_runtime_config
+                        vc.index.quantization = vc_new.index.quantization
+                        vc.index.pq_segments = vc_new.index.pq_segments
+                        vc.index.pq_centroids = vc_new.index.pq_centroids
                     vc.module_config = vc_new.module_config
 
             self.update_collection_config(new_cfg.name, apply)

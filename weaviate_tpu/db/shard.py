@@ -45,15 +45,13 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
         capacity=8192,
         chunk_size=8192,
     )
-    if cfg.index_type in ("flat", "ivf") and cfg.quantization:
-        # quantized scan is a flat-index capability; IVF lists hold raw
-        # vectors — honor the compression request rather than silently
-        # dropping it
+    if cfg.index_type == "flat" and cfg.quantization:
         return FlatIndex(
             quantization=cfg.quantization,
             pq_segments=cfg.pq_segments,
             pq_centroids=cfg.pq_centroids,
             rescore_limit=cfg.rescore_limit,
+            mesh=mesh,
             **common,
         )
     if cfg.index_type == "flat":
@@ -65,24 +63,29 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
     if cfg.index_type == "ivf":
         from weaviate_tpu.engine.ivf import IVFIndex
 
+        if cfg.quantization == "bq":
+            # no bq form for IVF lists — honor the compression request on
+            # the flat scan (documented fallback, not a silent drop)
+            return FlatIndex(quantization="bq",
+                             rescore_limit=cfg.rescore_limit, **common)
         # mesh forwarded so the single-replica guard fires loudly instead of
         # silently landing a sharded corpus on one device
         return IVFIndex(nlist=cfg.ivf_nlist, nprobe=cfg.ivf_nprobe,
                         mesh=mesh,
+                        quantization=cfg.quantization,
+                        pq_segments=cfg.pq_segments,
+                        pq_centroids=cfg.pq_centroids,
                         dtype=jnp.bfloat16 if cfg.storage_dtype == "bfloat16"
                         else jnp.float32,
                         **common)
     if cfg.index_type == "hnsw":
-        # reference-parity graph index (engine/hnsw.py); quantized configs
-        # stay on the flat TPU scan — the graph keeps exact f32 vectors
-        if cfg.quantization:
-            return FlatIndex(
-                quantization=cfg.quantization,
-                pq_segments=cfg.pq_segments,
-                pq_centroids=cfg.pq_centroids,
-                rescore_limit=cfg.rescore_limit,
-                **common,
-            )
+        # reference-parity graph index (engine/hnsw.py). A pq-quantized
+        # hnsw keeps its GRAPH (runtime ADC compression is applied once
+        # enough data exists — compress.go:38); bq has no ADC form for
+        # graph hops, so bq configs run the quantized flat scan instead.
+        if cfg.quantization == "bq":
+            return FlatIndex(quantization="bq",
+                             rescore_limit=cfg.rescore_limit, **common)
         from weaviate_tpu.engine.hnsw import HNSWIndex
 
         return HNSWIndex(
@@ -228,6 +231,10 @@ class Shard:
                     np.asarray([ids[j] for j in keep]),
                     np.stack([vecs[j] for j in keep]),
                 )
+                # configs that ask for quantization on a graph/ivf index
+                # compress at runtime (compress.go:38) — re-apply after the
+                # rebuild so a restart doesn't silently lose compression
+                self._maybe_compress(vec_name, idx)
 
     def _ensure_vector_index(self, vec_name: str, dim: int):
         if vec_name in self.vector_indexes:
@@ -238,6 +245,28 @@ class Shard:
         idx = _make_vector_index(vc, dim, mesh=self.mesh)
         self.vector_indexes[vec_name] = idx
         return idx
+
+    # min live vectors before a deferred runtime compression fires (the
+    # reference also defers PQ training until enough objects exist)
+    COMPRESS_MIN_LIVE = 4096
+
+    def _maybe_compress(self, vec_name: str, idx) -> None:
+        vc = self.config.vector_config(vec_name)
+        if (vc is None or not vc.index.quantization
+                or getattr(idx, "compressed", True)
+                or not hasattr(idx, "compress")
+                or len(idx) < self.COMPRESS_MIN_LIVE):
+            return
+        try:
+            idx.compress(quantization=vc.index.quantization,
+                         pq_segments=vc.index.pq_segments,
+                         pq_centroids=vc.index.pq_centroids)
+        except (RuntimeError, ValueError) as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "shard %s/%s: deferring runtime compression: %s",
+                self.name, vec_name, e)
 
     # -- write path ----------------------------------------------------------
 
@@ -338,6 +367,7 @@ class Shard:
                         np.asarray(ids), np.stack(vecs))
                 else:
                     idx.add_batch(np.asarray(ids), np.stack(vecs))
+                    self._maybe_compress(vec_name, idx)
         return doc_ids
 
     def _batched_search(self, vec_name: str, idx, query: np.ndarray, k: int,

@@ -80,6 +80,14 @@ class HNSWIndex:
         self._tombstone = np.zeros(cap, dtype=bool)
         # per-slot list over layers of int32 neighbor-slot arrays
         self._links: list[list[np.ndarray]] = [[] for _ in range(cap)]
+        self._visited = np.zeros(cap, dtype=np.int64)  # visit-epoch stamps
+        self._visit_epoch = 0
+        # runtime PQ compression state (compress.go:38): codes + codebook
+        # when compressed, and the per-query ADC LUT during a search
+        self._codes: np.ndarray | None = None
+        self._pq_codebook = None
+        self._pq_rescore = 4
+        self._adc_lut: np.ndarray | None = None
         self._id_to_slot: dict[int, int] = {}
         self._count = 0
         self._ep = -1  # entrypoint slot
@@ -103,7 +111,17 @@ class HNSWIndex:
 
     def _dist(self, q: np.ndarray, slots: np.ndarray) -> np.ndarray:
         """Distance from query [d] to a slot batch [m] — one vectorized op
-        (replaces the per-pair asm call of distancer/asm/*.s)."""
+        (replaces the per-pair asm call of distancer/asm/*.s).
+
+        With an active per-query ADC LUT (compressed graph traversal,
+        reference compress.go:38: candidate scoring runs on PQ codes), the
+        hop costs one [m_rows, m] code gather + LUT sum instead of a
+        [m_rows, d] float read; final candidates rescore exactly."""
+        if self._adc_lut is not None:
+            codes = self._codes[slots]  # [m_rows, m]
+            return np.take_along_axis(
+                self._adc_lut, codes.astype(np.int64).T, axis=1
+            ).sum(axis=0)
         rows = self._vecs[slots]
         if self.metric == "l2-squared":
             diff = rows - q
@@ -137,6 +155,12 @@ class HNSWIndex:
                                         np.full(new_cap - cap, -1, np.int64)])
         self._tombstone = np.concatenate([self._tombstone,
                                           np.zeros(new_cap - cap, bool)])
+        self._visited = np.concatenate([self._visited,
+                                        np.zeros(new_cap - cap, np.int64)])
+        if self._codes is not None:
+            self._codes = np.vstack([
+                self._codes,
+                np.zeros((new_cap - cap, self._codes.shape[1]), np.uint8)])
         self._links.extend([] for _ in range(new_cap - cap))
         for i in range(cap, new_cap):
             self._links[i] = []
@@ -150,11 +174,15 @@ class HNSWIndex:
         a list of (dist, slot) tuples. Tombstoned nodes are traversed but
         returned too — callers filter; pruning them here would disconnect
         regions behind tombstones (same reason the reference keeps them)."""
-        visited = np.zeros(len(self._vecs), dtype=bool)
+        # epoch-stamped visited marks: allocation-free per call (a fresh
+        # bool[capacity] per layer-search dominates at 1M-slot capacities)
+        self._visit_epoch += 1
+        epoch = self._visit_epoch
+        visited = self._visited
         cand: list[tuple[float, int]] = []  # min-heap
         top: list[tuple[float, int]] = []  # max-heap via negated dist
         for d, s in eps:
-            visited[s] = True
+            visited[s] = epoch
             heapq.heappush(cand, (d, s))
             heapq.heappush(top, (-d, s))
         while cand:
@@ -167,10 +195,10 @@ class HNSWIndex:
             neigh = links[layer]
             if len(neigh) == 0:
                 continue
-            fresh = neigh[~visited[neigh]]
+            fresh = neigh[visited[neigh] != epoch]
             if len(fresh) == 0:
                 continue
-            visited[fresh] = True
+            visited[fresh] = epoch
             dists = self._dist(q, fresh)  # ← the batched hop
             worst = -top[0][0] if top else np.inf
             for nd, ns in zip(dists.tolist(), fresh.tolist()):
@@ -231,19 +259,31 @@ class HNSWIndex:
         else:  # hamming over float values
             pair = (rows[:, None, :] != rows[None, :, :]).sum(-1).astype(
                 np.float32)
+        # greedy scan with a RUNNING dominated mask: selecting candidate j
+        # dominates every candidate closer to j than to the query — one
+        # vectorized compare per selection instead of one np.all per
+        # candidate (the 8.5M tiny-np.all pattern that ate ~60% of insert
+        # time in profiling)
+        dists = np.asarray([d for d, _c in cands], dtype=np.float32)
+        n = len(slots)
+        dominated = np.zeros(n, dtype=bool)
         selected: list[int] = []
-        pruned: list[int] = []
-        for i, (d, _c) in enumerate(cands):
+        for i in range(n):
             if len(selected) >= m:
                 break
-            if selected and not np.all(pair[i, selected] > d):
-                pruned.append(i)
+            if dominated[i]:
                 continue
             selected.append(i)
-        for i in pruned:
-            if len(selected) >= m:
-                break
-            selected.append(i)
+            dominated |= pair[:, i] <= dists
+        if len(selected) < m:
+            # backfill pruned candidates nearest-first (hnswlib
+            # keepPrunedConnections; recall collapses without it)
+            sel_mask = np.zeros(n, dtype=bool)
+            sel_mask[selected] = True
+            for i in np.nonzero(dominated & ~sel_mask)[0]:
+                if len(selected) >= m:
+                    break
+                selected.append(int(i))
         return [int(slots[i]) for i in selected]
 
     def _set_links(self, slot: int, layer: int, neighbors: list[int]):
@@ -281,6 +321,10 @@ class HNSWIndex:
     def add(self, doc_id: int, vector: np.ndarray) -> None:
         self.add_batch([doc_id], np.asarray(vector, dtype=np.float32)[None, :])
 
+    # empty-index batches at least this large build via the device bulk
+    # path (engine/hnsw_build.py) instead of incremental insert
+    BULK_BUILD_MIN = 4096
+
     def add_batch(self, doc_ids, vectors: np.ndarray) -> None:
         doc_ids = np.asarray(doc_ids, dtype=np.int64)
         vectors = self._norm(np.asarray(vectors, dtype=np.float32))
@@ -291,10 +335,32 @@ class HNSWIndex:
         if vectors.shape[1] != self.dim:
             raise ValueError(f"vector dim {vectors.shape[1]} != index dim {self.dim}")
         with self._lock:
-            for doc_id, vec in zip(doc_ids.tolist(), vectors):
-                self._insert_one(int(doc_id), vec)
+            # dispatch decided under the lock: a concurrent first batch
+            # must not race two bulk_builds (the RLock makes the nested
+            # bulk_build acquisition re-entrant). Non-MXU metrics keep the
+            # incremental path — the host knn fallback would materialize
+            # O(block*n*d) broadcast temporaries for manhattan/hamming.
+            if (self._count == 0 and len(vectors) >= self.BULK_BUILD_MIN
+                    and self.metric in ("l2-squared", "dot", "cosine",
+                                        "cosine-dot")
+                    and len(set(doc_ids.tolist())) == len(doc_ids)):
+                from weaviate_tpu.engine.hnsw_build import bulk_build
 
-    def _insert_one(self, doc_id: int, vec: np.ndarray):
+                bulk_build(self, doc_ids, vectors,
+                           knn_k=max(self.m0, self.ef_construction // 2))
+                return
+            batch_codes = None
+            if self._codes is not None:
+                # one device encode for the whole batch, not one RTT per row
+                from weaviate_tpu.ops.pq import pq_encode
+
+                batch_codes = pq_encode(self._pq_codebook, vectors)
+            for j, (doc_id, vec) in enumerate(zip(doc_ids.tolist(), vectors)):
+                self._insert_one(
+                    int(doc_id), vec,
+                    code=None if batch_codes is None else batch_codes[j])
+
+    def _insert_one(self, doc_id: int, vec: np.ndarray, code=None):
         old = self._id_to_slot.get(doc_id)
         if old is not None:
             # update = tombstone old node + fresh insert (the reference
@@ -306,6 +372,12 @@ class HNSWIndex:
         self._count += 1
         level = int(-math.log(max(self._rng.random(), 1e-12)) * self._ml)
         self._vecs[slot] = vec
+        if self._codes is not None:
+            if code is None:
+                from weaviate_tpu.ops.pq import pq_encode
+
+                code = pq_encode(self._pq_codebook, vec[None, :])[0]
+            self._codes[slot] = code
         self._levels[slot] = level
         self._doc_ids[slot] = doc_id
         self._id_to_slot[doc_id] = slot
@@ -443,9 +515,26 @@ class HNSWIndex:
             if self._ep < 0:
                 return (np.empty(0, np.int64), np.empty(0, np.float32))
             ef = max(self._effective_ef(k), k)
-            d0 = float(self._dist(q, np.array([self._ep]))[0])
-            d0, ep = self._greedy_descend(q, self._ep, d0, self._max_level, 0)
-            cands = self._search_layer(q, [(d0, ep)], ef, 0)
+            if self._codes is not None:
+                # compressed traversal: ADC hops, oversampled frontier,
+                # exact rescore of the result set (compress.go pattern)
+                ef = max(ef, k * self._pq_rescore)
+                self._adc_lut = self._query_lut(q)
+                try:
+                    d0 = float(self._dist(q, np.array([self._ep]))[0])
+                    d0, ep = self._greedy_descend(q, self._ep, d0,
+                                                  self._max_level, 0)
+                    cands = self._search_layer(q, [(d0, ep)], ef, 0)
+                finally:
+                    self._adc_lut = None
+                slots = np.asarray([s for _d, s in cands], dtype=np.int64)
+                exact = self._dist(q, slots)
+                cands = sorted(zip(exact.tolist(), slots.tolist()))
+            else:
+                d0 = float(self._dist(q, np.array([self._ep]))[0])
+                d0, ep = self._greedy_descend(q, self._ep, d0,
+                                              self._max_level, 0)
+                cands = self._search_layer(q, [(d0, ep)], ef, 0)
             allow_mask = None
             if allowed is not None:
                 allow_mask = np.zeros(len(self._vecs), dtype=bool)
@@ -493,13 +582,74 @@ class HNSWIndex:
 
     @property
     def compressed(self) -> bool:
-        return False
+        return self._codes is not None
 
-    def compress(self, *a, **kw):
-        raise NotImplementedError(
-            "PQ/BQ-compressed scans live on the flat/IVF TPU path "
-            "(engine/quantized.py); the host graph keeps exact f32 vectors"
-        )
+    def compress(self, quantization: str = "pq", pq_segments: int | None = None,
+                 pq_centroids: int = 16, rescore_limit: int = 4,
+                 **_ignored) -> None:
+        """Runtime compression of a LIVE graph (reference compress.go:38-89:
+        train PQ on current contents, swap the cache for a compressed one,
+        log AddPQ). Traversal distances switch to per-query ADC lookups
+        over uint8 codes; the ef result set is exact-rescored against the
+        retained f32 rows before returning, so recall stays within the
+        rescore envelope."""
+        if quantization != "pq":
+            raise ValueError("hnsw supports runtime quantization='pq' "
+                             "(bq has no ADC form for graph hops)")
+        if self.metric not in ("l2-squared", "dot", "cosine", "cosine-dot"):
+            raise ValueError(
+                f"no ADC form for metric {self.metric!r}")
+        from weaviate_tpu.ops.pq import pq_encode, pq_fit
+
+        with self._lock:
+            if self._codes is not None:
+                raise RuntimeError("index is already compressed")
+            live = np.nonzero(
+                (self._doc_ids[: self._count] >= 0)
+                & ~self._tombstone[: self._count])[0]
+            if len(live) < pq_centroids:
+                raise RuntimeError(
+                    f"need >= {pq_centroids} live vectors to train PQ, "
+                    f"have {len(live)}")
+            if not pq_segments:
+                from weaviate_tpu.ops.pq import default_pq_segments
+
+                pq_segments = default_pq_segments(self.dim, pq_centroids)
+            self._pq_rescore = rescore_limit
+            self._pq_codebook = pq_fit(self._vecs[live], m=pq_segments,
+                                       k=pq_centroids, iters=8)
+            self._codes = np.zeros((len(self._vecs), pq_segments),
+                                   dtype=np.uint8)
+            if self._count:
+                self._codes[: self._count] = pq_encode(
+                    self._pq_codebook, self._vecs[: self._count])
+            # durability: one condensed snapshot carries codes + codebook
+            # (the reference logs an AddPQ record; a snapshot is the same
+            # fixed point)
+            if self._log is not None:
+                self.condense()
+
+    def _query_lut(self, q: np.ndarray) -> np.ndarray:
+        """Per-query ADC table [m, k]: segment-wise distance from q to
+        every centroid (exact ADC for l2; dot/cosine fold linearly).
+
+        Numpy twin of ops/pq.py:pq_lut — the jitted device version would
+        cost a tunnel round trip per query on this host-graph path;
+        tests/test_runtime_compress.py asserts the two stay equal."""
+        cents = np.asarray(self._pq_codebook.centroids)  # [m, k, ds]
+        m, kc, ds = cents.shape
+        qs = q.reshape(m, ds)
+        if self.metric == "l2-squared":
+            diff = qs[:, None, :] - cents
+            return np.einsum("mkd,mkd->mk", diff, diff)
+        if self.metric == "dot":
+            return -np.einsum("md,mkd->mk", qs, cents)
+        if self.metric in ("cosine", "cosine-dot"):
+            lut = -np.einsum("md,mkd->mk", qs, cents)
+            lut[0] += 1.0  # constant shift once, exact for the sum
+            return lut
+        raise RuntimeError(
+            f"compressed traversal unsupported for metric {self.metric!r}")
 
     # -- maintenance ----------------------------------------------------------
 
@@ -529,6 +679,12 @@ class HNSWIndex:
                           for s in range(self._count)],
                 "ep": self._ep,
                 "max_level": self._max_level,
+                "pq_codes": (self._codes[: self._count].copy()
+                             if self._codes is not None else None),
+                "pq_codebook": (
+                    np.asarray(self._pq_codebook.centroids)
+                    if self._pq_codebook is not None else None),
+                "pq_rescore": self._pq_rescore,
             }
 
     @classmethod
@@ -550,6 +706,16 @@ class HNSWIndex:
         idx._max_level = snap["max_level"]
         idx._id_to_slot = {int(d): s for s, d in enumerate(snap["doc_ids"])
                            if d >= 0}
+        if snap.get("pq_codebook") is not None:
+            from weaviate_tpu.ops.pq import PQCodebook
+
+            import jax.numpy as jnp
+
+            idx._pq_codebook = PQCodebook(jnp.asarray(snap["pq_codebook"]))
+            idx._pq_rescore = snap.get("pq_rescore", 4)
+            m = snap["pq_codes"].shape[1]
+            idx._codes = np.zeros((len(idx._vecs), m), dtype=np.uint8)
+            idx._codes[:n] = snap["pq_codes"]
         return idx
 
     # -- commit log (reference commit_logger.go / condensor.go) ---------------

@@ -69,6 +69,67 @@ def _clear_list_rows(list_valid, flat_idx):
     return flat.at[flat_idx].set(False, mode="drop").reshape(nlist, cap)
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _scatter_code_lists(list_codes, list_valid, list_slots,
+                        flat_idx, codes, slots, write_mask):
+    """PQ-mode scatter: codes [m] uint8 rows into [nlist, cap, m] lists."""
+    nlist, cap, m = list_codes.shape
+    fc = list_codes.reshape(nlist * cap, m)
+    fva = list_valid.reshape(nlist * cap)
+    fs = list_slots.reshape(nlist * cap)
+    tgt = jnp.where(write_mask, flat_idx, nlist * cap)
+    fc = fc.at[tgt].set(codes, mode="drop")
+    fva = fva.at[tgt].set(True, mode="drop")
+    fs = fs.at[tgt].set(slots, mode="drop")
+    return (fc.reshape(nlist, cap, m), fva.reshape(nlist, cap),
+            fs.reshape(nlist, cap))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "nprobe", "metric", "use_allow"))
+def _ivf_probe_topk_pq(q, centroids, c_norms, list_codes, list_valid,
+                       list_slots, pq_centroids, allow_by_slot, k: int,
+                       nprobe: int, metric: str, use_allow: bool):
+    """PQ-resident probe: gather CODES from the probed lists, reconstruct
+    on the fly (per-segment centroid take — the decompression half of the
+    gather-matmul, ops/pq.py), score in bf16, masked top-k. HBM reads per
+    probed row are m bytes instead of 4d — the capacity regime IVF-PQ
+    exists for (reference: PQ inside each shard's HNSW,
+    compressionhelpers/product_quantization.go:372)."""
+    from weaviate_tpu.ops.pq import pq_reconstruct
+
+    nlist, cap, m = list_codes.shape
+    q32 = q.astype(jnp.float32)
+    if metric in ("cosine", "cosine-dot"):
+        q32 = normalize(q32)
+    cd = pairwise_distance(q32, centroids, metric="l2-squared",
+                           x_sq_norms=c_norms)
+    _, probes = jax.lax.top_k(-cd, nprobe)  # [B, nprobe]
+
+    codes = list_codes[probes].reshape(q.shape[0], nprobe * cap, m)
+    vld = list_valid[probes].reshape(q.shape[0], nprobe * cap)
+    slots = list_slots[probes].reshape(q.shape[0], nprobe * cap)
+    b, p = codes.shape[0], codes.shape[1]
+    x_hat = pq_reconstruct(
+        codes.reshape(b * p, m), pq_centroids, m
+    ).astype(jnp.bfloat16).reshape(b, p, -1)
+    dots = jnp.einsum("bd,bpd->bp", q32.astype(jnp.bfloat16), x_hat,
+                      preferred_element_type=jnp.float32)
+    if metric == "l2-squared":
+        qn = jnp.sum(q32 * q32, axis=-1)[:, None]
+        xn = jnp.sum(x_hat.astype(jnp.float32) ** 2, axis=-1)
+        d = jnp.maximum(qn - 2.0 * dots + xn, 0.0)
+    elif metric == "dot":
+        d = -dots
+    else:
+        d = 1.0 - dots
+    if use_allow:
+        ok = allow_by_slot[jnp.clip(slots, 0, allow_by_slot.shape[0] - 1)]
+        vld = vld & ok & (slots >= 0) & (slots < allow_by_slot.shape[0])
+    d = jnp.where(vld, d, MASKED_DISTANCE)
+    return topk_smallest(d, slots, min(k, nprobe * cap))
+
+
 @functools.partial(jax.jit, static_argnames=("k", "nprobe", "metric", "use_allow"))
 def _ivf_probe_topk(q, centroids, c_norms, list_vecs, list_valid, list_slots,
                     list_norms, allow_by_slot, k: int, nprobe: int,
@@ -121,10 +182,17 @@ class IVFStore:
                  train_threshold: int = 16_384,
                  delta_threshold: int = 8192,
                  query_chunk: int = 16,
-                 dtype=None):
+                 dtype=None,
+                 quantization: str | None = None,
+                 pq_segments: int | None = None,
+                 pq_centroids: int = 16,
+                 rescore_limit: int = 16):
         if metric not in _SUPPORTED_METRICS:
             raise ValueError(
                 f"ivf supports {_SUPPORTED_METRICS}, not {metric!r}")
+        if quantization not in (None, "pq"):
+            raise ValueError(f"ivf quantization must be None or 'pq', "
+                             f"not {quantization!r}")
         self.dim = dim
         self.metric = metric
         self.chunk_size = chunk_size
@@ -134,6 +202,23 @@ class IVFStore:
         self.train_threshold = train_threshold
         self.delta_threshold = delta_threshold
         self.query_chunk = query_chunk
+        # IVF-PQ residency (VERDICT r2 item 4b): posting lists hold uint8
+        # PQ codes instead of full rows; oversampled candidates rescore
+        # exactly against the host f32 mirror. The delta buffer stays
+        # exact either way.
+        self.quantization = quantization
+        self.pq_centroids = pq_centroids
+        if quantization and not pq_segments:
+            from weaviate_tpu.ops.pq import default_pq_segments
+
+            pq_segments = default_pq_segments(dim, pq_centroids)
+        self.pq_segments = pq_segments
+        self.rescore_limit = rescore_limit
+        self.codebook = None
+        self.list_codes = None
+        self._host_rows = (
+            np.zeros((max(capacity, 1024), dim), dtype=np.float32)
+            if quantization else None)
         self.normalize_on_add = metric in ("cosine", "cosine-dot")
         self._lock = threading.RLock()
         self._count = 0  # global slot high-water mark
@@ -183,9 +268,24 @@ class IVFStore:
             slots = np.arange(self._count, self._count + len(vectors),
                               dtype=np.int64)
             self._count += len(vectors)
+            self._remember_rows(slots, vectors)
             self._add_to_delta(slots, vectors)
             self._maybe_reorganize()
             return slots
+
+    def _remember_rows(self, slots: np.ndarray, vectors: np.ndarray):
+        """PQ mode keeps an f32 host mirror (codes are lossy): rescore +
+        retrain + rebuild all read from here."""
+        if self._host_rows is None or len(slots) == 0:
+            return
+        if self.normalize_on_add:
+            vectors = np.asarray(normalize(jnp.asarray(vectors)))
+        mx = int(np.max(slots))
+        if mx >= len(self._host_rows):
+            grown = np.zeros((_next_pow2(mx + 1), self.dim), np.float32)
+            grown[: len(self._host_rows)] = self._host_rows
+            self._host_rows = grown
+        self._host_rows[slots] = vectors
 
     def _add_to_delta(self, slots: np.ndarray, vectors: np.ndarray):
         dslots = self.delta.add(vectors)
@@ -202,6 +302,7 @@ class IVFStore:
             vectors = vectors[None, :]
         with self._lock:
             self._count = max(self._count, int(slots.max()) + 1 if len(slots) else 0)
+            self._remember_rows(slots, vectors)
             delta_upd_d, delta_upd_v = [], []
             fresh_s, fresh_v = [], []
             clear_flat = []
@@ -277,6 +378,11 @@ class IVFStore:
                 cents = np.asarray(normalize(jnp.asarray(cents)))
             self.centroids = jnp.asarray(cents)
             self._c_norms = jnp.sum(self.centroids * self.centroids, axis=1)
+            if self.quantization:
+                from weaviate_tpu.ops.pq import pq_fit
+
+                self.codebook = pq_fit(train_vecs, m=self.pq_segments,
+                                       k=self.pq_centroids, iters=8)
             self._rebuild_lists(vecs, slots)
             # delta fully absorbed
             self._reset_delta()
@@ -284,13 +390,20 @@ class IVFStore:
     def _all_live_host(self):
         """(vectors [L,d] f32, slots [L] int64) for every live slot."""
         out_v, out_s = [], []
-        if self.trained and self.list_vecs is not None:
-            lv = np.asarray(self.list_vecs, dtype=np.float32).reshape(-1, self.dim)
+        if self.trained and (self.list_vecs is not None
+                             or self.list_codes is not None):
             lval = np.asarray(self.list_valid).reshape(-1)
             lslot = np.asarray(self.list_slots).reshape(-1)
             live = np.nonzero(lval)[0]
-            out_v.append(lv[live])
-            out_s.append(lslot[live].astype(np.int64))
+            slots_live = lslot[live].astype(np.int64)
+            if self.quantization:
+                # codes are lossy — originals live in the host mirror
+                out_v.append(self._host_rows[slots_live])
+            else:
+                lv = np.asarray(self.list_vecs,
+                                dtype=np.float32).reshape(-1, self.dim)
+                out_v.append(lv[live])
+            out_s.append(slots_live)
         dsnap = self.delta.snapshot()
         dlive = np.nonzero(dsnap["valid"])[0]
         if len(dlive):
@@ -304,19 +417,29 @@ class IVFStore:
 
     def _rebuild_lists(self, vecs: np.ndarray, slots: np.ndarray):
         """Assign + scatter everything into fresh list tensors."""
-        assign = kmeans_assign(vecs, np.asarray(self.centroids))
+        assign = (kmeans_assign(vecs, np.asarray(self.centroids))
+                  if len(vecs) else np.empty(0, np.int64))
         counts = np.bincount(assign, minlength=self.nlist)
         cap = max(8, _next_pow2(int(counts.max()) if len(counts) else 8))
         self.list_cap = cap
-        self.list_vecs = jnp.zeros((self.nlist, cap, self.dim), dtype=self.dtype)
+        if self.quantization:
+            self.list_codes = jnp.zeros(
+                (self.nlist, cap, self.pq_segments), dtype=jnp.uint8)
+            self.list_vecs = None
+            self.list_norms = None
+        else:
+            self.list_vecs = jnp.zeros((self.nlist, cap, self.dim),
+                                       dtype=self.dtype)
+            self.list_norms = jnp.zeros((self.nlist, cap), dtype=jnp.float32)
         self.list_valid = jnp.zeros((self.nlist, cap), dtype=jnp.bool_)
         self.list_slots = jnp.full((self.nlist, cap), -1, dtype=jnp.int32)
-        self.list_norms = jnp.zeros((self.nlist, cap), dtype=jnp.float32)
         self._fill = np.zeros(self.nlist, dtype=np.int64)
         self._scatter_assigned(vecs, slots, assign)
 
     def _scatter_assigned(self, vecs, slots, assign):
         """Place (vec, slot) pairs at the next free position of their list."""
+        if len(vecs) == 0:
+            return
         pos = np.empty(len(assign), dtype=np.int64)
         order = np.argsort(assign, kind="stable")
         sorted_assign = assign[order]
@@ -335,20 +458,32 @@ class IVFStore:
             self._grow_cap()
         flat_idx = assign.astype(np.int64) * self.list_cap + pos
         bucket = _next_pow2(max(len(vecs), 8))
-        pad = bucket - len(vecs)
-        v_buf = np.zeros((bucket, self.dim), np.float32)
-        v_buf[:len(vecs)] = vecs
         i_buf = np.zeros(bucket, np.int32)
         i_buf[:len(vecs)] = flat_idx
         s_buf = np.zeros(bucket, np.int32)
         s_buf[:len(vecs)] = slots
         m_buf = np.zeros(bucket, bool)
         m_buf[:len(vecs)] = True
-        (self.list_vecs, self.list_valid, self.list_slots,
-         self.list_norms) = _scatter_lists(
-            self.list_vecs, self.list_valid, self.list_slots, self.list_norms,
-            jnp.asarray(i_buf), jnp.asarray(v_buf), jnp.asarray(s_buf),
-            jnp.asarray(m_buf))
+        if self.quantization:
+            from weaviate_tpu.ops.pq import pq_encode
+
+            codes = pq_encode(self.codebook, vecs)
+            c_buf = np.zeros((bucket, self.pq_segments), np.uint8)
+            c_buf[:len(vecs)] = codes
+            (self.list_codes, self.list_valid,
+             self.list_slots) = _scatter_code_lists(
+                self.list_codes, self.list_valid, self.list_slots,
+                jnp.asarray(i_buf), jnp.asarray(c_buf), jnp.asarray(s_buf),
+                jnp.asarray(m_buf))
+        else:
+            v_buf = np.zeros((bucket, self.dim), np.float32)
+            v_buf[:len(vecs)] = vecs
+            (self.list_vecs, self.list_valid, self.list_slots,
+             self.list_norms) = _scatter_lists(
+                self.list_vecs, self.list_valid, self.list_slots,
+                self.list_norms,
+                jnp.asarray(i_buf), jnp.asarray(v_buf), jnp.asarray(s_buf),
+                jnp.asarray(m_buf))
         for s, fi in zip(slots.tolist(), flat_idx.tolist()):
             self._slot_loc[int(s)] = ("list", int(fi))
 
@@ -357,17 +492,24 @@ class IVFStore:
         old_cap = self.list_cap
         new_cap = old_cap * 2
         pad = new_cap - old_cap
-        self.list_vecs = jnp.concatenate(
-            [self.list_vecs,
-             jnp.zeros((self.nlist, pad, self.dim), dtype=self.dtype)], axis=1)
+        if self.quantization:
+            self.list_codes = jnp.concatenate(
+                [self.list_codes,
+                 jnp.zeros((self.nlist, pad, self.pq_segments),
+                           dtype=jnp.uint8)], axis=1)
+        else:
+            self.list_vecs = jnp.concatenate(
+                [self.list_vecs,
+                 jnp.zeros((self.nlist, pad, self.dim), dtype=self.dtype)],
+                axis=1)
+            self.list_norms = jnp.concatenate(
+                [self.list_norms,
+                 jnp.zeros((self.nlist, pad), dtype=jnp.float32)], axis=1)
         self.list_valid = jnp.concatenate(
             [self.list_valid, jnp.zeros((self.nlist, pad), dtype=jnp.bool_)],
             axis=1)
         self.list_slots = jnp.concatenate(
             [self.list_slots, jnp.full((self.nlist, pad), -1, dtype=jnp.int32)],
-            axis=1)
-        self.list_norms = jnp.concatenate(
-            [self.list_norms, jnp.zeros((self.nlist, pad), dtype=jnp.float32)],
             axis=1)
         self.list_cap = new_cap
         # flat indices shift: old flat l*old_cap+p -> l*new_cap+p
@@ -389,6 +531,16 @@ class IVFStore:
             vecs = dsnap["vectors"][live]
             slots = np.asarray([self._delta_slots[int(d)] for d in live],
                                dtype=np.int64)
+            if self.quantization and self.codebook is None:
+                # compression was enabled while the store was empty —
+                # the codebook trains on the first flush with enough data
+                # (until then rows stay in the exact delta)
+                if len(vecs) < self.pq_centroids:
+                    return
+                from weaviate_tpu.ops.pq import pq_fit
+
+                self.codebook = pq_fit(vecs, m=self.pq_segments,
+                                       k=self.pq_centroids, iters=8)
             assign = kmeans_assign(vecs, np.asarray(self.centroids))
             self._scatter_assigned(vecs, slots, assign)
             self._reset_delta()
@@ -401,6 +553,34 @@ class IVFStore:
         self._delta_slots = {}
 
     # -- queries -------------------------------------------------------------
+
+    def _rescore(self, queries: np.ndarray, cand_slots: np.ndarray, k: int):
+        """Exact f32 rescore of PQ candidates against the host mirror
+        (reference rescore pattern: flat/index.go:347). Normalizes the
+        query side for cosine; mirror rows were normalized at insert."""
+        q = queries
+        if self.normalize_on_add:
+            q = np.asarray(normalize(jnp.asarray(q)))
+        b, kc = cand_slots.shape
+        safe = np.clip(cand_slots, 0, len(self._host_rows) - 1)
+        cand = self._host_rows[safe]  # [B, kc, d]
+        if self.metric == "dot":
+            dd = -np.einsum("bd,bkd->bk", q, cand)
+        elif self.metric in ("cosine", "cosine-dot"):
+            dd = 1.0 - np.einsum("bd,bkd->bk", q, cand)
+        else:
+            diff = q[:, None, :] - cand
+            dd = np.einsum("bkd,bkd->bk", diff, diff)
+        dd = np.where(cand_slots >= 0, dd, MASKED_DISTANCE)
+        k_eff = min(k, kc)
+        part = np.argpartition(dd, k_eff - 1, axis=1)[:, :k_eff]
+        pd = np.take_along_axis(dd, part, axis=1)
+        order = np.argsort(pd, axis=1, kind="stable")
+        sel = np.take_along_axis(part, order, axis=1)
+        out_d = np.take_along_axis(dd, sel, axis=1).astype(np.float32)
+        out_s = np.take_along_axis(cand_slots, sel, axis=1)
+        out_s = np.where(out_d >= MASKED_DISTANCE, -1, out_s)
+        return out_d, out_s
 
     def _effective_nprobe(self) -> int:
         if self.nprobe:
@@ -443,19 +623,35 @@ class IVFStore:
                 use_allow = allow_mask is not None
                 allow_dev = jnp.asarray(
                     allow_mask if use_allow else np.ones(1, bool))
-                k_eff = min(k, np_probe * self.list_cap)
+                k_cand = k * self.rescore_limit if self.quantization else k
+                k_eff = min(k_cand, np_probe * self.list_cap)
                 outs_d, outs_s = [], []
                 for s in range(0, b, self.query_chunk):
-                    qd, qs = _ivf_probe_topk(
-                        jnp.asarray(queries[s:s + self.query_chunk]),
-                        self.centroids, self._c_norms,
-                        self.list_vecs, self.list_valid, self.list_slots,
-                        self.list_norms, allow_dev, k_eff, np_probe,
-                        self.metric, use_allow)
+                    if self.quantization:
+                        qd, qs = _ivf_probe_topk_pq(
+                            jnp.asarray(queries[s:s + self.query_chunk]),
+                            self.centroids, self._c_norms,
+                            self.list_codes, self.list_valid,
+                            self.list_slots, self.codebook.centroids,
+                            allow_dev, k_eff, np_probe,
+                            self.metric, use_allow)
+                    else:
+                        qd, qs = _ivf_probe_topk(
+                            jnp.asarray(queries[s:s + self.query_chunk]),
+                            self.centroids, self._c_norms,
+                            self.list_vecs, self.list_valid, self.list_slots,
+                            self.list_norms, allow_dev, k_eff, np_probe,
+                            self.metric, use_allow)
                     outs_d.append(np.asarray(qd))
                     outs_s.append(np.asarray(qs, dtype=np.int64))
                 l_d = np.concatenate(outs_d)
                 l_s = np.concatenate(outs_s)
+                # masked rows (deleted / filtered) keep their slot ids in
+                # the top-k output — map them to -1 BEFORE rescore, which
+                # would otherwise resurrect them with exact distances
+                l_s = np.where(l_d >= MASKED_DISTANCE, -1, l_s)
+                if self.quantization:
+                    l_d, l_s = self._rescore(queries, l_s, k)
         # --- host merge of the two legs
         cat_d = np.concatenate([d_d, l_d], axis=1)
         cat_s = np.concatenate([d_s, l_s], axis=1)
@@ -529,9 +725,17 @@ class IVFStore:
                 "dtype": jnp.dtype(self.dtype).name,
                 "train_threshold": self.train_threshold,
                 "delta_threshold": self.delta_threshold,
-                # FlatIndex.snapshot() compatibility
+                # FlatIndex.snapshot() compatibility ("quantization" keys
+                # the FlatIndex restore dispatch; IVF-PQ state rides under
+                # its own keys)
                 "valid": self._valid_over_slots(),
                 "quantization": None,
+                "ivf_quantization": self.quantization,
+                "pq_segments": self.pq_segments,
+                "pq_centroids": self.pq_centroids,
+                "rescore_limit": self.rescore_limit,
+                "pq_codebook": (np.asarray(self.codebook.centroids)
+                                if self.codebook is not None else None),
             }
 
     def _valid_over_slots(self) -> np.ndarray:
@@ -550,10 +754,24 @@ class IVFStore:
                     chunk_size=snap.get("chunk_size", 8192),
                     train_threshold=snap.get("train_threshold", 16_384),
                     delta_threshold=snap.get("delta_threshold", 8192),
-                    dtype=dtype)
+                    dtype=dtype,
+                    quantization=snap.get("ivf_quantization"),
+                    pq_segments=snap.get("pq_segments"),
+                    pq_centroids=snap.get("pq_centroids", 16),
+                    rescore_limit=snap.get("rescore_limit", 16))
         slots = np.asarray(snap["live_slots"], dtype=np.int64)
         vecs = np.asarray(snap["live_vectors"], dtype=np.float32)
         store._count = snap["count"]
+        if snap.get("pq_codebook") is not None:
+            from weaviate_tpu.ops.pq import PQCodebook
+
+            store.codebook = PQCodebook(jnp.asarray(snap["pq_codebook"]))
+        if store.quantization and len(slots):
+            # mirror rows were normalized at original insert
+            norm = store.normalize_on_add
+            store.normalize_on_add = False
+            store._remember_rows(slots, vecs)
+            store.normalize_on_add = norm
         if snap.get("centroids") is not None:
             store.nlist = snap["nlist"]
             store.centroids = jnp.asarray(snap["centroids"])
@@ -566,11 +784,17 @@ class IVFStore:
                 # would crash the first _maybe_reorganize)
                 cap = 8
                 store.list_cap = cap
-                store.list_vecs = jnp.zeros((store.nlist, cap, store.dim),
-                                            dtype=store.dtype)
+                if store.quantization:
+                    store.list_codes = jnp.zeros(
+                        (store.nlist, cap, store.pq_segments),
+                        dtype=jnp.uint8)
+                else:
+                    store.list_vecs = jnp.zeros(
+                        (store.nlist, cap, store.dim), dtype=store.dtype)
+                    store.list_norms = jnp.zeros((store.nlist, cap),
+                                                 dtype=jnp.float32)
                 store.list_valid = jnp.zeros((store.nlist, cap), dtype=jnp.bool_)
                 store.list_slots = jnp.full((store.nlist, cap), -1, dtype=jnp.int32)
-                store.list_norms = jnp.zeros((store.nlist, cap), dtype=jnp.float32)
                 store._fill = np.zeros(store.nlist, dtype=np.int64)
         elif len(vecs):
             # untrained: everything back into the delta buffer
@@ -589,14 +813,16 @@ class IVFIndex(FlatIndex):
                  capacity: int = 8192, chunk_size: int = 8192,
                  nlist: int = 0, nprobe: int = 0,
                  train_threshold: int = 16_384, delta_threshold: int = 8192,
-                 mesh=None, dtype=None, **_ignored):
+                 mesh=None, dtype=None, quantization: str | None = None,
+                 **quant_kwargs):
         if mesh is not None:
             raise NotImplementedError(
                 "ivf is single-replica; collection sharding distributes it")
         store = IVFStore(dim=dim, metric=metric, capacity=capacity,
                          chunk_size=chunk_size, nlist=nlist, nprobe=nprobe,
                          train_threshold=train_threshold,
-                         delta_threshold=delta_threshold, dtype=dtype)
+                         delta_threshold=delta_threshold, dtype=dtype,
+                         quantization=quantization, **quant_kwargs)
         super().__init__(dim=dim, metric=metric, capacity=capacity,
                          chunk_size=chunk_size, store=store)
 
@@ -605,13 +831,61 @@ class IVFIndex(FlatIndex):
         with self._lock:
             self.store.train(force_nlist=nlist)
 
-    def compress(self, *a, **kw):
-        raise NotImplementedError(
-            "ivf does not support runtime PQ/BQ compression yet")
+    def compress(self, quantization: str = "pq", **quant_kwargs) -> None:
+        """Runtime switch to PQ residency: fit a codebook on live contents
+        and rebuild the posting lists as codes (reference lifecycle:
+        hnsw/compress.go:38 via config update). Slot ids are stable, so
+        the id<->slot maps carry over untouched."""
+        if quantization != "pq":
+            raise ValueError("ivf supports quantization='pq'")
+        from weaviate_tpu.ops.pq import default_pq_segments, pq_fit
+
+        with self._lock:
+            st = self.store
+            if st.quantization:
+                raise RuntimeError("index is already compressed")
+            vecs, slots = st._all_live_host()
+            # every fallible step runs BEFORE any store mutation, so a
+            # rejected compress leaves the uncompressed index fully intact
+            pq_centroids = quant_kwargs.get("pq_centroids") or st.pq_centroids
+            pq_segments = (quant_kwargs.get("pq_segments")
+                           or st.pq_segments
+                           or default_pq_segments(st.dim, pq_centroids))
+            if 0 < len(vecs) < pq_centroids:
+                raise RuntimeError(
+                    f"need >= {pq_centroids} live vectors to train PQ, "
+                    f"have {len(vecs)}")
+            codebook = (pq_fit(vecs, m=pq_segments, k=pq_centroids, iters=8)
+                        if len(vecs) else None)
+            st.quantization = "pq"
+            st.pq_segments = pq_segments
+            st.pq_centroids = pq_centroids
+            if quant_kwargs.get("rescore_limit"):
+                st.rescore_limit = quant_kwargs["rescore_limit"]
+            st.codebook = codebook
+            st._host_rows = np.zeros(
+                (max(_next_pow2(max(st.capacity, 1)), 1024), st.dim),
+                dtype=np.float32)
+            if len(vecs):
+                norm = st.normalize_on_add
+                st.normalize_on_add = False  # rows already normalized
+                st._remember_rows(slots, vecs)
+                st.normalize_on_add = norm
+            if st.trained:
+                # rebuild absorbs delta-resident rows too — reset the
+                # delta or its slots would be live in BOTH legs (duplicate
+                # results now, double-scatter at the next flush). The
+                # empty case still rebuilds so _fill reflects reality.
+                st._rebuild_lists(vecs, slots)
+                st._reset_delta()
 
     @property
     def trained(self) -> bool:
         return self.store.trained
+
+    @property
+    def compressed(self) -> bool:
+        return bool(self.store.quantization)
 
     @classmethod
     def restore(cls, snap: dict, **kwargs) -> "IVFIndex":

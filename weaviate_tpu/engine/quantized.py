@@ -120,12 +120,7 @@ class QuantizedVectorStore:
         if pq_segments:
             self.pq_segments = pq_segments
         else:
-            # 4-bit codes default to 1 bit/dim (m = d/4), 8-bit to 1 byte
-            # per 8 dims; m must divide d for the orthogonal-segment ADC
-            target = max(1, dim // (4 if pq_centroids <= 16 else 8))
-            while dim % target:
-                target -= 1
-            self.pq_segments = target
+            self.pq_segments = pq_ops.default_pq_segments(dim, pq_centroids)
         self.pq_centroids = pq_centroids
         self.codebook = codebook
         self.normalize_on_add = (

@@ -56,6 +56,16 @@ class PQCodebook(NamedTuple):
         return self.m * self.ds
 
 
+def default_pq_segments(dim: int, pq_centroids: int = 16) -> int:
+    """Segment-count policy shared by every PQ surface: 4-bit codes target
+    1 bit/dim (m = d/4), 8-bit codes 1 byte per 8 dims; m must divide d
+    for the orthogonal-segment ADC."""
+    target = max(1, dim // (4 if pq_centroids <= 16 else 8))
+    while dim % target:
+        target -= 1
+    return target
+
+
 def _seg_view(vectors: jnp.ndarray, m: int) -> jnp.ndarray:
     n, d = vectors.shape
     assert d % m == 0, f"dim {d} not divisible by {m} segments"
