@@ -1,0 +1,97 @@
+"""Multi-host (DCN) readiness (VERDICT r2 item 5).
+
+Two OS processes x 4 virtual CPU devices each join one JAX runtime via
+``maybe_initialize_distributed`` and run the SAME SPMD sharded-search
+step over the GLOBAL 8-device mesh — the simulated two-host pod. The
+collectives cross the process boundary the way they would cross DCN.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+
+    # 4 virtual devices per process BEFORE jax import
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=4")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from weaviate_tpu.parallel.mesh import (make_mesh,
+                                            maybe_initialize_distributed)
+    from weaviate_tpu.parallel.sharded_search import (replicate_array,
+                                                      shard_array,
+                                                      sharded_topk)
+    import jax.numpy as jnp
+
+    assert maybe_initialize_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    mesh = make_mesh()  # global mesh over all 8 devices
+    n, d, b, k = 512, 16, 4, 5
+    rng = np.random.default_rng(0)  # same seed on both processes
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    q = x[[7, 99, 255, 444]] + 0.01
+    valid = np.ones(n, dtype=bool)
+
+    xs = shard_array(jnp.asarray(x), mesh)
+    vs = shard_array(jnp.asarray(valid), mesh)
+    qs = replicate_array(jnp.asarray(q), mesh)
+    d_out, i_out = sharded_topk(qs, xs, vs, None, k=k, chunk_size=64,
+                                metric="l2-squared", mesh=mesh)
+    # fully-replicated output: every process can read it
+    ids = np.asarray(i_out)
+    assert list(ids[:, 0]) == [7, 99, 255, 444], ids[:, 0]
+    print(f"proc {jax.process_index()}: OK {ids[:, 0].tolist()}",
+          flush=True)
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_spmd_step(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "DCN_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "DCN_NUM_PROCESSES": "2",
+            "DCN_PROCESS_ID": str(pid),
+            "PYTHONPATH": os.pathsep.join(sys.path),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process SPMD step timed out")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out}"
+        assert "OK" in out, out

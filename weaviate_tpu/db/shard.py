@@ -66,7 +66,7 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
         if cfg.quantization == "bq":
             # no bq form for IVF lists — honor the compression request on
             # the flat scan (documented fallback, not a silent drop)
-            return FlatIndex(quantization="bq",
+            return FlatIndex(quantization="bq", mesh=mesh,
                              rescore_limit=cfg.rescore_limit, **common)
         # mesh forwarded so the single-replica guard fires loudly instead of
         # silently landing a sharded corpus on one device
@@ -84,7 +84,7 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
         # enough data exists — compress.go:38); bq has no ADC form for
         # graph hops, so bq configs run the quantized flat scan instead.
         if cfg.quantization == "bq":
-            return FlatIndex(quantization="bq",
+            return FlatIndex(quantization="bq", mesh=mesh,
                              rescore_limit=cfg.rescore_limit, **common)
         from weaviate_tpu.engine.hnsw import HNSWIndex
 
@@ -246,16 +246,15 @@ class Shard:
         self.vector_indexes[vec_name] = idx
         return idx
 
-    # min live vectors before a deferred runtime compression fires (the
-    # reference also defers PQ training until enough objects exist)
-    COMPRESS_MIN_LIVE = 4096
-
     def _maybe_compress(self, vec_name: str, idx) -> None:
         vc = self.config.vector_config(vec_name)
         if (vc is None or not vc.index.quantization
                 or getattr(idx, "compressed", True)
                 or not hasattr(idx, "compress")
-                or len(idx) < self.COMPRESS_MIN_LIVE):
+                # trainability floor — the SAME gate the config-update
+                # path has, so a restart can never silently drop
+                # compression a live update applied
+                or len(idx) < (vc.index.pq_centroids or 16)):
             return
         try:
             idx.compress(quantization=vc.index.quantization,

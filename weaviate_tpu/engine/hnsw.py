@@ -755,6 +755,7 @@ class HNSWIndex:
         wal_path = os.path.join(log_dir, "hnsw.wal")
         if not os.path.exists(wal_path):
             return
+        snap_count = self._count
         for payload in WriteAheadLog.replay(wal_path):
             op = pickle.loads(payload)
             tag = op[0]
@@ -796,6 +797,14 @@ class HNSWIndex:
                 slot = self._id_to_slot.get(doc_id)
                 if slot is not None:
                     self._ep, self._max_level = slot, level
+        if self._codes is not None and self._count > snap_count:
+            # inserts logged after the compress snapshot carry no codes in
+            # their WAL records — re-encode the replayed tail in one batch
+            # or ADC traversal would score them against all-zero codes
+            from weaviate_tpu.ops.pq import pq_encode
+
+            self._codes[snap_count: self._count] = pq_encode(
+                self._pq_codebook, self._vecs[snap_count: self._count])
 
     def close(self):
         if self._log is not None:

@@ -209,28 +209,27 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
         take = np.asarray(ids[s - start: s - start + min(slice_rows, n - s)],
                           dtype=np.int64)
         out[s: s + len(take)] = take
-    return out, (xd, norms)
+    return out
 
 
 def _knn_graph(vectors: np.ndarray, members: np.ndarray, knn_k: int,
-               metric: str):
+               metric: str) -> np.ndarray:
     """For each member, its knn_k nearest OTHER members (positions into
-    ``members``). Returns (knn, device_ctx or None)."""
+    ``members``)."""
     sub = vectors[members]
     n = len(sub)
     k_eff = min(knn_k + 1, n)
-    device_ctx = None
     if n <= _HOST_KNN_MAX or metric not in (
             "l2-squared", "dot", "cosine", "cosine-dot"):
         out = _host_knn(sub, k_eff, metric)
     else:
-        out, device_ctx = _device_knn(sub, k_eff, metric)
+        out = _device_knn(sub, k_eff, metric)
     # drop self-hits, keep knn_k columns: stable-sort by is_self pushes
     # non-self candidates to the front preserving distance order
     self_col = out == np.arange(n)[:, None]
     order = np.argsort(self_col, axis=1, kind="stable")
     res = np.take_along_axis(out, order, axis=1)[:, : min(knn_k, n - 1)]
-    return res, device_ctx
+    return res
 
 
 def bulk_build(index, doc_ids, vectors: np.ndarray, knn_k: int = 64,
@@ -272,7 +271,7 @@ def bulk_build(index, doc_ids, vectors: np.ndarray, knn_k: int = 64,
                     links.append(np.empty(0, dtype=np.int32))
                 continue
             budget = index.m0 if layer == 0 else index.m
-            knn, _ = _knn_graph(vectors, members, knn_k, index.metric)
+            knn = _knn_graph(vectors, members, knn_k, index.metric)
             fwd = _link_layer(index, vectors, members, knn, budget,
                               query_block)
             _write_links(index, members, fwd, layer)

@@ -90,13 +90,15 @@ def _scatter_code_lists(list_codes, list_valid, list_slots,
 def _ivf_probe_topk_pq(q, centroids, c_norms, list_codes, list_valid,
                        list_slots, pq_centroids, allow_by_slot, k: int,
                        nprobe: int, metric: str, use_allow: bool):
-    """PQ-resident probe: gather CODES from the probed lists, reconstruct
-    on the fly (per-segment centroid take — the decompression half of the
-    gather-matmul, ops/pq.py), score in bf16, masked top-k. HBM reads per
-    probed row are m bytes instead of 4d — the capacity regime IVF-PQ
-    exists for (reference: PQ inside each shard's HNSW,
+    """PQ-resident probe: gather CODES from the probed lists and score by
+    per-query ADC lookup (ops/pq.py:pq_lut) — a lax.scan over segments
+    accumulating [B, P] gathers, never materializing d-wide
+    reconstructions (an earlier reconstruct-matmul formulation held
+    [B, nprobe*cap, d] temporaries and OOM'd one chip at nprobe>=64).
+    HBM reads per probed row are m bytes instead of 4d — the capacity
+    regime IVF-PQ exists for (reference: PQ inside each shard's HNSW,
     compressionhelpers/product_quantization.go:372)."""
-    from weaviate_tpu.ops.pq import pq_reconstruct
+    from weaviate_tpu.ops.pq import pq_lut
 
     nlist, cap, m = list_codes.shape
     q32 = q.astype(jnp.float32)
@@ -110,19 +112,18 @@ def _ivf_probe_topk_pq(q, centroids, c_norms, list_codes, list_valid,
     vld = list_valid[probes].reshape(q.shape[0], nprobe * cap)
     slots = list_slots[probes].reshape(q.shape[0], nprobe * cap)
     b, p = codes.shape[0], codes.shape[1]
-    x_hat = pq_reconstruct(
-        codes.reshape(b * p, m), pq_centroids, m
-    ).astype(jnp.bfloat16).reshape(b, p, -1)
-    dots = jnp.einsum("bd,bpd->bp", q32.astype(jnp.bfloat16), x_hat,
-                      preferred_element_type=jnp.float32)
+    lut = pq_lut(q32, pq_centroids, metric, m)  # [B, m, kc]
+    lut_s = jnp.transpose(lut, (1, 0, 2))  # [m, B, kc]
+    codes_s = jnp.transpose(codes, (2, 0, 1)).astype(jnp.int32)  # [m, B, P]
+
+    def seg_add(acc, inp):
+        lut_seg, code_seg = inp  # [B, kc], [B, P]
+        return acc + jnp.take_along_axis(lut_seg, code_seg, axis=1), None
+
+    d, _ = jax.lax.scan(seg_add, jnp.zeros((b, p), jnp.float32),
+                        (lut_s, codes_s))
     if metric == "l2-squared":
-        qn = jnp.sum(q32 * q32, axis=-1)[:, None]
-        xn = jnp.sum(x_hat.astype(jnp.float32) ** 2, axis=-1)
-        d = jnp.maximum(qn - 2.0 * dots + xn, 0.0)
-    elif metric == "dot":
-        d = -dots
-    else:
-        d = 1.0 - dots
+        d = jnp.maximum(d, 0.0)
     if use_allow:
         ok = allow_by_slot[jnp.clip(slots, 0, allow_by_slot.shape[0] - 1)]
         vld = vld & ok & (slots >= 0) & (slots < allow_by_slot.shape[0])
@@ -776,7 +777,15 @@ class IVFStore:
             store.nlist = snap["nlist"]
             store.centroids = jnp.asarray(snap["centroids"])
             store._c_norms = jnp.sum(store.centroids * store.centroids, axis=1)
-            if len(vecs):
+            if store.quantization and store.codebook is None:
+                # quantization enabled before any codebook could train
+                # (empty compress + sub-threshold adds): rows go back to
+                # the exact delta; empty code lists keep _fill truthful
+                store._rebuild_lists(np.empty((0, store.dim), np.float32),
+                                     np.empty(0, np.int64))
+                if len(vecs):
+                    store._add_to_delta(slots, vecs)
+            elif len(vecs):
                 store._rebuild_lists(vecs, slots)
             else:
                 # trained-but-empty: allocate empty list tensors so later

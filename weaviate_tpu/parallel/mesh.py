@@ -2,10 +2,14 @@
 
 The framework uses a 1-D ``shard`` axis for corpus row-sharding (the analog
 of the reference's physical shards, usecases/sharding/state.go:28). On a
-multi-host pod the same axis spans DCN automatically via jax.devices().
+multi-host pod the same axis spans DCN automatically via jax.devices()
+once ``maybe_initialize_distributed`` has joined the global runtime.
 """
 
 from __future__ import annotations
+
+import os
+import threading
 
 import jax
 import numpy as np
@@ -13,9 +17,55 @@ from jax.sharding import Mesh
 
 SHARD_AXIS = "shard"
 
+_dist_lock = threading.Lock()
+_dist_initialized = False
+
+
+def maybe_initialize_distributed(env=None) -> bool:
+    """Join the multi-host JAX runtime when the environment names a
+    coordinator (SURVEY §5 distributed comms: ICI inside a host, DCN
+    across hosts — the analog of the reference's cluster join,
+    usecases/cluster/state.go:61, but for the DATA plane).
+
+    Env surface:
+      DCN_COORDINATOR_ADDRESS  host:port of process 0 (required to enable)
+      DCN_NUM_PROCESSES        total process count
+      DCN_PROCESS_ID           this process's rank
+
+    After this returns True, ``jax.devices()`` spans every host, so
+    ``make_mesh()``/``default_mesh()`` build GLOBAL meshes and the same
+    shard_map programs scale across DCN with zero further changes —
+    collectives over the mesh axis ride ICI within a host and DCN between
+    hosts, exactly the scaling-book recipe. Idempotent; returns whether
+    the distributed runtime is active.
+    """
+    global _dist_initialized
+    env = env if env is not None else os.environ
+    addr = env.get("DCN_COORDINATOR_ADDRESS")
+    if not addr:
+        return _dist_initialized
+    with _dist_lock:
+        if _dist_initialized:
+            return True
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(env.get("DCN_NUM_PROCESSES", "1")),
+            process_id=int(env.get("DCN_PROCESS_ID", "0")),
+        )
+        _dist_initialized = True
+    return True
+
 
 def device_count() -> int:
     return len(jax.devices())
+
+
+def local_device_count() -> int:
+    return len(jax.local_devices())
+
+
+def is_multiprocess() -> bool:
+    return jax.process_count() > 1
 
 
 def make_mesh(n_devices: int | None = None, axis_name: str = SHARD_AXIS) -> Mesh:

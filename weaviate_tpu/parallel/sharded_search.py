@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -201,10 +203,26 @@ def sharded_quantized_topk(
 
 
 def shard_array(arr, mesh: Mesh, axis: str = SHARD_AXIS, dim: int = 0):
-    """Place ``arr`` on ``mesh`` sharded along ``dim``."""
+    """Place ``arr`` on ``mesh`` sharded along ``dim``.
+
+    On a multi-process (DCN) mesh, device_put can only target addressable
+    devices — each process materializes its own shards from the (process-
+    locally identical) host array via make_array_from_callback."""
     spec = [None] * arr.ndim
     spec[dim] = axis
-    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+    sharding = NamedSharding(mesh, P(*spec))
+    if jax.process_count() > 1:
+        arr_np = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr_np.shape, sharding, lambda idx: arr_np[idx])
+    return jax.device_put(arr, sharding)
+
+
+def replicate_array_multihost(arr, mesh: Mesh):
+    arr_np = np.asarray(arr)
+    sharding = NamedSharding(mesh, P())
+    return jax.make_array_from_callback(
+        arr_np.shape, sharding, lambda idx: arr_np[idx])
 
 
 def grow_rows(arr, pad_rows: int, mesh: Mesh | None, axis: str = SHARD_AXIS):
@@ -242,4 +260,6 @@ def sharded_zeros(shape, dtype, mesh: Mesh, axis: str = SHARD_AXIS,
 
 
 def replicate_array(arr, mesh: Mesh):
+    if jax.process_count() > 1:
+        return replicate_array_multihost(arr, mesh)
     return jax.device_put(arr, NamedSharding(mesh, P()))

@@ -40,6 +40,14 @@ class Server:
         cfg = self.config
         self._setup_logging()
 
+        # multi-host data plane first (before anything touches jax):
+        # with DCN_COORDINATOR_ADDRESS set, jax.devices() spans every
+        # host and all meshes/collectives go global (SURVEY §5 comms)
+        from weaviate_tpu.parallel.mesh import maybe_initialize_distributed
+
+        if maybe_initialize_distributed():
+            logger.info("joined multi-host JAX runtime")
+
         from weaviate_tpu.auth import AuthConfig, AuthStack
         from weaviate_tpu.modules import default_provider
 
