@@ -16,6 +16,8 @@
 #include <cstdint>
 #include <cstring>
 #include <algorithm>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -167,6 +169,150 @@ void wn_merge_topk(const float* dists, const int64_t* ids,
         }
     }
     for (int64_t i = n; i < k; ++i) { out_d[i] = 3.0e38f; out_i[i] = -1; }
+}
+
+// ---- batch text analyzer -------------------------------------------------
+// The import hot loop (reference: inverted/analyzer.go called per put from
+// shard_write_put.go:454) moved to one FFI call per (property, batch):
+// tokenize every value, accumulate per-(term, row) tf + per-row token
+// counts. ASCII-only fast path — the Python caller routes non-ASCII values
+// through the unicode-aware tokenizer so index/delete key derivation stays
+// byte-identical per value. Modes: 0=word (lowercase, split on any
+// non-alphanumeric), 1=lowercase (split whitespace), 2=whitespace,
+// 3=field (trimmed whole value).
+
+namespace {
+struct AnalyzeOut {
+    std::string terms;                 // concatenated term bytes
+    std::vector<int64_t> term_offs;    // nterms+1
+    std::vector<int64_t> entry_offs;   // nterms+1 (into rows/tfs)
+    std::vector<int64_t> rows;         // per entry: row index
+    std::vector<uint32_t> tfs;         // per entry: term frequency
+    std::vector<int64_t> row_tokens;   // per row: token count
+};
+thread_local AnalyzeOut g_an;
+
+inline bool tok_char(uint8_t c, int mode) {
+    if (mode == 0)
+        return (c >= '0' && c <= '9') || (c >= 'a' && c <= 'z') ||
+               (c >= 'A' && c <= 'Z');
+    // whitespace-split modes: token chars = non-space. Python str.split()
+    // also treats the ASCII separators 0x1c-0x1f as whitespace — the
+    // index/unindex key contract requires byte-identical tokenization.
+    return !(c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+             c == '\f' || c == '\v' || (c >= 0x1c && c <= 0x1f));
+}
+}  // namespace
+
+int64_t wn_analyze_batch(const uint8_t* blob, const int64_t* offs,
+                         int64_t nrows, int32_t mode,
+                         int64_t* out_nterms, int64_t* out_nentries,
+                         int64_t* out_termbytes) {
+    g_an = AnalyzeOut();
+    g_an.row_tokens.assign((size_t)nrows, 0);
+    // term -> entries (rows ascend because rows are processed in order)
+    std::unordered_map<std::string, std::vector<std::pair<int64_t, uint32_t>>>
+        acc;
+    std::unordered_map<std::string, uint32_t> row_counts;
+    std::string tok;
+    for (int64_t r = 0; r < nrows; ++r) {
+        const uint8_t* p = blob + offs[r];
+        const uint8_t* end = blob + offs[r + 1];
+        row_counts.clear();
+        int64_t ntok = 0;
+        if (mode == 3) {  // field: trimmed whole value
+            while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' ||
+                               *p == '\r')) ++p;
+            const uint8_t* e = end;
+            while (e > p && (e[-1] == ' ' || e[-1] == '\t' ||
+                             e[-1] == '\n' || e[-1] == '\r')) --e;
+            if (e > p) {
+                row_counts.emplace(std::string((const char*)p, e - p), 1);
+                ntok = 1;
+            }
+        } else {
+            bool lower = mode != 2;
+            while (p < end) {
+                while (p < end && !tok_char(*p, mode)) ++p;
+                if (p >= end) break;
+                tok.clear();
+                while (p < end && tok_char(*p, mode)) {
+                    uint8_t c = *p++;
+                    if (lower && c >= 'A' && c <= 'Z') c += 32;
+                    tok.push_back((char)c);
+                }
+                ++ntok;
+                ++row_counts[tok];
+            }
+        }
+        g_an.row_tokens[(size_t)r] = ntok;
+        for (auto& kv : row_counts)
+            acc[kv.first].emplace_back(r, kv.second);
+    }
+    // deterministic output order: sorted terms
+    std::vector<const std::string*> keys;
+    keys.reserve(acc.size());
+    for (auto& kv : acc) keys.push_back(&kv.first);
+    std::sort(keys.begin(), keys.end(),
+              [](const std::string* a, const std::string* b) { return *a < *b; });
+    g_an.term_offs.push_back(0);
+    g_an.entry_offs.push_back(0);
+    for (const std::string* k : keys) {
+        g_an.terms += *k;
+        g_an.term_offs.push_back((int64_t)g_an.terms.size());
+        auto& entries = acc[*k];
+        for (auto& e : entries) {
+            g_an.rows.push_back(e.first);
+            g_an.tfs.push_back(e.second);
+        }
+        g_an.entry_offs.push_back((int64_t)g_an.rows.size());
+    }
+    *out_nterms = (int64_t)keys.size();
+    *out_nentries = (int64_t)g_an.rows.size();
+    *out_termbytes = (int64_t)g_an.terms.size();
+    return 0;
+}
+
+void wn_analyze_fetch(uint8_t* terms_blob, int64_t* term_offs,
+                      int64_t* entry_offs, int64_t* entry_rows,
+                      uint32_t* entry_tfs, int64_t* row_tokens) {
+    std::memcpy(terms_blob, g_an.terms.data(), g_an.terms.size());
+    std::memcpy(term_offs, g_an.term_offs.data(),
+                g_an.term_offs.size() * sizeof(int64_t));
+    std::memcpy(entry_offs, g_an.entry_offs.data(),
+                g_an.entry_offs.size() * sizeof(int64_t));
+    std::memcpy(entry_rows, g_an.rows.data(),
+                g_an.rows.size() * sizeof(int64_t));
+    std::memcpy(entry_tfs, g_an.tfs.data(),
+                g_an.tfs.size() * sizeof(uint32_t));
+    std::memcpy(row_tokens, g_an.row_tokens.data(),
+                g_an.row_tokens.size() * sizeof(int64_t));
+    g_an = AnalyzeOut();
+}
+
+// ---- batch varint framing ------------------------------------------------
+// Encode MANY sorted-u64 blocks in one call (one WAL frame per import
+// batch instead of one FFI round trip + Python pack per posting key).
+// vals: concatenated blocks; offs[nblocks+1]. out must hold 10 bytes per
+// value; out_lens[nblocks] gets per-block byte lengths. Returns total
+// bytes written.
+
+int64_t wn_varint_encode_many(const uint64_t* vals, const int64_t* offs,
+                              int64_t nblocks, uint8_t* out,
+                              int64_t* out_lens) {
+    uint8_t* p = out;
+    for (int64_t b = 0; b < nblocks; ++b) {
+        uint8_t* start = p;
+        uint64_t prev = 0;
+        for (int64_t i = offs[b]; i < offs[b + 1]; ++i) {
+            uint64_t d = vals[i] - prev;
+            prev = vals[i];
+            while (d >= 0x80) { *p++ = (uint8_t)(d | 0x80); d >>= 7; }
+            *p++ = (uint8_t)d;
+        }
+        out_lens[b] = (int64_t)(p - start);
+    }
+    return (int64_t)(p - out);
 }
 
 }  // extern "C"

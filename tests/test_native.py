@@ -164,3 +164,63 @@ def test_varint_decode_rejects_overlong_varint():
     bad = bytes([0xFF] * 12 + [0x01])
     with pytest.raises(ValueError):
         native.varint_decode(bad, count_hint=1)
+
+
+def test_analyze_batch_matches_python_tokenizer():
+    """The native batch analyzer must produce byte-identical tokenization
+    to the Python tokenizer for every ASCII value, including mixed
+    batches and the control-char whitespace set (0x1c-0x1f)."""
+    from collections import Counter
+
+    from weaviate_tpu import native
+    from weaviate_tpu.text.tokenizer import tokenize
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native library unavailable")
+    vals = ["Hello World hello", "  the QUICK brown-fox 42 ", "",
+            "a\x1cb c\x1dd", "x\ty\nz", "item777 item777 other"]
+    for mode in ("word", "lowercase", "whitespace", "field"):
+        res = native.analyze_batch(vals, mode)
+        terms, eoffs, rows, tfs, rtoks = res
+        for r, v in enumerate(vals):
+            py = tokenize(v, mode)
+            assert rtoks[r] == len(py), (mode, r, v, py)
+            c = Counter(py)
+            got = {}
+            for t_i, t in enumerate(terms):
+                for j in range(int(eoffs[t_i]), int(eoffs[t_i + 1])):
+                    if int(rows[j]) == r:
+                        got[t] = int(tfs[j])
+            assert got == dict(c), (mode, r, v, got, dict(c))
+
+
+def test_index_objects_mixed_ascii_unicode_batch(tmp_path):
+    """A batch mixing analyzer-eligible (ASCII) and Python-path
+    (non-ASCII) values sharing a term must not crash and must index
+    both (the set/ndarray filter_add mix)."""
+    import types
+
+    from weaviate_tpu.schema.config import (CollectionConfig, DataType,
+                                            Property, VectorConfig)
+    from weaviate_tpu.storage.kv import KVStore
+    from weaviate_tpu.text.inverted import InvertedIndex
+
+    cfg = CollectionConfig(
+        name="Doc",
+        properties=[Property(name="body", data_type=DataType.TEXT)],
+        vectors=[VectorConfig()])
+    inv = InvertedIndex(cfg, store=KVStore(str(tmp_path)))
+    objs = [
+        types.SimpleNamespace(doc_id=0, properties={"body": "hello common"},
+                              creation_time_ms=0, last_update_time_ms=0),
+        types.SimpleNamespace(doc_id=1,
+                              properties={"body": "héllo hello common"},
+                              creation_time_ms=0, last_update_time_ms=0),
+    ]
+    inv.index_objects(objs)
+    ids, _ = inv.bm25_search("hello", k=5)
+    assert set(ids.tolist()) == {0, 1}
+    assert set(inv.filterable_ids("body", "common").tolist()) == {0, 1}
+    assert set(inv.filterable_ids("body", "héllo").tolist()) == {1}

@@ -5,20 +5,83 @@ modulecapabilities.BackupBackend (entities/modulecapabilities/backup.go:
 Initialize/PutObject/GetObject/HomeDir/...). The filesystem backend is
 fully local (BACKUP_FILESYSTEM_PATH, modules/backup-filesystem/backend.go);
 the cloud backends talk to object stores. Here, s3/gcs/azure speak the
-shared minimal "HTTP object store" dialect (unauthenticated PUT/GET
-against an endpoint, the shape a local minio/azurite/fake-gcs test
-container accepts) and fail with a clear error when no endpoint is
-configured — this environment has no network egress, so real cloud
-authentication (SigV4 etc.) is intentionally out of scope.
+shared minimal "HTTP object store" dialect (PUT/GET against an endpoint —
+the shape a local minio/azurite/fake-gcs test container accepts) with
+REAL cloud authentication layered on when credentials are configured:
+
+- backup-s3:    AWS Signature V4 (AWS_ACCESS_KEY_ID/_SECRET_ACCESS_KEY,
+                optional _SESSION_TOKEN; region from BACKUP_S3_REGION or
+                AWS_REGION) — reference: modules/backup-s3 via minio-go.
+- backup-gcs:   OAuth bearer token (GOOGLE_OAUTH_ACCESS_TOKEN or
+                GCP_ACCESS_TOKEN) — reference: modules/backup-gcs.
+- backup-azure: SAS token appended to every URL
+                (AZURE_STORAGE_SAS_TOKEN) — reference: modules/backup-azure.
+
+Unauthenticated endpoints (minio/azurite/fake-gcs in CI) keep working:
+auth headers attach only when credentials are present.
 """
 
 from __future__ import annotations
 
+import datetime
+import hashlib
+import hmac
 import os
 import urllib.error
+import urllib.parse
 import urllib.request
 
 from weaviate_tpu.modules.base import BackupBackend, ModuleError
+
+
+def sigv4_headers(method: str, url: str, region: str, service: str,
+                  access_key: str, secret_key: str, payload_hash: str,
+                  amz_date: str, session_token: str | None = None,
+                  extra_headers: dict | None = None) -> dict:
+    """AWS Signature Version 4 request headers (no SDK — ~80 lines of
+    canonicalization + HMAC chain per the SigV4 spec). Deterministic given
+    ``amz_date``; tests/test_backup.py pins AWS's published known-answer
+    vector. Reference: modules/backup-s3 (minio-go signs the same way)."""
+    parts = urllib.parse.urlsplit(url)
+    host = parts.netloc
+    canonical_uri = urllib.parse.quote(parts.path or "/", safe="/")
+    q = urllib.parse.parse_qsl(parts.query, keep_blank_values=True)
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}="
+        f"{urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(q))
+    headers = {"host": host, "x-amz-date": amz_date,
+               "x-amz-content-sha256": payload_hash}
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    for k, v in (extra_headers or {}).items():
+        headers[k.lower()] = " ".join(str(v).split())
+    signed = ";".join(sorted(headers))
+    canonical_headers = "".join(
+        f"{k}:{headers[k]}\n" for k in sorted(headers))
+    canonical_request = "\n".join([
+        method.upper(), canonical_uri, canonical_query,
+        canonical_headers, signed, payload_hash])
+    date = amz_date[:8]
+    scope = f"{date}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest()])
+
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _hmac(("AWS4" + secret_key).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    signature = hmac.new(k, string_to_sign.encode(),
+                         hashlib.sha256).hexdigest()
+    auth = (f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={signed}, Signature={signature}")
+    out = {k2: v for k2, v in headers.items() if k2 != "host"}
+    out["Authorization"] = auth
+    return out
 
 
 def walk_files(root: str) -> list[str]:
@@ -132,19 +195,36 @@ class _HttpObjectStoreBackend(BackupBackend):
                 f"{self.endpoint_setting!r} or {self.endpoint_env})")
         return f"{self.endpoint}/{self.container}/{backup_id}/{key}"
 
+    def _auth_headers(self, method: str, url: str,
+                      payload_hash: str) -> dict:
+        """Per-backend request authentication; {} = anonymous (the
+        minio/azurite/fake-gcs CI shape)."""
+        return {}
+
+    def _sign_url(self, url: str) -> str:
+        """Per-backend URL decoration (Azure SAS)."""
+        return url
+
     def initialize(self, backup_id: str) -> None:
         self._url(backup_id, "")  # endpoint check
 
+    _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
     def put(self, backup_id: str, key: str, data: bytes) -> None:
-        req = urllib.request.Request(self._url(backup_id, key), data=data,
-                                     method="PUT")
+        url = self._sign_url(self._url(backup_id, key))
+        headers = self._auth_headers(
+            "PUT", url, hashlib.sha256(data).hexdigest())
+        req = urllib.request.Request(url, data=data, method="PUT",
+                                     headers=headers)
         with urllib.request.urlopen(req, timeout=60):
             pass
 
     def get(self, backup_id: str, key: str) -> bytes:
+        url = self._sign_url(self._url(backup_id, key))
+        req = urllib.request.Request(
+            url, headers=self._auth_headers("GET", url, self._EMPTY_SHA256))
         try:
-            with urllib.request.urlopen(self._url(backup_id, key),
-                                        timeout=60) as resp:
+            with urllib.request.urlopen(req, timeout=60) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
@@ -153,10 +233,12 @@ class _HttpObjectStoreBackend(BackupBackend):
 
     def put_file(self, backup_id: str, key: str, src_path: str) -> None:
         size = os.path.getsize(src_path)
+        url = self._sign_url(self._url(backup_id, key))
+        headers = {"Content-Length": str(size)}
+        headers.update(self._auth_headers("PUT", url, "UNSIGNED-PAYLOAD"))
         with open(src_path, "rb") as f:
-            req = urllib.request.Request(
-                self._url(backup_id, key), data=f, method="PUT",
-                headers={"Content-Length": str(size)})
+            req = urllib.request.Request(url, data=f, method="PUT",
+                                         headers=headers)
             with urllib.request.urlopen(req, timeout=300):
                 pass
 
@@ -164,9 +246,11 @@ class _HttpObjectStoreBackend(BackupBackend):
         import shutil
 
         os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        url = self._sign_url(self._url(backup_id, key))
+        req = urllib.request.Request(
+            url, headers=self._auth_headers("GET", url, self._EMPTY_SHA256))
         try:
-            with urllib.request.urlopen(self._url(backup_id, key),
-                                        timeout=300) as resp, \
+            with urllib.request.urlopen(req, timeout=300) as resp, \
                     open(dst_path, "wb") as out:
                 shutil.copyfileobj(resp, out, 1 << 20)
         except urllib.error.HTTPError as e:
@@ -188,11 +272,32 @@ class S3Backend(_HttpObjectStoreBackend):
     endpoint_env = "BACKUP_S3_ENDPOINT"
     container_env = "BACKUP_S3_BUCKET"
 
+    def _auth_headers(self, method: str, url: str,
+                      payload_hash: str) -> dict:
+        access = os.environ.get("AWS_ACCESS_KEY_ID", "")
+        secret = os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        if not access or not secret:
+            return {}  # anonymous endpoint (minio CI shape)
+        region = (os.environ.get("BACKUP_S3_REGION")
+                  or os.environ.get("AWS_REGION") or "us-east-1")
+        amz_date = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y%m%dT%H%M%SZ")
+        return sigv4_headers(
+            method, url, region, "s3", access, secret, payload_hash,
+            amz_date,
+            session_token=os.environ.get("AWS_SESSION_TOKEN") or None)
+
 
 class GCSBackend(_HttpObjectStoreBackend):
     name = "backup-gcs"
     endpoint_env = "BACKUP_GCS_ENDPOINT"
     container_env = "BACKUP_GCS_BUCKET"
+
+    def _auth_headers(self, method: str, url: str,
+                      payload_hash: str) -> dict:
+        token = (os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
+                 or os.environ.get("GCP_ACCESS_TOKEN"))
+        return {"Authorization": f"Bearer {token}"} if token else {}
 
 
 class AzureBackend(_HttpObjectStoreBackend):
@@ -200,3 +305,15 @@ class AzureBackend(_HttpObjectStoreBackend):
     endpoint_env = "BACKUP_AZURE_ENDPOINT"
     container_setting = "container"
     container_env = "BACKUP_AZURE_CONTAINER"
+
+    def _auth_headers(self, method: str, url: str,
+                      payload_hash: str) -> dict:
+        # blob uploads need the blob type even for azurite
+        return {"x-ms-blob-type": "BlockBlob"} if method == "PUT" else {}
+
+    def _sign_url(self, url: str) -> str:
+        sas = os.environ.get("AZURE_STORAGE_SAS_TOKEN", "").lstrip("?")
+        if not sas:
+            return url
+        sep = "&" if "?" in url else "?"
+        return f"{url}{sep}{sas}"

@@ -82,6 +82,12 @@ def _load():
             ("wn_varint_encode_u64", [u64p, i64, u8p], i64),
             ("wn_varint_decode_u64", [u8p, i64, u64p, i64], i64),
             ("wn_merge_topk", [f32p, i64p, i64, i64, i64, f32p, i64p], None),
+            ("wn_analyze_batch",
+             [u8p, i64p, i64, ctypes.c_int32, i64p, i64p, i64p], i64),
+            ("wn_analyze_fetch",
+             [u8p, i64p, i64p, i64p, ctypes.POINTER(ctypes.c_uint32), i64p],
+             None),
+            ("wn_varint_encode_many", [u64p, i64p, i64, u8p, i64p], i64),
         ]:
             fn = getattr(lib, name)
             fn.argtypes = args
@@ -261,3 +267,82 @@ def merge_topk_host(dists: np.ndarray, ids: np.ndarray, k: int):
                       dists.shape[0], dists.shape[1], k,
                       _ptr(out_d, ctypes.c_float), _ptr(out_i, ctypes.c_int64))
     return out_d, out_i
+
+
+# ---- batch text analyzer --------------------------------------------------
+
+_MODE_BY_TOKENIZATION = {"word": 0, "lowercase": 1, "whitespace": 2,
+                         "field": 3}
+
+
+def analyze_batch(values: list[str], tokenization: str):
+    """Tokenize + accumulate a batch of ASCII text values in ONE native
+    call (the import hot loop — reference inverted/analyzer.go per put).
+
+    Returns (terms [list of str, sorted], entry_offs [nterms+1],
+    entry_rows [E], entry_tfs [E], row_tokens [nrows]) — for each term,
+    entries rows/tfs slice [entry_offs[t]:entry_offs[t+1]] give the value
+    indices containing it and their term frequencies (rows ascending).
+    Returns None when the native library is unavailable (callers fall
+    back to the Python tokenizer).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    mode = _MODE_BY_TOKENIZATION[tokenization]
+    blob = "".join(values).encode("ascii")
+    offs = np.zeros(len(values) + 1, dtype=np.int64)
+    np.cumsum([len(v) for v in values], out=offs[1:])
+    nterms = ctypes.c_int64()
+    nentries = ctypes.c_int64()
+    termbytes = ctypes.c_int64()
+    blob_arr = np.frombuffer(blob, dtype=np.uint8) if blob else \
+        np.zeros(1, dtype=np.uint8)
+    lib.wn_analyze_batch(
+        _ptr(np.ascontiguousarray(blob_arr), ctypes.c_uint8),
+        _ptr(offs, ctypes.c_int64), len(values), mode,
+        ctypes.byref(nterms), ctypes.byref(nentries), ctypes.byref(termbytes))
+    nt, ne, tb = nterms.value, nentries.value, termbytes.value
+    terms_blob = np.empty(max(tb, 1), dtype=np.uint8)
+    term_offs = np.empty(nt + 1, dtype=np.int64)
+    entry_offs = np.empty(nt + 1, dtype=np.int64)
+    entry_rows = np.empty(max(ne, 1), dtype=np.int64)
+    entry_tfs = np.empty(max(ne, 1), dtype=np.uint32)
+    row_tokens = np.empty(max(len(values), 1), dtype=np.int64)
+    lib.wn_analyze_fetch(
+        _ptr(terms_blob, ctypes.c_uint8), _ptr(term_offs, ctypes.c_int64),
+        _ptr(entry_offs, ctypes.c_int64), _ptr(entry_rows, ctypes.c_int64),
+        _ptr(entry_tfs, ctypes.c_uint32), _ptr(row_tokens, ctypes.c_int64))
+    raw = terms_blob.tobytes()
+    terms = [raw[term_offs[t]:term_offs[t + 1]].decode("ascii")
+             for t in range(nt)]
+    return (terms, entry_offs, entry_rows[:ne], entry_tfs[:ne],
+            row_tokens[:len(values)])
+
+
+def varint_encode_many(arrays: list[np.ndarray]):
+    """Encode many ascending-u64 blocks in one call.
+
+    Returns list of bytes per block (Python fallback when no native lib).
+    """
+    lib = _load()
+    if lib is None or not arrays:
+        return [varint_encode(a) for a in arrays]
+    concat = np.concatenate([_u64(a) for a in arrays]) if arrays else \
+        np.empty(0, np.uint64)
+    offs = np.zeros(len(arrays) + 1, dtype=np.int64)
+    np.cumsum([len(a) for a in arrays], out=offs[1:])
+    out = np.empty(max(int(offs[-1]) * 10, 1), dtype=np.uint8)
+    lens = np.empty(len(arrays), dtype=np.int64)
+    total = lib.wn_varint_encode_many(
+        _ptr(np.ascontiguousarray(concat) if len(concat) else
+             np.zeros(1, np.uint64), ctypes.c_uint64),
+        _ptr(offs, ctypes.c_int64), len(arrays),
+        _ptr(out, ctypes.c_uint8), _ptr(lens, ctypes.c_int64))
+    blob = out[:total].tobytes()
+    res = []
+    pos = 0
+    for n in lens.tolist():
+        res.append(blob[pos:pos + n])
+        pos += n
+    return res

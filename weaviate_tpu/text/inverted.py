@@ -249,10 +249,21 @@ class InvertedIndex:
         all_docs: set[int] = set()
         prop_len_delta: dict[str, list] = {}  # prop -> [total_delta, count_delta]
 
+        # native batch analyzer: one FFI call per (text prop, batch) for
+        # ASCII values (csrc wn_analyze_batch — the import hot loop,
+        # reference inverted/analyzer.go per put). Non-ASCII values and
+        # odd shapes keep the unicode-aware Python path; ASCII-ness is a
+        # property of the value, so index/unindex key derivation stays
+        # consistent either way.
+        text_handled = self._index_text_batch(
+            objs, search_upd, filter_add, prop_len_delta)
+
         for obj in objs:
             doc = obj.doc_id
             all_docs.add(doc)
             for name, value in obj.properties.items():
+                if (name, doc) in text_handled:
+                    continue  # batch analyzer wrote postings + filter keys
                 self._collect_index_prop(
                     doc, name, value, search_upd, filter_add, numeric_add,
                     null_add, geo_puts, prop_len_delta)
@@ -294,6 +305,89 @@ class InvertedIndex:
             for k, _ in geo_puts:
                 self._geo_cache.pop(k.split(_SEP, 1)[0].decode(), None)
 
+    _JOIN_BY_TOKENIZATION = {"word": "\x01", "lowercase": " ",
+                             "whitespace": " "}
+
+    def _index_text_batch(self, objs, search_upd, filter_add,
+                          prop_len_delta) -> set:
+        """Batch-analyze ASCII text properties through the native analyzer
+        (one FFI call per prop per batch). Returns the (prop, doc) pairs
+        fully handled — postings, text filter keys, and prop-length
+        aggregates — identically to the per-value Python path."""
+        from weaviate_tpu import native
+
+        if not native.available():
+            return set()
+        handled: set = set()
+        jobs: dict[str, tuple[list[int], list[str]]] = {}
+        props: dict[str, Property] = {}
+        for obj in objs:
+            for name, value in obj.properties.items():
+                prop = props.get(name)
+                if prop is None:
+                    prop = self._prop_schema(name, value)
+                    if prop is None or prop.data_type not in (
+                            DataType.TEXT, DataType.TEXT_ARRAY):
+                        continue
+                    props[name] = prop
+                if prop.data_type not in (DataType.TEXT,
+                                          DataType.TEXT_ARRAY):
+                    continue
+                if not (prop.index_searchable or prop.index_filterable):
+                    continue
+                if isinstance(value, str):
+                    if not value.isascii():
+                        continue
+                elif isinstance(value, (list, tuple)):
+                    join = self._JOIN_BY_TOKENIZATION.get(prop.tokenization)
+                    if join is None or not all(
+                            isinstance(v, str) and v.isascii()
+                            for v in value):
+                        continue  # field-mode arrays keep the Python path
+                    value = join.join(value)
+                else:
+                    continue
+                docs, vals = jobs.setdefault(name, ([], []))
+                docs.append(obj.doc_id)
+                vals.append(value)
+                handled.add((name, obj.doc_id))
+        for name, (docs, vals) in jobs.items():
+            prop = props[name]
+            res = native.analyze_batch(vals, prop.tokenization)
+            if res is None:  # lib vanished mid-flight: Python path
+                for d in docs:
+                    handled.discard((name, d))
+                continue
+            terms, eoffs, rows, tfs, row_tokens = res
+            pfx = name.encode() + _SEP
+            docs_arr = np.asarray(docs, dtype=np.int64)
+            if prop.index_searchable:
+                rt = row_tokens.tolist()
+                for t_i, t in enumerate(terms):
+                    key = pfx + t.encode()
+                    m = search_upd.setdefault(key, {})
+                    for j in range(int(eoffs[t_i]), int(eoffs[t_i + 1])):
+                        r = int(rows[j])
+                        m[docs[r]] = [int(tfs[j]), rt[r]]
+                d = prop_len_delta.setdefault(name, [0, 0])
+                d[0] += int(row_tokens.sum())
+                d[1] += len(docs)
+            if prop.index_filterable:
+                for t_i, t in enumerate(terms):
+                    fkey = pfx + b"t" + t.encode()
+                    fdocs = docs_arr[rows[int(eoffs[t_i]):
+                                          int(eoffs[t_i + 1])]]
+                    cur = filter_add.get(fkey)
+                    if cur is None:
+                        # sorted ndarray: bitmap_add_many skips its
+                        # np.unique for these
+                        filter_add[fkey] = fdocs
+                    elif isinstance(cur, set):
+                        cur.update(fdocs.tolist())
+                    else:
+                        filter_add[fkey] = np.union1d(cur, fdocs)
+        return handled
+
     def _collect_index_prop(self, doc, name, value, search_upd, filter_add,
                             numeric_add, null_add, geo_puts, prop_len_delta):
         prop = self._prop_schema(name, value)
@@ -321,7 +415,17 @@ class InvertedIndex:
         for vk in self._filter_keys(prop, value):
             bk = _value_key(vk)
             if bk is not None:
-                filter_add.setdefault(pfx + bk, set()).add(doc)
+                cur = filter_add.get(pfx + bk)
+                if cur is None:
+                    filter_add[pfx + bk] = {doc}
+                elif isinstance(cur, set):
+                    cur.add(doc)
+                else:
+                    # the batch analyzer stored an ndarray for this key
+                    # (ASCII docs) — widen to a set to absorb this doc
+                    s = set(cur.tolist())
+                    s.add(doc)
+                    filter_add[pfx + bk] = s
         dt = prop.data_type
         if dt in (DataType.INT, DataType.NUMBER):
             numeric_add.setdefault(pfx + _enc_f64(float(value)), set()).add(doc)
