@@ -220,12 +220,12 @@ int64_t wn_analyze_batch(const uint8_t* blob, const int64_t* offs,
         const uint8_t* end = blob + offs[r + 1];
         row_counts.clear();
         int64_t ntok = 0;
-        if (mode == 3) {  // field: trimmed whole value
-            while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' ||
-                               *p == '\r')) ++p;
+        if (mode == 3) {  // field: trimmed whole value — the trim set must
+            // equal Python str.strip()'s ASCII whitespace (incl \v \f and
+            // 0x1c-0x1f), i.e. exactly the mode-1/2 separator set
+            while (p < end && !tok_char(*p, 1)) ++p;
             const uint8_t* e = end;
-            while (e > p && (e[-1] == ' ' || e[-1] == '\t' ||
-                             e[-1] == '\n' || e[-1] == '\r')) --e;
+            while (e > p && !tok_char(e[-1], 1)) --e;
             if (e > p) {
                 row_counts.emplace(std::string((const char*)p, e - p), 1);
                 ntok = 1;

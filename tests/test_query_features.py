@@ -246,3 +246,4 @@ def test_near_vector_autocut(db):
     hits = col.near_vector([1.0, 0.0], k=10, autocut=1)
     assert len(hits) == 5
     assert all(r.distance < 1.0 for r in hits)
+

@@ -419,3 +419,32 @@ def test_inverted_index_persists_across_reopen(tmp_path):
         db2.close()
     finally:
         InvertedIndex.index_object = orig
+
+
+def test_sort_composes_with_search(articles):
+    """GraphQL sort + nearVector: results re-order by the sort keys while
+    keeping the distance pairing (reference sorter/objects_sorter.go)."""
+    db, col = articles
+    from weaviate_tpu.api.graphql import GraphQLExecutor
+
+    ex = GraphQLExecutor(db)
+    q = """{ Get { Article(nearVector: {vector: [0.1,0.2,0.1,0.3,0.2,0.1,0.4,0.2]},
+                          sort: [{path: "views", order: desc}]) {
+        title _additional { distance } } } }"""
+    out = ex({"query": q})
+    assert not out.get("errors"), out
+    arts = out["data"]["Get"]["Article"]
+    views_order = [a["title"] for a in arts]
+    assert len(arts) == 5
+    # sorted by views desc: Vector search (500) first, Gardening (5) last
+    assert views_order[0] == "Vector search"
+    assert views_order[-1] == "Gardening"
+    assert all(a["_additional"]["distance"] is not None for a in arts)
+    # _distance sort puts the nearest first again
+    q2 = """{ Get { Article(nearVector: {vector: [0.1,0.2,0.1,0.3,0.2,0.1,0.4,0.2]},
+                           sort: [{path: "_distance", order: asc}]) {
+        _additional { distance } } } }"""
+    out2 = ex({"query": q2})
+    ds = [a["_additional"]["distance"]
+          for a in out2["data"]["Get"]["Article"]]
+    assert ds == sorted(ds)

@@ -39,6 +39,12 @@ def main():
         "--url", default="",
         help="REST address of a running server; requires --grpc-port")
     ap.add_argument("--grpc-port", type=int, default=0)
+    ap.add_argument(
+        "--concurrency", type=int, default=32,
+        help="closed-loop concurrent gRPC streams for the served-load "
+             "measurement (0 disables; VERDICT r2 item 6)")
+    ap.add_argument("--load-queries", type=int, default=1024,
+                    help="total queries across the concurrent streams")
     args = ap.parse_args()
     if args.url and not args.grpc_port:
         ap.error("--url mode also needs --grpc-port (queries run over "
@@ -164,6 +170,74 @@ def main():
         recall_n += len(gt & set(hits_by_query[i]))
     recall = recall_n / (args.queries * args.k)
 
+    # ---- served load: concurrent closed-loop clients ----------------------
+    # VERDICT r2 item 6: does the dynamic query batcher
+    # (runtime/query_batcher.py) actually coalesce under load and hold the
+    # latency envelope? N threads hammer gRPC Search back-to-back; the
+    # batcher stats report achieved batch sizes. Reference serving claim:
+    # README.md:34 / benchmark_sift.go:38-57.
+    served = {}
+    if args.concurrency > 0:
+        import threading
+
+        qpool = rng.standard_normal(
+            (args.load_queries, args.dim)).astype(np.float32)
+        lat_lock = threading.Lock()
+        load_lat = []
+        cursor = [0]
+
+        def worker():
+            while True:
+                with lat_lock:
+                    i = cursor[0]
+                    if i >= args.load_queries:
+                        return
+                    cursor[0] += 1
+                t0 = time.perf_counter()
+                query(qpool[i])
+                dt = time.perf_counter() - t0
+                with lat_lock:
+                    load_lat.append(dt)
+
+        # batcher stats before/after (in-process mode only)
+        batchers = []
+        if server is not None:
+            for col in server.db.collections.values():
+                for shard in col.shards.values():
+                    batchers.extend(shard._query_batchers.values())
+        before = [(b.dispatches, b.batched_queries) for b in batchers]
+        threads = [threading.Thread(target=worker)
+                   for _ in range(args.concurrency)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        ll = np.asarray(load_lat) if load_lat else np.asarray([0.0])
+        served = {
+            "streams": args.concurrency,
+            "served_qps": round(args.load_queries / wall, 1),
+            "p50_ms": round(float(np.percentile(ll, 50)) * 1e3, 2),
+            "p95_ms": round(float(np.percentile(ll, 95)) * 1e3, 2),
+        }
+        if server is not None:
+            batchers = []
+            for col in server.db.collections.values():
+                for shard in col.shards.values():
+                    batchers.extend(shard._query_batchers.values())
+            disp = sum(b.dispatches for b in batchers) - sum(
+                d for d, _ in before)
+            bq = sum(b.batched_queries for b in batchers) - sum(
+                q for _, q in before)
+            if disp:
+                served["dispatches"] = disp
+                served["avg_batch"] = round(bq / disp, 2)
+        log(f"served load ({args.concurrency} streams): "
+            f"{served['served_qps']} qps, p50 {served['p50_ms']} ms, "
+            f"p95 {served['p95_ms']} ms, avg batch "
+            f"{served.get('avg_batch', 'n/a')}")
+
     print(json.dumps({
         "metric": "e2e_server_knn",
         "n": args.n, "dim": args.dim, "k": args.k,
@@ -173,6 +247,7 @@ def main():
         "query_p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 2),
         "qps_single_stream": round(1.0 / float(np.median(lat)), 1),
         "recall_at_k": round(recall, 4),
+        "served_load": served,
     }), flush=True)
 
     chan.close()

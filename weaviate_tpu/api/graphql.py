@@ -401,6 +401,18 @@ class GraphQLExecutor:
             return [self._render_object(f, col, o, None, tenant)
                     for o in objs]
 
+        sort = args.get("sort")
+        if sort is not None:
+            # sort composes with search results (reference sorter/
+            # objects_sorter.go keeps the distance pairing through it)
+            from weaviate_tpu.query.sorter import sort_search_results
+
+            if not isinstance(sort, list):
+                sort = [sort]
+            results = sort_search_results(
+                results,
+                [{"path": s.get("path"), "order": s.get("order", "asc")}
+                 for s in sort])
         results = results[offset:offset + limit]
         rerank_field = None
         add = f.sel("_additional")

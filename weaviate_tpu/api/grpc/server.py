@@ -239,10 +239,19 @@ class GrpcServer:
     rerank (usecases/modules analog)."""
 
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
-                 modules=None, auth=None, max_workers: int = 16):
+                 modules=None, auth=None, max_workers: int | None = None):
+        # 64 workers: handlers mostly BLOCK on the query batcher's device
+        # dispatch, so the pool bounds how many queries can coalesce into
+        # one batch — 16 capped measured batch sizes at ~8 under 32
+        # concurrent streams (GRPC_MAX_WORKERS overrides)
         self.db = db
         self.modules = modules
         self.auth = auth
+        if max_workers is None:
+            import os
+
+            max_workers = int(os.environ.get("GRPC_MAX_WORKERS", "64"))
+        self._max_workers = max_workers
         handlers = {
             "Search": self._search,
             "BatchObjects": self._batch_objects,
@@ -264,7 +273,7 @@ class GrpcServer:
                 request_deserializer=req_types[name].FromString,
                 response_serializer=lambda resp: resp.SerializeToString(),
             )
-        self._server = grpc.server(ThreadPoolExecutor(max_workers=max_workers))
+        self._server = grpc.server(ThreadPoolExecutor(max_workers=self._max_workers))
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, method_handlers),))
         self.port = self._server.add_insecure_port(f"{host}:{port}")

@@ -724,7 +724,9 @@ class Shard:
         """One background cycle: flush dirty memtables, compact segment
         stacks past the threshold (reference: store_cyclecallbacks.go).
         Returns True when work was done (cyclemanager backoff signal)."""
-        from weaviate_tpu.runtime.metrics import lsm_segment_count
+        from weaviate_tpu.runtime.metrics import (
+            lsm_segment_count, vector_index_compressed,
+            vector_index_hbm_bytes, vector_index_tombstones)
 
         did = False
         if self.gc_staged():
@@ -736,6 +738,24 @@ class Shard:
                 did = True
             lsm_segment_count.labels(f"{self.collection_name}/{self.name}/{b.name}"
                                      ).set(b.segment_count)
+        for vec_name, idx in self.vector_indexes.items():
+            if idx is None:
+                continue
+            labels = (self.collection_name, self.name, vec_name or "default")
+            store = getattr(idx, "store", None)
+            live = len(idx)
+            total = getattr(store, "count", live) if store is not None                 else getattr(idx, "_count", live)
+            vector_index_tombstones.labels(*labels).set(max(total - live, 0))
+            vector_index_compressed.labels(*labels).set(
+                1 if getattr(idx, "compressed", False) else 0)
+            hbm = 0
+            for arr_name in ("vectors", "valid", "sq_norms", "codes",
+                             "rescore_rows", "list_vecs", "list_codes",
+                             "list_valid", "list_slots", "list_norms"):
+                arr = getattr(store, arr_name, None)
+                if arr is not None and hasattr(arr, "nbytes"):
+                    hbm += int(arr.nbytes)
+            vector_index_hbm_bytes.labels(*labels).set(hbm)
         return did
 
     def close(self):

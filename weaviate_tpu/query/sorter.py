@@ -2,7 +2,8 @@
 
 Reference: adapters/repos/db/sorter/ — comparators for every property
 data type with explicit null ordering (basic_comparators.go), applied to
-result sets before pagination.
+result sets before pagination; search results carry their distances
+through the sort (objects_sorter.go:21 Sort(objects, distances)).
 """
 
 from __future__ import annotations
@@ -31,22 +32,47 @@ def _sort_key_value(obj, path: str):
     return v
 
 
-def sort_objects(objects: list, sort_specs: list[dict]) -> list:
-    """Stable multi-key sort. ``sort_specs``: [{"path": "name",
-    "order": "asc"|"desc"}, ...] — applied right-to-left so the first
-    spec dominates (reference: objects_sorter.go)."""
-    out = list(objects)
+def _multikey_sort(items: list, sort_specs: list[dict], key_of) -> list:
+    """Stable multi-key sort applied right-to-left so the first spec
+    dominates (reference: objects_sorter.go). ``key_of(item, path)``
+    extracts the comparable; None sorts last regardless of order, and
+    mixed-type keys compare within the dominant type (others go last)."""
+    out = list(items)
     for spec in reversed(sort_specs):
         path = spec["path"] if isinstance(spec["path"], str) else spec["path"][0]
         desc = spec.get("order", "asc") == "desc"
-
-        keyed = [(_sort_key_value(o, path), o) for o in out]
-        nones = [o for kv, o in keyed if kv is None]
-        present = [(kv, o) for kv, o in keyed if kv is not None]
-        # mixed-type guard: compare within the dominant type, others go last
+        keyed = [(key_of(it, path), it) for it in out]
+        nones = [it for kv, it in keyed if kv is None]
+        present = [(kv, it) for kv, it in keyed if kv is not None]
         try:
             present.sort(key=lambda t: t[0], reverse=desc)
         except TypeError:
-            present.sort(key=lambda t: (str(type(t[0])), str(t[0])), reverse=desc)
-        out = [o for _, o in present] + nones
+            present.sort(key=lambda t: (str(type(t[0])), str(t[0])),
+                         reverse=desc)
+        out = [it for _, it in present] + nones
     return out
+
+
+def sort_objects(objects: list, sort_specs: list[dict]) -> list:
+    """Stable multi-key sort of StorageObjects. ``sort_specs``:
+    [{"path": "name", "order": "asc"|"desc"}, ...]."""
+    return _multikey_sort(objects, sort_specs, _sort_key_value)
+
+
+def sort_search_results(results: list, sort_specs: list[dict]) -> list:
+    """Sort SEARCH results (SearchResult: .object/.distance/.score) —
+    the reference's objects_sorter.go:21 Sort(objects, distances) keeps
+    the object<->distance pairing through the sort; the special paths
+    ``_distance``/``distance`` and ``_score`` sort the search metric
+    itself, composing with property keys in one stable multi-key sort."""
+
+    def key_of(r, path: str):
+        if path in ("_distance", "distance"):
+            return r.distance
+        if path in ("_score", "score"):
+            return r.score
+        if r.object is None:
+            return r.uuid if path in ("_id", "id", "uuid") else None
+        return _sort_key_value(r.object, path)
+
+    return _multikey_sort(results, sort_specs, key_of)

@@ -93,7 +93,13 @@ class HashBeater:
             raws = self._peer_rpc(peer, shard_name, "objects:fetch",
                                   {"uuids": pull_uuids})["objects"] \
                 if pull_uuids else []
-            n += shard.apply_sync([r for r in raws if r], pull_dels)
+            applied = shard.apply_sync([r for r in raws if r], pull_dels)
+            if applied:
+                from weaviate_tpu.runtime.metrics import (
+                    hashbeat_repairs_total)
+
+                hashbeat_repairs_total.labels("pulled").inc(applied)
+            n += applied
         if n:
             logger.info("hashbeat %s/%s vs %s reconciled %d entries",
                         self.col.config.name, shard_name, peer, n)

@@ -134,6 +134,10 @@ class Replicator:
                         prepared.append(node)
                     else:
                         errors.append(f"{node}: {f.exception()}")
+            from weaviate_tpu.runtime.metrics import replication_phase_total
+
+            replication_phase_total.labels(
+                "prepare", "ok" if len(prepared) >= need else "failed").inc()
             if len(prepared) < need:
                 # quorum impossible: abort what prepared; late preparers
                 # abort themselves via callback
@@ -152,6 +156,7 @@ class Replicator:
                 f.add_done_callback(
                     lambda fut, n=prep_futs[f]: commit_straggler(fut, n))
             # commit phase over the quorum set
+
             commit_futs = {pool.submit(self._commit, node, shard_name, rid):
                            node for node in prepared}
             results: list = []
@@ -173,6 +178,8 @@ class Replicator:
                 f.add_done_callback(
                     lambda fut, n=node: fut.exception() is not None
                     and safe_abort(n))
+            replication_phase_total.labels(
+                "commit", "ok" if len(results) >= need else "failed").inc()
             if len(results) < need:
                 raise ConsistencyError(
                     f"commit acked by {len(results)}/{len(prepared)} prepared "

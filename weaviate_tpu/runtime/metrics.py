@@ -258,6 +258,52 @@ lsm_segment_count = registry.gauge(
     "weaviate_tpu_lsm_segment_count",
     "Segments per bucket", ("bucket",))
 
+# -- LSM internals (reference: lsmkv/metrics.go) ------------------------------
+
+lsm_wal_bytes = registry.counter(
+    "weaviate_tpu_lsm_wal_bytes_total",
+    "WAL bytes appended per bucket", ("bucket",))
+lsm_memtable_bytes = registry.gauge(
+    "weaviate_tpu_lsm_memtable_bytes",
+    "Active memtable size estimate per bucket", ("bucket",))
+lsm_flush_duration = registry.histogram(
+    "weaviate_tpu_lsm_flush_duration_seconds",
+    "Sealed-memtable to segment flush latency", ("bucket",))
+lsm_compaction_duration = registry.histogram(
+    "weaviate_tpu_lsm_compaction_duration_seconds",
+    "Segment compaction latency", ("bucket",))
+
+# -- vector index internals (reference: hnsw/metrics.go) ----------------------
+
+vector_index_tombstones = registry.gauge(
+    "weaviate_tpu_vector_index_tombstones",
+    "Tombstoned (deleted, unreclaimed) vectors",
+    ("collection", "shard", "vector"))
+vector_index_hbm_bytes = registry.gauge(
+    "weaviate_tpu_vector_index_hbm_bytes",
+    "Device memory held by the index's arrays",
+    ("collection", "shard", "vector"))
+vector_index_compressed = registry.gauge(
+    "weaviate_tpu_vector_index_compressed",
+    "1 when the index serves from quantized codes",
+    ("collection", "shard", "vector"))
+
+# -- replication (reference: replication metrics in monitoring/) --------------
+
+replication_phase_total = registry.counter(
+    "weaviate_tpu_replication_phase_total",
+    "2PC phases by outcome", ("phase", "status"))
+hashbeat_repairs_total = registry.counter(
+    "weaviate_tpu_hashbeat_objects_repaired_total",
+    "Objects propagated by Merkle anti-entropy", ("direction",))
+
+# -- dynamic query batching ---------------------------------------------------
+
+batcher_batch_size = registry.histogram(
+    "weaviate_tpu_query_batcher_batch_size",
+    "Queries coalesced per device dispatch", (),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+
 
 def serve_metrics(host: str = "127.0.0.1", port: int = 2112):
     """Start the Prometheus /metrics listener (reference: a dedicated

@@ -96,6 +96,9 @@ class QueryBatcher:
                 drained = self._queue[: self.max_batch]
                 del self._queue[: len(drained)]
             try:
+                from weaviate_tpu.runtime.metrics import batcher_batch_size
+
+                batcher_batch_size.observe(len(drained))
                 self._dispatch(drained)
             except Exception as e:  # noqa: BLE001 — deliver to every waiter
                 for it in drained:

@@ -477,6 +477,19 @@ class Bucket:
         self._flush_lock = threading.Lock()  # serializes segment writers
         self._segments: list = []  # oldest -> newest
         self._sealed: list[_Memtable] = []  # oldest -> newest
+        # per-bucket metric children resolved once (reference:
+        # lsmkv/metrics.go wires the same vecs per bucket). Label =
+        # collection/shard/bucket derived from the directory — a bare
+        # bucket name ('searchable') is shared by every shard and would
+        # collapse the gauges into last-writer-wins.
+        from weaviate_tpu.runtime import metrics as _m
+
+        parts = os.path.normpath(dir_path).split(os.sep)[-2:]
+        label = "/".join([p for p in parts if p] + [name])
+        self._wal_bytes_metric = _m.lsm_wal_bytes.labels(label)
+        self._memtable_metric = _m.lsm_memtable_bytes.labels(label)
+        self._flush_metric = _m.lsm_flush_duration.labels(label)
+        self._compaction_metric = _m.lsm_compaction_duration.labels(label)
         self._load_segments()
         self._wal_seq = 0
         self._write_gen = 0
@@ -573,8 +586,10 @@ class Bucket:
 
     def _log_and_apply(self, key: bytes, value) -> None:
         packed = None if value is _TOMBSTONE else _pack_value(self.strategy, value)
-        self._mem.wal.append(
-            msgpack.packb({"k": key, "v": packed}, use_bin_type=True))
+        payload = msgpack.packb({"k": key, "v": packed}, use_bin_type=True)
+        self._wal_bytes_metric.inc(len(payload))
+        self._mem.wal.append(payload)
+        self._memtable_metric.set(self._mem.bytes)
         self._mem.apply(self.strategy, key, value)
         self._write_gen += 1
         if self._mem.bytes >= self.memtable_limit:
@@ -588,8 +603,9 @@ class Bucket:
             # values, "B" tag) instead of one _pack_value per posting key
             frame = [[k, {"set": v["set"], "del": sorted(v["del"])}]
                      for k, v in pairs]
-            self._mem.wal.append(
-                msgpack.packb({"B": frame}, use_bin_type=True))
+            payload = msgpack.packb({"B": frame}, use_bin_type=True)
+            self._wal_bytes_metric.inc(len(payload))
+            self._mem.wal.append(payload)
             for k, v in pairs:
                 self._mem.apply(self.strategy, k, v)
             self._write_gen += 1
@@ -616,10 +632,13 @@ class Bucket:
                 [k, None if v is _TOMBSTONE else _pack_value(self.strategy, v)]
                 for k, v in pairs
             ]
-        self._mem.wal.append(msgpack.packb({"b": frame}, use_bin_type=True))
+        payload = msgpack.packb({"b": frame}, use_bin_type=True)
+        self._wal_bytes_metric.inc(len(payload))
+        self._mem.wal.append(payload)
         for k, v in pairs:
             self._mem.apply(self.strategy, k, v)
         self._write_gen += 1
+        self._memtable_metric.set(self._mem.bytes)
         if self._mem.bytes >= self.memtable_limit:
             self._seal()
 
@@ -921,7 +940,8 @@ class Bucket:
                     self._next_seq += 1
                     items = list(mt.packed_items(self.strategy))
                 # segment write happens outside the bucket lock
-                seg = _Segment.write(seq_path, items)
+                with self._flush_metric.time():
+                    seg = _Segment.write(seq_path, items)
                 with self._lock:
                     self._segments.append(seg)
                     self._sealed.pop(0)
@@ -966,7 +986,7 @@ class Bucket:
         dropping tombstones (reference: segment_group_compaction.go +
         compactor_{replace,set,map}.go). Streams through a k-way merge —
         peak RAM is O(1) records, not the whole bucket."""
-        with self._flush_lock:
+        with self._flush_lock, self._compaction_metric.time():
             with self._lock:
                 snapshot = list(self._segments)
             if len(snapshot) <= 1:
