@@ -211,3 +211,40 @@ def test_scaler_scale_out_local(tmp_path):
     finally:
         db0.close()
         db1.close()
+
+
+def test_contextual_classification(db):
+    """TypeContextual (reference classifier_run_contextual.go): TF-IDF
+    ranks basedOn words, the informative fraction vectorizes, nearest
+    target wins. Without a vectorizer module the stored vector serves."""
+    db.create_collection(config_from_json({
+        "class": "Topic",
+        "properties": [{"name": "name", "dataType": ["text"]}]}))
+    db.create_collection(config_from_json({
+        "class": "Post",
+        "properties": [{"name": "body", "dataType": ["text"]},
+                       {"name": "topic", "dataType": ["cref"]}]}))
+    topics = db.get_collection("Topic")
+    posts = db.get_collection("Post")
+    rng = np.random.default_rng(2)
+    a = np.zeros(8, dtype=np.float32); a[0] = 1.0
+    b = np.zeros(8, dtype=np.float32); b[1] = 1.0
+    uid_a = topics.put_object({"name": "sports"}, vector=a)
+    topics.put_object({"name": "politics"}, vector=b)
+    p1 = posts.put_object(
+        {"body": "the match the goal the football game"},
+        vector=a + 0.01 * rng.standard_normal(8).astype(np.float32))
+
+    mgr = ClassificationManager(db)
+    job = mgr.start("Post", ["topic"], based_on_properties=["body"],
+                    kind="text2vec-contextionary-contextual",
+                    settings={"targetClass": "Topic"}, wait=True)
+    done = mgr.get(job["id"])
+    assert done["status"] == COMPLETED, done
+    assert done["meta"]["countSucceeded"] == 1
+    got = posts.get_object(p1).properties["topic"]
+    assert got[0]["beacon"].endswith(uid_a)
+    # validation: contextual without basedOnProperties is rejected
+    with pytest.raises(ClassificationError):
+        mgr.start("Post", ["topic"], kind="contextual",
+                  settings={"targetClass": "Topic"})

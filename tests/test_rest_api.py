@@ -436,3 +436,52 @@ def test_legacy_classless_patch(client):
     out = client.request("PATCH", f"/v1/objects/{uid}",
                          body={"properties": {"extra": "y"}})
     assert out["properties"] == {"t": "x", "extra": "y"}
+
+
+def test_request_body_validation(server):
+    """Structural 422s with field-path messages (reference: go-swagger
+    validates against embedded_spec.go before handlers run)."""
+    base = f"http://{server.address}" if "://" not in server.address         else server.address
+    import json
+    import urllib.error
+    import urllib.request
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    # malformed object: properties must be an object, vector numeric
+    status, out = post("/v1/objects", {
+        "class": "Article", "properties": ["not", "a", "dict"],
+        "vector": "nope"})
+    assert status == 422, (status, out)
+    msg = out["error"][0]["message"]
+    assert "properties" in msg and "vector" in msg  # ALL errors listed
+
+    # malformed schema: property missing dataType
+    status, out = post("/v1/schema", {
+        "class": "Broken",
+        "properties": [{"name": "x"}]})
+    assert status == 422, (status, out)
+    assert "dataType" in out["error"][0]["message"]
+
+    # schema: class required
+    status, out = post("/v1/schema", {"properties": []})
+    assert status == 422
+    assert "class is required" in out["error"][0]["message"]
+
+    # batch: objects must be a list of objects
+    status, out = post("/v1/batch/objects", {"objects": "nope"})
+    assert status == 422
+
+    # malformed id
+    status, out = post("/v1/objects", {
+        "class": "Article", "id": "not-a-uuid", "properties": {}})
+    assert status == 422
+    assert "uuid" in out["error"][0]["message"]

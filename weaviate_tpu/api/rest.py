@@ -424,6 +424,11 @@ class RestServer:
     def _classifications(self, method: str, seg: list[str], body):
         """POST /v1/classifications, GET /v1/classifications/{id}
         (reference: handlers_classification.go)."""
+        if method == "POST" and not seg:
+            from weaviate_tpu.api.validation import (CLASSIFICATION,
+                                                     validate_body)
+
+            validate_body(CLASSIFICATION, body or {}, "classification")
         from weaviate_tpu.classification import (
             ClassificationError,
             ClassificationManager,
@@ -633,6 +638,10 @@ class RestServer:
 
         if self.backup_manager is None:
             raise ApiError(422, "backups require a module provider")
+        if method == "POST" and len(seg) == 1:
+            from weaviate_tpu.api.validation import BACKUP, validate_body
+
+            validate_body(BACKUP, body or {}, "backup")
         try:
             if len(seg) == 1 and method == "POST":
                 b = body or {}
@@ -725,6 +734,10 @@ class RestServer:
                     self.db.get_collection(n).config.to_dict()
                     for n in self.db.list_collections()]}
             if method == "POST":
+                from weaviate_tpu.api.validation import (SCHEMA_CLASS,
+                                                         validate_body)
+
+                validate_body(SCHEMA_CLASS, body or {}, "class")
                 cfg = config_from_json(body or {})
                 self.schema_target.create_collection(cfg)
                 return 200, cfg.to_dict()
@@ -912,6 +925,9 @@ class RestServer:
         raise KeyError("/v1/objects/" + "/".join(seg))
 
     def _put_object(self, body: dict, tenant: str | None):
+        from weaviate_tpu.api.validation import OBJECT, validate_body
+
+        validate_body(OBJECT, body or {}, "object")
         class_name = body.get("class") or body.get("collection")
         if not class_name:
             raise ApiError(422, "object is missing a class")
@@ -958,6 +974,10 @@ class RestServer:
     # -- /v1/batch/objects -----------------------------------------------------
 
     def _batch_objects(self, body: dict):
+        from weaviate_tpu.api.validation import (BATCH_OBJECTS,
+                                                 validate_body)
+
+        validate_body(BATCH_OBJECTS, body or {}, "batch")
         objects = body.get("objects", [])
         # group by (class, tenant): one batch_put call writes to exactly one
         # tenant — grouping by class alone would land cross-tenant objects

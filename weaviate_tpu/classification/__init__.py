@@ -59,7 +59,9 @@ class ClassificationManager:
             # never mix tenants' objects into one training set
             raise ClassificationError(
                 "classification on a multi-tenant class requires a tenant")
-        if kind not in ("knn", "zeroshot"):
+        if kind == "text2vec-contextionary-contextual":
+            kind = "contextual"  # reference TypeContextual (validation.go:24)
+        if kind not in ("knn", "zeroshot", "contextual"):
             raise ClassificationError(f"unknown classification type {kind!r}")
         if not classify_properties:
             raise ClassificationError("classifyProperties must not be empty")
@@ -67,10 +69,15 @@ class ClassificationManager:
             if col.config.property(p) is None:
                 raise ClassificationError(
                     f"class {class_name} has no property {p!r}")
-        if kind == "zeroshot" and not settings.get("targetClass"):
+        if kind in ("zeroshot", "contextual") and \
+                not settings.get("targetClass"):
             raise ClassificationError(
-                "zeroshot needs settings.targetClass (the class whose "
+                f"{kind} needs settings.targetClass (the class whose "
                 "objects are the candidate labels)")
+        if kind == "contextual" and not based_on_properties:
+            raise ClassificationError(
+                "contextual classification needs basedOnProperties (the "
+                "text whose words are TF-IDF ranked)")
 
         job_id = str(uuid_mod.uuid4())
         try:
@@ -98,6 +105,8 @@ class ClassificationManager:
                 if kind == "knn":
                     self._run_knn(col, job, where, training_set_where,
                                   tenant)
+                elif kind == "contextual":
+                    self._run_contextual(col, job, where, tenant)
                 else:
                     self._run_zeroshot(col, job, where, tenant)
                 job["status"] = COMPLETED
@@ -217,6 +226,121 @@ class ClassificationManager:
             except Exception:
                 job["meta"]["countFailed"] += 1
 
+    def _run_contextual(self, col, job, where, tenant=None):
+        """Contextual classification (reference TypeContextual:
+        modules/text2vec-contextionary/classification/
+        classifier_run_contextual.go + tf_idf.go): no training data.
+        The basedOn words of the UNCLASSIFIED corpus are TF-IDF ranked;
+        per object only the informative fraction (above
+        ``tfidfCutoffPercentile``, default 50) forms a query that the
+        class's vectorizer embeds, and the nearest target-class object by
+        cosine wins. Falls back to the object's stored vector when no
+        vectorizer module is configured."""
+        import math
+
+        import jax.numpy as jnp
+
+        from weaviate_tpu.ops.topk import chunked_topk
+        from weaviate_tpu.text.tokenizer import tokenize
+
+        props = job["classifyProperties"]
+        based_on = job["basedOnProperties"]
+        settings = job["settings"]
+        cutoff = float(settings.get("tfidfCutoffPercentile", 50))
+        target = self.db.get_collection(settings["targetClass"])
+        candidates = [o for o in target.iter_objects()
+                      if o.vector is not None]
+        if not candidates:
+            raise ClassificationError(
+                f"target class {target.config.name} has no vectorized "
+                "objects")
+        unlabeled, _ = self._split(col, props, where, tenant=tenant)
+        job["meta"]["count"] = len(unlabeled)
+        if not unlabeled:
+            return
+        # corpus-wide document frequencies over the basedOn text
+        docs_tokens = []
+        df = Counter()
+        for obj in unlabeled:
+            text = " ".join(str(obj.properties.get(p, ""))
+                            for p in based_on)
+            toks = tokenize(text, "word")
+            docs_tokens.append(toks)
+            df.update(set(toks))
+        n_docs = len(unlabeled)
+
+        def query_text(toks: list[str]) -> str:
+            if not toks:
+                return ""
+            tf = Counter(toks)
+            scored = sorted(
+                ((tf[w] / len(toks)) * math.log(1 + n_docs / df[w]), w)
+                for w in tf)
+            keep = max(1, int(len(scored) * (1 - cutoff / 100.0)))
+            top = [w for _s, w in scored[-keep:]]
+            # preserve original word order for the vectorizer
+            top_set = set(top)
+            return " ".join(w for w in toks if w in top_set)
+
+        texts = [query_text(toks) for toks in docs_tokens]
+        vecs: list = [None] * len(unlabeled)
+        if self.modules is not None and any(texts):
+            # vectorizer calls are HTTP round trips — run them
+            # concurrently, not one serial call per object
+            from concurrent.futures import ThreadPoolExecutor
+
+            def embed(i):
+                if not texts[i]:
+                    return
+                try:
+                    vecs[i] = np.asarray(self.modules.vectorize_query(
+                        col.config, texts[i], ""), dtype=np.float32)
+                except Exception:
+                    vecs[i] = None
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                list(pool.map(embed, range(len(unlabeled))))
+        q_rows = []
+        for obj, vec in zip(unlabeled, vecs):
+            if vec is None:
+                vec = obj.vector
+            if vec is None:
+                raise ClassificationError(
+                    f"object {obj.uuid} has no vector and no vectorizer "
+                    "module is configured")
+            q_rows.append(np.asarray(vec, dtype=np.float32))
+        q = self._unit(q_rows)
+        x = self._unit([o.vector for o in candidates])
+        _, idx = chunked_topk(jnp.asarray(q), jnp.asarray(x), k=1,
+                              metric="cosine")
+        idx = np.asarray(idx)
+        self._assign_targets(col, job, unlabeled, candidates, target, idx,
+                             props, tenant)
+
+    def _assign_targets(self, col, job, unlabeled, candidates, target, idx,
+                        props, tenant):
+        """Write the chosen target per object (shared by zeroshot and
+        contextual — beacon for cref props, label text otherwise)."""
+        for row, obj in enumerate(unlabeled):
+            try:
+                best = candidates[int(idx[row, 0])]
+                updates = {}
+                for p in props:
+                    prop_cfg = col.config.property(p)
+                    if prop_cfg is not None and prop_cfg.data_type == "cref":
+                        updates[p] = [{
+                            "beacon": "weaviate://localhost/"
+                                      f"{target.config.name}/{best.uuid}"}]
+                    else:
+                        label = next(
+                            (v for v in best.properties.values()
+                             if isinstance(v, str)), best.uuid)
+                        updates[p] = label
+                self._apply(col, obj, updates, tenant)
+                job["meta"]["countSucceeded"] += 1
+            except Exception:
+                job["meta"]["countFailed"] += 1
+
     def _run_zeroshot(self, col, job, where, tenant=None):
         from weaviate_tpu.ops.topk import chunked_topk
         import jax.numpy as jnp
@@ -238,27 +362,8 @@ class ClassificationManager:
         _, idx = chunked_topk(jnp.asarray(q), jnp.asarray(x), k=1,
                               metric="cosine")
         idx = np.asarray(idx)
-        for row, obj in enumerate(unlabeled):
-            try:
-                best = candidates[int(idx[row, 0])]
-                updates = {}
-                for p in props:
-                    prop_cfg = col.config.property(p)
-                    if prop_cfg is not None and prop_cfg.data_type == "cref":
-                        updates[p] = [{
-                            "beacon": "weaviate://localhost/"
-                                      f"{target.config.name}/{best.uuid}"}]
-                    else:
-                        # non-ref target: copy the label object's natural
-                        # label property (its first text prop)
-                        label = next(
-                            (v for v in best.properties.values()
-                             if isinstance(v, str)), best.uuid)
-                        updates[p] = label
-                self._apply(col, obj, updates, tenant)
-                job["meta"]["countSucceeded"] += 1
-            except Exception:
-                job["meta"]["countFailed"] += 1
+        self._assign_targets(col, job, unlabeled, candidates, target, idx,
+                             props, tenant)
 
     @staticmethod
     def _apply(col, obj, updates: dict, tenant=None) -> None:

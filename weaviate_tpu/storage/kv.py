@@ -589,9 +589,9 @@ class Bucket:
         payload = msgpack.packb({"k": key, "v": packed}, use_bin_type=True)
         self._wal_bytes_metric.inc(len(payload))
         self._mem.wal.append(payload)
-        self._memtable_metric.set(self._mem.bytes)
         self._mem.apply(self.strategy, key, value)
         self._write_gen += 1
+        self._memtable_metric.set(self._mem.bytes)
         if self._mem.bytes >= self.memtable_limit:
             self._seal()
 
@@ -609,6 +609,7 @@ class Bucket:
             for k, v in pairs:
                 self._mem.apply(self.strategy, k, v)
             self._write_gen += 1
+            self._memtable_metric.set(self._mem.bytes)
             if self._mem.bytes >= self.memtable_limit:
                 self._seal()
             return
