@@ -48,6 +48,21 @@ class Server:
         if maybe_initialize_distributed():
             logger.info("joined multi-host JAX runtime")
 
+        # persistent XLA compilation cache under the data dir: vector-store
+        # capacity growth re-jits the donated scatter/search programs per
+        # pow2 level, which costs seconds each on a cold start — cached
+        # compiles make restarts and re-imports warm (users can point
+        # JAX_COMPILATION_CACHE_DIR elsewhere; respected if set)
+        if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            try:
+                import jax
+
+                cache_dir = os.path.join(cfg.data_path, ".jax_cache")
+                os.makedirs(cache_dir, exist_ok=True)
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+            except Exception as e:  # noqa: BLE001 — cache is best-effort
+                logger.warning("compilation cache disabled: %s", e)
+
         from weaviate_tpu.auth import AuthConfig, AuthStack
         from weaviate_tpu.modules import default_provider
 
