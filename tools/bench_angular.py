@@ -49,7 +49,7 @@ def main():
     def step(off, q_, x_, v_):
         return chunked_topk_distances(
             q_, x_, k=k, chunk_size=chunk, metric="cosine",
-            valid=v_, id_offset=off)
+            valid=v_, id_offset=off, selection="approx")
 
     d, i = step(jnp.int32(0), q_dev, x, valid)
     ids = np.asarray(i)
@@ -57,7 +57,22 @@ def main():
                             for r in range(batch)]))
     log(f"recall@{k} vs exact cosine: {recall:.4f}")
 
-    reps = 10
+    # measure + subtract the tunnel RTT and amortize over 101 reps
+    # (round-2 used reps=10 with no subtraction: ~+11 ms inflation)
+    @jax.jit
+    def _triv(s):
+        return s + 1.0
+
+    np.asarray(_triv(jnp.float32(0)))
+    _rtts = []
+    for _ in range(5):
+        _t0 = time.perf_counter()
+        np.asarray(_triv(jnp.float32(1)))
+        _rtts.append(time.perf_counter() - _t0)
+    rtt_s = float(np.median(_rtts))
+    log(f"tunnel RTT: {rtt_s*1e3:.1f} ms (subtracted)")
+
+    reps = 100
 
     @jax.jit
     def chained(q_, x_, v_):
@@ -72,7 +87,7 @@ def main():
     np.asarray(chained(q_dev, x, valid))
     t0 = time.perf_counter()
     np.asarray(chained(q_dev, x, valid))
-    ms = (time.perf_counter() - t0) / (reps + 1) * 1e3
+    ms = max(time.perf_counter() - t0 - rtt_s, 0.0) / (reps + 1) * 1e3
     log(f"device {ms:.2f} ms/scan -> {batch/(ms/1e3):.0f} qps")
     print(json.dumps({
         "metric": "angular_knn_1M_100d_cosine",
