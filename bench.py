@@ -181,12 +181,18 @@ def main():
     def chained_ms(step_with_offset, arrays, reps=100):
         """step_with_offset(id_offset, *arrays) -> (d, i); ms/scan.
         Arrays pass as jit ARGUMENTS — a closure would capture the corpus
-        as a compile-time constant and ship it through the compile RPC."""
+        as a compile-time constant and ship it through the compile RPC.
+        The carried distances TAINT the next iteration's QUERY (adding a
+        zero derived from them): id_offset alone only feeds the returned
+        ids, so distances would be loop-invariant and XLA could hoist the
+        whole scan out of the timing loop (observed: "scans" above HBM
+        peak bandwidth)."""
         @jax.jit
         def chained(*arrs):
             def body(_i, carry):
-                zero = (carry[0][0, 0] * 0.0).astype(jnp.int32)
-                d_, i_ = step_with_offset(zero, *arrs)
+                zero = carry[0][0, 0] * 0.0
+                tainted = (arrs[0] + zero.astype(arrs[0].dtype),) + arrs[1:]
+                d_, i_ = step_with_offset(zero.astype(jnp.int32), *tainted)
                 return (d_,)
             d0, _ = step_with_offset(jnp.int32(0), *arrs)
             (d_,) = jax.lax.fori_loop(0, reps, body, (d0,))
@@ -194,7 +200,7 @@ def main():
         np.asarray(chained(*arrays))  # compile + warm
         t0 = time.perf_counter()
         np.asarray(chained(*arrays))
-        return max((time.perf_counter() - t0 - rtt_s), 0.0) / (reps + 1) * 1e3
+        return max((time.perf_counter() - t0 - rtt_s), 1e-3) / (reps + 1) * 1e3
 
     def pipelined_ms(fn, reps=12):
         out = fn()

@@ -76,9 +76,12 @@ def main():
 
     @jax.jit
     def chained(q_, x_, v_):
+        # taint the query with the carried distances so the scan cannot
+        # be hoisted out of the timing loop (id_offset alone only feeds
+        # the returned ids)
         def body(_i, carry):
-            zero = (carry[0][0, 0] * 0.0).astype(jnp.int32)
-            d_, _ = step(zero, q_, x_, v_)
+            zero = carry[0][0, 0] * 0.0
+            d_, _ = step(zero.astype(jnp.int32), q_ + zero, x_, v_)
             return (d_,)
         d0, _ = step(jnp.int32(0), q_, x_, v_)
         (d_,) = jax.lax.fori_loop(0, reps, body, (d0,))

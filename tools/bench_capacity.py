@@ -110,11 +110,15 @@ def main():
     log(f"tunnel RTT: {rtt_s*1e3:.1f} ms (subtracted)")
 
     def chained_ms(step_fn, arrays, reps=200):
+        # the carried distances taint the next QUERY: id_offset alone only
+        # feeds ids, leaving distances loop-invariant — XLA then hoists
+        # the scan out of the loop (observed as above-HBM-peak "scans")
         @jax.jit
         def chained(*arrs):
             def body(_i, carry):
-                zero = (carry[0][0, 0] * 0.0).astype(jnp.int32)
-                d_, _ = step_fn(zero, *arrs)
+                zero = carry[0][0, 0] * 0.0
+                tainted = (arrs[0] + zero.astype(arrs[0].dtype),) + arrs[1:]
+                d_, _ = step_fn(zero.astype(jnp.int32), *tainted)
                 return (d_,)
             d0, _ = step_fn(jnp.int32(0), *arrs)
             (d_,) = jax.lax.fori_loop(0, reps, body, (d0,))
