@@ -113,13 +113,114 @@ def test_bq_topk_pallas_path_matches(rng):
     xw, qw = bq_ops.bq_encode(x), bq_ops.bq_encode(q)
     d0, i0 = bq_ops.bq_topk(qw, xw, k=8, chunk_size=128)
     d1, i1 = bq_ops.bq_topk(qw, xw, k=8, chunk_size=128, use_pallas=True)
-    # identical distance multisets; ids may differ where hamming TIES
-    # straddle the k-th boundary (both are valid top-k sets) — so assert
-    # that every returned id really has the reported distance
-    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    # the pallas path routes candidates through approx_max_k (exact on CPU,
+    # 0.95-recall-per-call on real TPU), so require a recall floor plus
+    # self-consistency (every returned id carries its true hamming) rather
+    # than bit-identical sets
     ham = bq_ops.bq_hamming_np(
         np.ascontiguousarray(np.asarray(qw)),
         np.ascontiguousarray(np.asarray(xw)))
+    overlap = 0
     for r in range(i0.shape[0]):
         np.testing.assert_array_equal(
             ham[r, np.asarray(i1)[r]], np.asarray(d1)[r].astype(np.int64))
+        overlap += len(set(np.asarray(i0)[r].tolist())
+                       & set(np.asarray(i1)[r].tolist()))
+    assert overlap >= int(0.75 * i0.shape[0] * 8)
+
+
+def test_bq_scan_reduce_strided_argmin(rng):
+    """v3 scan kernel: packed-merge correctness incl. validity, both
+    orientations (interpret mode — compiled conformance runs in bench)."""
+    from weaviate_tpu.ops import bq as bq_ops
+    from weaviate_tpu.ops.pallas_kernels import bq_scan_reduce
+
+    for (b, n, d, L, tp) in [(8, 2000, 128, 32, False),
+                             (5, 700, 96, 8, True),
+                             (6, 9000, 768, 64, False),
+                             (3, 130, 64, 4, False)]:
+        v = rng.standard_normal((n, d)).astype(np.float32)
+        q = rng.standard_normal((b, d)).astype(np.float32)
+        xw = np.asarray(bq_ops.bq_encode(jnp.asarray(v)))
+        qw = np.asarray(bq_ops.bq_encode(jnp.asarray(q)))
+        valid = rng.random(n) > 0.3
+        xin = jnp.asarray(np.ascontiguousarray(xw.T)) if tp else jnp.asarray(xw)
+        vals, ids = bq_scan_reduce(jnp.asarray(qw), xin,
+                                   valid=jnp.asarray(valid),
+                                   reduce_l=L, interpret=True, transposed=tp)
+        vals, ids = np.asarray(vals), np.asarray(ids)
+        ham = bq_ops.bq_hamming_np(
+            np.ascontiguousarray(qw), np.ascontiguousarray(xw)
+        ).astype(np.float32)
+        ham[:, ~valid] = np.inf
+        for r in range(b):
+            live = vals[r] < 1e20
+            # every surviving candidate self-consistent + global min kept
+            np.testing.assert_array_equal(ham[r, ids[r][live]], vals[r][live])
+            assert ham[r].min() == vals[r][live].min()
+            assert not np.any(~valid[ids[r][live]])
+
+
+def test_bq_topk_twostage_matches_full(rng):
+    from weaviate_tpu.ops import bq as bq_ops
+
+    n, d, b = 20000, 512, 6
+    centers = rng.standard_normal((500, d)).astype(np.float32)
+    v = (centers[rng.integers(0, 500, n)]
+         + 0.3 * rng.standard_normal((n, d))).astype(np.float32)
+    q = (v[rng.integers(0, n, b)]
+         + 0.05 * rng.standard_normal((b, d))).astype(np.float32)
+    xw = bq_ops.bq_encode(jnp.asarray(v))
+    qw = bq_ops.bq_encode(jnp.asarray(q))
+    wp = 4  # 128-bit prefix
+    xp_t = jnp.asarray(np.ascontiguousarray(np.asarray(xw)[:, :wp].T))
+    d_full, i_full = bq_ops.bq_topk(qw, xw, k=10, chunk_size=2000)
+    for use_pallas in (True, False):
+        d2, i2 = bq_ops.bq_topk_twostage(qw, xw, xp_t, k=10, refine=16,
+                                         use_pallas=use_pallas)
+        rec = np.mean([
+            len(set(np.asarray(i_full)[r].tolist())
+                & set(np.asarray(i2)[r].tolist())) / 10
+            for r in range(b)])
+        assert rec >= 0.85, f"two-stage recall {rec} (use_pallas={use_pallas})"
+        # returned distances are true full-width hammings
+        ham = bq_ops.bq_hamming_np(
+            np.ascontiguousarray(np.asarray(qw)),
+            np.ascontiguousarray(np.asarray(xw)))
+        for r in range(b):
+            ii = np.asarray(i2)[r]
+            np.testing.assert_array_equal(
+                ham[r, ii[ii >= 0]],
+                np.asarray(d2)[r][ii >= 0].astype(np.int64))
+
+
+def test_quantized_store_prefix_twostage(rng):
+    from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+    n, d = 6000, 256
+    centers = rng.standard_normal((200, d)).astype(np.float32)
+    v = (centers[rng.integers(0, 200, n)]
+         + 0.35 * rng.standard_normal((n, d))).astype(np.float32)
+    q = (v[rng.integers(0, n, 5)]
+         + 0.05 * rng.standard_normal((5, d))).astype(np.float32)
+    gt = np.argsort(
+        (q ** 2).sum(-1)[:, None] - 2.0 * q @ v.T + (v ** 2).sum(-1)[None, :],
+        axis=1)[:, :10]
+    st = QuantizedVectorStore(dim=d, quantization="bq", prefix_bits=128,
+                              rescore="host", capacity=1024)
+    st.use_pallas = True  # interpret-mode kernels on CPU
+    st.add(v)
+    assert st.prefix_t is not None and st.prefix_t.shape[0] == 4
+    dd, ii = st.search(q, k=10)
+    rec = np.mean([len(set(ii[r]) & set(gt[r])) / 10 for r in range(5)])
+    assert rec >= 0.9
+    # snapshot -> restore keeps the prefix and the results
+    st2 = QuantizedVectorStore.restore(st.snapshot())
+    st2.use_pallas = True
+    assert st2.prefix_t is not None
+    dd2, ii2 = st2.search(q, k=10)
+    np.testing.assert_array_equal(ii, ii2)
+    # a too-wide prefix is refused (would exceed the code width)
+    st3 = QuantizedVectorStore(dim=96, quantization="bq", prefix_bits=128)
+    assert st3.prefix_t is None
+    st3.add(rng.standard_normal((50, 96)).astype(np.float32))  # must not crash

@@ -1,0 +1,179 @@
+"""Round-4 kernel probes: unpack-variant cost + int8 MXU rate.
+
+Measures, with the chained hoist-proof harness (memory: axon-tpu-timing):
+  1. raw bf16 vs int8 matmul rate at the BQ scan shapes
+  2. bq unpack variants: 32-slice-concat (current) vs repeat+iota-shift
+  3. end-to-end bq_topk-shaped scans at 1M x 128 (B=1024) and 1M x 1536 (B=256)
+
+Run on the axon TPU. Prints findings to stdout.
+"""
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def log(*a):
+    print(*a, flush=True)
+
+
+# ---- chained timing -------------------------------------------------------
+@jax.jit
+def _triv(s):
+    return s + 1.0
+
+np.asarray(_triv(jnp.float32(0)))
+_rtts = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    np.asarray(_triv(jnp.float32(1)))
+    _rtts.append(time.perf_counter() - t0)
+RTT = float(np.median(_rtts))
+log(f"tunnel RTT {RTT*1e3:.1f} ms")
+
+
+def chained_ms(fn, arrays, reps=50):
+    """fn(*arrays) -> array; first array gets tainted by carry."""
+    @jax.jit
+    def chained(*arrs):
+        def body(_i, carry):
+            zero = (carry.reshape(-1)[0] * 0)
+            tainted = (arrs[0] + zero.astype(arrs[0].dtype),) + arrs[1:]
+            return fn(*tainted)
+        out0 = fn(*arrs)
+        return jax.lax.fori_loop(0, reps, body, out0)
+    r = np.asarray(jax.block_until_ready(chained(*arrays)))
+    t0 = time.perf_counter()
+    np.asarray(jax.block_until_ready(chained(*arrays)))
+    return max(time.perf_counter() - t0 - RTT, 1e-4) / (reps + 1) * 1e3
+
+
+# ---- 1. raw matmul rates ---------------------------------------------------
+def probe_matmul(b, n, d):
+    key = jax.random.PRNGKey(0)
+    xb = jax.random.normal(key, (n, d), dtype=jnp.bfloat16)
+    qb = jax.random.normal(key, (b, d), dtype=jnp.bfloat16)
+
+    def mm(q_, x_):
+        return jax.lax.dot_general(q_, x_, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32).max()
+
+    ms = chained_ms(mm, (qb, xb), reps=20)
+    tf = 2.0 * b * n * d / (ms / 1e3) / 1e12
+    log(f"bf16 matmul [{b},{d}]x[{n},{d}]: {ms:.2f} ms  {tf:.1f} TFLOP/s")
+
+    xi = (jax.random.normal(key, (n, d)) > 0).astype(jnp.int8)
+    qi = (jax.random.normal(key, (b, d)) > 0).astype(jnp.int8)
+
+    def mmi(q_, x_):
+        return jax.lax.dot_general(q_, x_, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.int32).max()
+
+    try:
+        ms = chained_ms(mmi, (qi, xi), reps=20)
+        tf = 2.0 * b * n * d / (ms / 1e3) / 1e12
+        log(f"int8 matmul [{b},{d}]x[{n},{d}]: {ms:.2f} ms  {tf:.1f} TOP/s")
+    except Exception as e:
+        log(f"int8 matmul failed: {type(e).__name__}: {str(e)[:200]}")
+
+
+# ---- 2. unpack variants in pallas -----------------------------------------
+MASKED = 1e30
+
+
+def _bq_new_kernel(q_ref, x_ref, qpop_ref, xpop_ref, out_ref, *, w, acc):
+    """repeat + iota-shift unpack, then one matmul."""
+    x = x_ref[:]  # [TILE, W] int32
+    rep = pltpu.repeat(x, 32, axis=1)            # [TILE, 32W], lane l -> word l%?  (tile-concat: copy j at lanes [j*W,(j+1)*W))
+    j = jax.lax.broadcasted_iota(jnp.int32, rep.shape, 1) // w
+    bits = (jax.lax.shift_right_logical(rep, j) & 1)
+    if acc == "bf16":
+        bits = bits.astype(jnp.bfloat16)
+        dots = jax.lax.dot_general(q_ref[:], bits, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+    else:
+        bits = bits.astype(jnp.int8)
+        dots = jax.lax.dot_general(q_ref[:], bits, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.int32).astype(jnp.float32)
+    d = qpop_ref[:] + xpop_ref[:] - 2.0 * dots
+    out_ref[:] = d.astype(jnp.bfloat16)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_n", "w", "acc"))
+def bq_new_tiled(q01, x_packed, qpop, xpop, tile_n, w, acc):
+    b = q01.shape[0]
+    n = x_packed.shape[0]
+    return pl.pallas_call(
+        functools.partial(_bq_new_kernel, w=w, acc=acc),
+        grid=(n // tile_n,),
+        in_specs=[
+            pl.BlockSpec((b, 32 * w), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, w), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b, tile_n), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, n), jnp.bfloat16),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * b * n * 32 * w,
+            bytes_accessed=q01.size * (2 if acc == "bf16" else 1) + x_packed.size * 4 + b * n * 2,
+            transcendentals=0,
+        ),
+    )(q01, x_packed, qpop, xpop)
+
+
+def probe_bq(n, d, b, tile_n=512):
+    from weaviate_tpu.ops import bq as bq_ops
+    from weaviate_tpu.ops.pallas_kernels import bq_mxu_block, bq_queries_to_planes
+
+    w = d // 32
+    key = jax.random.PRNGKey(1)
+    xw = jax.random.randint(key, (n, w), 0, (1 << 31) - 1, dtype=jnp.int32)
+    qw = jax.random.randint(key, (b, w), 0, (1 << 31) - 1, dtype=jnp.int32)
+    xpop = jnp.sum(jax.lax.population_count(xw).astype(jnp.int32), axis=1).astype(jnp.float32)
+
+    # current kernel (full block call, no topk)
+    def cur(qw_, xw_, xpop_):
+        return bq_mxu_block(qw_.astype(jnp.uint32), xw_.astype(jnp.uint32),
+                            x_pop=xpop_, tile_n=tile_n, interpret=False).astype(jnp.float32).max()
+
+    ms = chained_ms(cur, (qw, xw, xpop), reps=20)
+    log(f"bq CURRENT  n={n} d={d} b={b}: {ms:.2f} ms")
+
+    q01 = bq_queries_to_planes(qw.astype(jnp.uint32), w)
+    qpop = jnp.sum(q01.astype(jnp.float32), axis=1, keepdims=True)
+
+    for acc in ("bf16", "int8"):
+        q01a = q01 if acc == "bf16" else q01.astype(jnp.int8)
+        def new(q01_, xw_, qpop_, xpop_):
+            return bq_new_tiled(q01_, xw_, qpop_, xpop_[None, :], tile_n, w, acc).astype(jnp.float32).max()
+        try:
+            ms = chained_ms(new, (q01a, xw, qpop, xpop), reps=20)
+            log(f"bq NEW-{acc} n={n} d={d} b={b}: {ms:.2f} ms")
+            # conformance vs numpy on a small slice
+            out = np.asarray(bq_new_tiled(q01a[:, :], xw[:tile_n], qpop, xpop[None, :tile_n], tile_n, w, acc).astype(jnp.float32))
+            ref = bq_ops.bq_hamming_np(np.asarray(qw).astype(np.uint32)[:8],
+                                       np.asarray(xw[:tile_n]).astype(np.uint32))
+            if not np.array_equal(out[:8], ref.astype(np.float32)):
+                log(f"  !! conformance MISMATCH max err {np.abs(out[:8]-ref).max()}")
+            else:
+                log(f"  conformance ok")
+        except Exception as e:
+            log(f"bq NEW-{acc} failed: {type(e).__name__}: {str(e)[:300]}")
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "mm"):
+        probe_matmul(256, 1_048_576, 1536)
+        probe_matmul(1024, 1_048_576, 128)
+    if which in ("all", "bq"):
+        probe_bq(1_048_576, 1536, 256)
+        probe_bq(1_048_576, 128, 1024)

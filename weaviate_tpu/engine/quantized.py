@@ -63,6 +63,13 @@ def _scatter_codes(codes, valid, slots, new_codes, write_mask):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_prefix(prefix_t, slots, new_cols, write_mask):
+    """Donated column scatter into the transposed prefix array [Wp, C]."""
+    tgt = jnp.where(write_mask, slots, prefix_t.shape[1])
+    return prefix_t.at[:, tgt].set(new_cols, mode="drop")
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_rescore(rows, slots, new_rows, write_mask):
     tgt = jnp.where(write_mask, slots, rows.shape[0])
     return rows.at[tgt].set(new_rows.astype(rows.dtype), mode="drop")
@@ -105,6 +112,13 @@ class QuantizedVectorStore:
         mesh=None,
         rescore: str = "host",
         fetch_fn=None,
+        # BQ capacity regime: width (in bits, multiple of 128) of a
+        # separately-stored transposed sign-bit prefix. Searches then run
+        # two-stage (prefix scan -> gathered full-width refine ->
+        # rescore), reading ~prefix_bits/dim of the code bytes in stage 1
+        # (ops/bq.py bq_topk_twostage). Single-device stores only — the
+        # mesh path scans full codes per shard.
+        prefix_bits: int | None = None,
     ):
         if quantization not in ("pq", "bq"):
             raise ValueError(f"unknown quantization {quantization!r}")
@@ -130,6 +144,13 @@ class QuantizedVectorStore:
         )
         self.mesh = mesh
         self.n_shards = 1 if mesh is None else mesh.shape[SHARD_AXIS]
+        self.prefix_words = 0
+        if prefix_bits and quantization == "bq" and mesh is None:
+            wp = max(4, prefix_bits // 32 // 4 * 4)
+            # a prefix at least as wide as the code itself saves nothing
+            # (and would crash the column scatter for dim <= 128)
+            if wp < bq_ops.bq_words(dim):
+                self.prefix_words = wp
         from weaviate_tpu.ops.pallas_kernels import recommended
 
         self.use_pallas = recommended()
@@ -183,6 +204,10 @@ class QuantizedVectorStore:
     def _alloc_codes(self):
         w = self._code_width()
         self.codes = self._zeros((self.capacity, w), self._code_dtype())
+        self.prefix_t = (
+            jnp.zeros((self.prefix_words, self.capacity), jnp.uint32)
+            if self.prefix_words else None
+        )
         if self._valid_np.any():
             self.valid = self._placed(jnp.asarray(self._valid_np))
         else:
@@ -299,6 +324,11 @@ class QuantizedVectorStore:
             self.codes, self.valid = _scatter_codes(
                 self.codes, self.valid, slot_dev,
                 self._placed_replicated(cbuf), mask_dev)
+            if self.prefix_t is not None:
+                self.prefix_t = _scatter_prefix(
+                    self.prefix_t, slot_dev,
+                    jnp.asarray(cbuf[:, :self.prefix_words].T.copy()),
+                    mask_dev)
         else:
             # mask-redirect padding entries like _scatter_codes does —
             # a bare scatter of the zero-padded slot buffer would mark
@@ -332,6 +362,8 @@ class QuantizedVectorStore:
         self.valid = grow_rows(self.valid, pad, self.mesh)
         if self.rescore_rows is not None:
             self.rescore_rows = grow_rows(self.rescore_rows, pad, self.mesh)
+        if self.prefix_t is not None:
+            self.prefix_t = jnp.pad(self.prefix_t, ((0, 0), (0, pad)))
 
     def set_at_prenormalized(self, slots, vectors: np.ndarray):
         """set_at for vectors already normalized at their original insert
@@ -402,6 +434,11 @@ class QuantizedVectorStore:
             return pq_ops.pq_topk(
                 queries_dev, self.codes, cent, k=k_cand, chunk_size=cs,
                 metric=metric, valid=valid,
+            )
+        if self.prefix_t is not None:
+            return bq_ops.bq_topk_twostage(
+                qw, self.codes, self.prefix_t, k=k_cand,
+                refine=max(2, self.rescore_limit // 2), valid=valid,
             )
         return bq_ops.bq_topk(
             qw, self.codes, k=k_cand, chunk_size=cs, valid=valid,
@@ -530,6 +567,7 @@ class QuantizedVectorStore:
                 "pq_centroids": self.pq_centroids,
                 "rescore_limit": self.rescore_limit,
                 "rescore": self.rescore,
+                "prefix_bits": self.prefix_words * 32,
                 "chunk_size": self.chunk_size,
                 "codebook": (
                     None if self.codebook is None
@@ -548,6 +586,8 @@ class QuantizedVectorStore:
     @classmethod
     def restore(cls, snap: dict, mesh=None, **kwargs) -> "QuantizedVectorStore":
         kwargs.setdefault("rescore", snap.get("rescore", "host"))
+        if snap.get("prefix_bits"):
+            kwargs.setdefault("prefix_bits", snap["prefix_bits"])
         store = cls(
             dim=snap["dim"],
             metric=snap["metric"],

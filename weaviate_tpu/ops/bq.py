@@ -44,61 +44,84 @@ def bq_encode(vectors: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(bits << shifts, axis=-1, dtype=jnp.uint32)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "chunk_size", "use_pallas"))
+def _auto_reduce_l(n: int) -> int:
+    """Strided-reduction factor: keep >= ~16k candidate slots so the
+    birthday-bound top-k loss stays negligible, cap at the kernel's 64."""
+    l = max(1, min(n // 16384, 64))
+    return 1 << (l.bit_length() - 1)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk_size", "use_pallas",
+                                             "reduce_l"))
 def bq_topk(
     q_words: jnp.ndarray,
     x_words: jnp.ndarray,
     k: int,
-    chunk_size: int,
+    chunk_size: int = 0,
     valid: jnp.ndarray | None = None,
     id_offset: jnp.ndarray | int = 0,
     use_pallas: bool = False,
+    reduce_l: int | None = None,
 ):
     """Hamming top-k over packed words: q [B, w] uint32, x [N, w] uint32.
 
-    XOR + popcount + reduce on the VPU, chunk-scanned like the float path.
+    ``use_pallas`` takes the fused scan kernel (pallas_kernels.
+    bq_scan_reduce: ±64-int8 MXU matmul + in-kernel strided block-argmin,
+    then one approx_max_k over the N/L survivors). The fallback is a plain
+    XLA XOR+popcount pass (small corpora / CPU tests). ``chunk_size`` is
+    accepted for API compatibility; the fused kernel supertiles
+    internally.
     """
     from weaviate_tpu.ops.distances import MASKED_DISTANCE
-    from weaviate_tpu.ops.topk import approx_topk_smallest, topk_smallest
+    from weaviate_tpu.ops.topk import topk_smallest
 
     n, w = x_words.shape
-    assert n % chunk_size == 0, f"{n} rows not a multiple of {chunk_size}"
-    num_chunks = n // chunk_size
     b = q_words.shape[0]
 
+    if use_pallas:
+        from weaviate_tpu.ops.pallas_kernels import bq_scan_reduce
+
+        rl = reduce_l if reduce_l is not None else _auto_reduce_l(n)
+        vals, ids = bq_scan_reduce(q_words, x_words, valid=valid,
+                                   reduce_l=rl)
+        ncand = vals.shape[1]
+        kk = min(k, ncand)
+        if ncand > 4 * kk:
+            negd, pos = jax.lax.approx_max_k(-vals, min(4 * kk, ncand),
+                                             recall_target=0.95)
+            vals = -negd
+            ids = jnp.take_along_axis(ids, pos, axis=1)
+        fd, fi = topk_smallest(vals, ids, kk)
+        if kk < k:
+            fd = jnp.pad(fd, ((0, 0), (0, k - kk)),
+                         constant_values=MASKED_DISTANCE)
+            fi = jnp.pad(fi, ((0, 0), (0, k - kk)), constant_values=-1)
+        fi = jnp.where(fd >= MASKED_DISTANCE * 0.5, -1, fi + id_offset)
+        return fd, fi
+
+    # XLA fallback: chunked XOR+popcount pass; pad odd sizes with dead rows
+    # so peak memory stays O(B * chunk)
+    chunk_size = min(chunk_size or 8192, n)
+    if n % chunk_size:
+        pad = chunk_size - n % chunk_size
+        x_words = jnp.pad(x_words, ((0, pad), (0, 0)))
+        valid = ((jnp.arange(n + pad) < n) if valid is None
+                 else jnp.pad(valid.astype(bool), (0, pad)))
+        n += pad
+    num_chunks = n // chunk_size
     x_chunks = x_words.reshape(num_chunks, chunk_size, w)
     valid_chunks = None if valid is None else valid.reshape(num_chunks, chunk_size)
 
     init_d = jnp.full((b, k), MASKED_DISTANCE, dtype=jnp.float32)
     init_i = jnp.full((b, k), -1, dtype=jnp.int32)
 
-    if use_pallas:
-        # hoist the loop-invariant query unpack out of the scan body —
-        # XLA does not lift computation out of while-loop bodies
-        from weaviate_tpu.ops.pallas_kernels import (_SUBLANE, _pad_to,
-                                                     bq_queries_to_planes)
-
-        pb = _pad_to(max(b, 1), _SUBLANE)
-        q_padded = jnp.pad(q_words, ((0, pb - b), (0, 0))) if pb != b else q_words
-        q_planes = bq_queries_to_planes(q_padded, w)
-        q_pop = jnp.sum(q_planes.astype(jnp.float32), axis=1, keepdims=True)
-
     def body(carry, inp):
         best_d, best_i = carry
         chunk_idx, xc, vc = inp
-        if use_pallas:
-            # MXU path: unpack-in-VMEM + bf16 matmul (pallas_kernels
-            # bq_mxu_block) — the VPU popcount kernel loses to the MXU by
-            # ~2 orders of magnitude on TPU
-            from weaviate_tpu.ops.pallas_kernels import bq_mxu_block
-
-            d = bq_mxu_block(q_words, xc, valid=None, interpret=None,
-                             q_planes=q_planes, q_pop=q_pop)
-        else:
-            x_or = jax.lax.bitwise_xor(q_words[:, None, :], xc[None, :, :])
-            d = jnp.sum(
-                jax.lax.population_count(x_or), axis=-1, dtype=jnp.int32
-            ).astype(jnp.float32)
+        x_or = jax.lax.bitwise_xor(q_words[:, None, :], xc[None, :, :])
+        d = jnp.sum(
+            jax.lax.population_count(x_or), axis=-1, dtype=jnp.int32
+        ).astype(jnp.float32)
         if vc is not None:
             d = jnp.where(vc[None, :], d, MASKED_DISTANCE)
         ids = (
@@ -107,16 +130,12 @@ def bq_topk(
             + jax.lax.broadcasted_iota(jnp.int32, (1, chunk_size), 1)
         )
         ids = jnp.broadcast_to(ids, (b, chunk_size))
-        # two-stage: approx-select within THIS chunk only (one 0.95-recall
-        # invocation per candidate), then EXACT merge of the tiny carried
-        # set — carried winners can never be dropped by the approx op
-        ck_d, ck_i = approx_topk_smallest(d, ids, min(k, chunk_size))
-        ck_d = ck_d.astype(jnp.float32)  # bf16 kernel output -> f32 merge
         new_d, new_i = topk_smallest(
-            jnp.concatenate([best_d, ck_d], axis=1),
-            jnp.concatenate([best_i, ck_i], axis=1),
+            jnp.concatenate([best_d, d], axis=1),
+            jnp.concatenate([best_i, ids], axis=1),
             k,
         )
+        new_i = jnp.where(new_d >= MASKED_DISTANCE, -1, new_i)
         return (new_d, new_i), None
 
     chunk_ids = jnp.arange(num_chunks, dtype=jnp.int32)
@@ -130,6 +149,69 @@ def bq_topk(
         (fd, fi), _ = jax.lax.scan(
             body, (init_d, init_i), (chunk_ids, x_chunks, valid_chunks)
         )
+    return fd, fi
+
+
+@functools.partial(jax.jit, static_argnames=("k", "refine", "use_pallas"))
+def bq_topk_twostage(
+    q_words: jnp.ndarray,
+    x_words: jnp.ndarray,
+    x_prefix_t: jnp.ndarray,
+    k: int,
+    refine: int = 8,
+    valid: jnp.ndarray | None = None,
+    id_offset: jnp.ndarray | int = 0,
+    use_pallas: bool = True,
+):
+    """Two-stage BQ scan for the capacity regime.
+
+    Stage 1 scans a CONTIGUOUS transposed prefix array ``x_prefix_t``
+    [Wp, N] (the first 32*Wp sign bits of every row, stored separately so
+    the scan reads Wp/W of the bytes — column-slicing the full row-major
+    code array would still fetch whole HBM lines) and keeps refine*k
+    candidates per query. Stage 2 gathers the candidates' FULL rows from
+    the row-major ``x_words`` [N, W] (contiguous row gathers) and scores
+    exact hamming with one XOR+popcount over [B, R, W]. Exact top-k of
+    stage 2 follows; the only approximation is stage-1 candidate recall
+    (tunable via ``refine`` and the prefix width).
+    """
+    from weaviate_tpu.ops.distances import MASKED_DISTANCE
+    from weaviate_tpu.ops.topk import topk_smallest
+
+    n, w = x_words.shape
+    wp = x_prefix_t.shape[0]
+    b = q_words.shape[0]
+
+    if use_pallas:
+        from weaviate_tpu.ops.pallas_kernels import bq_scan_reduce
+
+        vals1, ids1 = bq_scan_reduce(
+            q_words[:, :wp], x_prefix_t, valid=valid,
+            reduce_l=_auto_reduce_l(n), transposed=True)
+        r = min(refine * k, vals1.shape[1])
+        negd, pos = jax.lax.approx_max_k(-vals1, r, recall_target=0.95)
+        cand_d1 = -negd
+        cand = jnp.take_along_axis(ids1, pos, axis=1)  # [B, R] global rows
+    else:
+        # fallback top-k already returns the pruned candidate set, sorted
+        cand_d1, ids1 = bq_topk(q_words[:, :wp], x_prefix_t.T,
+                                k=min(refine * k, n), valid=valid,
+                                use_pallas=False)
+        cand = jnp.where(ids1 < 0, 0, ids1)
+        r = cand.shape[1]
+    # stage 2: full-width exact hamming on the gathered candidates
+    xg = x_words[jnp.clip(cand, 0, n - 1)]         # [B, R, W]
+    x_or = jax.lax.bitwise_xor(q_words[:, None, :], xg)
+    ham = jnp.sum(jax.lax.population_count(x_or), axis=-1,
+                  dtype=jnp.int32).astype(jnp.float32)
+    ham = jnp.where(cand_d1 >= MASKED_DISTANCE * 0.5, MASKED_DISTANCE, ham)
+    kk = min(k, r)
+    fd, fi = topk_smallest(ham, cand, kk)
+    if kk < k:
+        fd = jnp.pad(fd, ((0, 0), (0, k - kk)),
+                     constant_values=MASKED_DISTANCE)
+        fi = jnp.pad(fi, ((0, 0), (0, k - kk)), constant_values=-1)
+    fi = jnp.where(fd >= MASKED_DISTANCE * 0.5, -1, fi + id_offset)
     return fd, fi
 
 
