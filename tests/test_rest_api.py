@@ -41,7 +41,7 @@ def test_schema_crud(client):
         {"name": "wordCount", "data_type": "int"},
     ]})
     schema = client.get_schema()
-    assert [c["name"] for c in schema["classes"]] == ["Article"]
+    assert [c["class"] for c in schema["classes"]] == ["Article"]
     cls = client.get_class("Article")
     assert {p["name"] for p in cls["properties"]} == {"title", "wordCount"}
     # weaviate-style property payload
@@ -219,8 +219,8 @@ def test_schema_mixed_property_styles(client):
         {"name": "n", "data_type": "int"},
     ]})
     props = {p["name"]: p for p in client.get_class("Mixed")["properties"]}
-    assert props["n"]["data_type"] == "int"
-    assert props["a"]["index_searchable"] is False
+    assert props["n"]["dataType"] == ["int"]
+    assert props["a"]["indexSearchable"] is False
 
 
 def test_config_from_json_reference_shape():
@@ -338,7 +338,7 @@ def test_update_class_config(client):
         "invertedIndexConfig": {"bm25": {"k1": 1.5, "b": 0.5}},
     })
     assert out["description"] == "updated"
-    assert out["inverted"]["bm25_k1"] == 1.5
+    assert out["invertedIndexConfig"]["bm25"]["k1"] == 1.5
     # immutable: vectorizer change rejected
     from weaviate_tpu.api.client import RestError
     with pytest.raises(RestError) as e:

@@ -448,3 +448,35 @@ def test_sort_composes_with_search(articles):
     ds = [a["_additional"]["distance"]
           for a in out2["data"]["Get"]["Article"]]
     assert ds == sorted(ds)
+
+
+def test_geo_grid_sublinear_and_exact():
+    """GeoGrid prunes to the cells intersecting the circle and agrees with
+    the exhaustive haversine scan, incl. date-line wrap and pole bands."""
+    import numpy as np
+
+    from weaviate_tpu.filters.filters import _geo_distance_m
+    from weaviate_tpu.text.inverted import GeoGrid
+
+    rng = np.random.default_rng(3)
+    n = 20000
+    lats = rng.uniform(-90, 90, n)
+    lons = rng.uniform(-180, 180, n)
+    ids = np.arange(n, dtype=np.int64)
+    grid = GeoGrid(ids, lats, lons)
+    cases = [
+        (48.2, 16.37, 600_000),       # mid-latitude, selective
+        (0.0, 179.9, 500_000),        # date-line wrap
+        (89.5, 10.0, 300_000),        # near-pole (lon span -> all)
+        (-33.9, 151.2, 2_000_000),    # large radius
+    ]
+    for clat, clon, max_m in cases:
+        pos = grid.candidate_positions(clat, clon, max_m)
+        d_cand = _geo_distance_m(clat, clon, grid.lats[pos], grid.lons[pos])
+        got = set(grid.ids[pos][d_cand <= max_m].tolist())
+        d_all = _geo_distance_m(clat, clon, lats, lons)
+        want = set(ids[d_all <= max_m].tolist())
+        assert got == want, (clat, clon, max_m)
+        # selective radii must touch far fewer rows than the corpus
+        if max_m <= 600_000:
+            assert len(pos) < n * 0.05

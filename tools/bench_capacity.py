@@ -154,7 +154,73 @@ def main():
             "qps": round(b / (ms / 1e3)),
         }
         log(f"BQ 10M x 1536 b={b}: {ms:.2f} ms/scan -> {b/(ms/1e3):.0f} qps")
+
+    # --- two-stage prefix scan at the same scale ----------------------------
+    # stage 1 reads only the 256-bit transposed prefix (16.7% of the bytes,
+    # 1/6 of the stage-1 matmul FLOPs); stage 2 gathers refine*k full rows
+    # and scores exact hamming. Scan cost is value-independent, so random
+    # codes time it honestly; the RECALL cost of the prefix is measured on
+    # clustered data in the 1M x 768 block below.
+    for wp_bits in (128, 256):
+        wp = wp_bits // 32
+        xp_t = jnp.transpose(xw[:, :wp])
+        for b in (64, 256):
+            qw = jax.lax.bitcast_convert_type(
+                jax.random.randint(jax.random.PRNGKey(1), (b, w),
+                                   -2**31, 2**31 - 1, dtype=jnp.int32),
+                jnp.uint32)
+            ms = chained_ms(
+                lambda off, q_, x_, xp_: bq_ops.bq_topk_twostage(
+                    q_, x_, xp_, k=100, refine=8, id_offset=off),
+                (qw, xw, xp_t))
+            out[f"bq2stage{wp_bits}_10M_1536d_b{b}"] = {
+                "device_batch_ms": round(ms, 2),
+                "qps": round(b / (ms / 1e3)),
+            }
+            log(f"BQ 2-stage/{wp_bits} 10M x 1536 b={b}: {ms:.2f} ms/scan "
+                f"-> {b/(ms/1e3):.0f} qps")
+        del xp_t
     del xw
+
+    # --- two-stage recall on CLUSTERED 1M x 768 (all on-device) ------------
+    # generated on-device (host transfer through the tunnel would dominate);
+    # ground truth from the exact bf16 flat scan; end-to-end = stage1 prefix
+    # -> stage2 full-hamming -> exact bf16 rescore of 100 candidates.
+    from weaviate_tpu.ops.topk import chunked_topk_distances
+
+    n1, d1 = 8 * chunk, 768
+    kc, kq = jax.random.split(jax.random.PRNGKey(3))
+    centers = jax.random.normal(kc, (65536, d1), dtype=jnp.float32)
+    assign = jax.random.randint(kc, (n1,), 0, 65536)
+    v = centers[assign] + 0.35 * jax.random.normal(kq, (n1, d1))
+    qi = jax.random.randint(kq, (256,), 0, n1)
+    q = v[qi] + 0.05 * jax.random.normal(kc, (256, d1))
+    v_bf = v.astype(jnp.bfloat16)
+    gt_d, gt_i = chunked_topk_distances(q, v_bf, k=10, chunk_size=chunk,
+                                        selection="approx")
+    xw1 = bq_ops.bq_encode(v)
+    qw1 = bq_ops.bq_encode(q)
+    def rescored(ids):
+        rows = v_bf[jnp.clip(ids, 0, n1 - 1)].astype(jnp.float32)
+        dd = jnp.sum((q[:, None, :] - rows) ** 2, axis=-1)
+        dd = jnp.where(ids >= 0, dd, 3e38)
+        kk, pos = jax.lax.top_k(-dd, 10)
+        return jnp.take_along_axis(ids, pos, axis=1)
+    gt_np = np.asarray(gt_i)
+    full_d, full_i = bq_ops.bq_topk(qw1, xw1, k=100, use_pallas=True)
+    r_full = np.mean([len(set(np.asarray(rescored(full_i))[r]) & set(gt_np[r])) / 10
+                      for r in range(256)])
+    recalls = {"bq_full_rescored": round(float(r_full), 4)}
+    for wp_bits in (128, 256):
+        wp = wp_bits // 32
+        xp1 = jnp.transpose(xw1[:, :wp])
+        d2, i2 = bq_ops.bq_topk_twostage(qw1, xw1, xp1, k=100, refine=8)
+        r2 = np.mean([len(set(np.asarray(rescored(i2))[r]) & set(gt_np[r])) / 10
+                      for r in range(256)])
+        recalls[f"bq2stage{wp_bits}_rescored"] = round(float(r2), 4)
+    out["recall_clustered_1M_768d_at10"] = recalls
+    log(f"clustered 1M x 768 recall@10 (vs exact bf16 scan): {recalls}")
+    del v, v_bf, centers, xw1
 
     # --- PQ4 over 10M x 768 (m=192 codes/row) -------------------------------
     n, d = 10 * chunk * 8, 768

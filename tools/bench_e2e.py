@@ -40,11 +40,16 @@ def main():
         help="REST address of a running server; requires --grpc-port")
     ap.add_argument("--grpc-port", type=int, default=0)
     ap.add_argument(
-        "--concurrency", type=int, default=32,
+        "--concurrency", type=str, default="32",
         help="closed-loop concurrent gRPC streams for the served-load "
-             "measurement (0 disables; VERDICT r2 item 6)")
+             "measurement (0 disables; comma list sweeps a QPS-vs-streams "
+             "curve, e.g. 32,64,128,256)")
     ap.add_argument("--load-queries", type=int, default=1024,
                     help="total queries across the concurrent streams")
+    ap.add_argument("--null-device", action="store_true",
+                    help="replace the device batch fn with a constant-time "
+                         "stub to isolate the serving-fabric latency "
+                         "(co-located p50 = fabric p50 + device ms)")
     args = ap.parse_args()
     if args.url and not args.grpc_port:
         ap.error("--url mode also needs --grpc-port (queries run over "
@@ -177,7 +182,27 @@ def main():
     # batcher stats report achieved batch sizes. Reference serving claim:
     # README.md:34 / benchmark_sift.go:38-57.
     served = {}
-    if args.concurrency > 0:
+    # --null-device: swap every live query batcher's batch_fn for a
+    # constant-time stub. What remains is the serving FABRIC — gRPC
+    # parse, batcher queueing, coalescing, reply build — i.e. the part
+    # of p50 that is NOT the device or the dev tunnel. Co-located-TPU
+    # p50 ~= fabric p50 + the chained device ms from bench.py.
+    if args.null_device and server is not None:
+        import numpy as _np
+
+        def _null_batch(queries, k, allow=None):
+            b = len(queries)
+            return (_np.zeros((b, k), dtype=_np.int64),
+                    _np.zeros((b, k), dtype=_np.float32))
+
+        query(queries[0])  # force batcher construction
+        for col in server.db.collections.values():
+            for shard in col.shards.values():
+                for b_ in shard._query_batchers.values():
+                    b_._batch_fn = _null_batch
+    stream_counts = [int(c) for c in str(args.concurrency).split(",")
+                     if int(c) > 0]
+    for n_streams in stream_counts:
         import threading
 
         qpool = rng.standard_normal(
@@ -207,7 +232,7 @@ def main():
                     batchers.extend(shard._query_batchers.values())
         before = [(b.dispatches, b.batched_queries) for b in batchers]
         threads = [threading.Thread(target=worker)
-                   for _ in range(args.concurrency)]
+                   for _ in range(n_streams)]
         t0 = time.perf_counter()
         for t in threads:
             t.start()
@@ -215,8 +240,8 @@ def main():
             t.join()
         wall = time.perf_counter() - t0
         ll = np.asarray(load_lat) if load_lat else np.asarray([0.0])
-        served = {
-            "streams": args.concurrency,
+        point = {
+            "streams": n_streams,
             "served_qps": round(args.load_queries / wall, 1),
             "p50_ms": round(float(np.percentile(ll, 50)) * 1e3, 2),
             "p95_ms": round(float(np.percentile(ll, 95)) * 1e3, 2),
@@ -231,12 +256,15 @@ def main():
             bq = sum(b.batched_queries for b in batchers) - sum(
                 q for _, q in before)
             if disp:
-                served["dispatches"] = disp
-                served["avg_batch"] = round(bq / disp, 2)
-        log(f"served load ({args.concurrency} streams): "
-            f"{served['served_qps']} qps, p50 {served['p50_ms']} ms, "
-            f"p95 {served['p95_ms']} ms, avg batch "
-            f"{served.get('avg_batch', 'n/a')}")
+                point["dispatches"] = disp
+                point["avg_batch"] = round(bq / disp, 2)
+        log(f"served load ({n_streams} streams): "
+            f"{point['served_qps']} qps, p50 {point['p50_ms']} ms, "
+            f"p95 {point['p95_ms']} ms, avg batch "
+            f"{point.get('avg_batch', 'n/a')}")
+        served = point if len(stream_counts) == 1 else {
+            **({} if not isinstance(served, dict) else served),
+            str(n_streams): point}
 
     print(json.dumps({
         "metric": "e2e_server_knn",

@@ -276,6 +276,22 @@ class GrpcServer:
         self._server = grpc.server(ThreadPoolExecutor(max_workers=self._max_workers))
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, method_handlers),))
+        # grpc.health.v1.Health/Check — the official v4 client health-checks
+        # the channel during connect() and refuses the server without it
+        # (reference wires grpc-health-probe the same way). The wire format
+        # is tiny (HealthCheckResponse{status: SERVING} = 0x08 0x01), so the
+        # handler is hand-rolled rather than depending on
+        # grpcio-health-checking (not in the image).
+        health_handlers = {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: b"\x08\x01",
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(
+                "grpc.health.v1.Health", health_handlers),))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.host = host
 
@@ -422,6 +438,10 @@ class GrpcServer:
         reply = pb.SearchReply()
         meta_req = req.metadata if req.HasField("metadata") else None
         props_req = req.properties if req.HasField("properties") else None
+        # pre-1.23 clients set neither api flag and read the deprecated
+        # Struct field (search_get.proto:272); modern clients
+        # (uses_123_api / uses_125_api) read the typed non_ref_props
+        legacy_props = not (req.uses_123_api or req.uses_125_api)
         generative = req.generative if req.HasField("generative") else None
         rerank = req.rerank if req.HasField("rerank") else None
 
@@ -438,12 +458,12 @@ class GrpcServer:
                     continue
                 out = reply.results.add()
                 self._fill_result(col, out, r.object, r, meta_req, props_req,
-                                  dtype_of)
+                                  dtype_of, legacy_props=legacy_props)
         else:
             for obj in fetched_objects:
                 out = reply.results.add()
                 self._fill_result(col, out, obj, None, meta_req, props_req,
-                                  dtype_of)
+                                  dtype_of, legacy_props=legacy_props)
 
         if generative is not None:
             self._generate(col, reply, generative)
@@ -528,7 +548,8 @@ class GrpcServer:
     # -- result marshalling --------------------------------------------------
 
     def _fill_result(self, col, out: "pb.SearchResult", obj, res,
-                     meta_req, props_req, dtype_of=None):
+                     meta_req, props_req, dtype_of=None,
+                     legacy_props=False):
         md = out.metadata
         if meta_req is None or meta_req.uuid:
             md.id = obj.uuid
@@ -575,6 +596,13 @@ class GrpcServer:
             if dtype == DataType.REFERENCE:
                 continue
             props.non_ref_props.fields[key].CopyFrom(_to_value(val, dtype))
+            if legacy_props and dtype != DataType.GEO:
+                try:
+                    # Struct.update merges key-by-key (ParseDict would
+                    # clear previously-written keys)
+                    props.non_ref_properties.update({key: val})
+                except Exception:  # noqa: BLE001 - non-Struct-able value
+                    pass
         props.target_collection = col.config.name
 
     def _group_results(self, col, reply, results, group_by,

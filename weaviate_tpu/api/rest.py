@@ -19,6 +19,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from weaviate_tpu import __version__ as VERSION
+
+# Weaviate API level implemented (reference openapi-specs/schema.json)
+API_VERSION = "1.25.2"
 from weaviate_tpu.db.shard import ShardReadOnlyError
 from weaviate_tpu.filters.filters import Filter
 from weaviate_tpu.schema.config import CollectionConfig, Property
@@ -33,7 +36,7 @@ class ApiError(Exception):
         self.message = message
 
 
-def object_to_json(class_name: str, obj) -> dict:
+def object_to_json(class_name: str, obj, tenant: str | None = None) -> dict:
     out = {
         "class": class_name,
         "id": obj.uuid,
@@ -41,6 +44,8 @@ def object_to_json(class_name: str, obj) -> dict:
         "creationTimeUnix": obj.creation_time_ms,
         "lastUpdateTimeUnix": obj.last_update_time_ms,
     }
+    if tenant:
+        out["tenant"] = tenant
     if obj.vector is not None:
         out["vector"] = np.asarray(obj.vector).tolist()
     named = {k: np.asarray(v).tolist() for k, v in obj.vectors.items() if k}
@@ -99,6 +104,98 @@ def _index_config_from_json(index_type: str | None, d: dict | None):
     if bq.get("enabled"):
         out.quantization = "bq"
         out.rescore_limit = bq.get("rescoreLimit", out.rescore_limit)
+    return out
+
+
+def class_to_wire(cfg: CollectionConfig) -> dict:
+    """Serialize a collection config as the reference's models.Class JSON
+    (openapi-specs/schema.json "Class") — the shape the official client's
+    _CollectionConfig parser and every external weaviate tool expect.
+    The internal snake_case dict (``cfg.to_dict()``) stays for
+    persistence and the intra-cluster API; the PUBLIC wire speaks
+    camelCase."""
+    def _prop(p) -> dict:
+        out = {
+            "name": p.name,
+            "dataType": [p.data_type],
+            "description": p.description,
+            "indexFilterable": p.index_filterable,
+            "indexSearchable": p.index_searchable,
+            "tokenization": p.tokenization,
+        }
+        if p.nested:
+            out["nestedProperties"] = [_prop(np_) for np_ in p.nested]
+        return out
+
+    def _index_cfg(ix) -> dict:
+        out = {
+            "distance": ix.metric,
+            "ef": ix.ef,
+            "efConstruction": ix.ef_construction,
+            "maxConnections": ix.max_connections,
+            "pq": {"enabled": ix.quantization == "pq",
+                   "segments": ix.pq_segments or 0,
+                   "centroids": ix.pq_centroids},
+            "bq": {"enabled": ix.quantization == "bq",
+                   "rescoreLimit": ix.rescore_limit},
+        }
+        if ix.index_type == "dynamic":
+            out["threshold"] = ix.flat_to_ann_threshold
+        return out
+
+    inv = cfg.inverted
+    default = None
+    named = {}
+    for v in cfg.vectors:
+        if v.name == "":
+            default = v
+        else:
+            named[v.name] = v
+    if default is None and not named:
+        from weaviate_tpu.schema.config import VectorConfig
+
+        default = VectorConfig()
+    out = {
+        "class": cfg.name,
+        "description": cfg.description,
+        "properties": [_prop(p) for p in cfg.properties],
+        "invertedIndexConfig": {
+            "bm25": {"k1": inv.bm25_k1, "b": inv.bm25_b},
+            "stopwords": {"preset": inv.stopwords_preset,
+                          "additions": inv.stopwords_additions,
+                          "removals": inv.stopwords_removals},
+            "indexTimestamps": inv.index_timestamps,
+            "indexNullState": inv.index_null_state,
+            "indexPropertyLength": inv.index_property_length,
+            "cleanupIntervalSeconds": 60,
+        },
+        "multiTenancyConfig": {
+            "enabled": cfg.multi_tenancy.enabled,
+            "autoTenantCreation": cfg.multi_tenancy.auto_tenant_creation,
+            "autoTenantActivation": cfg.multi_tenancy.auto_tenant_activation,
+        },
+        "replicationConfig": {
+            "factor": cfg.replication.factor,
+            "asyncEnabled": cfg.replication.async_enabled,
+        },
+        "shardingConfig": {
+            "desiredCount": cfg.sharding.desired_count,
+            "virtualPerPhysical": cfg.sharding.virtual_per_physical,
+        },
+        "moduleConfig": cfg.module_config,
+    }
+    if default is not None:
+        out["vectorizer"] = default.vectorizer
+        out["vectorIndexType"] = default.index.index_type
+        out["vectorIndexConfig"] = _index_cfg(default.index)
+    if named:
+        out["vectorConfig"] = {
+            name: {
+                "vectorizer": {v.vectorizer: v.module_config or {}},
+                "vectorIndexType": v.index.index_type,
+                "vectorIndexConfig": _index_cfg(v.index),
+            } for name, v in named.items()
+        }
     return out
 
 
@@ -344,7 +441,11 @@ class RestServer:
 
     def dispatch(self, method: str, path: str, params: dict, body):
         seg = [s for s in path.split("/") if s]
-        # /.well-known/*  (configure_api.go wires ready/live/openid)
+        # /.well-known/* — the reference serves these under the /v1
+        # basePath (swagger basePath /v1; the official client probes
+        # /v1/.well-known/...), and bare-root works too; accept both.
+        if seg[:2] == ["v1", ".well-known"]:
+            seg = seg[1:]
         if seg[:1] == [".well-known"]:
             if seg[1:] == ["ready"] or seg[1:] == ["live"]:
                 return 200, {}
@@ -360,7 +461,14 @@ class RestServer:
         seg = seg[1:]
 
         if seg == ["meta"]:
-            return 200, {"version": VERSION, "hostname": self.address,
+            # `version` carries the WEAVIATE API level this server speaks
+            # (the reference pins 1.25.2, openapi-specs/schema.json) — the
+            # official v4 client parses it as semver and refuses anything
+            # below 1.23.7. The implementation's own version rides in a
+            # separate field.
+            return 200, {"version": API_VERSION, "hostname": self.address,
+                         "tpuServerVersion": VERSION,
+                         "grpcMaxMessageSize": 104858000,
                          "modules": self.modules.meta()
                          if self.modules is not None else {}}
         if seg == ["metrics"]:
@@ -731,7 +839,7 @@ class RestServer:
         if not seg:
             if method == "GET":
                 return 200, {"classes": [
-                    self.db.get_collection(n).config.to_dict()
+                    class_to_wire(self.db.get_collection(n).config)
                     for n in self.db.list_collections()]}
             if method == "POST":
                 from weaviate_tpu.api.validation import (SCHEMA_CLASS,
@@ -740,11 +848,11 @@ class RestServer:
                 validate_body(SCHEMA_CLASS, body or {}, "class")
                 cfg = config_from_json(body or {})
                 self.schema_target.create_collection(cfg)
-                return 200, cfg.to_dict()
+                return 200, class_to_wire(cfg)
         elif len(seg) == 1:
             name = seg[0]
             if method == "GET":
-                return 200, self.db.get_collection(name).config.to_dict()
+                return 200, class_to_wire(self.db.get_collection(name).config)
             if method == "PUT":
                 # update mutable class config (reference: PUT /v1/schema/{c}).
                 # PARTIAL update semantics: only sections present in the
@@ -776,7 +884,7 @@ class RestServer:
                                         "vectorConfig", "vectors")):
                     merged.vectors = parsed.vectors
                 self.schema_target.update_collection(merged)
-                return 200, self.db.get_collection(name).config.to_dict()
+                return 200, class_to_wire(self.db.get_collection(name).config)
             if method == "DELETE":
                 self.schema_target.delete_collection(name)
                 return 200, None
@@ -903,7 +1011,7 @@ class RestServer:
                                      consistency=consistency)
                 if obj is None:
                     raise ApiError(404, f"object {uuid} not found")
-                return 200, object_to_json(class_name, obj)
+                return 200, object_to_json(class_name, obj, tenant=tenant)
             if method in ("PUT", "PATCH"):
                 body = dict(body or {})
                 body.setdefault("class", class_name)
@@ -944,8 +1052,9 @@ class RestServer:
             tenant=tenant or body.get("tenant"),
             creation_time_ms=int(body.get("creationTimeUnix") or 0),
         )
-        obj = col.get_object(uuid, tenant=tenant or body.get("tenant"))
-        return 200, object_to_json(class_name, obj)
+        eff_tenant = tenant or body.get("tenant")
+        obj = col.get_object(uuid, tenant=eff_tenant)
+        return 200, object_to_json(class_name, obj, tenant=eff_tenant)
 
     def _list_objects(self, params: dict):
         class_name = params.get("class")
@@ -967,7 +1076,9 @@ class RestServer:
                                  where=where, tenant=params.get("tenant"),
                                  after=params.get("after"))
         return 200, {
-            "objects": [object_to_json(class_name, o) for o in objs],
+            "objects": [object_to_json(class_name, o,
+                                       tenant=params.get("tenant"))
+                        for o in objs],
             "totalResults": len(objs),
         }
 

@@ -129,6 +129,194 @@ def _owner_dists(owner: np.ndarray, cands: np.ndarray, metric: str):
 
 
 _HOST_KNN_MAX = 32768
+_SELECT_DISPATCH_ROWS = 65536  # owners per host-level device dispatch
+
+
+def _device_backend() -> bool:
+    """Device link pipeline pays off on a real accelerator; on CPU the
+    gather-heavy selects lose to host BLAS."""
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _device_select_dispatch(xd, cand, owner_start, budget, metric, qb=1024):
+    """Diversity-select on DEVICE for one dispatch of owners.
+
+    xd [n, d] layer vectors (device-resident), cand [S, C] candidate
+    positions (-1 padded, device), owners are rows owner_start..+S of xd.
+    Returns [S, budget] selected positions (-1 padded), device array.
+
+    Same semantics as ``_batched_heuristic`` (dominated-mask loop +
+    nearest-first backfill), but batched on the chip: the pairwise
+    candidate matrices are MXU matmuls and the budget-step loop is a
+    ``lax.fori_loop`` over [B, C] masks. Owners are processed in
+    ``lax.map`` blocks inside ONE jit per dispatch — per-block host round
+    trips would pay a tunnel RTT each, and >200k-row gather-heavy single
+    programs crash the TPU worker (hence dispatch-level slicing; the
+    jitted program is module-level so every dispatch after the first
+    reuses the same trace, with ``start`` as a traced argument).
+    """
+    import jax.numpy as jnp
+
+    return _select_dispatch_jit(xd, cand, jnp.int32(owner_start), budget,
+                                metric, qb)
+
+
+def _lazy_select_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("budget", "metric", "qb"))
+    def run(xd_, cand_, start, budget, metric, qb):
+        s_rows, c = cand_.shape
+        blocks = s_rows // qb
+        def one(args):
+            blk_i, cand_blk = args
+            owners = jax.lax.dynamic_slice(
+                xd_, (start + blk_i * qb, 0), (qb, xd_.shape[1])
+            ).astype(jnp.float32)
+            valid = cand_blk >= 0
+            safe = jnp.clip(cand_blk, 0, xd_.shape[0] - 1)
+            cvecs = xd_[safe].astype(jnp.float32)        # [B, C, d]
+            dots = jnp.einsum("bcd,bed->bce", cvecs, cvecs,
+                              preferred_element_type=jnp.float32)
+            if metric == "l2-squared":
+                sq = jnp.einsum("bcd,bcd->bc", cvecs, cvecs)
+                pair = sq[:, :, None] - 2.0 * dots + sq[:, None, :]
+                osq = jnp.einsum("bd,bd->b", owners, owners)
+                od = jnp.einsum("bcd,bd->bc", cvecs, owners)
+                cand_d = osq[:, None] - 2.0 * od + sq
+            elif metric == "dot":
+                pair = -dots
+                cand_d = -jnp.einsum("bcd,bd->bc", cvecs, owners)
+            else:  # cosine family: rows normalized upstream
+                pair = 1.0 - dots
+                cand_d = 1.0 - jnp.einsum("bcd,bd->bc", cvecs, owners)
+            cand_d = jnp.where(valid, cand_d, jnp.inf)
+            # sort candidates by owner distance (full-width top_k = sort)
+            negd, order = jax.lax.top_k(-cand_d, c)
+            d_s = -negd                                   # [B, C] ascending
+            pair_s = jnp.take_along_axis(
+                jnp.take_along_axis(pair, order[:, :, None], axis=1),
+                order[:, None, :], axis=2)                # [B, C, C]
+            iota_c = jax.lax.broadcasted_iota(jnp.int32, (qb, c), 1)
+
+            def step(_i, st):
+                dominated, selected, count = st
+                avail = (~dominated) & (~selected) & jnp.isfinite(d_s)
+                first = jnp.argmax(avail, axis=1)         # [B]
+                has = jnp.take_along_axis(
+                    avail, first[:, None], axis=1)[:, 0] & (count < budget)
+                pick = (iota_c == first[:, None]) & has[:, None]
+                selected = selected | pick
+                count = count + has.astype(jnp.int32)
+                pcol = jnp.take_along_axis(
+                    pair_s, first[:, None, None], axis=2)[:, :, 0]
+                dominated = dominated | (
+                    (pcol <= d_s) & has[:, None])
+                return dominated, selected, count
+
+            dom0 = jnp.zeros((qb, c), bool)
+            sel0 = jnp.zeros((qb, c), bool)
+            cnt0 = jnp.zeros((qb,), jnp.int32)
+            dominated, selected, count = jax.lax.fori_loop(
+                0, min(budget, c), step, (dom0, sel0, cnt0))
+            # nearest-first backfill of pruned candidates up to budget
+            need = budget - count
+            fillable = dominated & (~selected) & jnp.isfinite(d_s)
+            fill_rank = jnp.cumsum(fillable.astype(jnp.int32), axis=1) - 1
+            selected = selected | (
+                fillable & (fill_rank < need[:, None]))
+            # emit selected (distance order), mapped back through `order`
+            sel_prio = jnp.where(selected, iota_c, c)
+            neg, picks = jax.lax.top_k(-sel_prio, min(budget, c))
+            got = -neg < c
+            orig = jnp.take_along_axis(order, picks, axis=1)
+            out_pos = jnp.where(
+                got, jnp.take_along_axis(safe, orig, axis=1), -1)
+            if budget > c:
+                out_pos = jnp.pad(out_pos, ((0, 0), (0, budget - c)),
+                                  constant_values=-1)
+            return out_pos
+
+        cand_blocks = cand_.reshape(blocks, qb, c)
+        blk_ids = jnp.arange(blocks, dtype=jnp.int32)
+        out = jax.lax.map(one, (blk_ids, cand_blocks))
+        return out.reshape(s_rows, budget)
+
+    return run
+
+
+class _SelectJit:
+    """Module-level holder so every dispatch shares one jit cache (a
+    per-call closure would retrace the large select program each time)."""
+
+    _fn = None
+
+    def __call__(self, *args):
+        if _SelectJit._fn is None:
+            _SelectJit._fn = _lazy_select_jit()
+        return _SelectJit._fn(*args)
+
+
+_select_dispatch_jit = _SelectJit()
+
+
+def _device_select(xd, cand, budget, metric, qb=1024):
+    """Blocked device selection over all owners; returns a DEVICE array
+    [M, budget]. Owners are the first cand.shape[0] rows of xd."""
+    import jax.numpy as jnp
+
+    m = cand.shape[0]
+    outs = []
+    for s in range(0, m, _SELECT_DISPATCH_ROWS):
+        rows = min(_SELECT_DISPATCH_ROWS, m - s)
+        pad = -(-rows // qb) * qb - rows
+        blk = cand[s:s + rows]
+        if pad:
+            blk = jnp.pad(blk, ((0, pad), (0, 0)), constant_values=-1)
+        out = _device_select_dispatch(xd, blk, s, budget, metric, qb)
+        outs.append(out[:rows])
+    return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+
+def _device_symmetrize(fwd, m_live: int):
+    """Union forward links with reverse edges (cap budget each way), on
+    device: one sort of the edge list + position-in-group scatter —
+    the vectorized twin of the host path below."""
+    import jax.numpy as jnp
+
+    m, budget = fwd.shape
+    src = jnp.repeat(jnp.arange(m, dtype=jnp.int32), budget)
+    dst = fwd.reshape(-1)
+    dst = jnp.where(dst >= 0, dst, m)  # dead edges sort to the end
+    order = jnp.argsort(dst, stable=True)
+    dst_s, src_s = dst[order], src[order]
+    starts = jnp.searchsorted(dst_s, jnp.arange(m, dtype=jnp.int32))
+    pos = jnp.arange(dst_s.shape[0], dtype=jnp.int32) - starts[
+        jnp.clip(dst_s, 0, m - 1)]
+    keep = (dst_s < m) & (pos < budget)
+    union = jnp.full((m, 2 * budget), -1, jnp.int32)
+    union = union.at[:, :budget].set(fwd)
+    flat = union.reshape(-1)
+    tgt = jnp.where(keep, dst_s * 2 * budget + budget + pos,
+                    m * 2 * budget)
+    flat = flat.at[tgt].set(src_s, mode="drop")
+    union = flat.reshape(m, 2 * budget)
+    # dedup per row (first occurrence wins)
+    srt_idx = jnp.argsort(union, axis=1, stable=True)
+    srt_val = jnp.take_along_axis(union, srt_idx, axis=1)
+    dup_sorted = jnp.concatenate([
+        jnp.zeros((m, 1), bool),
+        (srt_val[:, 1:] == srt_val[:, :-1]) & (srt_val[:, 1:] >= 0)],
+        axis=1)
+    dup = jnp.zeros_like(dup_sorted).at[
+        jnp.arange(m)[:, None], srt_idx].set(dup_sorted)
+    return jnp.where(dup, -1, union)
 
 
 def _host_knn(sub: np.ndarray, k_eff: int, metric: str,
@@ -160,10 +348,17 @@ def _host_knn(sub: np.ndarray, k_eff: int, metric: str,
 
 
 def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
-                query_block: int = 8192, chunk_size: int = 65536):
+                query_block: int = 8192, chunk_size: int = 65536,
+                return_device: bool = False):
     """Full-corpus knn in ONE device dispatch: lax.map over fixed-shape
     query blocks inside a single jit — per-block host round trips each
-    cost a tunnel RTT, so 1M rows would pay minutes in RTTs otherwise."""
+    cost a tunnel RTT, so 1M rows would pay minutes in RTTs otherwise.
+
+    ``return_device=True`` keeps everything on the chip and returns
+    (xd_padded, knn_ids_device) so the device link pipeline can run
+    without the ~0.5 GB knn download + re-upload (tunnel transfers move
+    at tens of MB/s — round-tripping intermediates dominated the r3
+    build)."""
     import jax
     import jax.numpy as jnp
 
@@ -200,6 +395,14 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
     vd = jnp.asarray(valid)
     norms = jnp.sum(xd.astype(jnp.float32) ** 2, axis=-1)
     norms_arg = norms if metric == "l2-squared" else None
+    if return_device:
+        parts = []
+        for s in range(0, n, slice_rows):
+            start = min(s, max(n + pad_rows - slice_rows, 0))
+            ids = knn_slice(xd, vd, norms_arg, start, k_eff, cs, metric)
+            parts.append(ids[s - start: s - start + min(slice_rows, n - s)])
+        knn_dev = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        return xd, knn_dev
     out = np.empty((n, k_eff), dtype=np.int64)
     for s in range(0, n, slice_rows):
         # clamp the window inside the padded corpus; overlap re-computes a
@@ -230,6 +433,29 @@ def _knn_graph(vectors: np.ndarray, members: np.ndarray, knn_k: int,
     order = np.argsort(self_col, axis=1, kind="stable")
     res = np.take_along_axis(out, order, axis=1)[:, : min(knn_k, n - 1)]
     return res
+
+
+def _device_link_layer(vectors: np.ndarray, members: np.ndarray,
+                       knn_k: int, budget: int, metric: str) -> np.ndarray:
+    """Fully device-resident knn -> select -> symmetrize -> select for one
+    layer: intermediates ([M, C] candidate tensors, ~0.5-1 GB at 1M rows)
+    never cross the tunnel; only the final [M, budget] link table comes
+    back. Returns positions into ``members`` (-1 padded)."""
+    import jax.numpy as jnp
+
+    sub = vectors[members]
+    n = len(sub)
+    k_eff = min(knn_k + 1, n)
+    xd, knn_dev = _device_knn(sub, k_eff, metric, return_device=True)
+    # drop self-hits on device (stable sort by is-self keeps distance order)
+    self_col = (knn_dev == jnp.arange(n)[:, None]).astype(jnp.int32)
+    order = jnp.argsort(self_col, axis=1, stable=True)
+    knn_dev = jnp.take_along_axis(knn_dev, order, axis=1)[
+        :, : min(knn_k, n - 1)].astype(jnp.int32)
+    fwd = _device_select(xd, knn_dev, budget, metric)
+    union = _device_symmetrize(fwd, n)
+    final = _device_select(xd, union, budget, metric)
+    return np.asarray(final, dtype=np.int64)
 
 
 def bulk_build(index, doc_ids, vectors: np.ndarray, knn_k: int = 64,
@@ -271,9 +497,18 @@ def bulk_build(index, doc_ids, vectors: np.ndarray, knn_k: int = 64,
                     links.append(np.empty(0, dtype=np.int32))
                 continue
             budget = index.m0 if layer == 0 else index.m
-            knn = _knn_graph(vectors, members, knn_k, index.metric)
-            fwd = _link_layer(index, vectors, members, knn, budget,
-                              query_block)
+            use_device = (
+                len(members) > _HOST_KNN_MAX
+                and index.metric in ("l2-squared", "dot",
+                                     "cosine", "cosine-dot")
+                and _device_backend())
+            if use_device:
+                fwd = _device_link_layer(vectors, members, knn_k, budget,
+                                         index.metric)
+            else:
+                knn = _knn_graph(vectors, members, knn_k, index.metric)
+                fwd = _link_layer(index, vectors, members, knn, budget,
+                                  query_block)
             _write_links(index, members, fwd, layer)
         # entrypoint: any node at the top level
         top = int(np.nonzero(levels == max_level)[0][0])

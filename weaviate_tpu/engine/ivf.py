@@ -113,15 +113,39 @@ def _ivf_probe_topk_pq(q, centroids, c_norms, list_codes, list_valid,
     slots = list_slots[probes].reshape(q.shape[0], nprobe * cap)
     b, p = codes.shape[0], codes.shape[1]
     lut = pq_lut(q32, pq_centroids, metric, m)  # [B, m, kc]
-    lut_s = jnp.transpose(lut, (1, 0, 2))  # [m, B, kc]
-    codes_s = jnp.transpose(codes, (2, 0, 1)).astype(jnp.int32)  # [m, B, P]
+    kc = lut.shape[2]
+    # ADC via ONE-HOT int8 MATMUL, chunked over the probed rows — the
+    # earlier per-segment take_along_axis formulation issued B*P*m VPU
+    # random gathers (~2 s/batch at capacity-scale probes and an OOM
+    # crash beyond nprobe=8); one-hot + batched matvec puts the sum on
+    # the MXU with bounded [B, Pc, kc*m] transients. LUT is per-query
+    # int8-quantized (rank-preserving per query; candidates get exactly
+    # rescored downstream).
+    from weaviate_tpu.ops.pq import quantize_lut_int8
 
-    def seg_add(acc, inp):
-        lut_seg, code_seg = inp  # [B, kc], [B, P]
-        return acc + jnp.take_along_axis(lut_seg, code_seg, axis=1), None
+    lut8, scale = quantize_lut_int8(lut)
+    # ~128 MB one-hot transient per scan step ACROSS the query batch
+    # (b * pc * kc * m int8)
+    pc = max(256, min(p, (1 << 27) // (kc * m * max(b, 1))))
+    n_chunks = -(-p // pc)
+    pad_p = n_chunks * pc - p
+    codes_p = jnp.pad(codes, ((0, 0), (0, pad_p), (0, 0)))
+    codes_c = codes_p.reshape(b, n_chunks, pc, m).transpose(1, 0, 2, 3)
 
-    d, _ = jax.lax.scan(seg_add, jnp.zeros((b, p), jnp.float32),
-                        (lut_s, codes_s))
+    def one_chunk(carry, codes_blk):
+        # copy-major tile (lane c*m + s) matching the code-major LUT flatten
+        rep = jnp.tile(codes_blk.astype(jnp.int32), (1, 1, kc))
+        lane = jax.lax.broadcasted_iota(jnp.int32, rep.shape, 2) // m
+        oh = (rep == lane).astype(jnp.int8)          # [B, Pc, kc*m]
+        dots = jax.lax.dot_general(
+            lut8, oh,
+            dimension_numbers=(((1,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32)         # [B, Pc]
+        return carry, dots
+
+    _, d8 = jax.lax.scan(one_chunk, None, codes_c)
+    d = (jnp.transpose(d8, (1, 0, 2)).reshape(b, n_chunks * pc)[:, :p]
+         .astype(jnp.float32) / scale[:, None])
     if metric == "l2-squared":
         d = jnp.maximum(d, 0.0)
     if use_allow:

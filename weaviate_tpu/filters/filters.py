@@ -171,16 +171,21 @@ def _eval(f: Filter, inv: InvertedIndex, size: int) -> np.ndarray:
         return _full(inv, size) & ~null_mask
 
     if op == Operator.WITHIN_GEO_RANGE:
-        ids, lats, lons = inv.geo_arrays(prop)
-        if not len(ids):
+        grid = inv.geo_grid(prop)
+        if not len(grid):
             return np.zeros(size, dtype=bool)
         spec = f.value  # {"geoCoordinates": {latitude, longitude}, "distance": {"max": m}}
         center = spec.get("geoCoordinates", spec)
-        max_m = spec["distance"]["max"] if "distance" in spec else spec["max"]
-        d = _geo_distance_m(float(center["latitude"]), float(center["longitude"]),
-                            lats, lons)
+        max_m = float(spec["distance"]["max"] if "distance" in spec
+                      else spec["max"])
+        clat = float(center["latitude"])
+        clon = float(center["longitude"])
+        # grid prune first (sublinear), exact haversine on the survivors
+        pos = grid.candidate_positions(clat, clon, max_m)
+        d = _geo_distance_m(clat, clon, grid.lats[pos], grid.lons[pos])
         mask = np.zeros(size, dtype=bool)
-        hit = ids[(d <= float(max_m)) & (ids < size)]
+        cand_ids = grid.ids[pos]
+        hit = cand_ids[(d <= max_m) & (cand_ids < size)]
         mask[hit] = True
         return mask
 

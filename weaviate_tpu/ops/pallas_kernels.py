@@ -754,6 +754,179 @@ def bq_scan_reduce(
     return vals, ids[:b]
 
 
+def _pq4_scan_kernel(lut_ref, c_ref, bias_ref, out_ref,
+                     *, m, subtiles, sub_rows, out_w, row_major, interpret):
+    """Fused 4-bit-PQ ADC scan supertile (the PQ twin of _bq_scan_kernel).
+
+    lut [B, 16m] int8 CODE-MAJOR per-query tables (quantized with a
+    per-query scale by the driver), codes [ST, m] uint8 row-major or
+    [m, ST] transposed, bias [1, ST] int32 carrying the strided slice id
+    (low 6 bits) and a dead-row offset. One int8 matmul against the
+    in-VMEM one-hot gives integer ADC sums; merge is shift + add + min.
+    """
+    lut = lut_ref[:]
+    slices_per_sub = sub_rows // out_w
+    rep_axis = 1 if row_major else 0
+    shape = (sub_rows, 16 * m) if row_major else (16 * m, sub_rows)
+    code_iota = jax.lax.broadcasted_iota(jnp.int32, shape, rep_axis) // m
+
+    def one_subtile(j, acc):
+        if row_major:
+            c = c_ref[pl.ds(j * sub_rows, sub_rows), :].astype(jnp.int32)
+        else:
+            c = c_ref[:, pl.ds(j * sub_rows, sub_rows)].astype(jnp.int32)
+        if interpret:
+            rep = jnp.concatenate([c] * 16, axis=rep_axis)
+        else:
+            rep = pltpu.repeat(c, 16, axis=rep_axis)  # 16m copy-major
+        oh = (rep == code_iota).astype(jnp.int8)
+        dots = jax.lax.dot_general(
+            lut, oh,
+            dimension_numbers=(((1,), (1 if row_major else 0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [B, sub] integer ADC sums
+        packed = (jax.lax.shift_left(dots, _SCAN_ID_BITS)
+                  + bias_ref[:, pl.ds(j * sub_rows, sub_rows)])
+        for s in range(slices_per_sub):
+            acc = jnp.minimum(acc, packed[:, s * out_w:(s + 1) * out_w])
+        return acc
+
+    init = jnp.full((lut.shape[0], out_w), jnp.iinfo(jnp.int32).max,
+                    jnp.int32)
+    if subtiles == 1:
+        acc = one_subtile(0, init)
+    else:
+        acc = jax.lax.fori_loop(0, subtiles, one_subtile, init)
+    out_ref[:] = acc
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "supertile", "sub_rows", "out_w", "row_major", "interpret"))
+def _pq4_scan_tiled(lut8, codes, bias, supertile, sub_rows, out_w,
+                    row_major, interpret):
+    b = lut8.shape[0]
+    if row_major:
+        n, m = codes.shape
+        c_spec = pl.BlockSpec((supertile, m), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    else:
+        m, n = codes.shape
+        c_spec = pl.BlockSpec((m, supertile), lambda i: (0, i),
+                              memory_space=pltpu.VMEM)
+    subtiles = supertile // sub_rows
+    reduce_l = supertile // out_w
+    return pl.pallas_call(
+        functools.partial(_pq4_scan_kernel, m=m, subtiles=subtiles,
+                          sub_rows=sub_rows, out_w=out_w,
+                          row_major=row_major, interpret=interpret),
+        grid=(n // supertile,),
+        in_specs=[
+            pl.BlockSpec((b, 16 * m), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            c_spec,
+            pl.BlockSpec((1, supertile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b, out_w), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, n // reduce_l), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * b * n * 16 * m,
+            bytes_accessed=lut8.size + codes.size
+            + b * (n // reduce_l) * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(lut8, codes, bias)
+
+
+def pq4_scan_reduce(
+    lut: jnp.ndarray,
+    codes: jnp.ndarray,
+    valid: jnp.ndarray | None = None,
+    reduce_l: int = 64,
+    interpret: bool | None = None,
+    transposed: bool = False,
+    sub_rows: int | None = None,
+):
+    """Full-corpus 4-bit-PQ ADC scan with in-kernel candidate reduction.
+
+    lut [B, m, k<=16] f32 per-query ADC tables (ops/pq.py pq_lut); codes
+    [N, m] uint8 row-major (or [m, N] with ``transposed=True``). The LUT
+    is quantized to int8 with one scale per QUERY (rank-preserving within
+    a query; the ~0.4% distance quantization is far below the downstream
+    exact-rescore tolerance), so the scan runs at the int8 MXU rate with
+    the same packed (value|slice-id) strided-min merge as the BQ kernel.
+
+    Returns (vals [B, ~N/L] f32 approximate ADC distances with dead rows
+    at MASKED_DISTANCE, ids [B, ~N/L] int32 global rows).
+    """
+    if interpret is None:
+        interpret = not recommended()
+    b, m, kk = lut.shape
+    if kk > 16:
+        raise ValueError(f"pq4 kernel requires k <= 16 centroids, got {kk}")
+    pm = _pad_to(max(m, 1), 8)
+    pb = _pad_to(max(b, 1), _SUBLANE)
+    row_major = (m >= 24) if not transposed else False
+    if transposed:
+        n = codes.shape[1]
+    else:
+        n = codes.shape[0]
+        if not row_major:
+            codes = codes.T
+    if sub_rows is None:
+        if row_major:
+            sub_rows = 256
+        else:
+            sub_rows = 2048 if pm <= 8 else (1024 if pm <= 24 else 512)
+        if pb > 512:
+            sub_rows = min(sub_rows, 1024)
+    reduce_l = max(1, min(reduce_l, 64))
+    reduce_l = 1 << (reduce_l.bit_length() - 1)
+    st_cap = 8192 if row_major else 16384
+    out_w = min(max(128, st_cap // reduce_l), sub_rows)
+    supertile = reduce_l * out_w
+    sub_rows = min(sub_rows, supertile)
+    pn = _pad_to(max(n, 1), supertile)
+    if pm != m:
+        lut = jnp.pad(lut, ((0, 0), (0, pm - m), (0, 0)))
+        codes = (jnp.pad(codes, ((0, 0), (0, pm - m))) if row_major
+                 else jnp.pad(codes, ((0, pm - m), (0, 0))))
+    if lut.shape[2] < 16:
+        lut = jnp.pad(lut, ((0, 0), (0, 0), (0, 16 - lut.shape[2])))
+    if pb != b:
+        lut = jnp.pad(lut, ((0, pb - b), (0, 0), (0, 0)))
+    if pn != n:
+        codes = (jnp.pad(codes, ((0, pn - n), (0, 0))) if row_major
+                 else jnp.pad(codes, ((0, 0), (0, pn - n))))
+    # per-query int8 quantization, code-major (padded segments carry
+    # zero entries) — shared helper keeps this and the IVF probe in sync
+    from weaviate_tpu.ops.pq import quantize_lut_int8
+
+    lut8, scale = quantize_lut_int8(lut)
+    dead_off = 2 * 127 * pm + 2  # past any legit int8 ADC sum
+    pos = jnp.arange(pn, dtype=jnp.int32)
+    slice_id = pos % supertile // out_w
+    if valid is None:
+        dead = pos >= n
+    else:
+        dead = jnp.logical_not(jnp.pad(valid.astype(bool), (0, pn - n),
+                                       constant_values=False))
+        dead = jnp.logical_or(dead, pos >= n)
+    bias = slice_id + jnp.where(dead, dead_off << _SCAN_ID_BITS, 0)
+    packed = _pq4_scan_tiled(lut8, codes, bias[None, :], supertile,
+                             sub_rows, out_w, row_major, interpret)
+    raw = jax.lax.shift_right_arithmetic(packed, _SCAN_ID_BITS)
+    slice_ids = jax.lax.bitwise_and(packed, (1 << _SCAN_ID_BITS) - 1)
+    col = jnp.arange(pn // reduce_l, dtype=jnp.int32)
+    ids = (slice_ids * out_w + (col % out_w)[None, :]
+           + (col // out_w * supertile)[None, :])
+    vals = raw[:b].astype(jnp.float32) / scale[:b, None]
+    vals = jnp.where(raw[:b] > 127 * pm, MASKED_DISTANCE, vals)
+    return vals, ids[:b]
+
+
 def bq_hamming_block(
     q_bits: jnp.ndarray,
     x_bits: jnp.ndarray,

@@ -242,6 +242,23 @@ def pq_topk(
 # 33-151, Distance :440) with the scalar gather turned into a matmul.
 
 
+def quantize_lut_int8(lut: jnp.ndarray):
+    """Per-query int8 quantization of ADC tables, code-major flattened.
+
+    lut [B, m, kc] f32 -> (lut8 [B, kc*m] int8 with lane order c*m + s —
+    the order pltpu.repeat / jnp.tile copy-major one-hots produce —
+    scale [B] f32). Rank-preserving within each query (one shared scale);
+    inverse: adc = dots / scale. Shared by the pq4 scan kernel and the
+    IVF probe so the clamp/flatten conventions cannot drift apart.
+    """
+    b, m, kc = lut.shape
+    scale = 127.0 / jnp.maximum(
+        jnp.max(jnp.abs(lut.reshape(b, -1)), axis=1), 1e-20)
+    lut8 = jnp.clip(jnp.round(lut * scale[:, None, None]), -127, 127)
+    lut8 = jnp.transpose(lut8, (0, 2, 1)).reshape(b, kc * m)
+    return lut8.astype(jnp.int8), scale
+
+
 @functools.partial(jax.jit, static_argnames=("metric", "m"))
 def pq_lut(q: jnp.ndarray, centroids: jnp.ndarray, metric: str, m: int):
     """Per-query ADC lookup tables: [B, m, k] f32.
@@ -265,82 +282,47 @@ def pq_lut(q: jnp.ndarray, centroids: jnp.ndarray, metric: str, m: int):
     return lut.at[:, 0, :].add(1.0)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "chunk_size", "metric", "m"))
+@functools.partial(jax.jit, static_argnames=("k", "chunk_size", "metric", "m",
+                                             "reduce_l"))
 def pq4_topk(
     q: jnp.ndarray,
     codes: jnp.ndarray,
     centroids: jnp.ndarray,
     k: int,
-    chunk_size: int,
+    chunk_size: int = 0,
     metric: str = "l2-squared",
     valid: jnp.ndarray | None = None,
     id_offset: jnp.ndarray | int = 0,
     m: int | None = None,
+    reduce_l: int | None = None,
 ):
-    """Compressed brute-force top-k over 4-bit codes via the Pallas ADC
-    kernels. Same contract as pq_topk. Formulation picked by batch size:
-    LUT-matmul costs 2*mk*B FLOPs/row, reconstruct-matmul 2*mk*d + 2*d*B
-    — the crossover sits at B ~ mk*d/(mk-d), so big batches reconstruct."""
-    from weaviate_tpu.ops.distances import MASKED_DISTANCE, normalize
-    from weaviate_tpu.ops.pallas_kernels import (pq4_lut_block,
-                                                 pq4_recon_block)
-    from weaviate_tpu.ops.topk import approx_topk_smallest, topk_smallest
+    """Compressed brute-force top-k over 4-bit codes via the fused ADC scan
+    kernel (pallas_kernels.pq4_scan_reduce: per-query int8 LUT, one-hot
+    int8 matmul, in-kernel strided block-argmin), then one approx_max_k
+    over the ~N/L survivors and an exact final top-k. Same contract as
+    pq_topk; ``chunk_size`` is accepted for API compatibility."""
+    from weaviate_tpu.ops.bq import _auto_reduce_l
+    from weaviate_tpu.ops.distances import MASKED_DISTANCE
+    from weaviate_tpu.ops.pallas_kernels import pq4_scan_reduce
+    from weaviate_tpu.ops.topk import topk_smallest
 
     m = m or centroids.shape[0]
     n = codes.shape[0]
-    assert n % chunk_size == 0, f"codes rows {n} not a multiple of {chunk_size}"
-    num_chunks = n // chunk_size
     b = q.shape[0]
-    d = centroids.shape[0] * centroids.shape[2]
-    mk16 = m * 16
-    use_recon = mk16 > d and b > (mk16 * d) // max(mk16 - d, 1)
-    q_recon = q
-    if use_recon and metric in ("cosine", "cosine-dot"):
-        q_recon = normalize(q.astype(jnp.float32))
-
-    lut = None if use_recon else pq_lut(q, centroids, metric, m)  # [B, m, k]
-
-    code_chunks = codes.reshape(num_chunks, chunk_size, m)
-    valid_chunks = None if valid is None else valid.reshape(num_chunks, chunk_size)
-
-    init_d = jnp.full((b, k), MASKED_DISTANCE, dtype=jnp.float32)
-    init_i = jnp.full((b, k), -1, dtype=jnp.int32)
-
-    def body(carry, inp):
-        best_d, best_i = carry
-        chunk_idx, cc, vc = inp
-        if use_recon:
-            d = pq4_recon_block(q_recon, cc, centroids, metric=metric,
-                                valid=vc)
-        else:
-            d = pq4_lut_block(lut, cc, valid=vc)
-        ids = (
-            chunk_idx * chunk_size
-            + id_offset
-            + jax.lax.broadcasted_iota(jnp.int32, (1, chunk_size), 1)
-        )
-        ids = jnp.broadcast_to(ids, (b, chunk_size))
-        # two-stage: approx-select within THIS chunk only (one 0.95-recall
-        # invocation per candidate), then EXACT merge of the tiny carried
-        # set — carried winners can never be dropped by the approx op
-        ck_d, ck_i = approx_topk_smallest(d, ids, min(k, chunk_size))
-        ck_d = ck_d.astype(jnp.float32)  # bf16 kernel output -> f32 merge
-        new_d, new_i = topk_smallest(
-            jnp.concatenate([best_d, ck_d], axis=1),
-            jnp.concatenate([best_i, ck_i], axis=1),
-            k,
-        )
-        return (new_d, new_i), None
-
-    chunk_ids = jnp.arange(num_chunks, dtype=jnp.int32)
-    if num_chunks == 1:
-        (fd, fi), _ = body(
-            (init_d, init_i),
-            (chunk_ids[0], code_chunks[0],
-             None if valid_chunks is None else valid_chunks[0]),
-        )
-    else:
-        (fd, fi), _ = jax.lax.scan(
-            body, (init_d, init_i), (chunk_ids, code_chunks, valid_chunks)
-        )
+    lut = pq_lut(q, centroids, metric, m)  # [B, m, k]
+    rl = reduce_l if reduce_l is not None else _auto_reduce_l(n)
+    vals, ids = pq4_scan_reduce(lut, codes, valid=valid, reduce_l=rl)
+    ncand = vals.shape[1]
+    kk = min(k, ncand)
+    if ncand > 4 * kk:
+        negd, pos = jax.lax.approx_max_k(-vals, min(4 * kk, ncand),
+                                         recall_target=0.95)
+        vals = -negd
+        ids = jnp.take_along_axis(ids, pos, axis=1)
+    fd, fi = topk_smallest(vals, ids, kk)
+    if kk < k:
+        fd = jnp.pad(fd, ((0, 0), (0, k - kk)),
+                     constant_values=MASKED_DISTANCE)
+        fi = jnp.pad(fi, ((0, 0), (0, k - kk)), constant_values=-1)
+    fi = jnp.where(fd >= MASKED_DISTANCE * 0.5, -1, fi + id_offset)
     return fd, fi
