@@ -144,10 +144,9 @@ def test_partial_class_update_preserves_omitted_fields(tmp_path):
         out = c.request("PUT", "/v1/schema/PU",
                         body={"description": "updated"})
         assert out["description"] == "updated"
-        assert out["inverted"]["bm25_k1"] == 1.9  # untouched
-        vc = next(v for v in out["vectors"] if v["name"] == "")
-        assert vc["vectorizer"] == "text2vec-bigram"  # untouched
-        assert vc["module_config"] == {"dim": 64}
+        assert out["invertedIndexConfig"]["bm25"]["k1"] == 1.9  # untouched
+        assert out["vectorizer"] == "text2vec-bigram"  # untouched
+        assert out["moduleConfig"] == {"text2vec-bigram": {"dim": 64}}
     finally:
         srv.stop()
         db.close()

@@ -1,0 +1,160 @@
+"""bf16 distance-error envelope across dims (VERDICT r3 item 10).
+
+For d in {128, 768, 1536} on clustered corpora: relative distance error
+and recall@10 of the bf16 storage path vs the exact f32 HIGHEST scan,
+plus the timing of the middle option — f32 storage at Precision.HIGH
+(3-pass bf16 emulation) — so BASELINE.md can state a measured
+speed/accuracy ladder instead of a guess.
+
+Run on the TPU. Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from weaviate_tpu.ops.topk import chunked_topk_distances
+
+    @jax.jit
+    def _triv(s):
+        return s + 1.0
+
+    np.asarray(_triv(jnp.float32(0)))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(_triv(jnp.float32(1)))
+        rtts.append(time.perf_counter() - t0)
+    rtt_s = float(np.median(rtts))
+
+    def chained_ms(fn, arrays, reps=40):
+        @jax.jit
+        def chained(*arrs):
+            def body(_i, carry):
+                zero = carry[0].reshape(-1)[0] * 0.0
+                tainted = (arrs[0] + zero.astype(arrs[0].dtype),) + arrs[1:]
+                return fn(*tainted)
+            return jax.lax.fori_loop(0, reps, body, fn(*arrs))
+        np.asarray(jax.block_until_ready(chained(*arrays))[0])
+        t0 = time.perf_counter()
+        np.asarray(jax.block_until_ready(chained(*arrays))[0])
+        return max(time.perf_counter() - t0 - rtt_s, 1e-3) / (reps + 1) * 1e3
+
+    out = {}
+    b, k, chunk = 256, 10, 131072
+    dims = [int(x) for x in (sys.argv[1].split(",") if len(sys.argv) > 1
+                             else ("128", "768", "1536"))]
+    for d in dims:
+        # full 1M at 1536d needs ~18 GB of f32 generation transients;
+        # halve the corpus there (error stats are size-independent)
+        n = 524_288 if d >= 1536 else 1_048_576
+        key = jax.random.PRNGKey(d)
+        kc, kq = jax.random.split(key)
+        centers = jax.random.normal(kc, (65536, d), dtype=jnp.float32)
+        assign = jax.random.randint(kc, (n,), 0, 65536)
+        v = centers[assign] + 0.35 * jax.random.normal(kq, (n, d))
+        qi = jax.random.randint(kq, (b,), 0, n)
+        q = v[qi] + 0.05 * jax.random.normal(kc, (b, d))
+        v_bf = v.astype(jnp.bfloat16)
+        norms = jnp.sum(v * v, axis=-1)
+
+        def run(x, prec_sel):
+            return chunked_topk_distances(
+                q, x, k=k, chunk_size=chunk, metric="l2-squared",
+                x_sq_norms=norms, selection=prec_sel)
+
+        # exact ground truth (f32 HIGHEST, exact selection)
+        gt_d, gt_i = run(v, "exact")
+        gt_d, gt_i = np.asarray(gt_d), np.asarray(gt_i)
+        # bf16 path (the serving default)
+        bf_d, bf_i = run(v_bf, "approx")
+        bf_d, bf_i = np.asarray(bf_d), np.asarray(bf_i)
+        rec = np.mean([len(set(bf_i[r]) & set(gt_i[r])) / k
+                       for r in range(b)])
+        # distance error ON MATCHED IDS (top-1 always matches or compare
+        # per-rank against gt distance scale)
+        scale = np.maximum(np.abs(gt_d[:, -1]), 1e-9)[:, None]
+        err = np.abs(bf_d - gt_d) / scale
+        # timings: bf16 vs f32-HIGH (3-pass) vs f32-HIGHEST (6-pass)
+        ms_bf = chained_ms(
+            lambda q_, x_, n_: chunked_topk_distances(
+                q_, x_, k=k, chunk_size=chunk, metric="l2-squared",
+                x_sq_norms=n_, selection="approx"), (q, v_bf, norms))
+
+        def f32_prec_scan(precision):
+            import functools
+
+            from weaviate_tpu.ops.distances import MASKED_DISTANCE
+
+            @functools.partial(jax.jit, static_argnames=("prec",))
+            def scan(q_, x_, n_, prec):
+                nch = x_.shape[0] // chunk
+                xc = x_.reshape(nch, chunk, x_.shape[1])
+                nc = n_.reshape(nch, chunk)
+                init = (jnp.full((b, k), MASKED_DISTANCE, jnp.float32),
+                        jnp.full((b, k), -1, jnp.int32))
+                def body(carry, inp):
+                    bd, bi = carry
+                    ci, xck, nck = inp
+                    dots = jax.lax.dot_general(
+                        q_, xck, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                        precision=prec)
+                    qn = jnp.sum(q_ * q_, axis=-1)[:, None]
+                    dmat = qn - 2.0 * dots + nck[None, :]
+                    ids = ci * chunk + jax.lax.broadcasted_iota(
+                        jnp.int32, (b, chunk), 1)
+                    negd, pos = jax.lax.approx_max_k(-dmat, 4 * k)
+                    cd = -negd
+                    cidx = jnp.take_along_axis(ids, pos, axis=1)
+                    nd, p2 = jax.lax.top_k(
+                        -jnp.concatenate([bd, cd], 1), k)
+                    cati = jnp.concatenate([bi, cidx], 1)
+                    return (-nd, jnp.take_along_axis(cati, p2, 1)), None
+                (fd, fi), _ = jax.lax.scan(
+                    body, init,
+                    (jnp.arange(nch, dtype=jnp.int32), xc, nc))
+                return fd, fi
+            return lambda q_, x_, n_: scan(q_, x_, n_, precision)
+
+        ms_high = chained_ms(f32_prec_scan(jax.lax.Precision.HIGH),
+                             (q, v, norms))
+        ms_highest = chained_ms(f32_prec_scan(jax.lax.Precision.HIGHEST),
+                                (q, v, norms))
+        # HIGH-precision accuracy
+        hd, hi = f32_prec_scan(jax.lax.Precision.HIGH)(q, v, norms)
+        hi = np.asarray(hi)
+        rec_h = np.mean([len(set(hi[r]) & set(gt_i[r])) / k
+                         for r in range(b)])
+        out[f"d{d}"] = {
+            "bf16_recall_at_10": round(float(rec), 4),
+            "bf16_rel_err_p50": round(float(np.median(err)), 6),
+            "bf16_rel_err_p99": round(float(np.percentile(err, 99)), 6),
+            "bf16_ms": round(ms_bf, 2),
+            "f32_high_recall_at_10": round(float(rec_h), 4),
+            "f32_high_ms": round(ms_high, 2),
+            "f32_highest_ms": round(ms_highest, 2),
+        }
+        log(f"d={d}: bf16 recall {rec:.4f} err p50 {np.median(err):.2e} "
+            f"p99 {np.percentile(err, 99):.2e} {ms_bf:.2f} ms | "
+            f"f32-HIGH recall {rec_h:.4f} {ms_high:.2f} ms | "
+            f"f32-HIGHEST {ms_highest:.2f} ms")
+        del v, v_bf, centers
+    print(json.dumps({"metric": "bf16_envelope_1M_b256", **out}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

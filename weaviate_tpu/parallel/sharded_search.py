@@ -27,6 +27,19 @@ from weaviate_tpu.ops.topk import chunked_topk_distances, topk_smallest
 from weaviate_tpu.parallel.mesh import SHARD_AXIS
 
 
+def _ici_merge_topk(d, ids, axis: str, k_out: int):
+    """The cross-shard candidate merge every SPMD entry point shares:
+    all_gather [n_shards, B, kk] (distance, id) pairs over ICI, flatten
+    per query, exact top-k (the device analog of the reference's
+    host-side merge, index.go:1644)."""
+    all_d = jax.lax.all_gather(d, axis)
+    all_i = jax.lax.all_gather(ids, axis)
+    n_sh, b, kk = all_d.shape
+    cat_d = jnp.transpose(all_d, (1, 0, 2)).reshape(b, n_sh * kk)
+    cat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(b, n_sh * kk)
+    return topk_smallest(cat_d, cat_i, min(k_out, n_sh * kk))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -70,13 +83,7 @@ def sharded_topk(
             use_pallas=use_pallas,
             selection=selection,
         )
-        # gather every shard's candidates: [n_shards, B, k] each
-        all_d = jax.lax.all_gather(d, axis)
-        all_i = jax.lax.all_gather(i, axis)
-        b = q_.shape[0]
-        cat_d = jnp.transpose(all_d, (1, 0, 2)).reshape(b, n_shards * k)
-        cat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(b, n_shards * k)
-        return topk_smallest(cat_d, cat_i, k)
+        return _ici_merge_topk(d, i, axis, k)
 
     in_specs = (
         P(),            # q replicated
@@ -174,12 +181,7 @@ def sharded_quantized_topk(
             dd = jnp.where(i_c >= 0, dd, MASKED_DISTANCE)
             d_c, i_c = topk_smallest(dd, i_c, min(k_out, i_c.shape[1]))
         gid = jnp.where(i_c >= 0, i_c + shard_idx * local_rows, -1)
-        all_d = jax.lax.all_gather(d_c, axis)
-        all_i = jax.lax.all_gather(gid, axis)
-        kk = all_d.shape[-1]
-        cat_d = jnp.transpose(all_d, (1, 0, 2)).reshape(b, n_shards * kk)
-        cat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(b, n_shards * kk)
-        return topk_smallest(cat_d, cat_i, min(k_out, n_shards * kk))
+        return _ici_merge_topk(d_c, gid, axis, k_out)
 
     # assemble args/specs in Python (quantization and rescore presence are
     # static): shard_map can't close over traced arrays and optional
@@ -263,3 +265,60 @@ def replicate_array(arr, mesh: Mesh):
     if jax.process_count() > 1:
         return replicate_array_multihost(arr, mesh)
     return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "nprobe", "metric", "mesh", "axis"),
+)
+def sharded_ivf_pq_topk(
+    q: jnp.ndarray,
+    centroids: jnp.ndarray,
+    list_codes: jnp.ndarray,
+    list_valid: jnp.ndarray,
+    list_slots: jnp.ndarray,
+    pq_centroids: jnp.ndarray,
+    k: int,
+    nprobe: int,
+    metric: str,
+    mesh: Mesh,
+    axis: str = SHARD_AXIS,
+):
+    """SPMD IVF-PQ probe over LIST-sharded posting lists.
+
+    The 100M-per-chip capacity layout (SURVEY §7): ``centroids``
+    [nlist, d], ``list_codes`` [nlist, cap, m], ``list_valid``
+    [nlist, cap], ``list_slots`` [nlist, cap] are all sharded over
+    ``axis`` on the LIST dim; ``q`` and the PQ codebook are replicated.
+    Each device ranks ITS local centroids, probes its local top-nprobe
+    lists (so the union covers >= the global top-nprobe; recall can only
+    exceed the single-device equivalent), scores codes via the chunked
+    one-hot int8 matmul (engine/ivf._ivf_probe_topk_pq), and contributes
+    k local candidates to an all_gather merge over ICI — slots, not
+    vectors, cross the interconnect (the SPMD analog of the reference's
+    scatter-gather, index.go:1541).
+    """
+    from weaviate_tpu.engine.ivf import _ivf_probe_topk_pq
+
+    n_shards = mesh.shape[axis]
+    dummy_allow = jnp.ones((1,), dtype=bool)
+
+    def local_probe(q_, cent_, codes_, valid_, slots_, pqc_):
+        local_nlist = cent_.shape[0]
+        cn = jnp.sum(cent_.astype(jnp.float32) ** 2, axis=-1)
+        d, s = _ivf_probe_topk_pq(
+            q_, cent_, cn, codes_, valid_, slots_, pqc_,
+            dummy_allow, min(k, local_nlist * codes_.shape[1]),
+            min(nprobe, local_nlist), metric, False)
+        return _ici_merge_topk(d, s, axis, k)
+
+    fn = shard_map(
+        local_probe,
+        mesh=mesh,
+        in_specs=(P(), P(axis, None), P(axis, None, None),
+                  P(axis, None), P(axis, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return fn(q, centroids, list_codes, list_valid, list_slots,
+              pq_centroids)

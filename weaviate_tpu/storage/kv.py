@@ -63,7 +63,9 @@ def _merge_values(strategy: str, older, newer):
         dele = (older["del"] | newer["del"]) - newer["add"]
         return {"add": add, "del": dele}
     if strategy == "map":
-        # value: {"set": {k: v}, "del": set}
+        # value: {"set": {k: v}, "del": set} (lazy column form coalesced)
+        older = _coalesce_map(older)
+        newer = _coalesce_map(newer)
         out = dict(older.get("set", {}))
         for k in newer.get("del", set()):
             out.pop(k, None)
@@ -74,7 +76,11 @@ def _merge_values(strategy: str, older, newer):
         return {"set": out, "del": dele}
     # roaringset: value {"add": np.uint64[], "del": np.uint64[]} — arrays are
     # kept sorted+unique at every boundary so the native C++ set algebra
-    # (weaviate_tpu/native, csrc/weaviate_native.cpp) applies directly
+    # (weaviate_tpu/native, csrc/weaviate_native.cpp) applies directly.
+    # Memtable-internal values may be LAZY ({"lazy": [parts...]}) — adds
+    # accumulated without merging; coalesce before any algebra.
+    older = _coalesce_roaring(older)
+    newer = _coalesce_roaring(newer)
     if len(newer["del"]) == 0 and len(older["del"]) == 0:
         # import fast path (adds only): 1 call instead of 4 — the per-key
         # FFI overhead dominated batch imports
@@ -87,6 +93,36 @@ def _merge_values(strategy: str, older, newer):
         native.union_sorted(older["del"], newer["del"]), newer["add"]
     )
     return {"add": add, "del": dele}
+
+
+def _coalesce_map(v):
+    """Collapse a lazy postings map value ({"plazy": [(docs, tfs, lens),
+    ...]}) into canonical {"set": {doc: [tf, len]}, "del": set()} form.
+    The import path hands the analyzer's COLUMN arrays straight through;
+    the doc->payload dict materializes once per key at read/flush instead
+    of once per (term, doc) posting in Python."""
+    if isinstance(v, dict) and "plazy" in v:
+        out: dict = {}
+        for docs, tfs, lens in v["plazy"]:
+            for d, t, ln in zip(docs.tolist(), tfs.tolist(), lens.tolist()):
+                out[d] = [t, ln]
+        return {"set": out, "del": v.get("del", set())}
+    return v
+
+
+def _coalesce_roaring(v):
+    """Collapse a lazy memtable roaringset value into canonical
+    {"add": sorted-unique u64, "del": ...} form. The memtable appends
+    per-write add-arrays to a ``lazy`` list instead of merging each one
+    through the set algebra — one np.unique over the concatenation at
+    read/flush time replaces hundreds of per-key FFI unions on the
+    import hot path."""
+    if isinstance(v, dict) and "lazy" in v:
+        parts = v["lazy"]
+        add = (np.unique(np.concatenate(parts)) if len(parts) > 1
+               else parts[0])
+        return {"add": add, "del": v["del"]}
+    return v
 
 
 def _sorted_unique_u64(ids) -> np.ndarray:
@@ -438,6 +474,31 @@ class _Memtable:
         cur = self.data.get(key)
         if value is _TOMBSTONE or cur is _TOMBSTONE or cur is None:
             self.data[key] = value
+        elif (strategy == "roaringset" and len(value["del"]) == 0
+                and (("lazy" in cur) or len(cur["del"]) == 0)):
+            # import hot path: APPEND the add-array; coalesce lazily at
+            # read/flush (per-key eager unions dominated batch imports)
+            if "lazy" in cur:
+                cur["lazy"].append(value["add"])
+            else:
+                self.data[key] = {"lazy": [cur["add"], value["add"]],
+                                  "del": cur["del"]}
+        elif (strategy == "map" and "plazy" in value
+                and ("plazy" in cur or not cur.get("del"))):
+            # import hot path: append the analyzer's column arrays; a
+            # plain-dict cur (rare mixed writes) absorbs the coalesced
+            # columns instead of converting back to arrays
+            if "plazy" in cur:
+                cur["plazy"].extend(value["plazy"])
+            else:
+                cur["set"].update(_coalesce_map(value)["set"])
+        elif (strategy == "map" and "plazy" not in value
+                and not value.get("del") and "plazy" not in cur
+                and not cur.get("del")):
+            # import hot path: the memtable owns ``cur`` (layer-merged
+            # copies are made at read time), so fold the update in place
+            # instead of copying both dicts per posting key
+            cur["set"].update(value["set"])
         else:
             self.data[key] = _merge_values(strategy, cur, value)
         self.bytes += len(key) + 64
@@ -448,6 +509,10 @@ class _Memtable:
             if v is _TOMBSTONE:
                 yield k, msgpack.packb({"__tomb__": True}, use_bin_type=True)
             else:
+                if strategy == "roaringset":
+                    v = _coalesce_roaring(v)
+                elif strategy == "map":
+                    v = _coalesce_map(v)
                 yield k, _pack_value(strategy, v)
 
 
@@ -555,6 +620,20 @@ class Bucket:
                         self._mem.apply(
                             self.strategy, k,
                             {"set": v["set"], "del": set(v["del"])})
+                elif "P" in rec:  # postings-column map import frame
+                    for k, db_, tb, lb in rec["P"]:
+                        self._mem.apply(self.strategy, k, {
+                            "plazy": [(np.frombuffer(db_, np.int64),
+                                       np.frombuffer(tb, np.uint32),
+                                       np.frombuffer(lb, np.uint32))],
+                            "del": set()})
+                elif "R" in rec:  # flat roaringset import frame
+                    for k, vadd, nadd, vdel, ndel in rec["R"]:
+                        self._mem.apply(self.strategy, k, {
+                            "add": native.varint_decode(vadd,
+                                                        count_hint=nadd),
+                            "del": native.varint_decode(vdel,
+                                                        count_hint=ndel)})
                 elif "b" in rec:  # batch frame
                     for k, v in rec["b"]:
                         self._mem.apply(
@@ -595,6 +674,18 @@ class Bucket:
         if self._mem.bytes >= self.memtable_limit:
             self._seal()
 
+    def _append_frame_and_apply(self, payload: bytes, pairs) -> None:
+        """Shared tail of every batch write path: WAL append, memtable
+        apply, write-gen bump, metrics, seal check. Caller holds _lock."""
+        self._wal_bytes_metric.inc(len(payload))
+        self._mem.wal.append(payload)
+        for k, v in pairs:
+            self._mem.apply(self.strategy, k, v)
+        self._write_gen += 1
+        self._memtable_metric.set(self._mem.bytes)
+        if self._mem.bytes >= self.memtable_limit:
+            self._seal()
+
     def _log_and_apply_many(self, pairs: list[tuple[bytes, object]]) -> None:
         """One WAL frame + one memtable pass for a whole batch."""
         if self.strategy == "map" and len(pairs) > 8 and not any(
@@ -604,44 +695,30 @@ class Bucket:
             frame = [[k, {"set": v["set"], "del": sorted(v["del"])}]
                      for k, v in pairs]
             payload = msgpack.packb({"B": frame}, use_bin_type=True)
-            self._wal_bytes_metric.inc(len(payload))
-            self._mem.wal.append(payload)
-            for k, v in pairs:
-                self._mem.apply(self.strategy, k, v)
-            self._write_gen += 1
-            self._memtable_metric.set(self._mem.bytes)
-            if self._mem.bytes >= self.memtable_limit:
-                self._seal()
+            self._append_frame_and_apply(payload, pairs)
             return
         if self.strategy == "roaringset" and len(pairs) > 8 and not any(
                 v is _TOMBSTONE for _, v in pairs):
             # import hot path: varint-encode every block in ONE native call
-            # instead of one FFI/Python codec round trip per posting key
+            # and pack ONE flat frame ("R" tag) — a per-key msgpack.packb
+            # here was ~10% of the whole import profile
             adds = [v["add"] for _, v in pairs]
             dels = [v["del"] for _, v in pairs]
             enc = native.varint_encode_many(adds + dels)
             n = len(pairs)
             frame = [
-                [k, msgpack.packb(
-                    {"vadd": enc[i], "nadd": len(adds[i]),
-                     "vdel": enc[n + i], "ndel": len(dels[i])},
-                    use_bin_type=True)]
+                [k, enc[i], len(adds[i]), enc[n + i], len(dels[i])]
                 for i, (k, _v) in enumerate(pairs)
             ]
-        else:
-            frame = [
-                [k, None if v is _TOMBSTONE else _pack_value(self.strategy, v)]
-                for k, v in pairs
-            ]
+            payload = msgpack.packb({"R": frame}, use_bin_type=True)
+            self._append_frame_and_apply(payload, pairs)
+            return
+        frame = [
+            [k, None if v is _TOMBSTONE else _pack_value(self.strategy, v)]
+            for k, v in pairs
+        ]
         payload = msgpack.packb({"b": frame}, use_bin_type=True)
-        self._wal_bytes_metric.inc(len(payload))
-        self._mem.wal.append(payload)
-        for k, v in pairs:
-            self._mem.apply(self.strategy, k, v)
-        self._write_gen += 1
-        self._memtable_metric.set(self._mem.bytes)
-        if self._mem.bytes >= self.memtable_limit:
-            self._seal()
+        self._append_frame_and_apply(payload, pairs)
 
     def _seal(self) -> None:
         """Active memtable -> sealed list; fresh memtable + WAL. O(1): the
@@ -712,6 +789,33 @@ class Bucket:
             self._log_and_apply_many(pairs)
         self._backpressure()
 
+    def map_set_columns_many(
+            self, pairs: list[tuple[bytes, tuple]]) -> None:
+        """Import fast path for postings maps: each value is a COLUMN
+        triple (docs int64[], tfs, lens) from the batch analyzer. One
+        WAL frame of raw array bytes ("P" tag), lazy memtable appends —
+        the doc->payload dicts materialize once at read/flush instead of
+        per (term, doc) posting in Python."""
+        assert self.strategy == "map"
+        if not pairs:
+            return
+        frame = [
+            [k, d.astype(np.int64, copy=False).tobytes(),
+             np.asarray(t, np.uint32).tobytes(),
+             np.asarray(ln, np.uint32).tobytes()]
+            for k, (d, t, ln) in pairs
+        ]
+        payload = msgpack.packb({"P": frame}, use_bin_type=True)
+        lazy_pairs = [
+            (k, {"plazy": [(np.asarray(d, np.int64),
+                            np.asarray(t), np.asarray(ln))],
+                 "del": set()})
+            for k, (d, t, ln) in pairs
+        ]
+        with self._lock:
+            self._append_frame_and_apply(payload, lazy_pairs)
+        self._backpressure()
+
     def map_delete(self, key: bytes, map_keys) -> None:
         assert self.strategy == "map"
         with self._lock:
@@ -780,9 +884,20 @@ class Bucket:
 
         ``replace`` walks newest -> oldest and stops at the first hit;
         merge strategies fold oldest -> newest."""
+        coalesce = (_coalesce_roaring if self.strategy == "roaringset"
+                    else _coalesce_map if self.strategy == "map" else None)
         with self._lock:
-            mem_layers = [m.data.get(key) for m in self._sealed]
-            mem_layers.append(self._mem.data.get(key))
+            mem_layers = []
+            for m in [*self._sealed, self._mem]:
+                v = m.data.get(key)
+                if coalesce is not None and isinstance(v, dict):
+                    canon = coalesce(v)
+                    if canon is not v:
+                        # write the canonical form back so a hot key is
+                        # coalesced once, not on every read
+                        m.data[key] = canon
+                    v = canon
+                mem_layers.append(v)
             segments = list(self._segments)
         if self.strategy == "replace":
             for v in reversed(mem_layers):
@@ -859,12 +974,18 @@ class Bucket:
                 yield k, rank, v
 
         def mem_iter(data, rank):
+            coalesce = (_coalesce_roaring if self.strategy == "roaringset"
+                        else _coalesce_map if self.strategy == "map"
+                        else None)
             for k in sorted(data):
                 if start is not None and k < start:
                     continue
                 if stop is not None and k >= stop:
                     return
-                yield k, rank, data[k]
+                v = data[k]
+                if coalesce is not None and isinstance(v, dict):
+                    v = coalesce(v)
+                yield k, rank, v
 
         iters = [seg_iter(s, i) for i, s in enumerate(segments)]
         iters += [mem_iter(d, len(segments) + i) for i, d in enumerate(mems)]

@@ -338,6 +338,7 @@ class InvertedIndex:
         """Batch insert: one WAL frame per bucket family per batch
         (reference: updateInvertedIndexLSM per put, shard_write_put.go:454)."""
         search_upd: dict[bytes, dict] = {}
+        search_cols: dict[bytes, list] = {}  # key -> [(docs, tfs, lens)...]
         filter_add: dict[bytes, set] = {}
         numeric_add: dict[bytes, set] = {}
         null_add: dict[bytes, set] = {}
@@ -352,7 +353,7 @@ class InvertedIndex:
         # property of the value, so index/unindex key derivation stays
         # consistent either way.
         text_handled = self._index_text_batch(
-            objs, search_upd, filter_add, prop_len_delta)
+            objs, search_cols, filter_add, prop_len_delta)
 
         for obj in objs:
             doc = obj.doc_id
@@ -373,6 +374,13 @@ class InvertedIndex:
         with self._lock:
             if search_upd:
                 self.searchable_bucket.map_set_many(search_upd.items())
+            if search_cols:
+                self.searchable_bucket.map_set_columns_many([
+                    (k, (parts[0] if len(parts) == 1 else (
+                        np.concatenate([p[0] for p in parts]),
+                        np.concatenate([p[1] for p in parts]),
+                        np.concatenate([p[2] for p in parts]))))
+                    for k, parts in search_cols.items()])
             filter_add.setdefault(_ALL_DOCS, set()).update(all_docs)
             self.filter_bucket.bitmap_add_many(filter_add.items())
             if numeric_add:
@@ -392,6 +400,8 @@ class InvertedIndex:
             # cache invalidation for every touched key
             for k in search_upd:
                 self._post_cache.pop(k)
+            for k in search_cols:
+                self._post_cache.pop(k)
             for k in filter_add:
                 self._bitmap_cache.pop((B_FILTER, k))
             for k in numeric_add:
@@ -404,7 +414,7 @@ class InvertedIndex:
     _JOIN_BY_TOKENIZATION = {"word": "\x01", "lowercase": " ",
                              "whitespace": " "}
 
-    def _index_text_batch(self, objs, search_upd, filter_add,
+    def _index_text_batch(self, objs, search_cols, filter_add,
                           prop_len_delta) -> set:
         """Batch-analyze ASCII text properties through the native analyzer
         (one FFI call per prop per batch). Returns the (prop, doc) pairs
@@ -458,13 +468,19 @@ class InvertedIndex:
             pfx = name.encode() + _SEP
             docs_arr = np.asarray(docs, dtype=np.int64)
             if prop.index_searchable:
-                rt = row_tokens.tolist()
+                # COLUMN postings: slice the analyzer's arrays per term —
+                # no per-(term, doc) Python loop; the storage layer's
+                # map_set_columns_many keeps them as arrays until flush
                 for t_i, t in enumerate(terms):
                     key = pfx + t.encode()
-                    m = search_upd.setdefault(key, {})
-                    for j in range(int(eoffs[t_i]), int(eoffs[t_i + 1])):
-                        r = int(rows[j])
-                        m[docs[r]] = [int(tfs[j]), rt[r]]
+                    sl = slice(int(eoffs[t_i]), int(eoffs[t_i + 1]))
+                    cols = (docs_arr[rows[sl]], tfs[sl],
+                            row_tokens[rows[sl]])
+                    cur = search_cols.get(key)
+                    if cur is None:
+                        search_cols[key] = [cols]
+                    else:
+                        cur.append(cols)
                 d = prop_len_delta.setdefault(name, [0, 0])
                 d[0] += int(row_tokens.sum())
                 d[1] += len(docs)
