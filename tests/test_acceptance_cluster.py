@@ -182,7 +182,7 @@ def test_cluster_wide_backup_restore(cluster, tmp_path_factory):
     assert st["status"] == "SUCCESS", st
 
     c0.delete_class("BK")
-    _wait(lambda: "BK" not in [cl["name"] for cl in
+    _wait(lambda: "BK" not in [cl["class"] for cl in
                                c1.get_schema()["classes"]])
 
     c0.request("POST", "/v1/backups/filesystem/cb1/restore",
@@ -263,7 +263,7 @@ def test_node_failure_detection_and_quorum(tmp_path_factory):
         c0.create_class({"class": "PostFailure", "properties": [
             {"name": "x", "dataType": ["text"]}]})
         _wait(lambda: "PostFailure" in [
-            c["name"] for c in c0.get_schema()["classes"]])
+            c["class"] for c in c0.get_schema()["classes"]])
     finally:
         for n in nodes:
             if n.name != victim_name:

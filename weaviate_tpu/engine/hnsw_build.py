@@ -306,7 +306,7 @@ def _self_drop_jit(kd, keep: int):
     return _SELF_DROP_JIT(kd, keep=keep)
 
 
-def _device_symmetrize(fwd, m_live: int):
+def _device_symmetrize(fwd):
     """Union forward links with reverse edges (cap budget each way), on
     device: one sort of the edge list + position-in-group scatter —
     the vectorized twin of the host path below. Jitted ONCE at module
@@ -475,10 +475,6 @@ def _device_link_layer(vectors: np.ndarray, members: np.ndarray,
     layer: intermediates ([M, C] candidate tensors, ~0.5-1 GB at 1M rows)
     never cross the tunnel; only the final [M, budget] link table comes
     back. Returns positions into ``members`` (-1 padded)."""
-    import jax.numpy as jnp
-
-    import jax
-
     sub = vectors[members]
     n = len(sub)
     k_eff = min(knn_k + 1, n)
@@ -489,7 +485,7 @@ def _device_link_layer(vectors: np.ndarray, members: np.ndarray,
     # per-call closures retrace every build
     knn_dev = _self_drop_jit(knn_dev, min(knn_k, n - 1))
     fwd = _device_select(xd, knn_dev, budget, metric)
-    union = _device_symmetrize(fwd, n)
+    union = _device_symmetrize(fwd)
     final = _device_select(xd, union, budget, metric)
     # fetch int32 — the int64 copy doubled a ~0.5 GB tunnel download at 1M
     return np.asarray(final)
