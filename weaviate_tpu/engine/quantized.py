@@ -439,6 +439,7 @@ class QuantizedVectorStore:
             return bq_ops.bq_topk_twostage(
                 qw, self.codes, self.prefix_t, k=k_cand,
                 refine=max(2, self.rescore_limit // 2), valid=valid,
+                use_pallas=self.use_pallas,
             )
         return bq_ops.bq_topk(
             qw, self.codes, k=k_cand, chunk_size=cs, valid=valid,

@@ -71,6 +71,18 @@ def bq_topk(
     XLA XOR+popcount pass (small corpora / CPU tests). ``chunk_size`` is
     accepted for API compatibility; the fused kernel supertiles
     internally.
+
+    EXACTNESS: the two paths do NOT return identical result sets. The
+    fallback (``use_pallas=False``) is fully exact. The pallas path is
+    approximate twice over — the strided block-argmin keeps one winner
+    per ``reduce_l`` rows (a true top-k member is dropped whenever two
+    winners share a block; birthday-bound loss ~k^2/(2*N/reduce_l)) and
+    the survivor selection uses ``approx_max_k`` (recall~0.95 per spec).
+    ``reduce_l=1`` removes only the block-argmin loss — the approx_max_k
+    selection still applies, so the pallas path never matches the
+    fallback bit-for-bit; exact parity requires ``use_pallas=False``.
+    Production callers oversample + rescore as QuantizedVectorStore
+    does, which absorbs the loss (measured recall deltas in PARITY.md).
     """
     from weaviate_tpu.ops.distances import MASKED_DISTANCE
     from weaviate_tpu.ops.topk import topk_smallest

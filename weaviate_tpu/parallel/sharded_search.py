@@ -297,6 +297,14 @@ def sharded_ivf_pq_topk(
     k local candidates to an all_gather merge over ICI — slots, not
     vectors, cross the interconnect (the SPMD analog of the reference's
     scatter-gather, index.go:1541).
+
+    NOTE: returned distances are int8-quantized ADC approximations (the
+    per-query LUT quantization in engine/ivf adds ~0.4% distance error)
+    and are NOT exact-rescored here — the merged candidate SLOTS are the
+    contract. Callers that surface distances (or need exact ordering at
+    the top) must rescore the merged candidates against full-precision
+    rows on the owning device or host, as QuantizedVectorStore.search
+    does for the single-device path.
     """
     from weaviate_tpu.engine.ivf import _ivf_probe_topk_pq
 
