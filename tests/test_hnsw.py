@@ -209,3 +209,66 @@ def test_via_shard_config(tmp_path, corpus):
     idx.add_batch(np.arange(50), corpus[:50])
     got, _ = idx.search_by_vector(corpus[5], 3)
     assert 5 in got.tolist()
+
+
+def test_native_walker_parity(corpus):
+    """The C++ walker (csrc wn_hnsw_*) and the Python walker must agree:
+    same graph, near-identical result sets (fp summation order may flip
+    exact ties), both above the recall gate. The Python walker is the
+    conformance oracle for the native one."""
+    from weaviate_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    idx = HNSWIndex(dim=32, max_connections=16, ef_construction=64, ef=64)
+    idx.add_batch(np.arange(len(corpus)), corpus)
+    assert idx._native is not None and not idx._native_dirty
+    rng = np.random.default_rng(3)
+    qs = rng.standard_normal((20, 32)).astype(np.float32)
+    overlaps, recalls = [], []
+    for q in qs:
+        ids_n, d_n = idx.search_by_vector(q, 10)
+        # force the Python walker on the same graph
+        nat, idx._native = idx._native, None
+        try:
+            ids_p, d_p = idx.search_by_vector(q, 10)
+        finally:
+            idx._native = nat
+        overlaps.append(len(set(ids_n.tolist()) & set(ids_p.tolist())) / 10)
+        gt = brute_force(corpus, q, 10)
+        recalls.append(len(set(ids_n.tolist()) & set(gt.tolist())) / 10)
+        # distances ascend and match the python walker's where ids agree
+        assert np.all(np.diff(d_n) >= -1e-6)
+    assert np.mean(overlaps) >= 0.97
+    assert np.mean(recalls) >= 0.95
+
+
+def test_native_walker_tombstones_and_filter(corpus):
+    """Native output filter: tombstoned docs never return; allow-list
+    (graph path, above flat cutoff) restricts results; updates reroute
+    to the new slot."""
+    from weaviate_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    idx = HNSWIndex(dim=32, max_connections=16, ef_construction=64, ef=64,
+                    flat_cutoff=0)  # force every filtered query to the graph
+    idx.add_batch(np.arange(500), corpus[:500])
+    q = corpus[7]
+    ids, _ = idx.search_by_vector(q, 5)
+    assert ids[0] == 7
+    idx.delete(7)
+    ids, _ = idx.search_by_vector(q, 5)
+    assert 7 not in ids.tolist()
+    # update doc 9 to be exactly at q: must come back first
+    idx.add(9, q)
+    ids, _ = idx.search_by_vector(q, 5)
+    assert ids[0] == 9
+    allow = np.arange(100, 200)
+    ids, _ = idx.search_by_vector(q, 5, allow_list=allow)
+    assert len(ids) and all(100 <= i < 200 for i in ids.tolist())
+    # cleanup burns slots; burned docs stay gone through the native path
+    idx.delete(*range(100, 150))
+    idx.cleanup_tombstones()
+    ids, _ = idx.search_by_vector(q, 20, allow_list=allow)
+    assert all(150 <= i < 200 for i in ids.tolist())

@@ -192,7 +192,7 @@ def test_analyze_batch_matches_python_tokenizer():
             for t_i, t in enumerate(terms):
                 for j in range(int(eoffs[t_i]), int(eoffs[t_i + 1])):
                     if int(rows[j]) == r:
-                        got[t] = int(tfs[j])
+                        got[t.decode("ascii")] = int(tfs[j])
             assert got == dict(c), (mode, r, v, got, dict(c))
 
 

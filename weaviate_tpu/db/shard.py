@@ -340,7 +340,6 @@ class Shard:
                 if old_raw is not None:
                     self._delete_doc(int(old_raw), obj.uuid)
                 obj.doc_id = first_id + i
-                self.tombstones.delete(obj.uuid.encode())
                 docid_puts.append((obj.uuid.encode(), obj.doc_id))
                 self._doc_to_uuid[obj.doc_id] = obj.uuid
                 object_puts.append((obj.uuid.encode(), obj.to_bytes()))
@@ -355,6 +354,8 @@ class Shard:
             # result resolution drops them), never missing postings for a
             # visible object. The objects-bucket WAL is the commit point.
             self._inverted.index_objects(objs)
+            # clear any prior delete markers in one frame
+            self.tombstones.delete_many(k for k, _ in docid_puts)
             self.docid.put_many(docid_puts)
             self.objects.put_many(object_puts)
             for vec_name, (ids, vecs) in vec_batches.items():

@@ -93,6 +93,14 @@ class HNSWIndex:
         self._ep = -1  # entrypoint slot
         self._max_level = -1
 
+        # native graph mirror (csrc wn_hnsw_*): the C++ walker replaces the
+        # Python heap loop for searches AND the per-layer ef-search of
+        # inserts; kept current incrementally via _set_links / vector /
+        # tombstone writes, re-uploaded in one batched sync after bulk
+        # mutations (bulk_build / restore / WAL replay mark it dirty)
+        self._native = None
+        self._native_dirty = False
+
         self._log: WriteAheadLog | None = None
         self._log_dir = commit_log_dir
         self._condense_above = condense_above_bytes
@@ -100,6 +108,16 @@ class HNSWIndex:
             os.makedirs(commit_log_dir, exist_ok=True)
             self._replay(commit_log_dir)
             self._log = WriteAheadLog(os.path.join(commit_log_dir, "hnsw.wal"))
+
+        if self._native is None:
+            from weaviate_tpu import native as _nat
+
+            if _nat.hnsw_supported(metric):
+                try:
+                    self._native = _nat.HnswNative(dim, metric)
+                except Exception:
+                    self._native = None
+        self._native_dirty = self._count > 0
 
     # -- distance (host batch engine) ----------------------------------------
 
@@ -174,6 +192,13 @@ class HNSWIndex:
         a list of (dist, slot) tuples. Tombstoned nodes are traversed but
         returned too — callers filter; pruning them here would disconnect
         regions behind tombstones (same reason the reference keeps them)."""
+        if (self._native is not None and not self._native_dirty
+                and self._adc_lut is None):
+            d, s = self._native.search_layer(
+                q, ef, layer,
+                np.asarray([slot for _d, slot in eps], dtype=np.int64),
+                np.asarray([dd for dd, _s in eps], dtype=np.float32))
+            return list(zip(d.tolist(), s.tolist()))
         # epoch-stamped visited marks: allocation-free per call (a fresh
         # bool[capacity] per layer-search dominates at 1M-slot capacities)
         self._visit_epoch += 1
@@ -227,6 +252,47 @@ class HNSWIndex:
                     dist, slot = float(dists[j]), int(neigh[j])
                     improved = True
         return dist, slot
+
+    # -- native mirror --------------------------------------------------------
+
+    def _native_sync(self):
+        """Re-upload the whole graph to the native mirror in one batched
+        pass — the recovery path after mutations that bypass the
+        incremental mirror (bulk_build's direct link writes, restore,
+        WAL replay). O(count) once; incremental afterward."""
+        nat = self._native
+        if nat is None:
+            return
+        nat.reset(len(self._vecs))
+        n = self._count
+        if n:
+            nat.set_vectors(0, np.ascontiguousarray(self._vecs[:n]))
+            slots: list[int] = []
+            layers: list[int] = []
+            counts: list[int] = []
+            total = 0
+            for s in range(n):
+                for ly, arr in enumerate(self._links[s]):
+                    slots.append(s)
+                    layers.append(ly)
+                    counts.append(len(arr))
+                    total += len(arr)
+            if slots:
+                neigh = np.empty(total, dtype=np.int32)
+                pos = 0
+                for s in range(n):
+                    for arr in self._links[s]:
+                        neigh[pos:pos + len(arr)] = arr
+                        pos += len(arr)
+                nat.set_links_batch(
+                    np.asarray(slots, dtype=np.int64),
+                    np.asarray(layers, dtype=np.int32),
+                    np.asarray(counts, dtype=np.int32), neigh)
+            dead = np.nonzero(self._tombstone[:n]
+                              | (self._doc_ids[:n] < 0))[0]
+            if len(dead):
+                nat.set_tombstones(dead)
+        self._native_dirty = False
 
     # -- neighbor selection (heuristic.go) ------------------------------------
 
@@ -291,6 +357,8 @@ class HNSWIndex:
         while len(links) <= layer:
             links.append(np.empty(0, dtype=np.int32))
         links[layer] = np.asarray(neighbors, dtype=np.int32)
+        if self._native is not None:
+            self._native.set_links(slot, layer, links[layer])
         if self._log is not None:
             self._log.append(pickle.dumps(
                 ("L", int(self._doc_ids[slot]), layer,
@@ -335,6 +403,10 @@ class HNSWIndex:
         if vectors.shape[1] != self.dim:
             raise ValueError(f"vector dim {vectors.shape[1]} != index dim {self.dim}")
         with self._lock:
+            if self._native_dirty and self._native is not None:
+                # catch up after a bulk mutation so incremental inserts
+                # keep the fast per-layer search
+                self._native_sync()
             # dispatch decided under the lock: a concurrent first batch
             # must not race two bulk_builds (the RLock makes the nested
             # bulk_build acquisition re-entrant). Non-MXU metrics keep the
@@ -367,11 +439,15 @@ class HNSWIndex:
             # re-adds under a new doc id; inside one index this is the analog)
             self._tombstone[old] = True
             self._doc_ids[old] = -1
+            if self._native is not None:
+                self._native.set_tombstones([old])
         slot = self._count
         self._grow(slot + 1)
         self._count += 1
         level = int(-math.log(max(self._rng.random(), 1e-12)) * self._ml)
         self._vecs[slot] = vec
+        if self._native is not None:
+            self._native.set_vectors(slot, vec)
         if self._codes is not None:
             if code is None:
                 from weaviate_tpu.ops.pq import pq_encode
@@ -412,15 +488,19 @@ class HNSWIndex:
     def delete(self, *doc_ids) -> None:
         """Tombstone (reference delete.go: delete marks, cleanup re-links)."""
         with self._lock:
+            dead_slots = []
             for doc_id in doc_ids:
                 slot = self._id_to_slot.pop(int(doc_id), None)
                 if slot is None:
                     continue
                 self._tombstone[slot] = True
                 self._doc_ids[slot] = -1
+                dead_slots.append(slot)
                 if self._log is not None:
                     self._log.append(pickle.dumps(("D", int(doc_id)),
                                                   protocol=pickle.HIGHEST_PROTOCOL))
+            if self._native is not None and dead_slots:
+                self._native.set_tombstones(dead_slots)
 
     def cleanup_tombstones(self) -> int:
         """Physically unlink tombstoned nodes, re-linking their neighbors
@@ -458,6 +538,12 @@ class HNSWIndex:
                 self._links[slot] = []
                 self._levels[slot] = -1
                 self._tombstone[slot] = False  # slot stays burned (not reused)
+                if self._native is not None:
+                    self._native.clear_links(slot)
+            if self._native is not None:
+                # burned slots stay tombstoned in the mirror: the native
+                # output filter is the only doc_id<0 check it has
+                self._native.set_tombstones(dead)
             if self._ep in dead_set:
                 self._elect_entrypoint()
             return len(dead)
@@ -515,6 +601,19 @@ class HNSWIndex:
             if self._ep < 0:
                 return (np.empty(0, np.int64), np.empty(0, np.float32))
             ef = max(self._effective_ef(k), k)
+            if self._native is not None and self._codes is None:
+                # fused native walk: greedy descent + layer-0 ef-search +
+                # live/allowed filter in one C++ call (the ≥2k-QPS serving
+                # path; the Python walker below is the fallback/oracle)
+                if self._native_dirty:
+                    self._native_sync()
+                allow_u8 = None
+                if allowed is not None:
+                    allow_u8 = np.zeros(len(self._vecs), dtype=np.uint8)
+                    allow_u8[allowed] = 1
+                d, s = self._native.search(q, k, ef, self._ep,
+                                           self._max_level, allow_u8)
+                return self._doc_ids[s].copy(), d.astype(np.float32)
             if self._codes is not None:
                 # compressed traversal: ADC hops, oversampled frontier,
                 # exact rescore of the result set (compress.go pattern)
@@ -716,6 +815,7 @@ class HNSWIndex:
             m = snap["pq_codes"].shape[1]
             idx._codes = np.zeros((len(idx._vecs), m), dtype=np.uint8)
             idx._codes[:n] = snap["pq_codes"]
+        idx._native_dirty = True  # fields were set past the mirror
         return idx
 
     # -- commit log (reference commit_logger.go / condensor.go) ---------------
@@ -805,6 +905,7 @@ class HNSWIndex:
 
             self._codes[snap_count: self._count] = pq_encode(
                 self._pq_codebook, self._vecs[snap_count: self._count])
+        self._native_dirty = True  # replay mutates links past the mirror
 
     def close(self):
         if self._log is not None:

@@ -549,6 +549,9 @@ def bulk_build(index, doc_ids, vectors: np.ndarray, knn_k: int = 64,
         top = int(np.nonzero(levels == max_level)[0][0])
         index._ep = top
         index._max_level = max_level
+        # vectors/levels/links were written past the native mirror — one
+        # batched re-upload on next use
+        index._native_dirty = True
         if index._log is not None:
             index.condense()
 

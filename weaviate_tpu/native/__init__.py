@@ -73,7 +73,9 @@ def _load():
         i64p = ctypes.POINTER(ctypes.c_int64)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         f32p = ctypes.POINTER(ctypes.c_float)
+        i32p = ctypes.POINTER(ctypes.c_int32)
         i64 = ctypes.c_int64
+        i32 = ctypes.c_int32
         for name, args, res in [
             ("wn_intersect_u64", [u64p, i64, u64p, i64, u64p], i64),
             ("wn_union_u64", [u64p, i64, u64p, i64, u64p], i64),
@@ -88,6 +90,40 @@ def _load():
              [u8p, i64p, i64p, i64p, ctypes.POINTER(ctypes.c_uint32), i64p],
              None),
             ("wn_varint_encode_many", [u64p, i64p, i64, u8p, i64p], i64),
+            ("wn_pt_new", [i32], ctypes.c_void_p),
+            ("wn_pt_free", [ctypes.c_void_p], None),
+            ("wn_pt_bytes", [ctypes.c_void_p], i64),
+            ("wn_pt_count", [ctypes.c_void_p], i64),
+            ("wn_pt_map_columns",
+             [ctypes.c_void_p, u8p, i64, u8p, i64p, i64, i64p, i64p,
+              ctypes.POINTER(ctypes.c_uint32),
+              ctypes.POINTER(ctypes.c_uint32), i32], i64),
+            ("wn_pt_map_delete",
+             [ctypes.c_void_p, u8p, i64, u8p, i64p, i64, i64p, i64p], None),
+            ("wn_pt_roar",
+             [ctypes.c_void_p, u8p, i64, u8p, i64p, i64, i64p, u64p, i32,
+              i32], i64),
+            ("wn_pt_tomb", [ctypes.c_void_p, u8p, i64], None),
+            ("wn_pt_items", [ctypes.c_void_p, u8p, i64, u8p, i64], i64),
+            ("wn_pt_get", [ctypes.c_void_p, u8p, i64], i64),
+            ("wn_pt_fetch", [u8p], None),
+            ("wn_hnsw_new", [i32, i32], ctypes.c_void_p),
+            ("wn_hnsw_free", [ctypes.c_void_p], None),
+            ("wn_hnsw_reset", [ctypes.c_void_p, i64], None),
+            ("wn_hnsw_set_vectors", [ctypes.c_void_p, i64, i64, f32p], None),
+            ("wn_hnsw_set_links", [ctypes.c_void_p, i64, i32, i32, i32p],
+             None),
+            ("wn_hnsw_set_links_batch",
+             [ctypes.c_void_p, i64, i64p, i32p, i32p, i32p], None),
+            ("wn_hnsw_clear_links", [ctypes.c_void_p, i64], None),
+            ("wn_hnsw_set_tombstones", [ctypes.c_void_p, i64p, i64, i32],
+             None),
+            ("wn_hnsw_search_layer",
+             [ctypes.c_void_p, f32p, i64, i32, i64p, f32p, i64, i64p, f32p],
+             i64),
+            ("wn_hnsw_search",
+             [ctypes.c_void_p, f32p, i64, i64, i64, i32, u8p, i64p, f32p],
+             i64),
         ]:
             fn = getattr(lib, name)
             fn.argtypes = args
@@ -314,8 +350,10 @@ def analyze_batch(values: list[str], tokenization: str):
         _ptr(entry_offs, ctypes.c_int64), _ptr(entry_rows, ctypes.c_int64),
         _ptr(entry_tfs, ctypes.c_uint32), _ptr(row_tokens, ctypes.c_int64))
     raw = terms_blob.tobytes()
-    terms = [raw[term_offs[t]:term_offs[t + 1]].decode("ascii")
-             for t in range(nt)]
+    # terms stay BYTES: every consumer (posting keys, cache keys) wants
+    # prefix + term as bytes — decoding to str here forced an immediate
+    # re-encode per term on the import hot path
+    terms = [raw[term_offs[t]:term_offs[t + 1]] for t in range(nt)]
     return (terms, entry_offs, entry_rows[:ne], entry_tfs[:ne],
             row_tokens[:len(values)])
 
@@ -346,3 +384,268 @@ def varint_encode_many(arrays: list[np.ndarray]):
         res.append(blob[pos:pos + n])
         pos += n
     return res
+
+
+# ---- HNSW graph walker (csrc wn_hnsw_*) ----------------------------------
+
+# engine/hnsw.py metric names -> native metric ids (csrc hnsw_dist)
+_HNSW_METRIC_IDS = {"l2-squared": 0, "dot": 1, "cosine": 2, "cosine-dot": 2,
+                    "manhattan": 3, "hamming": 4}
+
+
+def hnsw_supported(metric: str) -> bool:
+    return available() and metric in _HNSW_METRIC_IDS
+
+
+class HnswNative:
+    """Native mirror of an HNSW graph.
+
+    The graph-search hot loop (reference search.go:173-341) runs in C++
+    over a mirrored copy of the Python graph; engine/hnsw.py keeps the
+    mirror current incrementally (_set_links / vector writes /
+    tombstones) and re-uploads in one batched sync after bulk mutations.
+    There is deliberately NO numpy fallback here — when the native lib
+    is absent the engine keeps its original Python walker, which IS the
+    fallback (and the conformance oracle in tests/test_hnsw.py).
+    """
+
+    def __init__(self, dim: int, metric: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.dim = int(dim)
+        self._h = ctypes.c_void_p(
+            lib.wn_hnsw_new(self.dim, _HNSW_METRIC_IDS[metric]))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.wn_hnsw_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def reset(self, cap: int):
+        self._lib.wn_hnsw_reset(self._h, int(cap))
+
+    def set_vectors(self, slot0: int, vecs: np.ndarray):
+        vecs = np.ascontiguousarray(vecs, dtype=np.float32)
+        if vecs.ndim == 1:
+            vecs = vecs[None, :]
+        self._lib.wn_hnsw_set_vectors(self._h, int(slot0), len(vecs),
+                                      _ptr(vecs, ctypes.c_float))
+
+    def set_links(self, slot: int, layer: int, neigh: np.ndarray):
+        neigh = np.ascontiguousarray(neigh, dtype=np.int32)
+        self._lib.wn_hnsw_set_links(self._h, int(slot), int(layer),
+                                    len(neigh), _ptr(neigh, ctypes.c_int32))
+
+    def set_links_batch(self, slots: np.ndarray, layers: np.ndarray,
+                        counts: np.ndarray, neigh: np.ndarray):
+        slots = np.ascontiguousarray(slots, dtype=np.int64)
+        layers = np.ascontiguousarray(layers, dtype=np.int32)
+        counts = np.ascontiguousarray(counts, dtype=np.int32)
+        neigh = np.ascontiguousarray(neigh, dtype=np.int32)
+        self._lib.wn_hnsw_set_links_batch(
+            self._h, len(slots), _ptr(slots, ctypes.c_int64),
+            _ptr(layers, ctypes.c_int32), _ptr(counts, ctypes.c_int32),
+            _ptr(neigh, ctypes.c_int32))
+
+    def clear_links(self, slot: int):
+        self._lib.wn_hnsw_clear_links(self._h, int(slot))
+
+    def set_tombstones(self, slots, val: bool = True):
+        slots = np.ascontiguousarray(slots, dtype=np.int64)
+        if len(slots) == 0:
+            return
+        self._lib.wn_hnsw_set_tombstones(self._h, _ptr(slots, ctypes.c_int64),
+                                         len(slots), 1 if val else 0)
+
+    def search_layer(self, q: np.ndarray, ef: int, layer: int,
+                     ep_slots: np.ndarray, ep_dists: np.ndarray):
+        """One-layer ef-search (insert path). Returns (dists, slots)
+        ascending; tombstoned nodes included, as in the Python walker."""
+        q = np.ascontiguousarray(q, dtype=np.float32)
+        ep_slots = np.ascontiguousarray(ep_slots, dtype=np.int64)
+        ep_dists = np.ascontiguousarray(ep_dists, dtype=np.float32)
+        cap = int(ef) + len(ep_slots)
+        out_s = np.empty(cap, dtype=np.int64)
+        out_d = np.empty(cap, dtype=np.float32)
+        n = self._lib.wn_hnsw_search_layer(
+            self._h, _ptr(q, ctypes.c_float), int(ef), int(layer),
+            _ptr(ep_slots, ctypes.c_int64), _ptr(ep_dists, ctypes.c_float),
+            len(ep_slots), _ptr(out_s, ctypes.c_int64),
+            _ptr(out_d, ctypes.c_float))
+        return out_d[:n], out_s[:n]
+
+    def search(self, q: np.ndarray, k: int, ef: int, ep: int,
+               max_level: int, allow: np.ndarray | None = None):
+        """Fused query search: greedy descent + layer-0 ef-search +
+        live/allowed output filter. Returns (dists, slots) ascending."""
+        q = np.ascontiguousarray(q, dtype=np.float32)
+        out_s = np.empty(max(int(k), 1), dtype=np.int64)
+        out_d = np.empty(max(int(k), 1), dtype=np.float32)
+        if allow is not None:
+            allow = np.ascontiguousarray(allow, dtype=np.uint8)
+            ap = _ptr(allow, ctypes.c_uint8)
+        else:
+            ap = None
+        n = self._lib.wn_hnsw_search(
+            self._h, _ptr(q, ctypes.c_float), int(k), int(ef), int(ep),
+            int(max_level), ap, _ptr(out_s, ctypes.c_int64),
+            _ptr(out_d, ctypes.c_float))
+        return out_d[:n], out_s[:n]
+
+
+# ---- postings memtable (csrc wn_pt_*) ------------------------------------
+
+
+def _keys_blob(keys: list[bytes]):
+    blob = b"".join(keys)
+    offs = np.zeros(len(keys) + 1, dtype=np.int64)
+    np.cumsum([len(k) for k in keys], out=offs[1:])
+    return np.frombuffer(blob, dtype=np.uint8) if blob else \
+        np.zeros(1, np.uint8), offs
+
+
+_EMPTY_U8 = None
+
+
+def _empty_u8():
+    global _EMPTY_U8
+    if _EMPTY_U8 is None:
+        _EMPTY_U8 = np.zeros(1, dtype=np.uint8)
+    return _EMPTY_U8
+
+
+class PostingsTable:
+    """Native memtable for the "map" / "roaringset" LSM strategies.
+
+    One instance backs one kv.py _Memtable; the Python dict memtable is
+    the fallback (WEAVIATE_TPU_NO_NATIVE=1) and conformance oracle.
+    Batched writes return the WAL frame payload produced in the same
+    native call; reads come back as msgpack documents in the exact
+    shapes kv.py _unpack_value produces.
+    """
+
+    def __init__(self, strategy: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.strategy = strategy
+        self._h = ctypes.c_void_p(
+            lib.wn_pt_new(0 if strategy == "map" else 1))
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.wn_pt_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    @property
+    def bytes(self) -> int:
+        return self._lib.wn_pt_bytes(self._h)
+
+    def __len__(self) -> int:
+        return self._lib.wn_pt_count(self._h)
+
+    def _fetch(self, n: int) -> bytes:
+        out = np.empty(max(n, 1), dtype=np.uint8)
+        self._lib.wn_pt_fetch(_ptr(out, ctypes.c_uint8))
+        return out[:n].tobytes()
+
+    def map_columns(self, keys: list[bytes], entry_offs: np.ndarray,
+                    docs: np.ndarray, tfs: np.ndarray, lens: np.ndarray,
+                    prefix: bytes = b"", frame: bool = True) -> bytes | None:
+        """Apply per-key postings columns; returns the "P" WAL frame."""
+        kb, koffs = _keys_blob(keys)
+        docs = np.ascontiguousarray(docs, dtype=np.int64)
+        tfs = np.ascontiguousarray(tfs, dtype=np.uint32)
+        lens = np.ascontiguousarray(lens, dtype=np.uint32)
+        entry_offs = np.ascontiguousarray(entry_offs, dtype=np.int64)
+        pfx = (np.frombuffer(prefix, dtype=np.uint8) if prefix
+               else _empty_u8())
+        n = self._lib.wn_pt_map_columns(
+            self._h, _ptr(pfx, ctypes.c_uint8), len(prefix),
+            _ptr(kb, ctypes.c_uint8), _ptr(koffs, ctypes.c_int64),
+            len(keys), _ptr(entry_offs, ctypes.c_int64),
+            _ptr(docs if len(docs) else np.zeros(1, np.int64),
+                 ctypes.c_int64),
+            _ptr(tfs if len(tfs) else np.zeros(1, np.uint32),
+                 ctypes.c_uint32),
+            _ptr(lens if len(lens) else np.zeros(1, np.uint32),
+                 ctypes.c_uint32),
+            1 if frame else 0)
+        return self._fetch(n) if frame else None
+
+    def map_delete(self, keys: list[bytes], entry_offs: np.ndarray,
+                   del_docs: np.ndarray):
+        kb, koffs = _keys_blob(keys)
+        del_docs = np.ascontiguousarray(del_docs, dtype=np.int64)
+        entry_offs = np.ascontiguousarray(entry_offs, dtype=np.int64)
+        self._lib.wn_pt_map_delete(
+            self._h, _ptr(_empty_u8(), ctypes.c_uint8), 0,
+            _ptr(kb, ctypes.c_uint8), _ptr(koffs, ctypes.c_int64),
+            len(keys), _ptr(entry_offs, ctypes.c_int64),
+            _ptr(del_docs if len(del_docs) else np.zeros(1, np.int64),
+                 ctypes.c_int64))
+
+    def roar(self, keys: list[bytes], entry_offs: np.ndarray,
+             ids: np.ndarray, is_del: bool = False, prefix: bytes = b"",
+             frame: bool = True) -> bytes | None:
+        """Apply per-key id blocks (unsorted ok); returns the "R" frame."""
+        kb, koffs = _keys_blob(keys)
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        entry_offs = np.ascontiguousarray(entry_offs, dtype=np.int64)
+        pfx = (np.frombuffer(prefix, dtype=np.uint8) if prefix
+               else _empty_u8())
+        n = self._lib.wn_pt_roar(
+            self._h, _ptr(pfx, ctypes.c_uint8), len(prefix),
+            _ptr(kb, ctypes.c_uint8), _ptr(koffs, ctypes.c_int64),
+            len(keys), _ptr(entry_offs, ctypes.c_int64),
+            _ptr(ids if len(ids) else np.zeros(1, np.uint64),
+                 ctypes.c_uint64),
+            1 if is_del else 0, 1 if frame else 0)
+        return self._fetch(n) if frame else None
+
+    def tomb(self, key: bytes):
+        kb = np.frombuffer(key, dtype=np.uint8)
+        self._lib.wn_pt_tomb(self._h, _ptr(kb, ctypes.c_uint8), len(key))
+
+    def get_packed(self, key: bytes) -> bytes | None:
+        """msgpack value for one key (kv.py _unpack_value shape), or None."""
+        kb = np.frombuffer(key, dtype=np.uint8) if key else _empty_u8()
+        n = self._lib.wn_pt_get(self._h, _ptr(kb, ctypes.c_uint8), len(key))
+        if n < 0:
+            return None
+        return self._fetch(n)
+
+    def packed_items(self, start: bytes | None = None,
+                     stop: bytes | None = None):
+        """Ascending (key, msgpack-value) pairs in [start, stop)."""
+        sb = (np.frombuffer(start, dtype=np.uint8) if start
+              else _empty_u8())
+        tb = (np.frombuffer(stop, dtype=np.uint8) if stop
+              else _empty_u8())
+        n = self._lib.wn_pt_items(
+            self._h, _ptr(sb, ctypes.c_uint8),
+            len(start) if start is not None else -1,
+            _ptr(tb, ctypes.c_uint8),
+            len(stop) if stop is not None else -1)
+        blob = self._fetch(n)
+        out = []
+        pos = 0
+        while pos < len(blob):
+            kl = int.from_bytes(blob[pos:pos + 4], "little")
+            pos += 4
+            k = blob[pos:pos + kl]
+            pos += kl
+            vl = int.from_bytes(blob[pos:pos + 4], "little")
+            pos += 4
+            out.append((k, blob[pos:pos + vl]))
+            pos += vl
+        return out

@@ -461,16 +461,70 @@ def _open_segment(path: str):
 
 
 class _Memtable:
-    """In-RAM sorted-on-demand write buffer backed by one WAL file."""
+    """In-RAM sorted-on-demand write buffer backed by one WAL file.
 
-    __slots__ = ("data", "bytes", "wal")
+    Two backends: the Python dict (``data``) and, for the two
+    inverted-index strategies, the native C++ postings table (``nat``,
+    csrc wn_pt_*) — the import hot path runs whole (prop, batch) columns
+    through one FFI call there instead of ~15 Python ops per term. The
+    dict backend remains the fallback (WEAVIATE_TPU_NO_NATIVE=1) and
+    conformance oracle; "map" buckets only opt in via postings_schema
+    because the native table fixes the value shape to doc->(tf, len)."""
 
-    def __init__(self, wal: WriteAheadLog | None):
+    __slots__ = ("data", "bytes", "wal", "nat")
+
+    def __init__(self, wal: WriteAheadLog | None, strategy: str | None = None,
+                 postings_schema: bool = False):
         self.data: dict[bytes, object] = {}
         self.bytes = 0
         self.wal = wal
+        self.nat = None
+        if (strategy == "roaringset"
+                or (strategy == "map" and postings_schema)):
+            if native.available():
+                self.nat = native.PostingsTable(strategy)
+
+    @property
+    def has_data(self) -> bool:
+        if self.nat is not None:
+            return len(self.nat) > 0
+        return bool(self.data)
+
+    def _nat_apply(self, strategy: str, key: bytes, value) -> None:
+        nat = self.nat
+        if value is _TOMBSTONE:
+            nat.tomb(key)
+        elif strategy == "map":
+            if "plazy" in value:
+                for docs, tfs, lens in value["plazy"]:
+                    nat.map_columns([key], np.asarray([0, len(docs)]),
+                                    docs, tfs, lens, frame=False)
+            else:
+                dele = value.get("del") or ()
+                if dele:
+                    dele = np.asarray(sorted(dele), dtype=np.int64)
+                    nat.map_delete([key], np.asarray([0, len(dele)]), dele)
+                ent = value.get("set") or {}
+                if ent:
+                    docs = np.fromiter(ent.keys(), np.int64, len(ent))
+                    tfs = np.asarray([v[0] for v in ent.values()], np.uint32)
+                    lens = np.asarray([v[1] for v in ent.values()], np.uint32)
+                    nat.map_columns([key], np.asarray([0, len(docs)]),
+                                    docs, tfs, lens, frame=False)
+        else:  # roaringset
+            value = _coalesce_roaring(value)
+            if len(value["del"]):
+                nat.roar([key], np.asarray([0, len(value["del"])]),
+                         value["del"], is_del=True, frame=False)
+            if len(value["add"]):
+                nat.roar([key], np.asarray([0, len(value["add"])]),
+                         value["add"], frame=False)
+        self.bytes += len(key) + 64
 
     def apply(self, strategy: str, key: bytes, value) -> None:
+        if self.nat is not None:
+            self._nat_apply(strategy, key, value)
+            return
         cur = self.data.get(key)
         if value is _TOMBSTONE or cur is _TOMBSTONE or cur is None:
             self.data[key] = value
@@ -504,6 +558,10 @@ class _Memtable:
         self.bytes += len(key) + 64
 
     def packed_items(self, strategy: str) -> Iterator[tuple[bytes, bytes]]:
+        if self.nat is not None:
+            # one native pass: sorted keys, values already in segment format
+            yield from self.nat.packed_items()
+            return
         for k in sorted(self.data):
             v = self.data[k]
             if v is _TOMBSTONE:
@@ -529,11 +587,15 @@ class Bucket:
     MAX_SEALED = 4
 
     def __init__(self, dir_path: str, name: str, strategy: str = "replace",
-                 memtable_limit: int = 4 * 1024 * 1024, sync_wal: bool = False):
+                 memtable_limit: int = 4 * 1024 * 1024, sync_wal: bool = False,
+                 postings_schema: bool = False):
         if strategy not in STRATEGIES:
             raise ValueError(f"unknown strategy {strategy!r}")
         self.name = name
         self.strategy = strategy
+        # opt-in native memtable for "map" buckets whose values are
+        # postings (doc -> (tf, len)); roaringset buckets always qualify
+        self.postings_schema = postings_schema
         self.dir = os.path.join(dir_path, name)
         os.makedirs(self.dir, exist_ok=True)
         self.memtable_limit = memtable_limit
@@ -559,7 +621,7 @@ class Bucket:
         self._wal_seq = 0
         self._write_gen = 0
         self._maintain_gen = -1
-        self._mem = _Memtable(None)
+        self._mem = self._new_mem(None)
         self._recover_wals()
         if self._mem.wal is None:
             self._mem.wal = self._new_wal()
@@ -597,6 +659,10 @@ class Bucket:
         self._next_seq = (
             max((int(s.split("-")[1].split(".")[0]) for s in segs), default=-1) + 1
         )
+
+    def _new_mem(self, wal) -> _Memtable:
+        return _Memtable(wal, strategy=self.strategy,
+                         postings_schema=self.postings_schema)
 
     def _new_wal(self) -> WriteAheadLog:
         path = os.path.join(self.dir, f"wal-{self._wal_seq:06d}.bin")
@@ -649,12 +715,12 @@ class Bucket:
             if nm.startswith("wal-"):
                 seq = int(nm.split("-")[1].split(".")[0])
                 self._wal_seq = max(self._wal_seq, seq + 1)
-        if self._mem.data:
+        if self._mem.has_data:
             # recovered state becomes one segment; stale WALs then delete
             items = list(self._mem.packed_items(self.strategy))
             seg = self._write_segment(items)
             self._segments.append(seg)
-            self._mem = _Memtable(None)
+            self._mem = self._new_mem(None)
         for path in replayed_paths:
             try:
                 os.remove(path)
@@ -726,10 +792,10 @@ class Bucket:
         Never flushes inline — the writer applies backpressure AFTER
         releasing ``_lock`` (lock order is _flush_lock -> _lock; flushing
         from under _lock would ABBA-deadlock against maintenance)."""
-        if not self._mem.data:
+        if not self._mem.has_data:
             return
         self._sealed.append(self._mem)
-        self._mem = _Memtable(self._new_wal())
+        self._mem = self._new_mem(self._new_wal())
 
     def _backpressure(self) -> None:
         """Writer-side valve, called WITHOUT ``_lock``: when sealed
@@ -759,6 +825,18 @@ class Bucket:
         assert self.strategy == "replace"
         with self._lock:
             self._log_and_apply(key, _TOMBSTONE)
+        self._backpressure()
+
+    def delete_many(self, keys: Iterable[bytes]) -> None:
+        """Batch tombstones in one WAL frame (import writes one per
+        object to clear any prior delete marker — per-key frames were a
+        measurable slice of the batch-import profile)."""
+        assert self.strategy == "replace"
+        keys = list(keys)
+        if not keys:
+            return
+        with self._lock:
+            self._log_and_apply_many([(k, _TOMBSTONE) for k in keys])
         self._backpressure()
 
     def set_add(self, key: bytes, values) -> None:
@@ -816,6 +894,68 @@ class Bucket:
             self._append_frame_and_apply(payload, lazy_pairs)
         self._backpressure()
 
+    def _concat_tail(self, mem, payload: bytes) -> None:
+        """Post-native-write tail under _lock: WAL append + accounting
+        (the memtable apply already happened inside the native call)."""
+        self._wal_bytes_metric.inc(len(payload))
+        mem.wal.append(payload)
+        mem.bytes = mem.nat.bytes
+        self._write_gen += 1
+        self._memtable_metric.set(mem.bytes)
+        if mem.bytes >= self.memtable_limit:
+            self._seal()
+
+    def map_set_columns_concat(self, keys: list[bytes],
+                               entry_offs: np.ndarray, docs: np.ndarray,
+                               tfs: np.ndarray, lens: np.ndarray,
+                               prefix: bytes = b"") -> None:
+        """Import fast path: a whole (prop, batch) of postings columns in
+        ONE native call — memtable apply and "P" WAL frame come out of
+        the same pass (csrc wn_pt_map_columns). Key i is
+        prefix + keys[i]; its entries are the [entry_offs[i],
+        entry_offs[i+1]) slice of the columns."""
+        assert self.strategy == "map"
+        if not len(keys):
+            return
+        if self._mem.nat is None:  # dict-memtable fallback: legacy path
+            docs = np.asarray(docs)
+            pairs = []
+            for i, k in enumerate(keys):
+                sl = slice(int(entry_offs[i]), int(entry_offs[i + 1]))
+                pairs.append((prefix + k,
+                              (docs[sl], np.asarray(tfs)[sl],
+                               np.asarray(lens)[sl])))
+            return self.map_set_columns_many(pairs)
+        with self._lock:
+            mem = self._mem
+            payload = mem.nat.map_columns(keys, entry_offs, docs, tfs,
+                                          lens, prefix=prefix, frame=True)
+            self._concat_tail(mem, payload)
+        self._backpressure()
+
+    def bitmap_add_concat(self, keys: list[bytes], entry_offs: np.ndarray,
+                          ids: np.ndarray, prefix: bytes = b"",
+                          is_del: bool = False) -> None:
+        """Import fast path twin for roaringset buckets: per-key id blocks
+        (unsorted ok) applied + "R"-framed in one native call."""
+        assert self.strategy == "roaringset"
+        if not len(keys):
+            return
+        if self._mem.nat is None:
+            ids = np.asarray(ids, dtype=np.uint64)
+            pairs = [(prefix + k,
+                      ids[int(entry_offs[i]):int(entry_offs[i + 1])])
+                     for i, k in enumerate(keys)]
+            if is_del:
+                return self.bitmap_remove_many(pairs)
+            return self.bitmap_add_many(pairs)
+        with self._lock:
+            mem = self._mem
+            payload = mem.nat.roar(keys, entry_offs, ids, is_del=is_del,
+                                   prefix=prefix, frame=True)
+            self._concat_tail(mem, payload)
+        self._backpressure()
+
     def map_delete(self, key: bytes, map_keys) -> None:
         assert self.strategy == "map"
         with self._lock:
@@ -841,15 +981,35 @@ class Bucket:
             )
         self._backpressure()
 
+    def _bitmap_concat_args(self, pairs):
+        """(key, iterable) pairs -> the concat-call triple; shared by the
+        add and remove batch paths so their normalization cannot drift."""
+        keys = [k for k, _ in pairs]
+        blocks = [np.fromiter(v, np.uint64, len(v))
+                  if isinstance(v, (set, frozenset))
+                  else np.asarray(v).astype(np.uint64, copy=False)
+                  for _, v in pairs]
+        offs = np.zeros(len(blocks) + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in blocks], out=offs[1:])
+        ids = (np.concatenate(blocks) if offs[-1]
+               else np.empty(0, np.uint64))
+        return keys, offs, ids
+
     def bitmap_add_many(self, pairs: Iterable[tuple[bytes, Iterable]]) -> None:
         assert self.strategy == "roaringset"
+        pairs = list(pairs)
+        if not pairs:
+            return
+        if self._mem.nat is not None:
+            # route through the one-call native path (it sorts/dedupes
+            # each block itself)
+            keys, offs, ids = self._bitmap_concat_args(pairs)
+            return self.bitmap_add_concat(keys, offs, ids)
         pairs = [
             (k, {"add": _sorted_unique_u64(ids),
                  "del": np.empty(0, np.uint64)})
             for k, ids in pairs
         ]
-        if not pairs:
-            return
         with self._lock:
             self._log_and_apply_many(pairs)
         self._backpressure()
@@ -866,13 +1026,17 @@ class Bucket:
 
     def bitmap_remove_many(self, pairs: Iterable[tuple[bytes, Iterable]]) -> None:
         assert self.strategy == "roaringset"
+        pairs = list(pairs)
+        if not pairs:
+            return
+        if self._mem.nat is not None:
+            keys, offs, ids = self._bitmap_concat_args(pairs)
+            return self.bitmap_add_concat(keys, offs, ids, is_del=True)
         pairs = [
             (k, {"add": np.empty(0, np.uint64),
                  "del": np.unique(np.asarray(list(ids), np.uint64))})
             for k, ids in pairs
         ]
-        if not pairs:
-            return
         with self._lock:
             self._log_and_apply_many(pairs)
         self._backpressure()
@@ -889,6 +1053,14 @@ class Bucket:
         with self._lock:
             mem_layers = []
             for m in [*self._sealed, self._mem]:
+                if m.nat is not None:
+                    raw = m.nat.get_packed(key)
+                    v = None
+                    if raw is not None:
+                        v = (_TOMBSTONE if _is_tomb_record(raw)
+                             else _unpack_value(self.strategy, raw))
+                    mem_layers.append(v)
+                    continue
                 v = m.data.get(key)
                 if coalesce is not None and isinstance(v, dict):
                     canon = coalesce(v)
@@ -944,16 +1116,26 @@ class Bucket:
             return np.empty(0, np.uint64)
         return native.difference_sorted(v["add"], v["del"])
 
-    def _merged_layers(self):
+    def _merged_layers(self, start: bytes | None = None,
+                       stop: bytes | None = None):
         """Snapshot of (segments, memtables oldest->newest) for iteration.
 
         Sealed memtables are immutable; the ACTIVE memtable keeps mutating
         under concurrent writers, and iteration sorts its keys lazily, so a
         shallow dict copy is taken while still holding the lock (otherwise a
-        concurrent put() resizing the dict raises mid-sort)."""
+        concurrent put() resizing the dict raises mid-sort). Native-backed
+        memtables materialize their [start, stop) items (still packed) in
+        one call under the lock."""
         with self._lock:
-            return list(self._segments), [m.data for m in self._sealed] + \
-                [dict(self._mem.data)]
+            mems = []
+            for m in [*self._sealed, self._mem]:
+                if m.nat is not None:
+                    mems.append(m.nat.packed_items(start, stop))
+                elif m is self._mem:
+                    mems.append(dict(m.data))
+                else:
+                    mems.append(m.data)
+            return list(self._segments), mems
 
     def iter_merged(self, start: bytes | None = None,
                     stop: bytes | None = None
@@ -963,7 +1145,7 @@ class Bucket:
         (reference: segment cursors, lsmkv/cursor.go). ``start``/``stop``
         bound the key range [start, stop) — segments seek via their on-disk
         index, so a range scan costs O(log n + range)."""
-        segments, mems = self._merged_layers()
+        segments, mems = self._merged_layers(start, stop)
 
         def seg_iter(seg, rank):
             for k, raw in seg.iter_items(start=start):
@@ -974,6 +1156,12 @@ class Bucket:
                 yield k, rank, v
 
         def mem_iter(data, rank):
+            if isinstance(data, list):  # native table: (key, packed) pairs
+                for k, raw in data:
+                    v = _TOMBSTONE if _is_tomb_record(raw) else \
+                        _unpack_value(self.strategy, raw)
+                    yield k, rank, v
+                return
             coalesce = (_coalesce_roaring if self.strategy == "roaringset"
                         else _coalesce_map if self.strategy == "map"
                         else None)
@@ -1034,7 +1222,7 @@ class Bucket:
     @property
     def dirty(self) -> bool:
         """True when unflushed entries exist (active or sealed memtables)."""
-        return bool(self._mem.data) or bool(self._sealed)
+        return self._mem.has_data or bool(self._sealed)
 
     @property
     def segment_count(self) -> int:
@@ -1095,7 +1283,7 @@ class Bucket:
         with self._lock:
             idle = self._write_gen == self._maintain_gen
             self._maintain_gen = self._write_gen
-            if self._mem.data and not self._sealed and idle:
+            if self._mem.has_data and not self._sealed and idle:
                 self._seal()
         did = self.flush_pending() or did
         if self.segment_count > compact_above:

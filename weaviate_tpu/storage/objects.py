@@ -56,13 +56,23 @@ class StorageObject:
         self.vectors[""] = np.asarray(v, dtype=np.float32)
 
     def to_bytes(self) -> bytes:
+        u = self.uuid
+        try:
+            # canonical 36-char form: hex-parse directly (uuid.UUID() costs
+            # ~5x as much and this runs once per imported object)
+            uid = bytes.fromhex(u.replace("-", "")) if len(u) in (32, 36) \
+                else uuid_mod.UUID(u).bytes
+            if len(uid) != 16:
+                uid = uuid_mod.UUID(u).bytes
+        except ValueError:
+            uid = uuid_mod.UUID(u).bytes
         parts = [
             _HEADER.pack(
                 _VERSION,
                 self.doc_id,
                 self.creation_time_ms,
                 self.last_update_time_ms,
-                uuid_mod.UUID(self.uuid).bytes,
+                uid,
             ),
             struct.pack("<I", len(self.vectors)),
         ]

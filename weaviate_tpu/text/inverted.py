@@ -278,7 +278,10 @@ class InvertedIndex:
             self._own_dir = tempfile.TemporaryDirectory(prefix="inv-")
             store = KVStore(self._own_dir.name)
         self._store = store
-        self.searchable_bucket = store.bucket(B_SEARCH, "map")
+        # postings_schema: the searchable map values are strictly
+        # doc -> (tf, len), unlocking the native C++ memtable (kv.py)
+        self.searchable_bucket = store.bucket(B_SEARCH, "map",
+                                              postings_schema=True)
         self.filter_bucket = store.bucket(B_FILTER, "roaringset")
         self.numeric_bucket = store.bucket(B_NUMERIC, "roaringset")
         self.geo_bucket = store.bucket(B_GEO, "replace")
@@ -338,7 +341,9 @@ class InvertedIndex:
         """Batch insert: one WAL frame per bucket family per batch
         (reference: updateInvertedIndexLSM per put, shard_write_put.go:454)."""
         search_upd: dict[bytes, dict] = {}
-        search_cols: dict[bytes, list] = {}  # key -> [(docs, tfs, lens)...]
+        # analyzer-output concat jobs: (prefix, keys, entry_offs, cols...)
+        search_jobs: list[tuple] = []
+        filter_jobs: list[tuple] = []
         filter_add: dict[bytes, set] = {}
         numeric_add: dict[bytes, set] = {}
         null_add: dict[bytes, set] = {}
@@ -353,7 +358,7 @@ class InvertedIndex:
         # property of the value, so index/unindex key derivation stays
         # consistent either way.
         text_handled = self._index_text_batch(
-            objs, search_cols, filter_add, prop_len_delta)
+            objs, search_jobs, filter_jobs, prop_len_delta)
 
         for obj in objs:
             doc = obj.doc_id
@@ -374,13 +379,12 @@ class InvertedIndex:
         with self._lock:
             if search_upd:
                 self.searchable_bucket.map_set_many(search_upd.items())
-            if search_cols:
-                self.searchable_bucket.map_set_columns_many([
-                    (k, (parts[0] if len(parts) == 1 else (
-                        np.concatenate([p[0] for p in parts]),
-                        np.concatenate([p[1] for p in parts]),
-                        np.concatenate([p[2] for p in parts]))))
-                    for k, parts in search_cols.items()])
+            for pfx, keys, eoffs, docs_c, tfs_c, lens_c in search_jobs:
+                self.searchable_bucket.map_set_columns_concat(
+                    keys, eoffs, docs_c, tfs_c, lens_c, prefix=pfx)
+            for pfx, keys, eoffs, docs_c in filter_jobs:
+                self.filter_bucket.bitmap_add_concat(
+                    keys, eoffs, docs_c.astype(np.uint64), prefix=pfx)
             filter_add.setdefault(_ALL_DOCS, set()).update(all_docs)
             self.filter_bucket.bitmap_add_many(filter_add.items())
             if numeric_add:
@@ -397,11 +401,26 @@ class InvertedIndex:
                 pm["len_count"] += dc
             self._save_meta()
             self._version += 1
-            # cache invalidation for every touched key
+            # cache invalidation for every touched key; when a batch
+            # touches more keys than the cache could plausibly hold hot,
+            # one clear beats tens of thousands of per-key pops (the pops
+            # were 5% of the whole import profile)
             for k in search_upd:
                 self._post_cache.pop(k)
-            for k in search_cols:
-                self._post_cache.pop(k)
+            n_touched = sum(len(j[1]) for j in search_jobs)
+            if n_touched > 2048 or n_touched > len(self._post_cache.d):
+                self._post_cache.clear()
+            else:
+                for pfx, keys, _e, *_cols in search_jobs:
+                    for k in keys:
+                        self._post_cache.pop(pfx + k)
+            n_touched = sum(len(j[1]) for j in filter_jobs)
+            if n_touched > 2048 or n_touched > len(self._bitmap_cache.d):
+                self._bitmap_cache.clear()
+            else:
+                for pfx, keys, _e, _d in filter_jobs:
+                    for k in keys:
+                        self._bitmap_cache.pop((B_FILTER, pfx + k))
             for k in filter_add:
                 self._bitmap_cache.pop((B_FILTER, k))
             for k in numeric_add:
@@ -414,12 +433,14 @@ class InvertedIndex:
     _JOIN_BY_TOKENIZATION = {"word": "\x01", "lowercase": " ",
                              "whitespace": " "}
 
-    def _index_text_batch(self, objs, search_cols, filter_add,
+    def _index_text_batch(self, objs, search_jobs, filter_jobs,
                           prop_len_delta) -> set:
         """Batch-analyze ASCII text properties through the native analyzer
         (one FFI call per prop per batch). Returns the (prop, doc) pairs
         fully handled — postings, text filter keys, and prop-length
-        aggregates — identically to the per-value Python path."""
+        aggregates — identically to the per-value Python path. Output
+        lands in ``search_jobs``/``filter_jobs`` as whole-prop concat
+        columns for the storage layer's one-call native writes."""
         from weaviate_tpu import native
 
         if not native.available():
@@ -467,37 +488,20 @@ class InvertedIndex:
             terms, eoffs, rows, tfs, row_tokens = res
             pfx = name.encode() + _SEP
             docs_arr = np.asarray(docs, dtype=np.int64)
+            # whole-prop CONCAT columns: the per-term entry layout is the
+            # analyzer's own (entry_offs into docs/tfs/lens); the storage
+            # layer applies + WAL-frames them in one native call per prop
+            # (kv.py map_set_columns_concat / bitmap_add_concat)
+            keys = terms  # analyzer emits bytes keys directly
+            docs_col = docs_arr[rows]
             if prop.index_searchable:
-                # COLUMN postings: slice the analyzer's arrays per term —
-                # no per-(term, doc) Python loop; the storage layer's
-                # map_set_columns_many keeps them as arrays until flush
-                for t_i, t in enumerate(terms):
-                    key = pfx + t.encode()
-                    sl = slice(int(eoffs[t_i]), int(eoffs[t_i + 1]))
-                    cols = (docs_arr[rows[sl]], tfs[sl],
-                            row_tokens[rows[sl]])
-                    cur = search_cols.get(key)
-                    if cur is None:
-                        search_cols[key] = [cols]
-                    else:
-                        cur.append(cols)
+                search_jobs.append((pfx, keys, eoffs, docs_col, tfs,
+                                    row_tokens[rows]))
                 d = prop_len_delta.setdefault(name, [0, 0])
                 d[0] += int(row_tokens.sum())
                 d[1] += len(docs)
             if prop.index_filterable:
-                for t_i, t in enumerate(terms):
-                    fkey = pfx + b"t" + t.encode()
-                    fdocs = docs_arr[rows[int(eoffs[t_i]):
-                                          int(eoffs[t_i + 1])]]
-                    cur = filter_add.get(fkey)
-                    if cur is None:
-                        # sorted ndarray: bitmap_add_many skips its
-                        # np.unique for these
-                        filter_add[fkey] = fdocs
-                    elif isinstance(cur, set):
-                        cur.update(fdocs.tolist())
-                    else:
-                        filter_add[fkey] = np.union1d(cur, fdocs)
+                filter_jobs.append((pfx + b"t", keys, eoffs, docs_col))
         return handled
 
     def _collect_index_prop(self, doc, name, value, search_upd, filter_add,
