@@ -26,6 +26,7 @@
 // Python bindings: weaviate_tpu/native/dataplane.py.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/epoll.h>
@@ -33,6 +34,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -170,7 +172,7 @@ struct DP {
 
     // net-thread-owned
     std::unordered_map<uint64_t, Conn*> conns;
-    uint64_t next_conn_id = 1;
+    uint64_t next_conn_id = 2;  // 0 = listen socket, 1 = eventfd sentinel
     std::unordered_map<uint64_t, std::pair<uint64_t, int32_t>> tokens;
     uint64_t next_token = 1;
     std::vector<PendingBatch> pending;  // per collection id
@@ -333,6 +335,7 @@ struct FastSearch {
     size_t vec_len = 0;
     int32_t limit = 10;
     bool uses_123 = false;
+    bool md_uuid = false, md_distance = false;
 };
 
 bool parse_fast_search(const uint8_t* p, size_t n, FastSearch* out) {
@@ -389,9 +392,11 @@ bool parse_fast_search(const uint8_t* p, size_t n, FastSearch* out) {
                     uint32_t f2 = (uint32_t)(k2 >> 3), w2 = (uint32_t)(k2 & 7);
                     if (w2 != 0) return false;
                     uint64_t v = md.varint();
-                    // uuid(1)/distance(5)/certainty(6) are always present
-                    // in fast replies; anything else requested -> slow
-                    if (v && f2 != 1 && f2 != 5 && f2 != 6) return false;
+                    // the fast reply carries EXACTLY id + distance; any
+                    // other requested metadata -> slow path
+                    if (f2 == 1) out->md_uuid = v != 0;
+                    else if (f2 == 5) out->md_distance = v != 0;
+                    else if (v) return false;
                 }
                 if (!md.ok) return false;
                 break;
@@ -408,7 +413,8 @@ bool parse_fast_search(const uint8_t* p, size_t n, FastSearch* out) {
                 return false;  // any other feature -> Python
         }
     }
-    return r.ok && !out->collection.empty() && out->vec != nullptr;
+    return r.ok && !out->collection.empty() && out->vec != nullptr &&
+           out->md_uuid && out->md_distance;
 }
 
 void queue_fallback(DP* dp, Conn* c, Stream* s) {
@@ -932,11 +938,281 @@ int64_t dp_post_batch(int32_t coll_id, int64_t count,
     return misses;
 }
 
+// test hook: run the fast-path parser over a serialized SearchRequest.
+// Returns 1 when the fast path would accept it, 0 otherwise; fills
+// limit/dim_bytes when parsed.
+int32_t dp_test_parse(const uint8_t* p, int64_t n, int32_t* limit,
+                      int64_t* vec_bytes, int32_t* uses_123) {
+    FastSearch fs;
+    int ok = parse_fast_search(p, (size_t)n, &fs) ? 1 : 0;
+    *limit = fs.limit;
+    *vec_bytes = (int64_t)fs.vec_len;
+    *uses_123 = fs.uses_123 ? 1 : 0;
+    return ok;
+}
+
 void dp_stats(uint64_t* fast, uint64_t* fallback) {
     DP* dp = g_dp;
     if (dp == nullptr) { *fast = *fallback = 0; return; }
     *fast = dp->served_fast.load();
     *fallback = dp->served_fallback.load();
+}
+
+}  // extern "C"
+
+// ---- load-generator client ------------------------------------------------
+// With one CPU core, a Python gRPC client saturates at a fraction of the
+// native server's throughput — the server must be driven by native
+// streams to be measured honestly. One epoll loop in the calling thread
+// (GIL released for the whole run), M connections × S pipelined streams.
+
+namespace bench {
+
+struct BStream {
+    std::string body;  // full gRPC request message (prefixed)
+    size_t off = 0;
+    uint64_t t_start = 0;
+};
+
+struct BConn {
+    int fd = -1;
+    uint64_t id = 0;
+    nghttp2_session* sess = nullptr;
+    std::string outbuf;
+    bool epollout = false;
+    int inflight = 0;
+};
+
+struct BenchState {
+    std::string authority;
+    std::string request_proto_head;  // serialized SearchRequest minus vec
+    int32_t dim = 10;
+    int streams_per_conn = 8;
+    uint64_t deadline_us = 0;
+    uint64_t done = 0, errors = 0;
+    std::vector<float> lat_ms;
+    uint64_t rng = 0x9e3779b97f4a7c15ull;
+    bool stopping = false;
+    int epfd = -1;
+    std::unordered_map<uint64_t, BConn*> conns;
+};
+
+uint64_t xorshift(BenchState* st) {
+    uint64_t x = st->rng;
+    x ^= x << 13;
+    x ^= x >> 7;
+    x ^= x << 17;
+    return st->rng = x;
+}
+
+ssize_t bench_read_cb(nghttp2_session* sess, int32_t stream_id, uint8_t* buf,
+                      size_t length, uint32_t* flags, nghttp2_data_source*,
+                      void*) {
+    BStream* s =
+        (BStream*)nghttp2_session_get_stream_user_data(sess, stream_id);
+    if (s == nullptr) return 0;
+    size_t left = s->body.size() - s->off;
+    size_t n = left < length ? left : length;
+    std::memcpy(buf, s->body.data() + s->off, n);
+    s->off += n;
+    if (s->off == s->body.size()) *flags |= NGHTTP2_DATA_FLAG_EOF;
+    return (ssize_t)n;
+}
+
+void submit_query(BenchState* st, BConn* c) {
+    BStream* s = new BStream();
+    // SearchRequest = head + near_vector{vector_bytes=dim floats}
+    std::string nv;
+    std::string vec((size_t)st->dim * 4, '\0');
+    float* f = (float*)vec.data();
+    for (int i = 0; i < st->dim; ++i)
+        f[i] = (float)((int64_t)(xorshift(st) & 0xffff) - 32768) / 16384.0f;
+    pb_len(nv, 4, vec.data(), vec.size());
+    std::string msg = st->request_proto_head;
+    pb_len(msg, 43, nv.data(), nv.size());
+    grpc_wrap(s->body, msg);
+    s->t_start = now_us();
+    static const char kPath[] = "/weaviate.v1.Weaviate/Search";
+    nghttp2_nv hdrs[6] = {
+        {(uint8_t*)":method", (uint8_t*)"POST", 7, 4, 0},
+        {(uint8_t*)":scheme", (uint8_t*)"http", 7, 4, 0},
+        {(uint8_t*)":path", (uint8_t*)kPath, 5, sizeof(kPath) - 1, 0},
+        {(uint8_t*)":authority", (uint8_t*)st->authority.data(), 10,
+         st->authority.size(), 0},
+        {(uint8_t*)"content-type", (uint8_t*)"application/grpc", 12, 16, 0},
+        {(uint8_t*)"te", (uint8_t*)"trailers", 2, 8, 0},
+    };
+    nghttp2_data_provider prd;
+    prd.source.ptr = s;
+    prd.read_callback = bench_read_cb;
+    int32_t sid = nghttp2_submit_request(c->sess, nullptr, hdrs, 6, &prd, s);
+    if (sid < 0) {
+        delete s;
+        return;
+    }
+    c->inflight++;
+}
+
+int bench_on_stream_close(nghttp2_session* sess, int32_t stream_id,
+                          uint32_t error_code, void* user) {
+    auto* pr = (std::pair<BenchState*, BConn*>*)user;
+    BenchState* st = pr->first;
+    BConn* c = pr->second;
+    BStream* s =
+        (BStream*)nghttp2_session_get_stream_user_data(sess, stream_id);
+    if (s != nullptr) {
+        if (error_code == 0) {
+            st->done++;
+            st->lat_ms.push_back((float)(now_us() - s->t_start) / 1000.0f);
+        } else {
+            st->errors++;
+        }
+        delete s;
+    }
+    c->inflight--;
+    if (!st->stopping && now_us() < st->deadline_us) submit_query(st, c);
+    return 0;
+}
+
+void bench_flush(BenchState* st, BConn* c) {
+    for (;;) {
+        const uint8_t* data = nullptr;
+        ssize_t n = nghttp2_session_mem_send(c->sess, &data);
+        if (n <= 0) break;
+        c->outbuf.append((const char*)data, (size_t)n);
+    }
+    while (!c->outbuf.empty()) {
+        ssize_t n = ::send(c->fd, c->outbuf.data(), c->outbuf.size(),
+                           MSG_NOSIGNAL);
+        if (n > 0) c->outbuf.erase(0, (size_t)n);
+        else break;
+    }
+    bool want = !c->outbuf.empty();
+    if (want != c->epollout) {
+        c->epollout = want;
+        epoll_event ev{};
+        ev.events = EPOLLIN | (want ? EPOLLOUT : 0);
+        ev.data.u64 = c->id;
+        epoll_ctl(st->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+    }
+}
+
+}  // namespace bench
+
+extern "C" {
+
+// Drive `conns`×`streams` pipelined Search requests at 127.0.0.1:port for
+// duration_ms. head/head_len: serialized SearchRequest WITHOUT the
+// near_vector field (collection, limit, metadata, uses_123_api) — the
+// caller builds it once with real protobuf. Returns completed count;
+// fills qps/p50/p95/p99 (ms) and errors.
+int64_t dp_bench(int32_t port, int32_t conns, int32_t streams,
+                 int32_t duration_ms, int32_t dim, const uint8_t* head,
+                 int64_t head_len, double* qps, float* p50, float* p95,
+                 float* p99, int64_t* errors) {
+    using namespace bench;
+    BenchState st;
+    st.dim = dim;
+    st.streams_per_conn = streams;
+    st.request_proto_head.assign((const char*)head, (size_t)head_len);
+    char auth[32];
+    snprintf(auth, sizeof auth, "127.0.0.1:%d", port);
+    st.authority = auth;
+    st.epfd = epoll_create1(0);
+    std::vector<std::pair<BenchState*, BConn*>*> uds;
+    for (int i = 0; i < conns; ++i) {
+        int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = htons((uint16_t)port);
+        if (connect(fd, (sockaddr*)&addr, sizeof addr) != 0) {
+            ::close(fd);
+            continue;
+        }
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        // nonblocking AFTER the blocking connect: a full kernel send
+        // buffer must EAGAIN (bench_flush buffers it), not stall the
+        // generator's epoll loop and skew the measurement
+        fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+        BConn* c = new BConn();
+        c->fd = fd;
+        c->id = (uint64_t)i + 1;
+        auto* ud = new std::pair<BenchState*, BConn*>(&st, c);
+        uds.push_back(ud);
+        nghttp2_session_callbacks* cbs = nullptr;
+        nghttp2_session_callbacks_new(&cbs);
+        nghttp2_session_callbacks_set_on_stream_close_callback(
+            cbs, bench_on_stream_close);
+        nghttp2_session_client_new(&c->sess, cbs, ud);
+        nghttp2_session_callbacks_del(cbs);
+        nghttp2_settings_entry iv[1] = {
+            {NGHTTP2_SETTINGS_MAX_CONCURRENT_STREAMS, 1024}};
+        nghttp2_submit_settings(c->sess, 0, iv, 1);
+        st.conns[c->id] = c;
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.u64 = c->id;
+        epoll_ctl(st.epfd, EPOLL_CTL_ADD, fd, &ev);
+    }
+    if (st.conns.empty()) {
+        ::close(st.epfd);
+        *qps = 0;
+        return -1;
+    }
+    st.deadline_us = now_us() + (uint64_t)duration_ms * 1000;
+    uint64_t t0 = now_us();
+    for (auto& kv : st.conns) {
+        for (int sidx = 0; sidx < streams; ++sidx)
+            submit_query(&st, kv.second);
+        bench_flush(&st, kv.second);
+    }
+    epoll_event evs[64];
+    std::vector<char> buf(1 << 16);
+    while (now_us() < st.deadline_us + 200000) {  // 200ms drain grace
+        if (now_us() >= st.deadline_us) st.stopping = true;
+        bool any_inflight = false;
+        for (auto& kv : st.conns)
+            if (kv.second->inflight > 0) any_inflight = true;
+        if (st.stopping && !any_inflight) break;
+        int n = epoll_wait(st.epfd, evs, 64, 50);
+        for (int i = 0; i < n; ++i) {
+            auto cit = st.conns.find(evs[i].data.u64);
+            if (cit == st.conns.end()) continue;
+            BConn* c = cit->second;
+            if (evs[i].events & EPOLLIN) {
+                ssize_t r = ::recv(c->fd, buf.data(), buf.size(),
+                                   MSG_DONTWAIT);
+                while (r > 0) {
+                    nghttp2_session_mem_recv(c->sess, (const uint8_t*)buf.data(),
+                                             (size_t)r);
+                    r = ::recv(c->fd, buf.data(), buf.size(), MSG_DONTWAIT);
+                }
+            }
+            bench_flush(&st, c);
+        }
+    }
+    uint64_t t1 = now_us();
+    for (auto& kv : st.conns) {
+        nghttp2_session_del(kv.second->sess);
+        ::close(kv.second->fd);
+        delete kv.second;
+    }
+    for (auto* ud : uds) delete ud;
+    ::close(st.epfd);
+    std::sort(st.lat_ms.begin(), st.lat_ms.end());
+    auto pct = [&](double q) -> float {
+        if (st.lat_ms.empty()) return 0.0f;
+        size_t i = (size_t)(q * (st.lat_ms.size() - 1));
+        return st.lat_ms[i];
+    };
+    *qps = (double)st.done / ((double)(t1 - t0) / 1e6);
+    *p50 = pct(0.50);
+    *p95 = pct(0.95);
+    *p99 = pct(0.99);
+    *errors = (int64_t)st.errors;
+    return (int64_t)st.done;
 }
 
 }  // extern "C"

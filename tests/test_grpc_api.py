@@ -43,9 +43,22 @@ def db(tmp_path):
     d.close()
 
 
-@pytest.fixture
-def stub(db):
-    server = GrpcServer(db).start()
+@pytest.fixture(params=["python", "native"])
+def stub(db, request):
+    """Every test runs twice: against the Python gRPC server and against
+    the native C++ data plane (csrc/dataplane.cpp) serving the same
+    handlers — transport-level wire compatibility is asserted by the
+    whole suite passing on both."""
+    if request.param == "native":
+        from weaviate_tpu.native import dataplane as dpn
+
+        if not dpn.available():
+            pytest.skip("native data plane unavailable")
+        from weaviate_tpu.api.grpc.native_plane import NativeDataPlane
+
+        server = NativeDataPlane(db, GrpcServer(db)).start()
+    else:
+        server = GrpcServer(db).start()
     channel = grpc.insecure_channel(f"127.0.0.1:{server.port}")
     yield Stub(channel)
     channel.close()

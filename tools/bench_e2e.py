@@ -50,7 +50,15 @@ def main():
                     help="replace the device batch fn with a constant-time "
                          "stub to isolate the serving-fabric latency "
                          "(co-located p50 = fabric p50 + device ms)")
+    ap.add_argument("--native-plane", action="store_true",
+                    help="serve gRPC through the C++ data plane "
+                         "(csrc/dataplane.cpp) and drive the served-load "
+                         "phase with the native load generator")
     args = ap.parse_args()
+    if args.native_plane:
+        import os as _os
+
+        _os.environ["WEAVIATE_TPU_NATIVE_DATAPLANE"] = "1"
     if args.url and not args.grpc_port:
         ap.error("--url mode also needs --grpc-port (queries run over "
                  "gRPC)")
@@ -149,11 +157,20 @@ def main():
         response_deserializer=pb.SearchReply.FromString)
 
     def query(vec):
-        req = pb.SearchRequest(collection="Bench", limit=args.k)
-        req.near_vector.vector.extend(vec.tolist())
+        req = pb.SearchRequest(collection="Bench", limit=args.k,
+                               uses_123_api=True)
+        req.near_vector.vector_bytes = vec.astype("<f4").tobytes()
+        req.metadata.uuid = True
+        req.metadata.distance = True
         return search(req)
 
-    query(queries[0])  # warm (compile)
+    query(queries[0])  # warm (compile; registers with the native plane)
+    if args.native_plane and server is not None and hasattr(
+            server.grpc, "warm_collection"):
+        t_w = time.perf_counter()
+        server.grpc.warm_collection("Bench")
+        log(f"native plane reply cache warmed in "
+            f"{time.perf_counter() - t_w:.1f}s")
     lat = []
     hits_by_query = []
     for q in queries:
@@ -200,8 +217,58 @@ def main():
             for shard in col.shards.values():
                 for b_ in shard._query_batchers.values():
                     b_._batch_fn = _null_batch
+                if args.native_plane:
+                    _cid = _np.tile(_np.arange(args.k, dtype=_np.int64),
+                                    (256, 1))
+                    _cd = _np.tile(_np.linspace(0.01, 0.1, args.k,
+                                                dtype=_np.float32), (256, 1))
+                    _cn = _np.full(256, args.k, _np.int64)
+
+                    def _null_batch2(qs, k, vec_name="", _i=_cid, _d=_cd,
+                                     _n=_cn):
+                        b = len(qs)
+                        return _i[:b, :k], _d[:b, :k], _n[:b]
+
+                    shard.vector_search_batch = _null_batch2
     stream_counts = [int(c) for c in str(args.concurrency).split(",")
                      if int(c) > 0]
+    if args.native_plane and server is not None and not hasattr(
+            server.grpc, "dp"):
+        # the plane silently fell back to the Python server (no
+        # libnghttp2 / auth configured) — measure that honestly instead
+        log("WARNING: native plane not active; using Python load gen")
+        args.native_plane = False
+    if args.native_plane and stream_counts:
+        # native load generator: with one core a Python client saturates
+        # long before the C++ plane does
+        from weaviate_tpu.native import dataplane as dpn
+
+        head = pb.SearchRequest(collection="Bench", limit=args.k,
+                                uses_123_api=True)
+        head.metadata.uuid = True
+        head.metadata.distance = True
+        hb = head.SerializeToString()
+        for n_streams in stream_counts:
+            conns = max(1, min(16, n_streams // 4))
+            per = max(1, n_streams // conns)
+            f0, b0 = server.grpc.dp.stats() if server is not None else (0, 0)
+            st = dpn.bench(grpc_port, conns=conns, streams=per,
+                           duration_ms=8000, dim=args.dim, request_head=hb)
+            f1, b1 = server.grpc.dp.stats() if server is not None else (0, 0)
+            point = {"streams": conns * per,
+                     "served_qps": round(st["qps"], 1),
+                     "p50_ms": round(st["p50_ms"], 2),
+                     "p95_ms": round(st["p95_ms"], 2),
+                     "fast_path": f1 - f0, "fallback": b1 - b0,
+                     "errors": st["errors"]}
+            log(f"served load (native, {conns}x{per} streams): "
+                f"{point['served_qps']} qps, p50 {point['p50_ms']} ms, "
+                f"p95 {point['p95_ms']} ms, fast {point['fast_path']} "
+                f"fallback {point['fallback']}")
+            served = point if len(stream_counts) == 1 else {
+                **({} if not isinstance(served, dict) else served),
+                str(conns * per): point}
+        stream_counts = []
     for n_streams in stream_counts:
         import threading
 

@@ -1,0 +1,282 @@
+"""Native gRPC data plane: Python side.
+
+Pairs csrc/dataplane.cpp (epoll + libnghttp2 transport, fast-path
+Search parse, batch coalescing, C++ reply building) with this dispatcher:
+
+- search batches -> ONE Shard.vector_search_batch device dispatch for the
+  whole coalesced batch; results go back via dp_post_batch, which builds
+  every reply in C++ from the docid -> (uuid, PropertiesResult bytes)
+  cache. Cache misses come back here, get answered through the real
+  protobuf path, and seed the cache — the plane self-warms, no import
+  hook needed (docids are never reused, so entries can't go stale).
+- everything else (filters, hybrid, tenants, BatchObjects, ...) arrives
+  as raw request bytes and is answered by the SAME servicer methods the
+  Python gRPC server uses (GrpcServer handlers), so behavior is
+  identical by construction.
+
+Reference bar: Go handlers scaling with cores
+(adapters/handlers/grpc/server.go:50, adapters/repos/db/index.go:1576).
+Enable with WEAVIATE_TPU_NATIVE_DATAPLANE=1 (requires libnghttp2 and no
+auth configured — fallback requests carry no per-request credentials).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import grpc
+import numpy as np
+
+from weaviate_tpu.api.grpc import v1_pb2 as pb
+from weaviate_tpu.native import dataplane as dpn
+
+logger = logging.getLogger(__name__)
+
+_REQ_TYPES = {
+    "Search": pb.SearchRequest,
+    "BatchObjects": pb.BatchObjectsRequest,
+    "BatchDelete": pb.BatchDeleteRequest,
+    "TenantsGet": pb.TenantsGetRequest,
+}
+
+
+class _Ctx:
+    """Minimal grpc.ServicerContext stand-in for fallback dispatch."""
+
+    class Abort(Exception):
+        def __init__(self, code, message):
+            self.code = code
+            self.message = message
+
+    def invocation_metadata(self):
+        return []
+
+    def abort(self, code, message):
+        raise _Ctx.Abort(code, message)
+
+
+class NativeDataPlane:
+    """Drop-in for GrpcServer (same ``port``/``start``/``stop`` surface),
+    serving the gRPC port through the C++ transport."""
+
+    def __init__(self, db, grpc_server, host: str = "127.0.0.1",
+                 port: int = 0, window_us: int = 0):
+        self.db = db
+        self.server = grpc_server  # handler logic donor (not started)
+        self.dp = dpn.DataPlane(port=port, window_us=window_us)
+        self.port = self.dp.port
+        self.host = host
+        self._coll_by_id: dict[int, str] = {}
+        self._registered: set[str] = set()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        t = threading.Thread(target=self._dispatch_loop,
+                             name="dp-dispatch", daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self, grace: float = 0.5):
+        self._stop.set()
+        self.dp.stop()
+        for t in self._threads:
+            t.join(timeout=grace + 1.0)
+
+    # -- collection registry --------------------------------------------------
+
+    def _eligible(self, col) -> bool:
+        """Fast-path only for the plain shape: single shard, single
+        tenant, unreplicated, default vector. Everything else still
+        works — through the fallback."""
+        cfg = col.config
+        if cfg.multi_tenancy.enabled:
+            return False
+        if getattr(cfg.replication, "factor", 1) > 1:
+            return False
+        if len(col.shards) != 1:
+            return False
+        return True
+
+    def _maybe_register(self, name: str):
+        if name in self._registered:
+            return
+        try:
+            col = self.db.get_collection(name)
+        except Exception:
+            return
+        if not self._eligible(col):
+            self._registered.add(name)  # don't re-check every query
+            return
+        shard = next(iter(col.shards.values()))
+        idx = shard.vector_indexes.get("")
+        if idx is None or not hasattr(idx, "search_by_vector_batch"):
+            return  # not ready yet (no vectors imported)
+        cid = self.dp.register_collection(name, int(idx.dim))
+        if cid >= 0:
+            self._coll_by_id[cid] = name
+            self._registered.add(name)
+            # bulk-warm the reply cache off the dispatch thread; misses
+            # self-seed in the meantime
+            threading.Thread(target=self.warm_collection, args=(name,),
+                             name=f"dp-warm-{name}", daemon=True).start()
+
+    def warm_collection(self, name: str, chunk: int = 2048):
+        """Populate the C++ docid -> (uuid, PropertiesResult) reply cache
+        for every live object. One-time O(corpus) Python pass; after it,
+        plain nearVector queries never touch Python per-query."""
+        cid = None
+        for c, n in self._coll_by_id.items():
+            if n == name:
+                cid = c
+        if cid is None:
+            return
+        col = self.db.get_collection(name)
+        shard = next(iter(col.shards.values()))
+        dtype_of = {p.name: p.data_type for p in col.config.properties}
+        ids: list[int] = []
+        uuids: list[str] = []
+        props: list[bytes] = []
+        for doc_id in list(shard._doc_to_uuid.keys()):
+            obj = shard.object_by_doc_id(doc_id)
+            if obj is None:
+                continue
+            out = pb.SearchResult()
+            self.server._fill_result(col, out, obj, None, _FAST_META, None,
+                                     dtype_of)
+            ids.append(doc_id)
+            uuids.append(obj.uuid)
+            props.append(out.properties.SerializeToString())
+            if len(ids) >= chunk:
+                self.dp.cache_put(cid, ids, uuids, props)
+                ids, uuids, props = [], [], []
+        if ids:
+            self.dp.cache_put(cid, ids, uuids, props)
+
+    # -- dispatch -------------------------------------------------------------
+
+    def _dispatch_loop(self):
+        while not self._stop.is_set():
+            try:
+                item = self.dp.wait(200)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                raise
+            if item is None:
+                continue
+            if item == "stopped":
+                return
+            try:
+                if isinstance(item, dpn.SearchBatch):
+                    self._run_batch(item)
+                else:
+                    self._run_fallback(item)
+            except Exception:  # noqa: BLE001 — keep serving
+                logger.exception("data plane dispatch failed")
+                # every stream in the failed item must get an error reply
+                # or its client hangs until the deadline
+                toks = (item.tokens.tolist()
+                        if isinstance(item, dpn.SearchBatch)
+                        else [item.token])
+                for tok in toks:
+                    try:
+                        self.dp.post_raw(int(tok), b"", 13, "internal error")
+                    except Exception:
+                        pass
+
+    def _run_batch(self, batch: dpn.SearchBatch):
+        t0 = time.perf_counter()
+        name = self._coll_by_id.get(batch.coll_id)
+        col = self.db.get_collection(name)
+        shard = next(iter(col.shards.values()))
+        kmax = int(batch.ks.max())
+        ids, dists, counts = shard.vector_search_batch(batch.queries, kmax)
+        took = time.perf_counter() - t0
+        miss = self.dp.post_batch(batch, ids, dists, counts, took)
+        if len(miss) == 0:
+            return
+        # cache misses: answer via real protobuf and seed the cache
+        tok_pos = {int(t): i for i, t in enumerate(batch.tokens)}
+        seed_ids: list[int] = []
+        seed_uuids: list[str] = []
+        seed_props: list[bytes] = []
+        dtype_of = {p.name: p.data_type for p in col.config.properties}
+        for t in miss:
+            i = tok_pos[int(t)]
+            reply = pb.SearchReply(took=took)
+            n = int(min(counts[i], batch.ks[i]))
+            for j in range(n):
+                doc = int(ids[i, j])
+                obj = shard.object_by_doc_id(doc)
+                if obj is None:
+                    continue
+                out = reply.results.add()
+                res = _Res(float(dists[i, j]))
+                self.server._fill_result(col, out, obj, res,
+                                         _FAST_META, None, dtype_of)
+                seed_ids.append(doc)
+                seed_uuids.append(obj.uuid)
+                seed_props.append(out.properties.SerializeToString())
+            self.dp.post_raw(int(t), reply.SerializeToString())
+        if seed_ids:
+            self.dp.cache_put(batch.coll_id, seed_ids, seed_uuids,
+                              seed_props)
+
+    def _run_fallback(self, item: dpn.FallbackRequest):
+        method = item.method.rsplit("/", 1)[-1]
+        handler = {
+            "Search": self.server._search,
+            "BatchObjects": self.server._batch_objects,
+            "BatchDelete": self.server._batch_delete,
+            "TenantsGet": self.server._tenants_get,
+        }.get(method)
+        if handler is None:
+            self.dp.post_raw(item.token, b"", 12,
+                             f"unknown method {item.method}")
+            return
+        from weaviate_tpu.api.grpc.server import ApiError
+
+        req_type = _REQ_TYPES[method]
+        ctx = _Ctx()
+        try:
+            req = req_type.FromString(item.payload)
+            reply = handler(req, ctx)
+            self.dp.post_raw(item.token, reply.SerializeToString())
+            # a Search that fell back on an unregistered collection
+            # registers it so the NEXT plain query takes the fast path
+            if method == "Search" and req.collection:
+                self._maybe_register(req.collection)
+        except (_Ctx.Abort, ApiError) as e:
+            code = e.code.value[0] if hasattr(e.code, "value") else int(e.code)
+            self.dp.post_raw(item.token, b"", code, str(e.message))
+        except KeyError as e:
+            self.dp.post_raw(item.token, b"",
+                             grpc.StatusCode.NOT_FOUND.value[0], str(e))
+        except ValueError as e:
+            self.dp.post_raw(
+                item.token, b"",
+                grpc.StatusCode.INVALID_ARGUMENT.value[0], str(e))
+        except Exception as e:  # noqa: BLE001
+            logger.exception("fallback handler failed")
+            self.dp.post_raw(item.token, b"",
+                             grpc.StatusCode.INTERNAL.value[0], str(e))
+
+
+class _Res:
+    """SearchResult stand-in for _fill_result on the fast path."""
+
+    __slots__ = ("distance", "score", "rerank_score")
+
+    def __init__(self, distance: float):
+        self.distance = distance
+        self.score = None
+        self.rerank_score = None
+
+
+_FAST_META = pb.MetadataRequest(uuid=True, distance=True)

@@ -497,6 +497,74 @@ class Shard:
         return (np.asarray(out_ids, np.int64),
                 np.asarray(out_d, np.float32))
 
+    def vector_search_batch(self, queries: np.ndarray, k: int,
+                            vec_name: str = ""):
+        """Batched twin of vector_search for the native data plane's
+        coalesced dispatch (csrc/dataplane.cpp): one index batch search,
+        queued (not-yet-indexed) vectors brute-forced against the whole
+        query block and merged per row. No filters — filtered queries
+        take the fallback path. Returns (ids [B, k], dists [B, k],
+        counts [B]); dead rows are -1-padded."""
+        idx = self.vector_indexes.get(vec_name)
+        b = len(queries)
+        if idx is None:
+            return (np.full((b, k), -1, np.int64),
+                    np.full((b, k), np.inf, np.float32),
+                    np.zeros(b, np.int64))
+        queue = self._index_queues.get(vec_name)
+        pending = queue.snapshot() if queue is not None else []
+        ids, dists = idx.search_by_vector_batch(queries, k)
+        ids = np.asarray(ids, np.int64)
+        dists = np.asarray(dists, np.float32)
+        if pending:
+            q_ids = np.asarray([d for d, _ in pending], np.int64)
+            q_vecs = np.stack([v for _, v in pending]).astype(np.float32)
+            qd = self._host_pairwise(np.asarray(queries, np.float32),
+                                     q_vecs, idx.metric)  # [B, nq]
+            cat_ids = np.concatenate(
+                [ids, np.broadcast_to(q_ids, (b, len(q_ids)))], axis=1)
+            cat_d = np.concatenate([dists, qd.astype(np.float32)], axis=1)
+            order = np.argsort(cat_d, axis=1, kind="stable")
+            out_i = np.full((b, k), -1, np.int64)
+            out_d = np.full((b, k), np.inf, np.float32)
+            for r in range(b):
+                seen: set = set()
+                n = 0
+                for j in order[r]:
+                    did = int(cat_ids[r, j])
+                    if did < 0 or did in seen:
+                        continue
+                    seen.add(did)
+                    out_i[r, n] = did
+                    out_d[r, n] = cat_d[r, j]
+                    n += 1
+                    if n == k:
+                        break
+            ids, dists = out_i, out_d
+        counts = (ids >= 0).sum(axis=1).astype(np.int64)
+        return ids, dists, counts
+
+    @staticmethod
+    def _host_pairwise(qs: np.ndarray, vecs: np.ndarray,
+                       metric: str) -> np.ndarray:
+        """[B, n] host-BLAS distances (queued-tail scoring; see the
+        numpy-not-jit note in _queued_candidates)."""
+        if metric in ("cosine", "cosine-dot"):
+            def unit(a):
+                n = np.linalg.norm(a, axis=-1, keepdims=True)
+                return a / np.where(n > 1e-30, n, 1.0)
+
+            return 1.0 - unit(qs) @ unit(vecs).T
+        if metric == "dot":
+            return -(qs @ vecs.T)
+        if metric == "hamming":
+            return (qs[:, None, :] != vecs[None, :, :]).sum(-1).astype(
+                np.float32)
+        if metric == "manhattan":
+            return np.abs(qs[:, None, :] - vecs[None, :, :]).sum(-1)
+        sq = (qs ** 2).sum(-1)[:, None] + (vecs ** 2).sum(-1)[None, :]
+        return sq - 2.0 * (qs @ vecs.T)
+
     def _queued_candidates(self, vec_name: str, query: np.ndarray,
                            allow_list: np.ndarray | None):
         queue = self._index_queues.get(vec_name)
@@ -524,21 +592,7 @@ class Shard:
         # device store pads to buckets for exactly this reason) — the
         # queue is small, host BLAS is plenty
         q = np.asarray(query, np.float32)
-        if metric in ("cosine", "cosine-dot"):
-            def unit(a):
-                n = np.linalg.norm(a, axis=-1, keepdims=True)
-                return a / np.where(n > 1e-30, n, 1.0)
-
-            d = 1.0 - unit(vecs) @ unit(q[None, :])[0]
-        elif metric == "dot":
-            d = -(vecs @ q)
-        elif metric == "hamming":
-            d = (vecs != q[None, :]).sum(axis=1).astype(np.float32)
-        elif metric == "manhattan":
-            d = np.abs(vecs - q[None, :]).sum(axis=1)
-        else:  # l2-squared
-            diff = vecs - q[None, :]
-            d = np.einsum("nd,nd->n", diff, diff)
+        d = self._host_pairwise(q[None, :], vecs, metric)[0]
         return ids, d.astype(np.float32)
 
     def bm25_search(self, query: str, k: int = 10,

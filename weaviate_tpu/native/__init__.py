@@ -33,41 +33,50 @@ _tried = False
 _lock = threading.Lock()
 
 
+def build_and_load(src: str, so: str, link: list[str] | None = None):
+    """Compile-if-stale + atomic-replace + dlopen for a native library.
+    Shared by this loader and the data-plane loader (dataplane.py).
+    Returns the CDLL or None (numpy/Python fallback is safer than a
+    stale-ABI .so)."""
+    if os.environ.get("WEAVIATE_TPU_NO_NATIVE"):
+        return None
+    src = os.path.abspath(src)
+    stale = (
+        os.path.exists(so) and os.path.exists(src)
+        and os.path.getmtime(src) > os.path.getmtime(so)
+    )
+    if not os.path.exists(so) or stale:
+        if os.path.exists(src):
+            try:
+                # build to a per-pid temp path and rename into place:
+                # os.replace is atomic, so concurrent processes never
+                # dlopen a half-written library
+                tmp = f"{so}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                     "-o", tmp, src] + (link or []),
+                    check=True, capture_output=True, timeout=120,
+                    cwd=os.path.dirname(src),
+                )
+                os.replace(tmp, so)
+            except Exception:
+                return None
+    if not os.path.exists(so):
+        return None
+    try:
+        return ctypes.CDLL(so)
+    except OSError:
+        return None
+
+
 def _load():
     global _lib, _tried
     with _lock:
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("WEAVIATE_TPU_NO_NATIVE"):
-            return None
-        src = os.path.abspath(_SRC)
-        stale = (
-            os.path.exists(_SO) and os.path.exists(src)
-            and os.path.getmtime(src) > os.path.getmtime(_SO)
-        )
-        if not os.path.exists(_SO) or stale:
-            if os.path.exists(src):
-                try:
-                    # build to a per-pid temp path and rename into place:
-                    # os.replace is atomic, so concurrent processes never
-                    # dlopen a half-written library
-                    tmp = f"{_SO}.{os.getpid()}.tmp"
-                    subprocess.run(
-                        ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
-                         "-o", tmp, src],
-                        check=True, capture_output=True, timeout=120,
-                    )
-                    os.replace(tmp, _SO)
-                except Exception:
-                    # a stale .so may have the wrong ABI — numpy fallback
-                    # is safer than loading it
-                    return None
-        if not os.path.exists(_SO):
-            return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+        lib = build_and_load(_SRC, _SO)
+        if lib is None:
             return None
         u64p = ctypes.POINTER(ctypes.c_uint64)
         i64p = ctypes.POINTER(ctypes.c_int64)

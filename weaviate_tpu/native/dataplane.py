@@ -1,0 +1,235 @@
+"""ctypes bindings for the native gRPC data plane (csrc/dataplane.cpp).
+
+The Python gRPC fabric caps the server at ~1.2k QPS on one core
+(BASELINE r4); the native plane moves transport + fast-path Search
+parsing + batch coalescing + reply building into C++ over the system
+libnghttp2 and hands Python one coalesced device dispatch per batch plus
+raw request bytes for everything else. ``available()`` is False when the
+shared library (or libnghttp2) is absent — the Python gRPC server is the
+fallback, and stays the default unless WEAVIATE_TPU_NATIVE_DATAPLANE=1.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libwvdataplane.so")
+_SRC_DIR = os.path.abspath(os.path.join(_HERE, os.pardir, os.pardir, "csrc"))
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        from weaviate_tpu.native import build_and_load
+
+        lib = build_and_load(os.path.join(_SRC_DIR, "dataplane.cpp"), _SO,
+                             link=["-l:libnghttp2.so.14", "-lpthread"])
+        if lib is None:
+            return None
+        i32, i64 = ctypes.c_int32, ctypes.c_int64
+        u64 = ctypes.c_uint64
+        i64p = ctypes.POINTER(i64)
+        u64p = ctypes.POINTER(u64)
+        i32p = ctypes.POINTER(i32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        for name, args, res in [
+            ("dp_start", [i32, i32, i32], i32),
+            ("dp_stop", [], None),
+            ("dp_register_collection", [ctypes.c_char_p, i32], i32),
+            ("dp_cache_put", [i32, i64, i64p, u8p, u8p, i64p], None),
+            ("dp_cache_clear", [i32], None),
+            ("dp_wait",
+             [i32, i32p, i64p, u64p, i32p, f32p, u64p, ctypes.c_char_p,
+              i32, i64p], i32),
+            ("dp_fallback_payload", [u64, u8p], None),
+            ("dp_post_raw", [u64, u8p, i64, i32, ctypes.c_char_p], None),
+            ("dp_post_batch",
+             [i32, i64, u64p, i32p, i64, i64p, f32p, i64p, ctypes.c_float,
+              u64p], i64),
+            ("dp_stats", [u64p, u64p], None),
+            ("dp_bench",
+             [i32, i32, i32, i32, i32, u8p, i64, ctypes.POINTER(
+                 ctypes.c_double), f32p, f32p, f32p, i64p], i64),
+        ]:
+            fn = getattr(lib, name)
+            fn.argtypes = args
+            fn.restype = res
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, t):
+    return a.ctypes.data_as(ctypes.POINTER(t))
+
+
+@dataclass
+class SearchBatch:
+    coll_id: int
+    tokens: np.ndarray   # uint64 [n]
+    ks: np.ndarray       # int32 [n]
+    queries: np.ndarray  # float32 [n, dim]
+
+
+@dataclass
+class FallbackRequest:
+    token: int
+    method: str
+    payload: bytes
+
+
+class DataPlane:
+    """One process-wide native data plane instance."""
+
+    MAX_BATCH = 128
+
+    def __init__(self, port: int = 0, max_batch: int = 0,
+                 window_us: int = 0, max_dim: int = 4096):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native data plane unavailable")
+        self._lib = lib
+        self.max_batch = max_batch or self.MAX_BATCH
+        self.max_dim = max_dim
+        p = lib.dp_start(port, self.max_batch, window_us)
+        if p < 0:
+            raise OSError(-p, "dp_start failed")
+        self.port = int(p)
+        self._dims: dict[int, int] = {}
+        # reusable dp_wait buffers (one waiter thread)
+        self._tokens = np.empty(self.max_batch, np.uint64)
+        self._ks = np.empty(self.max_batch, np.int32)
+        self._qbuf = np.empty(self.max_batch * max_dim, np.float32)
+
+    def stop(self):
+        self._lib.dp_stop()
+
+    def register_collection(self, name: str, dim: int) -> int:
+        if dim <= 0 or dim > self.max_dim:
+            # the dp_wait query buffer is sized max_batch*max_dim —
+            # larger dims must stay on the fallback path
+            return -1
+        cid = self._lib.dp_register_collection(name.encode(), int(dim))
+        if cid >= 0:
+            self._dims[cid] = int(dim)
+        return cid
+
+    def cache_put(self, coll_id: int, doc_ids, uuids: list[str],
+                  props: list[bytes]):
+        doc_ids = np.ascontiguousarray(doc_ids, dtype=np.int64)
+        ub = "".join(uuids).encode("ascii")
+        assert len(ub) == 36 * len(doc_ids)
+        ua = np.frombuffer(ub, np.uint8)
+        blob = b"".join(props)
+        poffs = np.zeros(len(props) + 1, np.int64)
+        np.cumsum([len(p) for p in props], out=poffs[1:])
+        pa = np.frombuffer(blob, np.uint8) if blob else np.zeros(1, np.uint8)
+        self._lib.dp_cache_put(coll_id, len(doc_ids),
+                               _ptr(doc_ids, ctypes.c_int64),
+                               _ptr(ua, ctypes.c_uint8),
+                               _ptr(pa, ctypes.c_uint8),
+                               _ptr(poffs, ctypes.c_int64))
+
+    def wait(self, timeout_ms: int = 200):
+        """None (timeout) | SearchBatch | FallbackRequest | 'stopped'."""
+        coll = ctypes.c_int32(0)
+        count = ctypes.c_int64(0)
+        token = ctypes.c_uint64(0)
+        plen = ctypes.c_int64(0)
+        mbuf = ctypes.create_string_buffer(256)
+        kind = self._lib.dp_wait(
+            timeout_ms, ctypes.byref(coll), ctypes.byref(count),
+            _ptr(self._tokens, ctypes.c_uint64),
+            _ptr(self._ks, ctypes.c_int32),
+            _ptr(self._qbuf, ctypes.c_float), ctypes.byref(token), mbuf,
+            256, ctypes.byref(plen))
+        if kind == 0:
+            return None
+        if kind == 3:
+            return "stopped"
+        if kind == 1:
+            n = count.value
+            dim = self._dims.get(coll.value, 0)
+            return SearchBatch(
+                coll_id=coll.value, tokens=self._tokens[:n].copy(),
+                ks=self._ks[:n].copy(),
+                queries=self._qbuf[:n * dim].reshape(n, dim).copy())
+        payload = np.empty(max(plen.value, 1), np.uint8)
+        self._lib.dp_fallback_payload(token.value,
+                                      _ptr(payload, ctypes.c_uint8))
+        return FallbackRequest(token=token.value,
+                               method=mbuf.value.decode(),
+                               payload=payload[:plen.value].tobytes())
+
+    def post_raw(self, token: int, reply: bytes, status: int = 0,
+                 message: str = ""):
+        buf = np.frombuffer(reply, np.uint8) if reply else \
+            np.zeros(1, np.uint8)
+        self._lib.dp_post_raw(ctypes.c_uint64(token),
+                              _ptr(buf, ctypes.c_uint8), len(reply),
+                              status, message.encode() or None)
+
+    def post_batch(self, batch: SearchBatch, ids: np.ndarray,
+                   dists: np.ndarray, counts: np.ndarray,
+                   took_s: float) -> np.ndarray:
+        """Returns tokens the C++ side could not serve (cache misses)."""
+        n, kmax = ids.shape
+        ids = np.ascontiguousarray(ids, np.int64)
+        dists = np.ascontiguousarray(dists, np.float32)
+        counts = np.ascontiguousarray(counts, np.int64)
+        miss = np.empty(n, np.uint64)
+        tokens = np.ascontiguousarray(batch.tokens, np.uint64)
+        ks = np.ascontiguousarray(batch.ks, np.int32)
+        nm = self._lib.dp_post_batch(
+            batch.coll_id, n, _ptr(tokens, ctypes.c_uint64),
+            _ptr(ks, ctypes.c_int32), kmax, _ptr(ids, ctypes.c_int64),
+            _ptr(dists, ctypes.c_float), _ptr(counts, ctypes.c_int64),
+            ctypes.c_float(took_s), _ptr(miss, ctypes.c_uint64))
+        return miss[:nm].copy()
+
+    def stats(self) -> tuple[int, int]:
+        fast = ctypes.c_uint64(0)
+        fb = ctypes.c_uint64(0)
+        self._lib.dp_stats(ctypes.byref(fast), ctypes.byref(fb))
+        return fast.value, fb.value
+
+
+def bench(port: int, conns: int, streams: int, duration_ms: int, dim: int,
+          request_head: bytes) -> dict:
+    """Native load generator against a Search endpoint (ours or any
+    gRPC server speaking the same proto). ``request_head``: serialized
+    SearchRequest WITHOUT near_vector (collection/limit/metadata/flags)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native data plane unavailable")
+    head = np.frombuffer(request_head, np.uint8)
+    qps = ctypes.c_double(0)
+    p50 = ctypes.c_float(0)
+    p95 = ctypes.c_float(0)
+    p99 = ctypes.c_float(0)
+    errors = ctypes.c_int64(0)
+    done = lib.dp_bench(port, conns, streams, duration_ms, dim,
+                        _ptr(head, ctypes.c_uint8), len(request_head),
+                        ctypes.byref(qps), ctypes.byref(p50),
+                        ctypes.byref(p95), ctypes.byref(p99),
+                        ctypes.byref(errors))
+    return {"done": int(done), "qps": qps.value, "p50_ms": p50.value,
+            "p95_ms": p95.value, "p99_ms": p99.value,
+            "errors": int(errors.value)}

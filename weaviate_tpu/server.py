@@ -122,8 +122,26 @@ class Server:
 
         from weaviate_tpu.api.grpc.server import GrpcServer
 
-        self.grpc = GrpcServer(self.db, host=cfg.host, port=cfg.grpc_port,
-                               modules=modules, auth=auth).start()
+        use_native_plane = False
+        if os.environ.get("WEAVIATE_TPU_NATIVE_DATAPLANE") == "1" \
+                and auth is None:
+            from weaviate_tpu.native import dataplane as _dpn
+
+            use_native_plane = _dpn.available()
+        if use_native_plane:
+            # C++ transport serves the port; the (unstarted) GrpcServer
+            # donates its handler logic to the fallback path
+            from weaviate_tpu.api.grpc.native_plane import NativeDataPlane
+
+            handlers = GrpcServer(self.db, host=cfg.host, port=0,
+                                  modules=modules, auth=None)
+            self.grpc = NativeDataPlane(self.db, handlers, host=cfg.host,
+                                        port=cfg.grpc_port).start()
+            logger.info("native gRPC data plane enabled")
+        else:
+            self.grpc = GrpcServer(self.db, host=cfg.host,
+                                   port=cfg.grpc_port,
+                                   modules=modules, auth=auth).start()
 
         if cfg.profiling_port:
             # reference: setupGoProfiling serves pprof on PROFILING_PORT
