@@ -344,6 +344,29 @@ def main():
     log(f"[quant] PQ4: {ms_pq4:.2f} ms, {batch/(ms_pq4/1e3):.0f} qps, "
         f"rescored recall@10 {rec_pq4:.4f}")
 
+    # two-stage PQ (r4 verdict item 6): 128-bit BQ sign prefix stage 1 ->
+    # gathered exact-ADC stage 2 (ops/pq.pq_topk_twostage). At d=128 the
+    # prefix is the full sign code, so stage 1 costs the BQ scan and the
+    # win over the exhaustive PQ4 ADC is dropping its inherent 4x FLOPs.
+    xp_t = jnp.transpose(xw[:, :4]).copy()
+    def pq2_step():
+        return pq_ops.pq_topk_twostage(
+            q_cl_dev, qw, codes, book.centroids, xp_t, k=k_cand,
+            refine=8, metric="l2-squared", valid=valid)
+    ms_pq2 = chained_ms(
+        lambda off, q_, qw_, c_, cent_, xp_, v_: pq_ops.pq_topk_twostage(
+            q_, qw_, c_, cent_, xp_, k=k_cand, refine=8,
+            metric="l2-squared", valid=v_, id_offset=off),
+        (q_cl_dev, qw, codes, book.centroids, xp_t, valid))
+    d_, i_ = pq2_step()
+    rec_pq2 = rescore_recall(i_)
+    quant["pq_twostage128"] = {
+        "device_batch_ms": round(ms_pq2, 3),
+        "qps": round(batch / (ms_pq2 / 1e3)),
+        "recall_at_10_rescored": round(float(rec_pq2), 4)}
+    log(f"[quant] PQ 2-stage/128: {ms_pq2:.2f} ms, "
+        f"{batch/(ms_pq2/1e3):.0f} qps, rescored recall@10 {rec_pq2:.4f}")
+
     # --- compiled-kernel conformance on device ------------------------------
     conformance = "ok"
     try:
@@ -383,6 +406,75 @@ def main():
     except Exception as e:  # noqa: BLE001
         conformance = f"error: {e}"
     log(f"kernel conformance (compiled, on-device): {conformance}")
+
+    # --- serving fabric (native data plane, null device) --------------------
+    # Isolates the C++ gRPC fabric — transport + coalescing + reply build
+    # — from both the device and the dev tunnel (bench_e2e --native-plane
+    # --null-device is the full-size version). Best-effort: absent
+    # libnghttp2, reports null.
+    fabric = None
+    try:
+        from weaviate_tpu.native import dataplane as dpn
+
+        if dpn.available():
+            import tempfile
+
+            os.environ["WEAVIATE_TPU_NATIVE_DATAPLANE"] = "1"
+            from weaviate_tpu.api.grpc import v1_pb2 as pbv
+            from weaviate_tpu.config import ServerConfig
+            from weaviate_tpu.server import Server
+
+            srv = Server(ServerConfig(
+                data_path=tempfile.mkdtemp(prefix="bench-fabric-"),
+                rest_port=0, grpc_port=0, disable_telemetry=True)).start()
+            if hasattr(srv.grpc, "dp"):
+                col = srv.db.create_collection_from_dict({
+                    "class": "Fab",
+                    "vectorIndexType": "flat",
+                    "properties": [
+                        {"name": "seq", "dataType": ["int"]}],
+                }) if hasattr(srv.db, "create_collection_from_dict") else None
+                if col is None:
+                    from weaviate_tpu.schema.config import (
+                        CollectionConfig,
+                        Property,
+                    )
+
+                    col = srv.db.create_collection(CollectionConfig(
+                        name="Fab",
+                        properties=[Property(name="seq",
+                                             data_type="int")]))
+                fr = np.random.default_rng(0)
+                col.batch_put([
+                    {"properties": {"seq": i},
+                     "vector": fr.standard_normal(32).astype(np.float32)}
+                    for i in range(5000)])
+                srv.grpc._maybe_register("Fab", warm=False)
+                srv.grpc.warm_collection("Fab")
+                shard = next(iter(col.shards.values()))
+                cid = np.tile(np.arange(10, dtype=np.int64), (256, 1))
+                cdd = np.tile(np.linspace(0.01, 0.1, 10,
+                                          dtype=np.float32), (256, 1))
+                cnn = np.full(256, 10, np.int64)
+                shard.vector_search_batch = (
+                    lambda qs, k2, vec_name="": (cid[:len(qs), :k2],
+                                                 cdd[:len(qs), :k2],
+                                                 cnn[:len(qs)]))
+                head = pbv.SearchRequest(collection="Fab", limit=10,
+                                         uses_123_api=True)
+                head.metadata.uuid = True
+                head.metadata.distance = True
+                st = dpn.bench(srv.grpc.port, conns=8, streams=8,
+                               duration_ms=4000, dim=32,
+                               request_head=head.SerializeToString())
+                fabric = {"qps": round(st["qps"]),
+                          "p50_ms": round(st["p50_ms"], 2),
+                          "p95_ms": round(st["p95_ms"], 2),
+                          "streams": 64, "errors": st["errors"]}
+                log(f"[fabric] native plane null-device: {fabric}")
+            srv.stop()
+    except Exception as e:  # noqa: BLE001
+        log(f"[fabric] skipped: {e}")
 
     wd.cancel()
     print(json.dumps({

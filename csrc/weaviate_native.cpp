@@ -820,6 +820,17 @@ int64_t search_layer(HnswGraph* g, const float* q, int64_t ef, int32_t layer,
         if (layer >= (int32_t)slot_layers.size()) continue;
         const std::vector<int32_t>& neigh = slot_layers[(size_t)layer];
         float worst = top.empty() ? 3.0e38f : top.top().first;
+        // the walk is memory-latency-bound at 1M+ slots (each unvisited
+        // neighbor's row is a cold cacheline); prefetch the whole
+        // frontier's rows before scoring (reference analog:
+        // asm/prefetch_amd64.s PREFETCHT0 during traversal)
+        for (int32_t ns : neigh) {
+            if (g->visited[(size_t)ns] != epoch) {
+                const float* row = g->vecs.data() + (size_t)ns * g->dim;
+                for (int32_t o = 0; o < g->dim; o += 16)
+                    __builtin_prefetch(row + o, 0, 1);
+            }
+        }
         for (int32_t ns : neigh) {
             if (g->visited[(size_t)ns] == epoch) continue;
             g->visited[(size_t)ns] = epoch;

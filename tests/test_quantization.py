@@ -179,3 +179,76 @@ def test_compress_twice_raises(rng):
     idx.compress("bq")
     with pytest.raises(RuntimeError):
         idx.compress("bq")
+
+
+def test_pq_twostage_prefix_matches_full_scan():
+    """Two-stage PQ (BQ sign prefix stage 1 + gathered ADC stage 2,
+    ops/pq.pq_topk_twostage) must reach the same rescored results as the
+    exhaustive PQ scan on clustered data."""
+    import numpy as np
+
+    from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+    rng = np.random.default_rng(11)
+    centers = rng.standard_normal((40, 256)).astype(np.float32) * 2.0
+    xs = (centers[rng.integers(0, 40, 3000)]
+          + 0.3 * rng.standard_normal((3000, 256))).astype(np.float32)
+    qs = xs[rng.integers(0, 3000, 8)] + 0.05 * rng.standard_normal(
+        (8, 256)).astype(np.float32)
+
+    full = QuantizedVectorStore(dim=256, quantization="pq", rescore="host")
+    two = QuantizedVectorStore(dim=256, quantization="pq", rescore="host",
+                               prefix_bits=128)
+    for st in (full, two):
+        st.train(xs[:2000])
+        st.add(xs)
+    assert two.prefix_words == 4 and two.prefix_t is not None
+    d_f, i_f = full.search(qs, k=10)
+    d_t, i_t = two.search(qs, k=10)
+    overlap = np.mean([
+        len(set(i_f[r].tolist()) & set(i_t[r].tolist())) / 10
+        for r in range(len(qs))])
+    assert overlap >= 0.9, overlap
+    assert i_t[0, 0] == i_f[0, 0]  # self-hit survives the prefix
+
+
+def test_pq_twostage_snapshot_roundtrip_codes_only():
+    """Codes-only snapshots must carry the PQ prefix (it cannot be
+    rebuilt from codes)."""
+    import numpy as np
+
+    from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+    rng = np.random.default_rng(3)
+    xs = rng.standard_normal((500, 160)).astype(np.float32)
+    st = QuantizedVectorStore(dim=160, quantization="pq", rescore="none",
+                              prefix_bits=128)
+    st.train(xs)
+    st.add(xs)
+    snap = st.snapshot()
+    assert snap.get("prefix_t") is not None
+    st2 = QuantizedVectorStore.restore(snap)
+    assert st2.prefix_t is not None
+    d1, i1 = st.search(xs[:4], k=5)
+    d2, i2 = st2.search(xs[:4], k=5)
+    assert np.array_equal(i1, i2)
+
+
+def test_pq_twostage_train_after_add_rebuilds_prefix():
+    """train() after add() must re-derive the sign prefix (the re-encode
+    path scatters codes AND prefix; a zeroed prefix silently floors
+    stage-1 recall)."""
+    import numpy as np
+
+    from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+    rng = np.random.default_rng(5)
+    xs = rng.standard_normal((2000, 256)).astype(np.float32)
+    st = QuantizedVectorStore(dim=256, quantization="pq", rescore="host",
+                              prefix_bits=128)
+    st.add(xs)          # untrained: codes+prefix deferred
+    st.train(xs[:1500])
+    pt = np.asarray(st.prefix_t)
+    assert pt[:, :2000].any(), "prefix still zeroed after train()"
+    d, i = st.search(xs[:6], k=5)
+    assert (i[:, 0] == np.arange(6)).all()

@@ -244,6 +244,30 @@ def main():
         }
         log(f"PQ4 10M x 768 b={b}: {ms:.2f} ms/scan -> {b/(ms/1e3):.0f} qps")
 
+    # --- two-stage PQ at the same scale (r4 verdict item 6) -----------------
+    # stage 1: 128-bit BQ sign prefix scan (1.6% of the f32 bytes);
+    # stage 2: gathered exact-ADC on refine*k rows (ops/pq.pq_topk_twostage)
+    wp = 4
+    xp_t = jax.lax.bitcast_convert_type(
+        jax.random.randint(jax.random.PRNGKey(5), (wp, n), -2**31,
+                           2**31 - 1, dtype=jnp.int32), jnp.uint32)
+    xp_t.block_until_ready()
+    for b in (64, 256):
+        q = jax.random.normal(jax.random.PRNGKey(2), (b, d),
+                              dtype=jnp.float32)
+        qp = bq_ops.bq_encode(q[:, :wp * 32])
+        ms = chained_ms(
+            lambda off, q_, qp_, c_, ct_, xp_: pq_ops.pq_topk_twostage(
+                q_, qp_, c_, ct_, xp_, k=100, refine=8,
+                metric="l2-squared", id_offset=off),
+            (q, qp, codes, cent, xp_t))
+        out[f"pq2stage128_10M_768d_b{b}"] = {
+            "device_batch_ms": round(ms, 2),
+            "qps": round(b / (ms / 1e3)),
+        }
+        log(f"PQ 2-stage/128 10M x 768 b={b}: {ms:.2f} ms/scan -> "
+            f"{b/(ms/1e3):.0f} qps")
+
     print(json.dumps({"metric": "capacity_scans_10M", **out}), flush=True)
 
 

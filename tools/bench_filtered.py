@@ -1,0 +1,111 @@
+"""Filtered-search policy measurement (VERDICT r4 item 9).
+
+Masked full scan vs gather-then-scan across selectivities on the real
+chip: the full scan's cost is selectivity-independent, the gather path's
+is O(|allowed|) — this tool measures the crossover that sets the
+engine/store.py policy (allowed <= capacity/16 -> gather) and the
+recall-parity of both paths. Chained hoist-proof device timing
+(BASELINE methodology).
+
+Usage: python tools/bench_filtered.py [--n 1000000] [--dim 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--reps", type=int, default=51)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from weaviate_tpu.engine.store import DeviceVectorStore
+
+    rng = np.random.default_rng(0)
+    store = DeviceVectorStore(dim=args.dim, metric="l2-squared")
+    xs = rng.standard_normal((args.n, args.dim)).astype(np.float32)
+    for s in range(0, args.n, 131072):
+        store.add(xs[s:s + 131072])
+    qs = rng.standard_normal((args.batch, args.dim)).astype(np.float32)
+
+    # tunnel RTT baseline (BASELINE r3 methodology)
+    trivial = jax.jit(lambda x: x + 1.0)
+    _ = trivial(jnp.zeros(8)).block_until_ready()
+    t0 = time.perf_counter()
+    _ = trivial(jnp.zeros(8)).block_until_ready()
+    rtt = time.perf_counter() - t0
+
+    def timed(fn):
+        fn()  # compile
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            fn()
+        out = fn()
+        _ = np.asarray(out[0])
+        return (time.perf_counter() - t0 - rtt) / args.reps
+
+    out = {"metric": "filtered_search", "n": args.n, "dim": args.dim,
+           "batch": args.batch, "rtt_ms": round(rtt * 1e3, 1),
+           "points": {}}
+    for sel in (0.001, 0.01, 0.0625, 0.10, 0.5):
+        m = max(args.k, int(args.n * sel))
+        allowed = np.sort(rng.choice(args.n, m, replace=False))
+        mask = np.zeros(store.capacity, dtype=bool)
+        mask[allowed] = True
+
+        # ground truth on the filtered subset
+        sub = xs[allowed]
+        d_gt = ((qs[:8, None, :] - sub[None, :, :]) ** 2).sum(-1)
+        gt = allowed[np.argsort(d_gt, axis=1)[:, :args.k]]
+
+        def masked():
+            full = np.zeros(store.capacity, dtype=bool)
+            full[:len(mask)] = mask
+            from weaviate_tpu.ops.topk import chunked_topk_distances
+
+            valid = jnp.logical_and(store.valid, jnp.asarray(full))
+            return chunked_topk_distances(
+                jnp.asarray(qs), store.vectors, k=args.k,
+                chunk_size=min(store.chunk_size, store.capacity),
+                metric="l2-squared", valid=valid,
+                x_sq_norms=store.sq_norms,
+                use_pallas=store.use_pallas, selection=store.selection)
+
+        def gathered():
+            return store._search_gathered(qs, args.k, allowed,
+                                          squeeze=False)
+
+        t_mask = timed(masked)
+        t_gather = timed(gathered)
+        d_g, i_g = store._search_gathered(qs[:8], args.k, allowed, False)
+        rec = np.mean([len(set(i_g[r].tolist()) & set(gt[r].tolist()))
+                       / args.k for r in range(8)])
+        point = {"allowed": m,
+                 "masked_ms": round(t_mask * 1e3, 2),
+                 "gather_ms": round(t_gather * 1e3, 2),
+                 "gather_recall": round(float(rec), 4)}
+        out["points"][f"{sel:g}"] = point
+        log(f"sel {sel:g} ({m} rows): masked {point['masked_ms']} ms, "
+            f"gather {point['gather_ms']} ms, recall {rec:.4f}")
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,7 @@ class NativeDataPlane:
         self.host = host
         self._coll_by_id: dict[int, str] = {}
         self._registered: set[str] = set()
+        self._reg_lock = threading.Lock()  # dispatch vs warm threads
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -103,7 +104,7 @@ class NativeDataPlane:
             return False
         return True
 
-    def _maybe_register(self, name: str):
+    def _maybe_register(self, name: str, warm: bool = True):
         if name in self._registered:
             return
         try:
@@ -111,7 +112,8 @@ class NativeDataPlane:
         except Exception:
             return
         if not self._eligible(col):
-            self._registered.add(name)  # don't re-check every query
+            with self._reg_lock:
+                self._registered.add(name)  # don't re-check every query
             return
         shard = next(iter(col.shards.values()))
         idx = shard.vector_indexes.get("")
@@ -119,19 +121,24 @@ class NativeDataPlane:
             return  # not ready yet (no vectors imported)
         cid = self.dp.register_collection(name, int(idx.dim))
         if cid >= 0:
-            self._coll_by_id[cid] = name
-            self._registered.add(name)
-            # bulk-warm the reply cache off the dispatch thread; misses
-            # self-seed in the meantime
-            threading.Thread(target=self.warm_collection, args=(name,),
-                             name=f"dp-warm-{name}", daemon=True).start()
+            with self._reg_lock:
+                self._coll_by_id[cid] = name
+                self._registered.add(name)
+            if warm:
+                # bulk-warm the reply cache off the dispatch thread;
+                # misses self-seed in the meantime
+                threading.Thread(target=self.warm_collection, args=(name,),
+                                 name=f"dp-warm-{name}",
+                                 daemon=True).start()
 
     def warm_collection(self, name: str, chunk: int = 2048):
         """Populate the C++ docid -> (uuid, PropertiesResult) reply cache
         for every live object. One-time O(corpus) Python pass; after it,
         plain nearVector queries never touch Python per-query."""
         cid = None
-        for c, n in self._coll_by_id.items():
+        with self._reg_lock:
+            items = list(self._coll_by_id.items())
+        for c, n in items:
             if n == name:
                 cid = c
         if cid is None:

@@ -105,12 +105,38 @@ def filters_from_pb(f: "pb.Filters") -> Filter:
     return Filter(op, path=path, value=value)
 
 
+def _struct_to_dict(s) -> dict:
+    """google.protobuf.Struct -> dict, MessageToDict-compatible (numbers
+    stay floats — Struct is JSON-typed) at ~1/10 the cost; this runs once
+    per imported object on the gRPC hot path."""
+    out = {}
+    for k, v in s.fields.items():
+        kind = v.WhichOneof("kind")
+        if kind == "string_value":
+            out[k] = v.string_value
+        elif kind == "number_value":
+            out[k] = v.number_value
+        elif kind == "bool_value":
+            out[k] = v.bool_value
+        elif kind == "struct_value":
+            out[k] = _struct_to_dict(v.struct_value)
+        elif kind == "list_value":
+            out[k] = [
+                (_struct_to_dict(e.struct_value)
+                 if e.WhichOneof("kind") == "struct_value"
+                 else json_format.MessageToDict(e))
+                for e in v.list_value.values]
+        else:  # null_value / unset
+            out[k] = None
+    return out
+
+
 def _props_from_batch_object(bo: "pb.BatchObject") -> dict:
     """Flatten the typed batch property payload back into a plain dict
     (the reference re-assembles models.Object the same way,
     v1/batch_parse_request.go)."""
     p = bo.properties
-    props = json_format.MessageToDict(p.non_ref_properties)
+    props = _struct_to_dict(p.non_ref_properties)
     for arr in p.number_array_properties:
         props[arr.prop_name] = (
             list(np.frombuffer(arr.values_bytes, dtype="<f8"))

@@ -145,12 +145,20 @@ class QuantizedVectorStore:
         self.mesh = mesh
         self.n_shards = 1 if mesh is None else mesh.shape[SHARD_AXIS]
         self.prefix_words = 0
-        if prefix_bits and quantization == "bq" and mesh is None:
+        if prefix_bits and mesh is None:
             wp = max(4, prefix_bits // 32 // 4 * 4)
-            # a prefix at least as wide as the code itself saves nothing
-            # (and would crash the column scatter for dim <= 128)
-            if wp < bq_ops.bq_words(dim):
-                self.prefix_words = wp
+            if quantization == "bq":
+                # a prefix at least as wide as the code itself saves
+                # nothing (and would crash the column scatter for
+                # dim <= 128)
+                if wp < bq_ops.bq_words(dim):
+                    self.prefix_words = wp
+            else:
+                # PQ two-stage: the prefix is a BQ SIGN slice of the raw
+                # vectors (ops/pq.pq_topk_twostage) — it needs that many
+                # leading dims to exist
+                if wp * 32 <= dim:
+                    self.prefix_words = wp
         from weaviate_tpu.ops.pallas_kernels import recommended
 
         self.use_pallas = recommended()
@@ -268,8 +276,10 @@ class QuantizedVectorStore:
         live = np.nonzero(self._valid_np)[0]
         for s in range(0, len(live), batch):
             sl = live[s:s + batch]
-            codes = self._encode(self._vectors_for(sl))
-            self._write_codes(sl, codes, rows=None)
+            rows = self._vectors_for(sl)
+            # rows ride along so _write_codes can (re-)derive the PQ sign
+            # prefix — a train() AFTER add() must not leave prefix_t zeroed
+            self._write_codes(sl, self._encode(rows), rows=rows)
 
     # -- mutation ------------------------------------------------------------
 
@@ -304,7 +314,15 @@ class QuantizedVectorStore:
         self._write_codes(slots, codes, rows=vectors)
 
     def _write_codes(self, slots: np.ndarray, codes: np.ndarray | None,
-                     rows: np.ndarray | None):
+                     rows: np.ndarray | None, pref: np.ndarray | None = None):
+        if (pref is None and rows is not None and self.prefix_words
+                and self.quantization == "pq" and codes is not None):
+            # PQ prefix comes from the raw vectors' sign bits, not the
+            # codes (the BQ store slices its own codes instead); derived
+            # here so every write path — add, re-encode after train,
+            # restore-from-vectors — carries it
+            pref = np.asarray(bq_ops.bq_encode(
+                jnp.asarray(np.asarray(rows)[:, :self.prefix_words * 32])))
         """Scatter codes (and bf16 rescore rows) into the device arrays,
         donated in place; padding to pow2 buckets bounds compiled variants."""
         m = len(slots)
@@ -325,10 +343,16 @@ class QuantizedVectorStore:
                 self.codes, self.valid, slot_dev,
                 self._placed_replicated(cbuf), mask_dev)
             if self.prefix_t is not None:
+                if self.quantization == "bq":
+                    pcols = cbuf[:, :self.prefix_words].T.copy()
+                else:
+                    pbuf = np.zeros((bucket, self.prefix_words),
+                                    dtype=np.uint32)
+                    if pref is not None:
+                        pbuf[:m] = pref[:, :self.prefix_words]
+                    pcols = pbuf.T.copy()
                 self.prefix_t = _scatter_prefix(
-                    self.prefix_t, slot_dev,
-                    jnp.asarray(cbuf[:, :self.prefix_words].T.copy()),
-                    mask_dev)
+                    self.prefix_t, slot_dev, jnp.asarray(pcols), mask_dev)
         else:
             # mask-redirect padding entries like _scatter_codes does —
             # a bare scatter of the zero-padded slot buffer would mark
@@ -425,12 +449,21 @@ class QuantizedVectorStore:
                 quantization=quant_key, metric=metric, mesh=self.mesh,
                 use_pallas=self.use_pallas,
             )
-        if quant_key == "pq4":
-            return pq_ops.pq4_topk(
-                queries_dev, self.codes, cent, k=k_cand, chunk_size=cs,
-                metric=metric, valid=valid,
-            )
-        if quant_key == "pq":
+        if quant_key in ("pq4", "pq"):
+            if self.prefix_t is not None:
+                qp = bq_ops.bq_encode(
+                    queries_dev[:, :self.prefix_words * 32])
+                return pq_ops.pq_topk_twostage(
+                    queries_dev, qp, self.codes, cent, self.prefix_t,
+                    k=k_cand, refine=max(2, self.rescore_limit // 2),
+                    metric=metric, valid=valid, m=self.pq_segments,
+                    use_pallas=self.use_pallas,
+                )
+            if quant_key == "pq4":
+                return pq_ops.pq4_topk(
+                    queries_dev, self.codes, cent, k=k_cand, chunk_size=cs,
+                    metric=metric, valid=valid,
+                )
             return pq_ops.pq_topk(
                 queries_dev, self.codes, cent, k=k_cand, chunk_size=cs,
                 metric=metric, valid=valid,
@@ -582,6 +615,10 @@ class QuantizedVectorStore:
                     self.rescore_rows, dtype=np.float32)
             else:
                 snap["codes"] = np.asarray(self.codes)
+                if self.prefix_t is not None and self.quantization == "pq":
+                    # PQ prefixes derive from the raw vectors — a
+                    # codes-only snapshot must carry them explicitly
+                    snap["prefix_t"] = np.asarray(self.prefix_t)
             return snap
 
     @classmethod
@@ -611,5 +648,11 @@ class QuantizedVectorStore:
                 # codes-only snapshot: restore codes directly
                 store._valid_np[live] = True
                 store._write_codes(live, snap["codes"][live], rows=None)
+                if snap.get("prefix_t") is not None \
+                        and store.prefix_t is not None:
+                    pt = snap["prefix_t"]
+                    store.prefix_t = jnp.asarray(np.pad(
+                        pt, ((0, 0),
+                             (0, store.capacity - pt.shape[1]))))
         store._count = snap["count"]
         return store

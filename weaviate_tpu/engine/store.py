@@ -270,6 +270,16 @@ class DeviceVectorStore:
             vectors, valid, norms = self.vectors, self.valid, self.sq_norms
             capacity = self.capacity
             if allow_mask is not None:
+                allowed = np.flatnonzero(allow_mask)
+                # low-selectivity policy (measured, tools/bench_filtered.py
+                # + BASELINE r5): below ~1/16 of the corpus, gathering the
+                # allowed rows and scanning the dense gather beats masking
+                # the full scan — the full scan's cost is selectivity-
+                # independent, the gather's is O(|allowed|)
+                if (self.mesh is None and len(allowed) > 0
+                        and len(allowed) <= capacity // 16):
+                    return self._search_gathered(queries, k, allowed,
+                                                 squeeze)
                 full = np.zeros(capacity, dtype=bool)
                 full[: len(allow_mask)] = allow_mask
                 valid = jnp.logical_and(valid, self._placed(full))
@@ -291,6 +301,46 @@ class DeviceVectorStore:
                     use_pallas=self.use_pallas, selection=self.selection,
                 )
         d_np, i_np = np.asarray(d), np.asarray(i)
+        if squeeze:
+            return d_np[0], i_np[0]
+        return d_np, i_np
+
+    def _search_gathered(self, queries: np.ndarray, k: int,
+                         allowed: np.ndarray, squeeze: bool):
+        """Filtered search at low selectivity: gather the allowed rows
+        into a dense pow2-padded buffer on device and scan THAT
+        (reference analog: flatSearchCutoff routes small filters to
+        brute force over the allow list, hnsw/index.go:95). Called under
+        ``_lock`` by ``search``. Buckets bound compiled variants."""
+        m = len(allowed)
+        bucket = 1 << max(7, (m - 1).bit_length())
+        slot_buf = np.zeros(bucket, dtype=np.int32)
+        slot_buf[:m] = allowed
+        vmask = np.zeros(bucket, dtype=bool)
+        vmask[:m] = True
+        slots_dev = jnp.asarray(slot_buf)
+        rows = self.vectors[slots_dev]
+        valid_g = jnp.logical_and(self.valid[slots_dev],
+                                  jnp.asarray(vmask))
+        norms_g = (self.sq_norms[slots_dev]
+                   if self.sq_norms is not None else None)
+        metric = ("cosine" if self.metric in ("cosine", "cosine-dot")
+                  else self.metric)
+        d, i = chunked_topk_distances(
+            jnp.asarray(queries), rows, k=min(k, bucket),
+            chunk_size=bucket, metric=metric, valid=valid_g,
+            x_sq_norms=norms_g, use_pallas=self.use_pallas,
+            selection=self.selection,
+        )
+        d_np, i_np = np.asarray(d), np.asarray(i)
+        live = i_np >= 0
+        i_np = np.where(live, slot_buf[np.clip(i_np, 0, bucket - 1)], -1)
+        if i_np.shape[1] < k:
+            # keep search()'s documented [B, k] shape when k > bucket
+            pad = k - i_np.shape[1]
+            i_np = np.pad(i_np, ((0, 0), (0, pad)), constant_values=-1)
+            d_np = np.pad(d_np, ((0, 0), (0, pad)),
+                          constant_values=np.float32(np.inf))
         if squeeze:
             return d_np[0], i_np[0]
         return d_np, i_np

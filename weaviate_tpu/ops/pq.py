@@ -282,6 +282,76 @@ def pq_lut(q: jnp.ndarray, centroids: jnp.ndarray, metric: str, m: int):
     return lut.at[:, 0, :].add(1.0)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "refine", "metric", "m",
+                                             "use_pallas"))
+def pq_topk_twostage(
+    q: jnp.ndarray,
+    q_prefix_words: jnp.ndarray,
+    codes: jnp.ndarray,
+    centroids: jnp.ndarray,
+    prefix_t: jnp.ndarray,
+    k: int,
+    refine: int = 8,
+    metric: str = "l2-squared",
+    valid: jnp.ndarray | None = None,
+    id_offset: jnp.ndarray | int = 0,
+    m: int | None = None,
+    use_pallas: bool = True,
+):
+    """Two-stage PQ scan (the r4 verdict's "extend the prefix idea to PQ").
+
+    An exhaustive ADC scan pays 2*B*N*d MXU FLOPs no matter how small the
+    codes (BASELINE r4 roofline note) — pruning is the only way under it.
+    Stage 1 scans a 128/256-bit transposed BQ SIGN prefix (built from the
+    raw vectors at insert, ops/bq semantics; int8-MXU hamming via
+    bq_scan_reduce) and keeps refine*k candidates; stage 2 gathers those
+    candidates' PQ codes and scores them with exact per-query ADC tables
+    (pq_lut — exact for l2/dot by segment orthogonality). The full code
+    array is only ever touched at R = refine*k rows per query.
+    """
+    from weaviate_tpu.ops import bq as bq_ops
+    from weaviate_tpu.ops.distances import MASKED_DISTANCE
+    from weaviate_tpu.ops.topk import topk_smallest
+
+    n = codes.shape[0]
+    m = m or centroids.shape[0]
+
+    if use_pallas:
+        from weaviate_tpu.ops.pallas_kernels import bq_scan_reduce
+
+        vals1, ids1 = bq_scan_reduce(
+            q_prefix_words, prefix_t, valid=valid,
+            reduce_l=bq_ops._auto_reduce_l(n), transposed=True)
+        r = min(refine * k, vals1.shape[1])
+        negd, pos = jax.lax.approx_max_k(-vals1, r, recall_target=0.95)
+        cand_d1 = -negd
+        cand = jnp.take_along_axis(ids1, pos, axis=1)  # [B, R] rows
+    else:
+        cand_d1, ids1 = bq_ops.bq_topk(
+            q_prefix_words, prefix_t.T, k=min(refine * k, n), valid=valid,
+            use_pallas=False)
+        cand = jnp.where(ids1 < 0, 0, ids1)
+        r = cand.shape[1]
+
+    cg = codes[jnp.clip(cand, 0, n - 1)].astype(jnp.int32)  # [B, R, m]
+    lut = pq_lut(q, centroids, metric, m)  # [B, m, kc]
+    seg = jnp.arange(m)[None, :]
+
+    def adc_one(lut_b, cg_b):  # [m, kc], [R, m] -> [R]
+        return lut_b[seg, cg_b].sum(axis=1)
+
+    d2 = jax.vmap(adc_one)(lut, cg)  # [B, R]
+    d2 = jnp.where(cand_d1 >= MASKED_DISTANCE * 0.5, MASKED_DISTANCE, d2)
+    kk = min(k, r)
+    fd, fi = topk_smallest(d2, cand, kk)
+    if kk < k:
+        fd = jnp.pad(fd, ((0, 0), (0, k - kk)),
+                     constant_values=MASKED_DISTANCE)
+        fi = jnp.pad(fi, ((0, 0), (0, k - kk)), constant_values=-1)
+    fi = jnp.where(fd >= MASKED_DISTANCE * 0.5, -1, fi + id_offset)
+    return fd, fi
+
+
 @functools.partial(jax.jit, static_argnames=("k", "chunk_size", "metric", "m",
                                              "reduce_l"))
 def pq4_topk(
