@@ -489,6 +489,7 @@ def main():
         "device": device_stats,
         "quantized_clustered_1M_128d": quant,
         "kernel_conformance": conformance,
+        "serving_fabric_null_device": fabric,
         "tunnel_rtt_ms": round(rtt_s * 1e3, 1),
     }), flush=True)
 

@@ -252,3 +252,34 @@ def test_pq_twostage_train_after_add_rebuilds_prefix():
     assert pt[:, :2000].any(), "prefix still zeroed after train()"
     d, i = st.search(xs[:6], k=5)
     assert (i[:, 0] == np.arange(6)).all()
+
+
+def test_pq_twostage_chunked_stage2_matches_unchunked():
+    """The R-chunked one-hot stage 2 (HBM-transient bound) must produce
+    identical results to the unchunked path."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from weaviate_tpu.ops import bq as bq_ops
+    from weaviate_tpu.ops import pq as pq_ops
+
+    rng = np.random.default_rng(8)
+    n, d, m = 4096, 160, 40
+    xs = rng.standard_normal((n, d)).astype(np.float32)
+    book = pq_ops.pq_fit(xs, m=m, k=16, iters=4)
+    codes = jnp.asarray(pq_ops.pq_encode(book, xs))
+    prefix_t = jnp.transpose(bq_ops.bq_encode(jnp.asarray(xs[:, :128])))
+    q = jnp.asarray(xs[:6] + 0.01 * rng.standard_normal((6, d)).astype(
+        np.float32))
+    qp = bq_ops.bq_encode(q[:, :128])
+    d1, i1 = pq_ops.pq_topk_twostage(q, qp, codes, book.centroids,
+                                     prefix_t, k=20, refine=8,
+                                     use_pallas=False)
+    # tiny budget forces many R-chunks
+    d2, i2 = pq_ops.pq_topk_twostage(q, qp, codes, book.centroids,
+                                     prefix_t, k=20, refine=8,
+                                     use_pallas=False,
+                                     chunk_budget_bytes=16384)
+    assert np.array_equal(np.asarray(i1), np.asarray(i2))
+    assert np.allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5,
+                       atol=1e-5)

@@ -92,10 +92,24 @@ def main():
         return max(time.perf_counter() - t0 - rtt_s, 1e-3) / (reps + 1) * 1e3
 
     # ---- part 1: timing at full scale (synthetic codes) -------------------
+    # generate in donated chunked fills: a one-shot randint materializes
+    # ~2x the 9.6 GB array and OOMs the 16 GB chip
+    import functools
+
     key = jax.random.PRNGKey(0)
-    xw = jax.lax.bitcast_convert_type(
-        jax.random.randint(key, (n, w), -2**31, 2**31 - 1, dtype=jnp.int32),
-        jnp.uint32)
+    gen_rows = CHUNK * 8
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def fill(buf, ci):
+        blk = jax.lax.bitcast_convert_type(
+            jax.random.randint(jax.random.fold_in(key, ci),
+                               (gen_rows, w), -2**31, 2**31 - 1,
+                               dtype=jnp.int32), jnp.uint32)
+        return jax.lax.dynamic_update_slice(buf, blk, (ci * gen_rows, 0))
+
+    xw = jnp.zeros((n, w), dtype=jnp.uint32)
+    for ci in range(n // gen_rows):
+        xw = fill(xw, ci)
     xw.block_until_ready()
     xp_t = jnp.transpose(xw[:, :wp]).copy()
     xp_t.block_until_ready()

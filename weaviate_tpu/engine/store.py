@@ -271,13 +271,20 @@ class DeviceVectorStore:
             capacity = self.capacity
             if allow_mask is not None:
                 allowed = np.flatnonzero(allow_mask)
-                # low-selectivity policy (measured, tools/bench_filtered.py
-                # + BASELINE r5): below ~1/16 of the corpus, gathering the
-                # allowed rows and scanning the dense gather beats masking
-                # the full scan — the full scan's cost is selectivity-
-                # independent, the gather's is O(|allowed|)
-                if (self.mesh is None and len(allowed) > 0
-                        and len(allowed) <= capacity // 16):
+                # selectivity policy (measured, tools/bench_filtered.py —
+                # BASELINE r5): the masked scan's cost is selectivity-
+                # independent, the gather's is O(|allowed|), so gather
+                # wins everywhere below ~50% of the corpus — bounded by
+                # a 1 GB transient-gather HBM budget computed on the
+                # PADDED pow2 bucket at the actual storage dtype
+                m_allowed = len(allowed)
+                bucket = 1 << max(7, (m_allowed - 1).bit_length()) \
+                    if m_allowed else 0
+                row_bytes = self.dim * jnp.dtype(
+                    self.vectors.dtype).itemsize
+                if (self.mesh is None and m_allowed > 0
+                        and m_allowed <= capacity // 2
+                        and bucket * row_bytes <= (1 << 30)):
                     return self._search_gathered(queries, k, allowed,
                                                  squeeze)
                 full = np.zeros(capacity, dtype=bool)
