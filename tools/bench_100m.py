@@ -98,7 +98,10 @@ def main():
     import functools
 
     if args.skip_timing:
-        return part2(args, out, rtt_s)
+        if args.skip_recall:
+            print(json.dumps(out), flush=True)
+            return
+        return part2(args, out)
     key = jax.random.PRNGKey(0)
     gen_rows = CHUNK * 8
 
@@ -157,11 +160,11 @@ def main():
 
     # ---- part 2: real clustered build + recall at --real-n -----------------
     if not args.skip_recall:
-        return part2(args, out, rtt_s)
+        return part2(args, out)
     print(json.dumps(out), flush=True)
 
 
-def part2(args, out, rtt_s):
+def part2(args, out):
     import functools
 
     import numpy as np
@@ -174,105 +177,104 @@ def part2(args, out, rtt_s):
     d = args.dim
     w = d // 32
     wp = 4
-    if True:
-        rn = (args.real_n // CHUNK) * CHUNK
-        n_chunks = rn // CHUNK
-        kc = jax.random.PRNGKey(7)
-        n_centers = 65536
-        centers = jax.random.normal(kc, (n_centers, d), dtype=jnp.float32)
+    rn = (args.real_n // CHUNK) * CHUNK
+    n_chunks = rn // CHUNK
+    kc = jax.random.PRNGKey(7)
+    n_centers = 65536
+    centers = jax.random.normal(kc, (n_centers, d), dtype=jnp.float32)
 
-        # centers/q are ARGUMENTS everywhere: a jit closure would ship
-        # the 200 MB table as a compile-RPC constant through the tunnel
-        # (minutes-long compiles; see axon timing notes)
-        def _gen(rows, cents):
-            keys = jax.vmap(lambda r: jax.random.fold_in(kc, r))(rows)
-            a = jax.vmap(
-                lambda kk: jax.random.randint(kk, (), 0, n_centers))(keys)
-            noise = jax.vmap(
-                lambda kk: jax.random.normal(kk, (d,)))(keys)
-            return cents[a] + 0.35 * noise
+    # centers/q are ARGUMENTS everywhere: a jit closure would ship
+    # the 200 MB table as a compile-RPC constant through the tunnel
+    # (minutes-long compiles; see axon timing notes)
+    def _gen(rows, cents):
+        keys = jax.vmap(lambda r: jax.random.fold_in(kc, r))(rows)
+        a = jax.vmap(
+            lambda kk: jax.random.randint(kk, (), 0, n_centers))(keys)
+        noise = jax.vmap(
+            lambda kk: jax.random.normal(kk, (d,)))(keys)
+        return cents[a] + 0.35 * noise
 
-        gen_rows = jax.jit(_gen)
+    gen_rows = jax.jit(_gen)
 
-        # queries: perturbed copies of existing rows
-        qrows = jax.random.randint(jax.random.PRNGKey(9), (args.queries,),
-                                   0, rn)
-        q = gen_rows(qrows, centers) + 0.05 * jax.random.normal(
-            jax.random.PRNGKey(10), (args.queries, d))
-        q.block_until_ready()
-        log("queries generated; compiling build/gt steps...")
+    # queries: perturbed copies of existing rows
+    qrows = jax.random.randint(jax.random.PRNGKey(9), (args.queries,),
+                               0, rn)
+    q = gen_rows(qrows, centers) + 0.05 * jax.random.normal(
+        jax.random.PRNGKey(10), (args.queries, d))
+    q.block_until_ready()
+    log("queries generated; compiling build/gt steps...")
 
-        codes = jnp.zeros((rn, w), dtype=jnp.uint32)
-        prefix = jnp.zeros((wp, rn), dtype=jnp.uint32)
+    codes = jnp.zeros((rn, w), dtype=jnp.uint32)
+    prefix = jnp.zeros((wp, rn), dtype=jnp.uint32)
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
-        def build_step(ci, codes, prefix, cents):
-            v = _gen(ci * CHUNK + jnp.arange(CHUNK), cents)
-            cw = bq_ops.bq_encode(v)
-            codes = jax.lax.dynamic_update_slice(
-                codes, cw, (ci * CHUNK, 0))
-            prefix = jax.lax.dynamic_update_slice(
-                prefix, jnp.transpose(cw[:, :wp]), (0, ci * CHUNK))
-            return codes, prefix
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def build_step(ci, codes, prefix, cents):
+        v = _gen(ci * CHUNK + jnp.arange(CHUNK), cents)
+        cw = bq_ops.bq_encode(v)
+        codes = jax.lax.dynamic_update_slice(
+            codes, cw, (ci * CHUNK, 0))
+        prefix = jax.lax.dynamic_update_slice(
+            prefix, jnp.transpose(cw[:, :wp]), (0, ci * CHUNK))
+        return codes, prefix
 
-        @jax.jit
-        def gt_step(ci, carry_d, carry_i, cents, q):
-            v = _gen(ci * CHUNK + jnp.arange(CHUNK),
-                     cents).astype(jnp.bfloat16).astype(jnp.float32)
-            dd = (jnp.sum(q * q, -1)[:, None]
-                  - 2.0 * q @ v.T + jnp.sum(v * v, -1)[None, :])
-            ids = ci * CHUNK + jax.lax.broadcasted_iota(
-                jnp.int32, (1, CHUNK), 1)
-            ids = jnp.broadcast_to(ids, (args.queries, CHUNK))
-            negd, pos = jax.lax.top_k(-dd, 10)
-            cd = -negd
-            cid = jnp.take_along_axis(ids, pos, axis=1)
-            md, mi = jnp.concatenate([carry_d, cd], 1), jnp.concatenate(
-                [carry_i, cid], 1)
-            negd2, pos2 = jax.lax.top_k(-md, 10)
-            return -negd2, jnp.take_along_axis(mi, pos2, axis=1)
+    @jax.jit
+    def gt_step(ci, carry_d, carry_i, cents, q):
+        v = _gen(ci * CHUNK + jnp.arange(CHUNK),
+                 cents).astype(jnp.bfloat16).astype(jnp.float32)
+        dd = (jnp.sum(q * q, -1)[:, None]
+              - 2.0 * q @ v.T + jnp.sum(v * v, -1)[None, :])
+        ids = ci * CHUNK + jax.lax.broadcasted_iota(
+            jnp.int32, (1, CHUNK), 1)
+        ids = jnp.broadcast_to(ids, (args.queries, CHUNK))
+        negd, pos = jax.lax.top_k(-dd, 10)
+        cd = -negd
+        cid = jnp.take_along_axis(ids, pos, axis=1)
+        md, mi = jnp.concatenate([carry_d, cd], 1), jnp.concatenate(
+            [carry_i, cid], 1)
+        negd2, pos2 = jax.lax.top_k(-md, 10)
+        return -negd2, jnp.take_along_axis(mi, pos2, axis=1)
 
-        t0 = time.perf_counter()
-        gt_d = jnp.full((args.queries, 10), 3e38, jnp.float32)
-        gt_i = jnp.full((args.queries, 10), -1, jnp.int32)
-        for ci in range(n_chunks):
-            codes, prefix = build_step(ci, codes, prefix, centers)
-            gt_d, gt_i = gt_step(ci, gt_d, gt_i, centers, q)
-            if ci % 32 == 0:
-                codes.block_until_ready()
-                el = time.perf_counter() - t0
-                log(f"  build+gt chunk {ci}/{n_chunks} "
-                    f"({(ci+1)*CHUNK/max(el,1e-9):.0f} rows/s)")
-        codes.block_until_ready()
-        build_s = time.perf_counter() - t0
-        log(f"real build {rn} rows in {build_s:.0f}s")
+    t0 = time.perf_counter()
+    gt_d = jnp.full((args.queries, 10), 3e38, jnp.float32)
+    gt_i = jnp.full((args.queries, 10), -1, jnp.int32)
+    for ci in range(n_chunks):
+        codes, prefix = build_step(ci, codes, prefix, centers)
+        gt_d, gt_i = gt_step(ci, gt_d, gt_i, centers, q)
+        if ci % 32 == 0:
+            codes.block_until_ready()
+            el = time.perf_counter() - t0
+            log(f"  build+gt chunk {ci}/{n_chunks} "
+                f"({(ci+1)*CHUNK/max(el,1e-9):.0f} rows/s)")
+    codes.block_until_ready()
+    build_s = time.perf_counter() - t0
+    log(f"real build {rn} rows in {build_s:.0f}s")
 
-        qw = bq_ops.bq_encode(q)
-        gt_np = np.asarray(gt_i)
-        qn = np.asarray(q)
-        recalls = {}
-        # candidate count must scale with rows-per-cluster (~rn/65536
-        # here): k=100 collapses at 30M, k=400 recovers >=0.95
-        for kcand in (100, 400, 1000):
-            d2, i2 = bq_ops.bq_topk_twostage(qw, codes, prefix, k=kcand,
-                                             refine=8)
-            cand = np.asarray(i2)
-            recall_n = 0
-            for r in range(args.queries):
-                rows = np.asarray(gen_rows(jnp.asarray(
-                    np.clip(cand[r], 0, rn - 1)), centers))
-                dd = ((qn[r][None, :] - rows) ** 2).sum(-1)
-                dd[cand[r] < 0] = np.inf
-                top = cand[r][np.argsort(dd)[:10]]
-                recall_n += len(set(top.tolist()) & set(gt_np[r].tolist()))
-            recalls[f"k{kcand}"] = round(
-                recall_n / (args.queries * 10), 4)
-            log(f"real clustered {rn} k_cand={kcand}: recall@10 "
-                f"{recalls[f'k{kcand}']}")
-        out["real_clustered"] = {
-            "n": rn, "build_s": round(build_s, 1),
-            "recall_at_10": recalls,
-        }
+    qw = bq_ops.bq_encode(q)
+    gt_np = np.asarray(gt_i)
+    qn = np.asarray(q)
+    recalls = {}
+    # candidate count must scale with rows-per-cluster (~rn/65536
+    # here): k=100 collapses at 30M, k=400 recovers >=0.95
+    for kcand in (100, 400, 1000):
+        d2, i2 = bq_ops.bq_topk_twostage(qw, codes, prefix, k=kcand,
+                                         refine=8)
+        cand = np.asarray(i2)
+        recall_n = 0
+        for r in range(args.queries):
+            rows = np.asarray(gen_rows(jnp.asarray(
+                np.clip(cand[r], 0, rn - 1)), centers))
+            dd = ((qn[r][None, :] - rows) ** 2).sum(-1)
+            dd[cand[r] < 0] = np.inf
+            top = cand[r][np.argsort(dd)[:10]]
+            recall_n += len(set(top.tolist()) & set(gt_np[r].tolist()))
+        recalls[f"k{kcand}"] = round(
+            recall_n / (args.queries * 10), 4)
+        log(f"real clustered {rn} k_cand={kcand}: recall@10 "
+            f"{recalls[f'k{kcand}']}")
+    out["real_clustered"] = {
+        "n": rn, "build_s": round(build_s, 1),
+        "recall_at_10": recalls,
+    }
 
     print(json.dumps(out), flush=True)
 

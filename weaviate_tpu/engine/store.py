@@ -272,10 +272,11 @@ class DeviceVectorStore:
             if allow_mask is not None:
                 allowed = np.flatnonzero(allow_mask)
                 # selectivity policy (measured, tools/bench_filtered.py —
-                # BASELINE r5): the masked scan's cost is selectivity-
-                # independent, the gather's is O(|allowed|), so gather
-                # wins everywhere below ~50% of the corpus — bounded by
-                # a 1 GB transient-gather HBM budget computed on the
+                # BASELINE r5, hoist-proof harness): masked full scan is
+                # selectivity-independent (~11.1 ms at 1M×128 B=256);
+                # gather is ~1.4 ms + linear (5.2 ms at 10%, 23 ms at
+                # 50%) — crossover ≈22%, policy cut at capacity/8 with a
+                # 1 GB transient-gather HBM budget computed on the
                 # PADDED pow2 bucket at the actual storage dtype
                 m_allowed = len(allowed)
                 bucket = 1 << max(7, (m_allowed - 1).bit_length()) \
@@ -283,7 +284,7 @@ class DeviceVectorStore:
                 row_bytes = self.dim * jnp.dtype(
                     self.vectors.dtype).itemsize
                 if (self.mesh is None and m_allowed > 0
-                        and m_allowed <= capacity // 2
+                        and m_allowed <= capacity // 8
                         and bucket * row_bytes <= (1 << 30)):
                     return self._search_gathered(queries, k, allowed,
                                                  squeeze)
