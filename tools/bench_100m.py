@@ -61,6 +61,12 @@ def main():
     out = {"metric": "capacity_100M", "n": n, "dim": d,
            "hbm_gb": round(n * (w + wp) * 4 / 1e9, 2)}
 
+    if args.skip_timing:
+        if args.skip_recall:
+            print(json.dumps(out), flush=True)
+            return
+        return part2(args, out)
+
     @jax.jit
     def _triv(s):
         return s + 1.0
@@ -97,11 +103,6 @@ def main():
     # ~2x the 9.6 GB array and OOMs the 16 GB chip
     import functools
 
-    if args.skip_timing:
-        if args.skip_recall:
-            print(json.dumps(out), flush=True)
-            return
-        return part2(args, out)
     key = jax.random.PRNGKey(0)
     gen_rows = CHUNK * 8
 

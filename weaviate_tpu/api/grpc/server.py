@@ -134,32 +134,49 @@ def _struct_to_dict(s) -> dict:
 def _props_from_batch_object(bo: "pb.BatchObject") -> dict:
     """Flatten the typed batch property payload back into a plain dict
     (the reference re-assembles models.Object the same way,
-    v1/batch_parse_request.go)."""
+    v1/batch_parse_request.go). Iterates only the SET fields — walking
+    all ten repeated-array fields per object cost ~10 µs each on the
+    import hot path."""
     p = bo.properties
-    props = _struct_to_dict(p.non_ref_properties)
-    for arr in p.number_array_properties:
-        props[arr.prop_name] = (
-            list(np.frombuffer(arr.values_bytes, dtype="<f8"))
-            if arr.values_bytes else list(arr.values))
-    for arr in p.int_array_properties:
-        props[arr.prop_name] = list(arr.values)
-    for arr in p.text_array_properties:
-        props[arr.prop_name] = list(arr.values)
-    for arr in p.boolean_array_properties:
-        props[arr.prop_name] = list(arr.values)
-    for obj in p.object_properties:
-        props[obj.prop_name] = _object_value_to_dict(obj.value)
-    for arr in p.object_array_properties:
-        props[arr.prop_name] = [_object_value_to_dict(v) for v in arr.values]
-    for name in p.empty_list_props:
-        props[name] = []
-    for ref in p.single_target_ref_props:
-        props[ref.prop_name] = [
-            {"beacon": f"weaviate://localhost/{u}"} for u in ref.uuids]
-    for ref in p.multi_target_ref_props:
-        props[ref.prop_name] = [
-            {"beacon": f"weaviate://localhost/{ref.target_collection}/{u}"}
-            for u in ref.uuids]
+    props: dict = {}
+    refs: list = []  # applied LAST — pre-rewrite precedence: a prop name
+    # set both as a ref and as an array resolves to the ref beacons
+    for fd, val in p.ListFields():
+        name = fd.name
+        if name == "non_ref_properties":
+            props.update(_struct_to_dict(val))
+        elif name == "number_array_properties":
+            for arr in val:
+                props[arr.prop_name] = (
+                    list(np.frombuffer(arr.values_bytes, dtype="<f8"))
+                    if arr.values_bytes else list(arr.values))
+        elif name in ("int_array_properties", "text_array_properties",
+                      "boolean_array_properties"):
+            for arr in val:
+                props[arr.prop_name] = list(arr.values)
+        elif name == "object_properties":
+            for obj in val:
+                props[obj.prop_name] = _object_value_to_dict(obj.value)
+        elif name == "object_array_properties":
+            for arr in val:
+                props[arr.prop_name] = [
+                    _object_value_to_dict(v) for v in arr.values]
+        elif name == "empty_list_props":
+            for nm in val:
+                props[nm] = []
+        elif name == "single_target_ref_props":
+            for ref in val:
+                refs.append((ref.prop_name, [
+                    {"beacon": f"weaviate://localhost/{u}"}
+                    for u in ref.uuids]))
+        elif name == "multi_target_ref_props":
+            for ref in val:
+                refs.append((ref.prop_name, [
+                    {"beacon":
+                     f"weaviate://localhost/{ref.target_collection}/{u}"}
+                    for u in ref.uuids]))
+    for name, beacons in refs:
+        props[name] = beacons
     return props
 
 
