@@ -1,0 +1,158 @@
+"""Native data-plane edge cases (csrc/dataplane.cpp + native_plane.py).
+
+The dual-transport run of tests/test_grpc_api.py proves wire parity for
+the whole RPC surface; this file covers the plane's OWN seams: fast-path
+eligibility boundaries, cache coherence across mutations, the native
+load generator, and stats accounting. Skips without libnghttp2.
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.grpc import v1_pb2 as pb
+from weaviate_tpu.api.grpc.server import GrpcServer
+from weaviate_tpu.db.database import Database
+from weaviate_tpu.schema.config import CollectionConfig, Property
+
+dpn = pytest.importorskip("weaviate_tpu.native.dataplane")
+
+if not dpn.available():
+    pytest.skip("native data plane unavailable", allow_module_level=True)
+
+from weaviate_tpu.api.grpc.native_plane import NativeDataPlane  # noqa: E402
+
+
+@pytest.fixture
+def db(tmp_path):
+    d = Database(str(tmp_path))
+    yield d
+    d.close()
+
+
+@pytest.fixture
+def plane(db):
+    p = NativeDataPlane(db, GrpcServer(db)).start()
+    yield p
+    p.stop()
+
+
+def _search_rpc(port):
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    return chan, chan.unary_unary(
+        "/weaviate.v1.Weaviate/Search",
+        request_serializer=pb.SearchRequest.SerializeToString,
+        response_deserializer=pb.SearchReply.FromString)
+
+
+def _fill(db, name="DP", dim=8, n=300):
+    col = db.create_collection(CollectionConfig(
+        name=name, properties=[Property(name="seq", data_type="int")]))
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((n, dim)).astype(np.float32)
+    col.batch_put([{"properties": {"seq": i}, "vector": vecs[i]}
+                   for i in range(n)])
+    return col, vecs
+
+
+def _req(name, vec, k=5, metadata=True, uses_123=True, certainty=False):
+    r = pb.SearchRequest(collection=name, limit=k, uses_123_api=uses_123)
+    r.near_vector.vector_bytes = vec.tobytes()
+    if metadata:
+        r.metadata.uuid = True
+        r.metadata.distance = True
+    if certainty:
+        r.metadata.certainty = True
+    return r
+
+
+def _wait_registered(plane, name, timeout=5.0):
+    return plane.wait_registered(name, timeout)
+
+
+def test_fast_path_engages_and_counts(db, plane):
+    _col, vecs = _fill(db)
+    chan, rpc = _search_rpc(plane.port)
+    r1 = rpc(_req("DP", vecs[3]), timeout=10)  # registers via fallback
+    assert _wait_registered(plane, "DP")
+    plane.warm_collection("DP")
+    f0, b0 = plane.dp.stats()
+    r2 = rpc(_req("DP", vecs[3]), timeout=10)
+    f1, b1 = plane.dp.stats()
+    assert f1 == f0 + 1 and b1 == b0
+    assert [x.metadata.id for x in r2.results] == \
+        [x.metadata.id for x in r1.results]
+    chan.close()
+
+
+def test_feature_requests_fall_back(db, plane):
+    """Anything beyond the plain shape must take the fallback and still
+    answer correctly: certainty metadata, legacy API flag, filters."""
+    _col, vecs = _fill(db)
+    chan, rpc = _search_rpc(plane.port)
+    rpc(_req("DP", vecs[0]), timeout=10)
+    assert _wait_registered(plane, "DP")
+    plane.warm_collection("DP")
+    f0, b0 = plane.dp.stats()
+    # certainty requested -> slow path, but correct
+    r = rpc(_req("DP", vecs[5], certainty=True), timeout=10)
+    assert r.results[0].metadata.certainty_present
+    # legacy (no uses_123_api) -> slow path
+    rpc(_req("DP", vecs[5], uses_123=False), timeout=10)
+    # filters -> slow path
+    req = _req("DP", vecs[5])
+    req.filters.on.append("seq")
+    req.filters.operator = pb.Filters.OPERATOR_GREATER_THAN_EQUAL
+    req.filters.value_int = 100
+    rf = rpc(req, timeout=10)
+    assert len(rf.results) > 0
+    f1, b1 = plane.dp.stats()
+    assert f1 == f0  # none of these took the fast path
+    assert b1 >= b0 + 2
+    chan.close()
+
+
+def test_big_dim_collections_stay_on_fallback(db, plane):
+    """dim > DataPlane.max_dim must never register (the dp_wait query
+    buffer is sized max_batch*max_dim)."""
+    dim = plane.dp.max_dim + 123
+    col = db.create_collection(CollectionConfig(
+        name="Big", properties=[Property(name="seq", data_type="int")]))
+    rng = np.random.default_rng(1)
+    vecs = rng.standard_normal((20, dim)).astype(np.float32)
+    col.batch_put([{"properties": {"seq": i}, "vector": vecs[i]}
+                   for i in range(20)])
+    chan, rpc = _search_rpc(plane.port)
+    r1 = rpc(_req("Big", vecs[7]), timeout=15)
+    r2 = rpc(_req("Big", vecs[7]), timeout=15)
+    assert r1.results[0].metadata.id == r2.results[0].metadata.id
+    f, _b = plane.dp.stats()
+    assert f == 0  # never fast
+    chan.close()
+
+
+def test_unknown_collection_not_found(db, plane):
+    chan, rpc = _search_rpc(plane.port)
+    with pytest.raises(grpc.RpcError) as e:
+        rpc(_req("Nope", np.zeros(8, np.float32)), timeout=10)
+    assert e.value.code() == grpc.StatusCode.NOT_FOUND
+    chan.close()
+
+
+def test_native_load_generator_round_trip(db, plane):
+    _col, vecs = _fill(db, n=500)
+    chan, rpc = _search_rpc(plane.port)
+    rpc(_req("DP", vecs[0]), timeout=10)
+    assert _wait_registered(plane, "DP")
+    plane.warm_collection("DP")
+    head = pb.SearchRequest(collection="DP", limit=5, uses_123_api=True)
+    head.metadata.uuid = True
+    head.metadata.distance = True
+    st = dpn.bench(plane.port, conns=2, streams=4, duration_ms=800,
+                   dim=8, request_head=head.SerializeToString())
+    assert st["errors"] == 0 and st["done"] > 50, st
+    chan.close()

@@ -167,10 +167,14 @@ def main():
     query(queries[0])  # warm (compile; registers with the native plane)
     if args.native_plane and server is not None and hasattr(
             server.grpc, "warm_collection"):
-        t_w = time.perf_counter()
-        server.grpc.warm_collection("Bench")
-        log(f"native plane reply cache warmed in "
-            f"{time.perf_counter() - t_w:.1f}s")
+        if server.grpc.wait_registered("Bench"):
+            t_w = time.perf_counter()
+            server.grpc.warm_collection("Bench")  # joins the auto-warm
+            log(f"native plane reply cache warm after "
+                f"{time.perf_counter() - t_w:.1f}s")
+        else:
+            log("WARNING: collection never fast-path registered — "
+                "served numbers below are FALLBACK-path numbers")
     lat = []
     hits_by_query = []
     for q in queries:

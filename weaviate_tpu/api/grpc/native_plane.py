@@ -71,6 +71,7 @@ class NativeDataPlane:
         self._coll_by_id: dict[int, str] = {}
         self._registered: set[str] = set()
         self._reg_lock = threading.Lock()  # dispatch vs warm threads
+        self._warm_threads: dict[str, threading.Thread] = {}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -127,14 +128,38 @@ class NativeDataPlane:
             if warm:
                 # bulk-warm the reply cache off the dispatch thread;
                 # misses self-seed in the meantime
-                threading.Thread(target=self.warm_collection, args=(name,),
-                                 name=f"dp-warm-{name}",
-                                 daemon=True).start()
+                t = threading.Thread(target=self._warm_once, args=(name,),
+                                     name=f"dp-warm-{name}", daemon=True)
+                with self._reg_lock:
+                    self._warm_threads[name] = t
+                t.start()
 
-    def warm_collection(self, name: str, chunk: int = 2048):
-        """Populate the C++ docid -> (uuid, PropertiesResult) reply cache
-        for every live object. One-time O(corpus) Python pass; after it,
-        plain nearVector queries never touch Python per-query."""
+    def wait_registered(self, name: str, timeout: float = 10.0) -> bool:
+        """Block until `name` is fast-path registered (registration runs
+        on the dispatcher thread after the first Search on it)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._reg_lock:
+                if name in self._coll_by_id.values():
+                    return True
+            time.sleep(0.02)
+        return False
+
+    def warm_collection(self, name: str, chunk: int = 2048) -> bool:
+        """Ensure the reply cache for `name` is fully warm. Joins an
+        in-flight auto-warm instead of repeating the O(corpus) pass;
+        returns False when the collection never registered."""
+        with self._reg_lock:
+            t = self._warm_threads.get(name)
+        if t is not None:
+            t.join()
+            return True
+        return self._warm_once(name, chunk)
+
+    def _warm_once(self, name: str, chunk: int = 2048) -> bool:
+        """One O(corpus) pass populating the C++ docid -> (uuid,
+        PropertiesResult) reply cache; after it, plain nearVector
+        queries never touch Python per-query."""
         cid = None
         with self._reg_lock:
             items = list(self._coll_by_id.items())
@@ -142,7 +167,7 @@ class NativeDataPlane:
             if n == name:
                 cid = c
         if cid is None:
-            return
+            return False
         col = self.db.get_collection(name)
         shard = next(iter(col.shards.values()))
         dtype_of = {p.name: p.data_type for p in col.config.properties}
@@ -164,6 +189,7 @@ class NativeDataPlane:
                 ids, uuids, props = [], [], []
         if ids:
             self.dp.cache_put(cid, ids, uuids, props)
+        return True
 
     # -- dispatch -------------------------------------------------------------
 
