@@ -109,3 +109,36 @@ def test_bulk_build_cosine():
     idx.add_batch(np.arange(n), vecs)
     ids, dists = idx.search_by_vector(vecs[7] * 3.0, k=3)  # scale-invariant
     assert ids[0] == 7 and dists[0] < 1e-5
+
+
+def test_device_knn_pallas_branch_on_cpu(monkeypatch):
+    """Force the bf16/pallas knn branch (normally TPU-only) on CPU with a
+    shimmed scan: the block-size adjustment must hand the kernel
+    1024-query bf16 blocks and reassemble full-size slices, for both a
+    1024-multiple query_block and a non-multiple one."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import weaviate_tpu.engine.hnsw_build as hb
+    import weaviate_tpu.ops.pallas_kernels as pk
+    import weaviate_tpu.ops.topk as topk_mod
+
+    seen = []
+
+    def shim(qblk, xscan, k, chunk_size, metric, valid, x_sq_norms,
+             selection, use_pallas):
+        assert use_pallas is True
+        seen.append((tuple(qblk.shape), str(qblk.dtype)))
+        return (jnp.zeros((qblk.shape[0], k), jnp.float32),
+                jnp.zeros((qblk.shape[0], k), jnp.int32))
+
+    monkeypatch.setattr(pk, "recommended", lambda: True)
+    monkeypatch.setattr(topk_mod, "chunked_topk_distances", shim)
+    rng = np.random.default_rng(2)
+    xs = rng.standard_normal((16384, 16)).astype(np.float32)
+    for qb in (2048, 1500):  # multiple and non-multiple of 1024
+        seen.clear()
+        out = hb._device_knn(xs, 9, "l2-squared", query_block=qb)
+        assert out.shape == (16384, 9)
+        assert all(s == (1024, 16) and d == "bfloat16" for s, d in seen), \
+            seen[:2]

@@ -404,36 +404,51 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
     x = np.pad(sub, ((0, pad_rows), (0, 0)))
     valid = np.arange(n + pad_rows) < n
 
+    from weaviate_tpu.ops.pallas_kernels import recommended
+
+    use_pallas = recommended()
     # host-level slices of a few query blocks each: one giant program over
     # 1M queries reproducibly crashes the TPU worker, and per-slice fetches
     # stay small. Queries are dynamic-sliced FROM the device-resident
-    # corpus (they ARE corpus rows) — zero query uploads.
+    # corpus (they ARE corpus rows) — zero query uploads. On the pallas
+    # path the fused kernel's [qb, chunk] distance tile must fit scoped
+    # VMEM, so blocks are capped at 1024 queries (the serving scan's
+    # shape), keeping the slice size by raising the block count.
     blocks_per_slice = 8
+    if use_pallas and query_block > 1024:
+        if query_block % 1024 == 0:
+            blocks_per_slice *= query_block // 1024
+        query_block = 1024
     slice_rows = blocks_per_slice * query_block
 
     @functools.partial(jax.jit, static_argnames=("k", "cs", "metric"))
-    def knn_slice(xd, vd, norms, start, k, cs, metric):
+    def knn_slice(xscan, vd, norms, start, k, cs, metric):
         qs = jax.lax.dynamic_slice(
-            xd, (start, 0), (slice_rows, xd.shape[1]))
-        qb = qs.reshape(blocks_per_slice, query_block, xd.shape[1])
+            xscan, (start, 0), (slice_rows, xscan.shape[1]))
+        qb = qs.reshape(blocks_per_slice, query_block, xscan.shape[1])
 
         def one(qblk):
             _d, i = chunked_topk_distances(
-                qblk.astype(jnp.float32), xd, k=k, chunk_size=cs,
+                qblk, xscan, k=k, chunk_size=cs,
                 metric=metric, valid=vd, x_sq_norms=norms,
-                selection="approx")
+                selection="approx", use_pallas=use_pallas)
             return i
         return jax.lax.map(one, qb).reshape(slice_rows, k)
 
     xd = jnp.asarray(x)
     vd = jnp.asarray(valid)
+    # the scan runs bf16 on the fused MXU kernel — the same storage/
+    # precision choice as the flat serving scan (recall envelope in
+    # BASELINE); candidate ids feed an exact f32 select stage afterwards.
+    # The f32 knn scan was 47.8 s of the 121 s 300k build (BASELINE r5).
+    xscan = xd.astype(jnp.bfloat16) if use_pallas else xd
     norms = jnp.sum(xd.astype(jnp.float32) ** 2, axis=-1)
     norms_arg = norms if metric == "l2-squared" else None
     if return_device:
         parts = []
         for s in range(0, n, slice_rows):
             start = min(s, max(n + pad_rows - slice_rows, 0))
-            ids = knn_slice(xd, vd, norms_arg, start, k_eff, cs, metric)
+            ids = knn_slice(xscan, vd, norms_arg, start, k_eff, cs, metric)
             parts.append(ids[s - start: s - start + min(slice_rows, n - s)])
         knn_dev = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         return xd, knn_dev
@@ -442,7 +457,7 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
         # clamp the window inside the padded corpus; overlap re-computes a
         # few rows rather than compiling a second (tail) shape
         start = min(s, max(n + pad_rows - slice_rows, 0))
-        ids = knn_slice(xd, vd, norms_arg, start, k_eff, cs, metric)
+        ids = knn_slice(xscan, vd, norms_arg, start, k_eff, cs, metric)
         take = np.asarray(ids[s - start: s - start + min(slice_rows, n - s)],
                           dtype=np.int64)
         out[s: s + len(take)] = take
