@@ -286,3 +286,84 @@ def test_storm_postings_concurrent_index_unindex(tmp_path, fast_switch,
     assert got == expected, (len(got), len(expected),
                              list(got ^ expected)[:10])
     store.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_storm_replicated_writes(tmp_path, fast_switch, seed):
+    """Concurrent QUORUM writers through different nodes of a real
+    3-node in-process cluster under jittered shard locks: every
+    acknowledged uuid must be readable from every replica after the
+    dust settles (no lost acks — the -race-analog invariant for the
+    replication path)."""
+    from weaviate_tpu.cluster import ClusterNode
+    from weaviate_tpu.schema.config import ReplicationConfig
+
+    names = ["p0", "p1", "p2"]
+    nodes = [ClusterNode(n, str(tmp_path / n), raft_peers=names,
+                         gossip_interval=0.1,
+                         election_timeout=(0.2, 0.4)) for n in names]
+    try:
+        for n in nodes:
+            n.membership.join([p.address for p in nodes])
+        for n in nodes:
+            n.start()
+        for n in nodes:
+            n.raft.wait_for_leader(timeout=15.0)
+        rng = random.Random(seed)
+        nodes[0].create_collection(CollectionConfig(
+            name="RepStorm",
+            properties=[Property(name="t", data_type="text")],
+            replication=ReplicationConfig(factor=3)))
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if all("RepStorm" in n.db.collections for n in nodes):
+                break
+            time.sleep(0.1)
+        cols = [n.db.get_collection("RepStorm") for n in nodes]
+        for col in cols:
+            _inject_jitter(col, rng)
+        acked: list[list[str]] = [[], [], []]
+        errors: list = []
+
+        def writer(w):
+            try:
+                nrng = np.random.default_rng(seed * 10 + w)
+                for i in range(40):
+                    u = f"00000000-0000-4000-9000-{w:03d}{i:09d}"
+                    cols[w].put_object(
+                        {"t": f"storm w{w} i{i}"},
+                        vector=nrng.standard_normal(8).astype(np.float32),
+                        uuid=u, consistency="QUORUM")
+                    acked[w].append(u)
+            except Exception as e:  # noqa: BLE001
+                errors.append((w, e))
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errors, errors
+        all_acked = [u for ws in acked for u in ws]
+        assert len(all_acked) == 120
+        # QUORUM ack == readable; anti-entropy converges the third copy
+        from weaviate_tpu.replication import HashBeater
+
+        deadline = time.time() + 60
+        missing = list(all_acked)
+        while time.time() < deadline and missing:
+            missing = [u for u in all_acked
+                       if any(cols[r].get_object(u) is None
+                              for r in range(3))]
+            if missing:
+                for col in cols:
+                    HashBeater(col).beat()
+                time.sleep(0.3)
+        assert not missing, (len(missing), missing[:5])
+    finally:
+        for n in nodes:
+            try:
+                n.close()
+            except Exception:
+                pass

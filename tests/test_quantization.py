@@ -283,3 +283,41 @@ def test_pq_twostage_chunked_stage2_matches_unchunked():
     assert np.array_equal(np.asarray(i1), np.asarray(i2))
     assert np.allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5,
                        atol=1e-5)
+
+
+def test_prefix_bits_reachable_from_schema_api(tmp_path):
+    """The two-stage prefix must be configurable through the public
+    vectorIndexConfig wire (snake_case passthrough), not only the engine
+    constructor."""
+    import numpy as np
+
+    from weaviate_tpu.api.rest import _index_config_from_json
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        Property,
+        VectorIndexConfig,
+    )
+
+    cfg = _index_config_from_json("flat", {"bq": {"enabled": True},
+                                           "prefix_bits": 128})
+    assert cfg.quantization == "bq" and cfg.prefix_bits == 128
+
+    db = Database(str(tmp_path))
+    from weaviate_tpu.schema.config import VectorConfig
+
+    col = db.create_collection(CollectionConfig(
+        name="Pfx",
+        vectors=[VectorConfig(index=VectorIndexConfig(
+            index_type="flat", quantization="bq", prefix_bits=128))],
+        properties=[Property(name="s", data_type="int")]))
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((500, 256)).astype(np.float32)
+    col.batch_put([{"properties": {"s": i}, "vector": vecs[i]}
+                   for i in range(500)])
+    shard = next(iter(col.shards.values()))
+    store = shard.vector_indexes[""].store
+    assert store.prefix_words == 4 and store.prefix_t is not None
+    r = col.near_vector(vecs[9], k=3)
+    assert r[0].object.properties["s"] == 9
+    db.close()

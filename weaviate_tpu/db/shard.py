@@ -51,6 +51,7 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
             pq_segments=cfg.pq_segments,
             pq_centroids=cfg.pq_centroids,
             rescore_limit=cfg.rescore_limit,
+            prefix_bits=cfg.prefix_bits,
             mesh=mesh,
             **common,
         )
@@ -67,7 +68,8 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
             # no bq form for IVF lists — honor the compression request on
             # the flat scan (documented fallback, not a silent drop)
             return FlatIndex(quantization="bq", mesh=mesh,
-                             rescore_limit=cfg.rescore_limit, **common)
+                             rescore_limit=cfg.rescore_limit,
+                             prefix_bits=cfg.prefix_bits, **common)
         # mesh forwarded so the single-replica guard fires loudly instead of
         # silently landing a sharded corpus on one device
         return IVFIndex(nlist=cfg.ivf_nlist, nprobe=cfg.ivf_nprobe,
@@ -85,7 +87,8 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
         # graph hops, so bq configs run the quantized flat scan instead.
         if cfg.quantization == "bq":
             return FlatIndex(quantization="bq", mesh=mesh,
-                             rescore_limit=cfg.rescore_limit, **common)
+                             rescore_limit=cfg.rescore_limit,
+                             prefix_bits=cfg.prefix_bits, **common)
         from weaviate_tpu.engine.hnsw import HNSWIndex
 
         return HNSWIndex(
@@ -107,6 +110,8 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
                 pq_segments=cfg.pq_segments,
                 pq_centroids=cfg.pq_centroids,
                 rescore_limit=cfg.rescore_limit,
+                prefix_bits=cfg.prefix_bits,
+                mesh=mesh,
                 **common,
             )
         return DynamicIndex(
@@ -259,7 +264,9 @@ class Shard:
         try:
             idx.compress(quantization=vc.index.quantization,
                          pq_segments=vc.index.pq_segments,
-                         pq_centroids=vc.index.pq_centroids)
+                         pq_centroids=vc.index.pq_centroids,
+                         rescore_limit=vc.index.rescore_limit,
+                         prefix_bits=vc.index.prefix_bits)
         except (RuntimeError, ValueError) as e:
             import logging
 
