@@ -22,7 +22,8 @@ RUN pip install --no-cache-dir \
 
 ENV PYTHONPATH=/app \
     PERSISTENCE_DATA_PATH=/var/lib/weaviate \
-    JAX_PLATFORMS=cpu
+    JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR=/var/lib/weaviate/.jax_cache
 
 VOLUME /var/lib/weaviate
 EXPOSE 8080 50051 2112

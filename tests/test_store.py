@@ -107,3 +107,64 @@ def test_dim_mismatch_raises(rng):
     store = DeviceVectorStore(dim=8)
     with pytest.raises(ValueError):
         store.add(rng.standard_normal((2, 16)).astype(np.float32))
+
+
+def test_staged_adds_visible_to_every_read_path(rng):
+    """add() stages rows host-side; each public read path must flush first
+    so visibility matches the old inline-scatter behavior exactly."""
+    store = DeviceVectorStore(dim=8)
+    vecs = rng.standard_normal((50, 8)).astype(np.float32)
+    slots = store.add(vecs)
+    assert store._staged_rows == 50  # below the flush threshold
+    d, i = store.search(vecs[7], k=1)
+    assert i[0] == slots[7]
+    assert store._staged_rows == 0
+    # get() on a still-staged row
+    s2 = store.add(vecs[:3] + 10.0)
+    got = store.get(s2[1])
+    assert np.allclose(got[0], vecs[1] + 10.0, atol=1e-4)
+    # delete of a staged row flushes first, then tombstones
+    s3 = store.add(vecs[:2] - 5.0)
+    store.delete(s3[0])
+    d, i = store.search(vecs[0] - 5.0, k=1)
+    assert i[0] != s3[0]
+    # live_count sees staged rows
+    store.add(vecs[:4] + 20.0)
+    assert store.live_count() == 50 + 3 + 2 - 1 + 4
+
+
+def test_staged_flush_threshold(rng):
+    store = DeviceVectorStore(dim=8)
+    limit = store._stage_limit
+    n = limit + 10
+    for s in range(0, n, 1000):
+        store.add(rng.standard_normal((min(1000, n - s), 8))
+                  .astype(np.float32))
+    # at least one threshold flush happened without any read
+    assert store._staged_rows < limit
+
+
+def test_failed_flush_keeps_staged_rows(rng, monkeypatch):
+    """A flush-time failure (OOM, compile error) must not drop rows whose
+    add() already returned success — they stay staged and re-flushable."""
+    import weaviate_tpu.engine.store as store_mod
+
+    store = DeviceVectorStore(dim=8)
+    vecs = rng.standard_normal((20, 8)).astype(np.float32)
+    slots = store.add(vecs)
+
+    calls = {"n": 0}
+    orig = store_mod._scatter_rows
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected flush failure")
+        return orig(*a, **k)
+
+    monkeypatch.setattr(store_mod, "_scatter_rows", boom)
+    with pytest.raises(RuntimeError):
+        store.flush_staged()
+    assert store._staged_rows == 20  # retained
+    d, i = store.search(vecs[4], k=1)  # retry succeeds
+    assert i[0] == slots[4]

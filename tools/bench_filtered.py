@@ -43,6 +43,9 @@ def main():
     xs = rng.standard_normal((args.n, args.dim)).astype(np.float32)
     for s in range(0, args.n, 131072):
         store.add(xs[s:s + 131072])
+    # the timing loops below read store.vectors/valid/sq_norms directly,
+    # bypassing the flush-on-read of the store's public methods
+    store.flush_staged()
     qs = rng.standard_normal((args.batch, args.dim)).astype(np.float32)
 
     # chained hoist-proof device timing (BASELINE methodology): R

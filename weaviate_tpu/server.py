@@ -48,16 +48,21 @@ class Server:
         if maybe_initialize_distributed():
             logger.info("joined multi-host JAX runtime")
 
-        # persistent XLA compilation cache under the data dir: vector-store
-        # capacity growth re-jits the donated scatter/search programs per
-        # pow2 level, which costs seconds each on a cold start — cached
-        # compiles make restarts and re-imports warm (users can point
-        # JAX_COMPILATION_CACHE_DIR elsewhere; respected if set)
+        # persistent XLA compilation cache: vector-store capacity growth
+        # re-jits the donated scatter/search programs per pow2 level, which
+        # costs seconds each on a cold start. The cache keys on program +
+        # hardware, not on any instance state, so it lives in the USER
+        # cache dir rather than under data_path — a fresh data directory
+        # (new deploy, CI run, benchmark) still starts warm (users can
+        # point JAX_COMPILATION_CACHE_DIR elsewhere; respected if set)
         if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
             try:
                 import jax
 
-                cache_dir = os.path.join(cfg.data_path, ".jax_cache")
+                cache_root = os.environ.get("XDG_CACHE_HOME") or \
+                    os.path.join(os.path.expanduser("~"), ".cache")
+                cache_dir = os.path.join(cache_root, "weaviate-tpu",
+                                         "xla-cache")
                 os.makedirs(cache_dir, exist_ok=True)
                 jax.config.update("jax_compilation_cache_dir", cache_dir)
             except Exception as e:  # noqa: BLE001 — cache is best-effort
