@@ -993,6 +993,64 @@ int64_t wn_hnsw_search(void* h, const float* q, int64_t k, int64_t ef,
     return n;
 }
 
+// Batch storobj frame encode — byte-identical to the Python codec
+// (weaviate_tpu/storage/objects.py to_bytes; reference analog:
+// entities/storobj/storage_object.go:567 MarshalBinary). Per frame:
+//   u8 version=1 | u64 doc_id | u64 ctime_ms | u64 mtime_ms | 16B uuid |
+//   u32 n_vecs=1 | u16 name_len=0 | u32 dim | dim*f32 |
+//   u32 props_len | props msgpack (packed by the caller)
+// Covers the flagship import shape (exactly one unnamed vector); other
+// shapes keep the Python encoder. uuids arrive as concatenated canonical
+// strings (dashes optional); frame_offs[n+1] is precomputed by the caller
+// (fixed part 55 = 41 header + 4 n_vecs + 2 name_len + 4 dim + 4
+// props_len, plus dim*4 + props_len). Returns 0, or -(i+1) when object
+// i's uuid fails to parse (caller falls back to the Python path).
+int64_t wn_storobj_encode_batch(
+        const uint8_t* uuids, const int64_t* uoffs,
+        const uint8_t* props, const int64_t* poffs,
+        const float* vectors, int32_t dim,
+        const int64_t* doc_ids, const int64_t* created_ms,
+        const int64_t* updated_ms, int64_t n,
+        uint8_t* out, const int64_t* frame_offs) {
+    auto hexval = [](uint8_t c) -> int {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+        if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+        return -1;
+    };
+    for (int64_t i = 0; i < n; ++i) {
+        uint8_t* p = out + frame_offs[i];
+        *p++ = 1;  // version
+        uint64_t w;
+        w = (uint64_t)doc_ids[i];    memcpy(p, &w, 8); p += 8;
+        w = (uint64_t)created_ms[i]; memcpy(p, &w, 8); p += 8;
+        w = (uint64_t)updated_ms[i]; memcpy(p, &w, 8); p += 8;
+        const uint8_t* u = uuids + uoffs[i];
+        int64_t ulen = uoffs[i + 1] - uoffs[i];
+        int nyb = 0;
+        uint8_t cur = 0;
+        for (int64_t j = 0; j < ulen; ++j) {
+            uint8_t c = u[j];
+            if (c == '-') continue;
+            int v = hexval(c);
+            if (v < 0 || nyb >= 32) return -(i + 1);
+            if (nyb & 1) *p++ = (uint8_t)((cur << 4) | v);
+            else cur = (uint8_t)v;
+            ++nyb;
+        }
+        if (nyb != 32) return -(i + 1);
+        uint32_t u32 = 1;  memcpy(p, &u32, 4); p += 4;   // n_named_vectors
+        uint16_t u16 = 0;  memcpy(p, &u16, 2); p += 2;   // name_len ("")
+        u32 = (uint32_t)dim; memcpy(p, &u32, 4); p += 4;
+        memcpy(p, vectors + (size_t)i * (size_t)dim, (size_t)dim * 4);
+        p += (size_t)dim * 4;
+        u32 = (uint32_t)(poffs[i + 1] - poffs[i]);
+        memcpy(p, &u32, 4); p += 4;
+        memcpy(p, props + poffs[i], (size_t)u32); p += (size_t)u32;
+    }
+    return 0;
+}
+
 int64_t wn_varint_encode_many(const uint64_t* vals, const int64_t* offs,
                               int64_t nblocks, uint8_t* out,
                               int64_t* out_lens) {

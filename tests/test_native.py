@@ -224,3 +224,62 @@ def test_index_objects_mixed_ascii_unicode_batch(tmp_path):
     assert set(ids.tolist()) == {0, 1}
     assert set(inv.filterable_ids("body", "common").tolist()) == {0, 1}
     assert set(inv.filterable_ids("body", "héllo").tolist()) == {1}
+
+
+def test_storobj_encode_batch_byte_parity():
+    """Native batch frames must be byte-identical to the Python codec."""
+    import msgpack
+    import uuid as uuid_mod
+
+    import numpy as np
+    import pytest
+
+    from weaviate_tpu import native
+    from weaviate_tpu.storage.objects import StorageObject
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(0)
+    objs = []
+    for i in range(17):
+        o = StorageObject(
+            uuid=str(uuid_mod.uuid4()),
+            doc_id=1000 + i,
+            properties={"seq": i, "name": f"row {i}", "ok": i % 2 == 0,
+                        "score": i * 0.5, "tags": ["a", "b"],
+                        "nested": {"x": 1.0}},
+            creation_time_ms=1700000000000 + i,
+            last_update_time_ms=1700000000500 + i)
+        o.vector = rng.standard_normal(24).astype(np.float32)
+        objs.append(o)
+    frames = native.storobj_encode_batch(
+        [o.uuid.encode() for o in objs],
+        [msgpack.packb(o.properties, use_bin_type=True) for o in objs],
+        np.stack([o.vector for o in objs]),
+        np.asarray([o.doc_id for o in objs], dtype=np.int64),
+        np.asarray([o.creation_time_ms for o in objs], dtype=np.int64),
+        np.asarray([o.last_update_time_ms for o in objs], dtype=np.int64))
+    assert frames is not None
+    for o, f in zip(objs, frames):
+        assert f == o.to_bytes()
+        back = StorageObject.from_bytes(f)
+        assert back.uuid == o.uuid and back.doc_id == o.doc_id
+        assert back.properties == o.properties
+
+
+def test_storobj_encode_batch_bad_uuid_falls_back():
+    import msgpack
+
+    import numpy as np
+    import pytest
+
+    from weaviate_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    out = native.storobj_encode_batch(
+        [b"not-a-uuid"], [msgpack.packb({})],
+        np.zeros((1, 4), dtype=np.float32),
+        np.zeros(1, dtype=np.int64), np.zeros(1, dtype=np.int64),
+        np.zeros(1, dtype=np.int64))
+    assert out is None

@@ -342,19 +342,53 @@ class Shard:
             self.meta.put(b"doc_counter", self._counter)
             docid_puts: list[tuple[bytes, object]] = []
             object_puts: list[tuple[bytes, object]] = []
-            for i, obj in enumerate(objs):
-                old_raw = self.docid.get(obj.uuid.encode())
+            uuid_keys = [o.uuid.encode() for o in objs]
+            old_raws = self.docid.get_many(uuid_keys)
+            # flagship import shape (exactly one unnamed vector per
+            # object): all storobj value frames come out of ONE native
+            # call; props are msgpacked here so the bytes match the
+            # Python encoder exactly. Any other shape — or a uuid the
+            # fast parser rejects — keeps the per-object Python codec.
+            frames = None
+            from weaviate_tpu import native
+
+            single_vec = (objs and native.available() and all(
+                len(o.vectors) == 1 and "" in o.vectors for o in objs))
+            if single_vec:
+                import msgpack
+
+                vec_block = np.stack([
+                    np.asarray(o.vectors[""], dtype=np.float32)
+                    for o in objs])
+                n_objs = len(objs)
+                frames = native.storobj_encode_batch(
+                    uuid_keys,
+                    [msgpack.packb(o.properties, use_bin_type=True)
+                     for o in objs],
+                    vec_block,
+                    np.arange(first_id, first_id + n_objs, dtype=np.int64),
+                    np.fromiter((o.creation_time_ms for o in objs),
+                                np.int64, n_objs),
+                    np.fromiter((o.last_update_time_ms for o in objs),
+                                np.int64, n_objs))
+            for i, (obj, old_raw) in enumerate(zip(objs, old_raws)):
                 if old_raw is not None:
                     self._delete_doc(int(old_raw), obj.uuid)
                 obj.doc_id = first_id + i
-                docid_puts.append((obj.uuid.encode(), obj.doc_id))
+                docid_puts.append((uuid_keys[i], obj.doc_id))
                 self._doc_to_uuid[obj.doc_id] = obj.uuid
-                object_puts.append((obj.uuid.encode(), obj.to_bytes()))
-                for vec_name, vec in obj.vectors.items():
-                    ids, vecs = vec_batches.setdefault(vec_name, ([], []))
-                    ids.append(obj.doc_id)
-                    vecs.append(np.asarray(vec, dtype=np.float32))
+                object_puts.append((
+                    uuid_keys[i],
+                    frames[i] if frames is not None else obj.to_bytes()))
+                if frames is None:
+                    for vec_name, vec in obj.vectors.items():
+                        ids, vecs = vec_batches.setdefault(
+                            vec_name, ([], []))
+                        ids.append(obj.doc_id)
+                        vecs.append(np.asarray(vec, dtype=np.float32))
                 doc_ids.append(obj.doc_id)
+            if frames is not None:
+                vec_batches[""] = (doc_ids, vec_block)
             # ordering invariant: inverted postings land BEFORE the objects
             # bucket. A crash in between leaves ghost postings (doc ids the
             # object replay never resurrects — filters mask them out and
@@ -369,11 +403,14 @@ class Shard:
                 idx = self._ensure_vector_index(vec_name, len(vecs[0]))
                 if idx is None:
                     continue
+                # fast path hands a prebuilt [n, d] block; list -> stack
+                block = vecs if isinstance(vecs, np.ndarray) \
+                    else np.stack(vecs)
                 if self.async_indexing:
                     self._index_queue(vec_name, idx).push(
-                        np.asarray(ids), np.stack(vecs))
+                        np.asarray(ids), block)
                 else:
-                    idx.add_batch(np.asarray(ids), np.stack(vecs))
+                    idx.add_batch(np.asarray(ids), block)
                     self._maybe_compress(vec_name, idx)
         return doc_ids
 

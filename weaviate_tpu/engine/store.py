@@ -203,8 +203,16 @@ class DeviceVectorStore:
         slots = (self._staged_slots[0] if len(self._staged_slots) == 1
                  else np.concatenate(self._staged_slots))
         bucket = _next_pow2(max(m, 8))
-        padded = np.zeros((bucket, self.dim), dtype=np.float32)
-        padded[:m] = vectors
+        # sub-f32 storage (bf16) transfers in the STORAGE dtype — half the
+        # host->device bytes; the scan reads bf16 rows either way, and the
+        # in-kernel norms then derive from exactly the rows being scanned.
+        # cosine keeps f32 staging: rows normalize in-kernel pre-cast.
+        stage_dt = (jnp.dtype(self.dtype)
+                    if (not self.normalize_on_add
+                        and jnp.dtype(self.dtype).itemsize < 4)
+                    else np.dtype(np.float32))
+        padded = np.zeros((bucket, self.dim), dtype=stage_dt)
+        padded[:m] = vectors.astype(stage_dt)
         slot_buf = np.zeros(bucket, dtype=np.int32)
         slot_buf[:m] = slots
         mask = np.zeros(bucket, dtype=bool)

@@ -99,6 +99,9 @@ def _load():
              [u8p, i64p, i64p, i64p, ctypes.POINTER(ctypes.c_uint32), i64p],
              None),
             ("wn_varint_encode_many", [u64p, i64p, i64, u8p, i64p], i64),
+            ("wn_storobj_encode_batch",
+             [u8p, i64p, u8p, i64p, f32p, i32, i64p, i64p, i64p, i64,
+              u8p, i64p], i64),
             ("wn_pt_new", [i32], ctypes.c_void_p),
             ("wn_pt_free", [ctypes.c_void_p], None),
             ("wn_pt_bytes", [ctypes.c_void_p], i64),
@@ -312,6 +315,56 @@ def merge_topk_host(dists: np.ndarray, ids: np.ndarray, k: int):
                       dists.shape[0], dists.shape[1], k,
                       _ptr(out_d, ctypes.c_float), _ptr(out_i, ctypes.c_int64))
     return out_d, out_i
+
+
+# ---- batch storobj frame encoder ------------------------------------------
+
+
+def storobj_encode_batch(uuid_strs: list[bytes], props_blobs: list[bytes],
+                         vectors: np.ndarray, doc_ids: np.ndarray,
+                         created_ms: np.ndarray, updated_ms: np.ndarray):
+    """Encode N storage-object value frames (single unnamed vector each)
+    in one native call; byte-identical to StorageObject.to_bytes.
+
+    ``uuid_strs``: canonical-form uuid strings as bytes; ``props_blobs``:
+    caller-msgpacked property dicts; ``vectors``: [n, dim] f32.
+    Returns a list of ``bytes`` frames, or None when the native library
+    is unavailable or a uuid fails the fast parse (callers fall back to
+    the Python encoder).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n, dim = vectors.shape
+    uuids = b"".join(uuid_strs)
+    uoffs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(u) for u in uuid_strs], out=uoffs[1:])
+    props = b"".join(props_blobs)
+    poffs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in props_blobs], out=poffs[1:])
+    # fixed part: 41 header + 4 n_vecs + 2 name_len + 4 dim + 4 props_len
+    frame_offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.diff(poffs) + (55 + 4 * dim), out=frame_offs[1:])
+    out = np.empty(int(frame_offs[-1]), dtype=np.uint8)
+    vectors = np.ascontiguousarray(vectors, dtype=np.float32)
+    doc_ids = np.ascontiguousarray(doc_ids, dtype=np.int64)
+    created_ms = np.ascontiguousarray(created_ms, dtype=np.int64)
+    updated_ms = np.ascontiguousarray(updated_ms, dtype=np.int64)
+    ub = np.frombuffer(uuids, dtype=np.uint8) if uuids else \
+        np.empty(0, np.uint8)
+    pb = np.frombuffer(props, dtype=np.uint8) if props else \
+        np.empty(0, np.uint8)
+    rc = lib.wn_storobj_encode_batch(
+        _ptr(ub, ctypes.c_uint8), _ptr(uoffs, ctypes.c_int64),
+        _ptr(pb, ctypes.c_uint8), _ptr(poffs, ctypes.c_int64),
+        _ptr(vectors, ctypes.c_float), ctypes.c_int32(dim),
+        _ptr(doc_ids, ctypes.c_int64), _ptr(created_ms, ctypes.c_int64),
+        _ptr(updated_ms, ctypes.c_int64), ctypes.c_int64(n),
+        _ptr(out, ctypes.c_uint8), _ptr(frame_offs, ctypes.c_int64))
+    if rc != 0:
+        return None
+    buf = out.tobytes()
+    return [buf[frame_offs[i]:frame_offs[i + 1]] for i in range(n)]
 
 
 # ---- batch text analyzer --------------------------------------------------

@@ -55,18 +55,26 @@ class Server:
         # cache dir rather than under data_path — a fresh data directory
         # (new deploy, CI run, benchmark) still starts warm (users can
         # point JAX_COMPILATION_CACHE_DIR elsewhere; respected if set)
-        if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-            try:
-                import jax
+        try:
+            import jax
 
+            if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
                 cache_root = os.environ.get("XDG_CACHE_HOME") or \
                     os.path.join(os.path.expanduser("~"), ".cache")
                 cache_dir = os.path.join(cache_root, "weaviate-tpu",
                                          "xla-cache")
                 os.makedirs(cache_dir, exist_ok=True)
                 jax.config.update("jax_compilation_cache_dir", cache_dir)
-            except Exception as e:  # noqa: BLE001 — cache is best-effort
-                logger.warning("compilation cache disabled: %s", e)
+            # jax skips persisting compiles that took <1s — but the
+            # store's pow2 capacity ladder is made of exactly such
+            # programs (pad/scatter at each level, ~0.7s each on a
+            # remote-compile rig), so every restart paid ~10s of
+            # recurring sub-threshold compiles. Persist everything,
+            # whichever cache dir is in effect (incl. the env override).
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception as e:  # noqa: BLE001 — cache is best-effort
+            logger.warning("compilation cache disabled: %s", e)
 
         from weaviate_tpu.auth import AuthConfig, AuthStack
         from weaviate_tpu.modules import default_provider
