@@ -128,7 +128,11 @@ def _owner_dists(owner: np.ndarray, cands: np.ndarray, metric: str):
     return (cands != owner[:, None, :]).sum(-1).astype(np.float32)
 
 
-_HOST_KNN_MAX = 32768
+# host-BLAS knn ceiling: above this the device path wins. 8192 (not the
+# r4 32768): at 1M rows layer 1 has ~31k members and the host O(M^2 d)
+# scan there was ~40 s of the build on one core; with the persistent
+# compile cache the device path's per-shape jit cost no longer recurs.
+_HOST_KNN_MAX = 8192
 _SELECT_DISPATCH_ROWS = 65536  # owners per host-level device dispatch
 
 
@@ -176,26 +180,34 @@ def _lazy_select_jit():
         blocks = s_rows // qb
         def one(args):
             blk_i, cand_blk = args
+            # xd_ may arrive bf16 (the scan-precision copy): gathers move
+            # half the HBM bytes and the pair matmuls run the MXU's
+            # native input width; every contraction accumulates f32
             owners = jax.lax.dynamic_slice(
-                xd_, (start + blk_i * qb, 0), (qb, xd_.shape[1])
-            ).astype(jnp.float32)
+                xd_, (start + blk_i * qb, 0), (qb, xd_.shape[1]))
             valid = cand_blk >= 0
             safe = jnp.clip(cand_blk, 0, xd_.shape[0] - 1)
-            cvecs = xd_[safe].astype(jnp.float32)        # [B, C, d]
+            cvecs = xd_[safe]                             # [B, C, d]
             dots = jnp.einsum("bcd,bed->bce", cvecs, cvecs,
                               preferred_element_type=jnp.float32)
             if metric == "l2-squared":
-                sq = jnp.einsum("bcd,bcd->bc", cvecs, cvecs)
+                sq = jnp.einsum("bcd,bcd->bc", cvecs, cvecs,
+                                preferred_element_type=jnp.float32)
                 pair = sq[:, :, None] - 2.0 * dots + sq[:, None, :]
-                osq = jnp.einsum("bd,bd->b", owners, owners)
-                od = jnp.einsum("bcd,bd->bc", cvecs, owners)
+                osq = jnp.einsum("bd,bd->b", owners, owners,
+                                 preferred_element_type=jnp.float32)
+                od = jnp.einsum("bcd,bd->bc", cvecs, owners,
+                                preferred_element_type=jnp.float32)
                 cand_d = osq[:, None] - 2.0 * od + sq
             elif metric == "dot":
                 pair = -dots
-                cand_d = -jnp.einsum("bcd,bd->bc", cvecs, owners)
+                cand_d = -jnp.einsum("bcd,bd->bc", cvecs, owners,
+                                     preferred_element_type=jnp.float32)
             else:  # cosine family: rows normalized upstream
                 pair = 1.0 - dots
-                cand_d = 1.0 - jnp.einsum("bcd,bd->bc", cvecs, owners)
+                cand_d = 1.0 - jnp.einsum(
+                    "bcd,bd->bc", cvecs, owners,
+                    preferred_element_type=jnp.float32)
             cand_d = jnp.where(valid, cand_d, jnp.inf)
             # sort candidates by owner distance (full-width top_k = sort)
             negd, order = jax.lax.top_k(-cand_d, c)
@@ -419,6 +431,13 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
         if query_block % 1024 == 0:
             blocks_per_slice *= query_block // 1024
         query_block = 1024
+    # a slice may not exceed the padded corpus (small layers: the
+    # dynamic_slice of queries comes FROM the corpus rows)
+    while blocks_per_slice > 1 and \
+            blocks_per_slice * query_block > n + pad_rows:
+        blocks_per_slice //= 2
+    if query_block > n + pad_rows:
+        query_block = n + pad_rows
     slice_rows = blocks_per_slice * query_block
 
     @functools.partial(jax.jit, static_argnames=("k", "cs", "metric"))
@@ -439,8 +458,10 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
     vd = jnp.asarray(valid)
     # the scan runs bf16 on the fused MXU kernel — the same storage/
     # precision choice as the flat serving scan (recall envelope in
-    # BASELINE); candidate ids feed an exact f32 select stage afterwards.
-    # The f32 knn scan was 47.8 s of the 121 s 300k build (BASELINE r5).
+    # BASELINE); candidate ids then feed the select stages, which also
+    # run at scan precision (bf16 inputs, f32 accumulation — recall
+    # parity pinned by the bench ef sweep). The f32 knn scan was 47.8 s
+    # of the 121 s 300k build (BASELINE r5).
     xscan = xd.astype(jnp.bfloat16) if use_pallas else xd
     norms = jnp.sum(xd.astype(jnp.float32) ** 2, axis=-1)
     norms_arg = norms if metric == "l2-squared" else None
@@ -451,7 +472,11 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
             ids = knn_slice(xscan, vd, norms_arg, start, k_eff, cs, metric)
             parts.append(ids[s - start: s - start + min(slice_rows, n - s)])
         knn_dev = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        return xd, knn_dev
+        # hand back the SCAN-precision corpus (bf16 on the pallas path):
+        # the select stages gather from it and run their pair matmuls at
+        # the MXU's native width with f32 accumulation, so the f32 copy
+        # can be freed now (it is half the pipeline's HBM at 1M rows)
+        return xscan, knn_dev
     out = np.empty((n, k_eff), dtype=np.int64)
     for s in range(0, n, slice_rows):
         # clamp the window inside the padded corpus; overlap re-computes a
@@ -472,7 +497,11 @@ def _knn_graph(vectors: np.ndarray, members: np.ndarray, knn_k: int,
     n = len(sub)
     k_eff = min(knn_k + 1, n)
     if n <= _HOST_KNN_MAX or metric not in (
-            "l2-squared", "dot", "cosine", "cosine-dot"):
+            "l2-squared", "dot", "cosine", "cosine-dot") \
+            or not _device_backend():
+        # CPU backends keep exact host BLAS at every size — the device
+        # path's approx per-chunk selection only earns its recall cost
+        # on a real accelerator
         out = _host_knn(sub, k_eff, metric)
     else:
         out = _device_knn(sub, k_eff, metric)
@@ -489,21 +518,60 @@ def _device_link_layer(vectors: np.ndarray, members: np.ndarray,
     """Fully device-resident knn -> select -> symmetrize -> select for one
     layer: intermediates ([M, C] candidate tensors, ~0.5-1 GB at 1M rows)
     never cross the tunnel; only the final [M, budget] link table comes
-    back. Returns positions into ``members`` (-1 padded)."""
+    back. Selects run at scan precision (bf16 on TPU, f32 accumulation)
+    — recall parity is pinned by the bench ef sweep. Returns positions
+    into ``members`` (-1 padded)."""
+    import os
+    import time as _time
+
+    trace = os.environ.get("WEAVIATE_TPU_BUILD_TRACE") == "1"
+
+    def _t(label, fn):
+        t0 = _time.perf_counter()
+        out = fn()
+        # force REAL execution before dispatching the next stage: letting
+        # the whole pipeline queue up behind async dispatch made the 300k
+        # build 2x slower end-to-end on the tunnel runtime (pathological
+        # queue drain), and block_until_ready is not trustworthy there
+        # (handles report completion before execution) — a tiny
+        # data-dependent fetch is. Costs one RTT per stage.
+        probe = out[-1] if isinstance(out, tuple) else out
+        np.asarray(probe.ravel()[0])
+        if trace:
+            print(f"    [build-trace] {label:12s} "
+                  f"{_time.perf_counter()-t0:7.2f}s", flush=True)
+        return out
+
     sub = vectors[members]
     n = len(sub)
     k_eff = min(knn_k + 1, n)
-    xd, knn_dev = _device_knn(sub, k_eff, metric, return_device=True)
+    xd, knn_dev = _t("knn", lambda: _device_knn(
+        sub, k_eff, metric, return_device=True))
 
     # drop self-hits on device (stable sort by is-self keeps distance
     # order); module-level jit — eager ops each pay a tunnel dispatch,
     # per-call closures retrace every build
-    knn_dev = _self_drop_jit(knn_dev, min(knn_k, n - 1))
-    fwd = _device_select(xd, knn_dev, budget, metric)
-    union = _device_symmetrize(fwd)
-    final = _device_select(xd, union, budget, metric)
-    # fetch int32 — the int64 copy doubled a ~0.5 GB tunnel download at 1M
-    return np.asarray(final)
+    knn_dev = _t("self_drop", lambda: _self_drop_jit(
+        knn_dev, min(knn_k, n - 1)))
+    fwd = _t("select1", lambda: _device_select(xd, knn_dev, budget, metric))
+    union = _t("symmetrize", lambda: _device_symmetrize(fwd))
+    final = _t("select2", lambda: _device_select(xd, union, budget, metric))
+    # fetch int32 — the int64 copy doubled a ~0.5 GB tunnel download at
+    # 1M; concurrent sliced fetches run ~1.7x faster than one big pull
+    # on the tunnel transport (measured at 300k x 64)
+    return _t("download", lambda: _parallel_fetch(final))
+
+
+def _parallel_fetch(arr, chunk_rows: int = 65536, workers: int = 4):
+    n = arr.shape[0]
+    if n <= chunk_rows:
+        return np.asarray(arr)
+    import concurrent.futures as cf
+
+    with cf.ThreadPoolExecutor(workers) as ex:
+        parts = list(ex.map(lambda s: np.asarray(arr[s:s + chunk_rows]),
+                            range(0, n, chunk_rows)))
+    return np.concatenate(parts)
 
 
 def bulk_build(index, doc_ids, vectors: np.ndarray, knn_k: int = 64,
@@ -516,6 +584,9 @@ def bulk_build(index, doc_ids, vectors: np.ndarray, knn_k: int = 64,
     snapshot lands at the end (same durability fixed point,
     condensor.go:27).
     """
+    from weaviate_tpu.runtime.compile_cache import ensure_compile_cache
+
+    ensure_compile_cache()  # link-pipeline jits are seconds each, cold
     doc_ids = np.asarray(doc_ids, dtype=np.int64)
     vectors = index._norm(np.asarray(vectors, dtype=np.float32))
     n = len(vectors)
@@ -553,8 +624,15 @@ def bulk_build(index, doc_ids, vectors: np.ndarray, knn_k: int = 64,
                                      "cosine", "cosine-dot")
                 and _device_backend())
             if use_device:
-                fwd = _device_link_layer(vectors, members, knn_k, budget,
-                                         index.metric)
+                # device-scan selection cost scales ~linearly with k
+                # (k=65 ran 5x the k=10 scan) and 48 candidates measured
+                # recall-equivalent to 64 at 300k/1M (0.99 @ ef=24;
+                # symmetrize refills the m0 budget with reverse edges).
+                # Host BLAS knn below is exact and cheap at its sizes —
+                # it keeps the caller's full candidate count (the PQ-ADC
+                # traversal is sensitive to thinner graphs there).
+                fwd = _device_link_layer(vectors, members, min(48, knn_k),
+                                         budget, index.metric)
             else:
                 knn = _knn_graph(vectors, members, knn_k, index.metric)
                 fwd = _link_layer(index, vectors, members, knn, budget,

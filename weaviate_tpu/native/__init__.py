@@ -363,8 +363,10 @@ def storobj_encode_batch(uuid_strs: list[bytes], props_blobs: list[bytes],
         _ptr(out, ctypes.c_uint8), _ptr(frame_offs, ctypes.c_int64))
     if rc != 0:
         return None
-    buf = out.tobytes()
-    return [buf[frame_offs[i]:frame_offs[i + 1]] for i in range(n)]
+    # one copy per frame (ndarray slices are views; .tobytes() on each
+    # materializes just that frame — no whole-buffer duplicate)
+    return [out[frame_offs[i]:frame_offs[i + 1]].tobytes()
+            for i in range(n)]
 
 
 # ---- batch text analyzer --------------------------------------------------

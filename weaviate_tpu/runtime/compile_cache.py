@@ -1,0 +1,54 @@
+"""Persistent XLA compilation-cache setup, shared by the server entry
+point and the offline tools (bulk builds, benchmarks).
+
+The vector store's pow2 capacity ladder and the bulk-build link pipeline
+re-jit per shape level; each program costs 0.5-20 s to compile (more on a
+remote-compile rig). Two defaults make every process after the first
+start warm:
+
+- cache dir in the USER cache location (keys are program + hardware, not
+  instance state), overridable via JAX_COMPILATION_CACHE_DIR
+- persistence threshold 0: jax's default skips sub-1 s compiles, which
+  is exactly the population the capacity ladder is made of
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_done = False
+
+
+def ensure_compile_cache() -> None:
+    """Idempotent; call before the first jit dispatch."""
+    global _done
+    if _done:
+        return
+    _done = True
+    try:
+        import jax
+
+        explicit = bool(os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+        if not explicit and jax.default_backend() == "cpu":
+            # CPU-platform AOT executables embed the COMPILING machine's
+            # feature set; on rigs where compiles are serviced remotely
+            # the cached artifact can then be loaded on a host missing
+            # those features (observed: +amx entries from the compile
+            # service loaded on a non-amx host — a SIGILL hazard). CPU
+            # compiles are cheap locally; cache only accelerator
+            # programs unless the user opts in with an explicit dir.
+            return
+        if not explicit:
+            cache_root = os.environ.get("XDG_CACHE_HOME") or \
+                os.path.join(os.path.expanduser("~"), ".cache")
+            cache_dir = os.path.join(cache_root, "weaviate-tpu",
+                                     "xla-cache")
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:  # noqa: BLE001 — cache is best-effort
+        logger.warning("compilation cache disabled: %s", e)

@@ -48,33 +48,11 @@ class Server:
         if maybe_initialize_distributed():
             logger.info("joined multi-host JAX runtime")
 
-        # persistent XLA compilation cache: vector-store capacity growth
-        # re-jits the donated scatter/search programs per pow2 level, which
-        # costs seconds each on a cold start. The cache keys on program +
-        # hardware, not on any instance state, so it lives in the USER
-        # cache dir rather than under data_path — a fresh data directory
-        # (new deploy, CI run, benchmark) still starts warm (users can
-        # point JAX_COMPILATION_CACHE_DIR elsewhere; respected if set)
-        try:
-            import jax
+        # persistent XLA compilation cache (shared helper — the offline
+        # tools and bulk builds need the same warm starts as the server)
+        from weaviate_tpu.runtime.compile_cache import ensure_compile_cache
 
-            if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-                cache_root = os.environ.get("XDG_CACHE_HOME") or \
-                    os.path.join(os.path.expanduser("~"), ".cache")
-                cache_dir = os.path.join(cache_root, "weaviate-tpu",
-                                         "xla-cache")
-                os.makedirs(cache_dir, exist_ok=True)
-                jax.config.update("jax_compilation_cache_dir", cache_dir)
-            # jax skips persisting compiles that took <1s — but the
-            # store's pow2 capacity ladder is made of exactly such
-            # programs (pad/scatter at each level, ~0.7s each on a
-            # remote-compile rig), so every restart paid ~10s of
-            # recurring sub-threshold compiles. Persist everything,
-            # whichever cache dir is in effect (incl. the env override).
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.0)
-        except Exception as e:  # noqa: BLE001 — cache is best-effort
-            logger.warning("compilation cache disabled: %s", e)
+        ensure_compile_cache()
 
         from weaviate_tpu.auth import AuthConfig, AuthStack
         from weaviate_tpu.modules import default_provider
