@@ -371,9 +371,16 @@ class Shard:
                                 np.int64, n_objs),
                     np.fromiter((o.last_update_time_ms for o in objs),
                                 np.int64, n_objs))
-            for i, (obj, old_raw) in enumerate(zip(objs, old_raws)):
-                if old_raw is not None:
-                    self._delete_doc(int(old_raw), obj.uuid)
+            # update path: every replaced doc's teardown runs BATCHED —
+            # the per-object form paid one device dispatch per tombstone
+            # (flat.delete -> store.delete) and one inverted pass each,
+            # which made re-imports ~5x slower than fresh inserts
+            updates = [(int(old_raw), obj.uuid)
+                       for obj, old_raw in zip(objs, old_raws)
+                       if old_raw is not None]
+            if updates:
+                self._delete_docs_batch(updates)
+            for i, obj in enumerate(objs):
                 obj.doc_id = first_id + i
                 docid_puts.append((uuid_keys[i], obj.doc_id))
                 self._doc_to_uuid[obj.doc_id] = obj.uuid
@@ -453,6 +460,24 @@ class Shard:
         if old is not None:
             self._inverted.unindex_object(old)
         self._doc_to_uuid.pop(doc_id, None)
+
+    def _delete_docs_batch(self, pairs: list[tuple[int, str]]) -> None:
+        """Batched twin of ``_delete_doc`` for the update path: one
+        vector-index delete (one device tombstone scatter), one batched
+        object fetch, one inverted unindex pass."""
+        doc_ids = [d for d, _u in pairs]
+        for q in self._index_queues.values():
+            for d in doc_ids:
+                q.delete(d)
+        for idx in self.vector_indexes.values():
+            if idx is not None:
+                idx.delete(*doc_ids)
+        raws = self.objects.get_many([u.encode() for _d, u in pairs])
+        olds = [StorageObject.from_bytes(r) for r in raws if r is not None]
+        if olds:
+            self._inverted.unindex_objects(olds)
+        for d in doc_ids:
+            self._doc_to_uuid.pop(d, None)
 
     def delete_object(self, uuid: str, tombstone_ms: int | None = None) -> bool:
         import time as _time
