@@ -20,10 +20,14 @@ RUN pip install --no-cache-dir \
     g++ -O3 -shared -fPIC -o weaviate_tpu/native/libweaviate_native.so \
         csrc/weaviate_native.cpp || true
 
+# No JAX_COMPILATION_CACHE_DIR here: an explicit dir bypasses the
+# CPU-backend guard in runtime/compile_cache.py, and a /var/lib/weaviate
+# volume remounted on a different-ISA host could then load AOT CPU
+# executables with foreign feature sets (SIGILL at startup). The runtime
+# picks a safe per-host cache location itself.
 ENV PYTHONPATH=/app \
     PERSISTENCE_DATA_PATH=/var/lib/weaviate \
-    JAX_PLATFORMS=cpu \
-    JAX_COMPILATION_CACHE_DIR=/var/lib/weaviate/.jax_cache
+    JAX_PLATFORMS=cpu
 
 VOLUME /var/lib/weaviate
 EXPOSE 8080 50051 2112

@@ -1,5 +1,5 @@
 """Benchmark: flat brute-force kNN on TPU + quantized scans + device-side
-steady-state timing + compiled-kernel conformance.
+steady-state timing + compiled-kernel conformance + selection microbench.
 
 North-star config #1 (BASELINE.md): flat index, l2-squared, SIFT1M-shaped
 corpus (1M x 128), k=10. Measurements this emits (VERDICT r1 items 1/2/9):
@@ -9,13 +9,30 @@ corpus (1M x 128), k=10. Measurements this emits (VERDICT r1 items 1/2/9):
   (async dispatch pipeline, block at the end) for bf16 / f32-exact / BQ /
   PQ4 scans at several batch sizes, plus achieved HBM GB/s — so kernel
   regressions are visible through rig noise
+- ``selection_microbench``: per-batch device time for selection="exact" /
+  "approx" / "fused" on the same corpus, plus a k=1 fused floor so the
+  SELECTION overhead (time above the raw distance scan) of each mode is
+  separable — the round-6 fused-top-k acceptance gate
 - quantized scans measured on CLUSTERED data (mixture of gaussians — the
   shape real embeddings have) with exact-rescore recall@10
 - ``kernel_conformance``: compiled (Mosaic, not interpret) Pallas kernels
   checked bit-exact against numpy on the chip
 
-Prints ONE JSON line:
-  {"metric": ..., "value": QPS, "unit": "qps", "vs_baseline": x, ...}
+Sections run through ``run_section``: each one retries with backoff on
+transient remote-compile/tunnel errors, and the accumulated results JSON
+is emitted incrementally after every section (stderr line + optional
+BENCH_JSON_PATH file), so a mid-run infra failure still exits rc=0 with
+every completed section in the final stdout JSON. Knobs:
+
+  BENCH_N / BENCH_BATCH / BENCH_CHUNK / BENCH_DTYPE   sizing
+  BENCH_SECTIONS=a,b,c     run only these sections
+  BENCH_SECTION_RETRIES=2  attempts = retries + 1
+  BENCH_FAIL_SECTION=name  inject a persistent failure (resilience tests)
+  BENCH_JSON_PATH=path     also write partial results JSON atomically
+
+Prints ONE JSON line on stdout:
+  {"metric": ..., "value": QPS, "unit": "qps", "vs_baseline": x,
+   "sections": {...}, ...}
 detail on stderr.
 """
 
@@ -26,6 +43,7 @@ import os
 import sys
 import threading
 import time
+import traceback
 
 
 def _watchdog(seconds: float):
@@ -58,67 +76,223 @@ def clustered_corpus(rng, n, dim, n_clusters=65536, spread=0.35):
     quantization cell size — SIFT-like, not degenerate near-duplicates."""
     import numpy as np
 
+    n_clusters = min(n_clusters, max(16, n // 8))
     centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
     assign = rng.integers(0, n_clusters, n)
     out = centers[assign] + spread * rng.standard_normal((n, dim)).astype(np.float32)
     return out.astype(np.float32)
 
 
-def main():
-    wd = _watchdog(float(os.environ.get("BENCH_WATCHDOG_S", "1500")))
+# -- section harness ---------------------------------------------------------
+
+RESULTS: dict = {"sections": {}}
+
+
+def _emit_partial():
+    """Incremental results: atomically rewrite BENCH_JSON_PATH (if set)
+    after every section, so even a hard crash leaves the completed
+    sections on disk."""
+    path = os.environ.get("BENCH_JSON_PATH")
+    if not path:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(RESULTS, f)
+    os.replace(tmp, path)
+
+
+def run_section(name: str, fn, ctx: dict, deps: tuple = ()) -> bool:
+    """Run one bench section with retry-with-backoff.
+
+    Transient remote-compile / tunnel errors (the BENCH_r05 rc=1 failure
+    mode) get retries + 1 attempts with exponential backoff; a section
+    that still fails is recorded as {"ok": false, "error": ...} and the
+    run continues — partial results beat no results. ``deps`` names ctx
+    keys earlier sections must have produced: a missing dep (skipped via
+    BENCH_SECTIONS or failed upstream) skips this section immediately —
+    deterministic, so no retries wasted."""
+    wanted = os.environ.get("BENCH_SECTIONS")
+    if wanted and name not in [s.strip() for s in wanted.split(",")]:
+        return False
+    missing = [d for d in deps if d not in ctx]
+    if missing:
+        RESULTS["sections"][name] = {
+            "ok": False, "skipped_missing_deps": missing}
+        log(f"[section {name}] skipped: missing {missing} "
+            f"(upstream section skipped or failed)")
+        _emit_partial()
+        return False
+    retries = int(os.environ.get("BENCH_SECTION_RETRIES", "2"))
+    last: BaseException | None = None
+    for attempt in range(retries + 1):
+        try:
+            if os.environ.get("BENCH_FAIL_SECTION") == name:
+                raise RuntimeError(f"injected failure in section {name!r}")
+            t0 = time.perf_counter()
+            out = fn(ctx) or {}
+            entry = {"ok": True,
+                     "seconds": round(time.perf_counter() - t0, 2)}
+            entry.update(out)
+            RESULTS["sections"][name] = entry
+            log(json.dumps({"section": name, **entry}))
+            _emit_partial()
+            return True
+        except BaseException as e:  # noqa: BLE001 — record, retry, move on
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            last = e
+            log(f"[section {name}] attempt {attempt + 1}/{retries + 1} "
+                f"failed: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            if attempt < retries:
+                time.sleep(min(2.0 * 2 ** attempt, 30.0))
+    RESULTS["sections"][name] = {"ok": False, "error": repr(last),
+                                 "attempts": retries + 1}
+    log(json.dumps({"section": name, "ok": False, "error": repr(last)}))
+    _emit_partial()
+    return False
+
+
+# -- sections ----------------------------------------------------------------
+
+
+def sec_setup(ctx):
     import numpy as np
 
-    n, dim, k = 1_000_000, 128, 10
-    batch = int(os.environ.get("BENCH_BATCH", "1024"))
+    n = int(os.environ.get("BENCH_N", "1000000"))
+    dim, k = 128, 10
+    batch = min(int(os.environ.get("BENCH_BATCH", "1024")), n)
     n_query_batches = 8
-
     rng = np.random.default_rng(0)
-    corpus = rng.standard_normal((n, dim)).astype(np.float32)
-    queries = rng.standard_normal((n_query_batches, batch, dim)).astype(np.float32)
-    log(f"corpus {corpus.nbytes/1e9:.2f} GB, {n_query_batches}x{batch} queries")
+    ctx.update(n=n, dim=dim, k=k, batch=batch,
+               n_query_batches=n_query_batches, rng=rng)
+    ctx["corpus"] = rng.standard_normal((n, dim)).astype(np.float32)
+    ctx["queries"] = rng.standard_normal(
+        (n_query_batches, batch, dim)).astype(np.float32)
+    log(f"corpus {ctx['corpus'].nbytes/1e9:.2f} GB, "
+        f"{n_query_batches}x{batch} queries")
+    return {"n": n, "dim": dim, "k": k, "batch": batch}
 
-    # --- CPU BLAS exact-scan baseline (chunked, same algorithm) -------------
-    def cpu_scan(qb):
-        best_d = np.full((batch, k), np.inf, np.float32)
-        best_i = np.zeros((batch, k), np.int64)
-        cn = (corpus ** 2).sum(-1)
-        qn = (qb ** 2).sum(-1)[:, None]
-        step = 131072
-        for s in range(0, n, step):
-            c = corpus[s:s + step]
-            d = qn - 2.0 * qb @ c.T + cn[None, s:s + step]
-            idx = np.argpartition(d, k, axis=1)[:, :k]
-            dd = np.take_along_axis(d, idx, axis=1)
-            cat_d = np.concatenate([best_d, dd], 1)
-            cat_i = np.concatenate([best_i, idx + s], 1)
-            sel = np.argpartition(cat_d, k, axis=1)[:, :k]
-            best_d = np.take_along_axis(cat_d, sel, 1)
-            best_i = np.take_along_axis(cat_i, sel, 1)
-        order = np.argsort(best_d, 1)
-        return np.take_along_axis(best_d, order, 1), np.take_along_axis(best_i, order, 1)
+
+def _cpu_exact_knn(corpus, qb, k, step=131072):
+    """Chunked exact l2 kNN on host BLAS — the ground-truth/baseline scan
+    shared by the random-corpus and clustered-corpus sections."""
+    import numpy as np
+
+    n = len(corpus)
+    best_d = np.full((len(qb), k), np.inf, np.float32)
+    best_i = np.zeros((len(qb), k), np.int64)
+    cn = (corpus ** 2).sum(-1)
+    qn = (qb ** 2).sum(-1)[:, None]
+    for s in range(0, n, step):
+        c = corpus[s:s + step]
+        d = qn - 2.0 * qb @ c.T + cn[None, s:s + step]
+        idx = np.argpartition(d, min(k, d.shape[1] - 1), axis=1)[:, :k]
+        dd = np.take_along_axis(d, idx, axis=1)
+        cat_d = np.concatenate([best_d, dd], 1)
+        cat_i = np.concatenate([best_i, idx + s], 1)
+        sel = np.argpartition(cat_d, k, axis=1)[:, :k]
+        best_d = np.take_along_axis(cat_d, sel, 1)
+        best_i = np.take_along_axis(cat_i, sel, 1)
+    order = np.argsort(best_d, 1)
+    return (np.take_along_axis(best_d, order, 1),
+            np.take_along_axis(best_i, order, 1))
+
+
+def sec_cpu_baseline(ctx):
+    n, k, batch = ctx["n"], ctx["k"], ctx["batch"]
 
     t0 = time.perf_counter()
-    gt_d, gt_i = cpu_scan(queries[0])
+    gt_d, gt_i = _cpu_exact_knn(ctx["corpus"], ctx["queries"][0], k)
     cpu_s = time.perf_counter() - t0
-    cpu_qps = batch / cpu_s
-    log(f"CPU BLAS exact scan: {cpu_s*1e3:.1f} ms/batch -> {cpu_qps:.1f} QPS")
+    ctx["gt_i"] = gt_i
+    ctx["cpu_qps"] = batch / cpu_s
+    log(f"CPU BLAS exact scan: {cpu_s*1e3:.1f} ms/batch -> "
+        f"{ctx['cpu_qps']:.1f} QPS")
+    return {"cpu_qps": round(ctx["cpu_qps"], 1)}
 
-    # --- TPU path -----------------------------------------------------------
+
+def sec_device_setup(ctx):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    log(f"device: {dev}, platform: {dev.platform}")
+    n, dim = ctx["n"], ctx["dim"]
+    store_dtype = (jnp.bfloat16
+                   if os.environ.get("BENCH_DTYPE", "bf16") == "bf16"
+                   else jnp.float32)
+    chunk = min(int(os.environ.get("BENCH_CHUNK", "65536")), n)
+    n_pad = -(-n // chunk) * chunk
+    padded = np.zeros((n_pad, dim), dtype=np.float32)
+    padded[:n] = ctx["corpus"]
+    x = jax.device_put(jnp.asarray(padded, dtype=store_dtype), dev)
+    ctx.update(
+        dev=dev, store_dtype=store_dtype, chunk=chunk, n_pad=n_pad, x=x,
+        norms=jnp.sum(jnp.asarray(x, dtype=jnp.float32) ** 2, axis=-1),
+        valid=jnp.asarray(np.arange(n_pad) < n),
+    )
+    # tunnel RTT: one fetch costs a full RTT (~120 ms on the tunnel rig) —
+    # measure and subtract from chained device timings, amortized over
+    # enough reps that the residual error is <1% of the reading
+    @jax.jit
+    def _triv(s):
+        return s + 1.0
+
+    np.asarray(_triv(jnp.float32(0)))
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(_triv(jnp.float32(1)))
+        rtts.append(time.perf_counter() - t0)
+    ctx["rtt_s"] = float(np.median(rtts))
+    log(f"tunnel RTT: {ctx['rtt_s']*1e3:.1f} ms (subtracted from device "
+        f"timings)")
+    return {"platform": dev.platform,
+            "tunnel_rtt_ms": round(ctx["rtt_s"] * 1e3, 1)}
+
+
+def _chained_ms(ctx, step_with_offset, arrays, reps=100):
+    """step_with_offset(id_offset, *arrays) -> (d, i); ms/scan, device
+    time, chained inside ONE jit so async dispatch can't lie. The carried
+    distances TAINT the next iteration's query (adding a zero derived from
+    them): id_offset alone only feeds the returned ids, so distances would
+    be loop-invariant and XLA could hoist the whole scan out of the timing
+    loop (observed: "scans" above HBM peak bandwidth)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def chained(*arrs):
+        def body(_i, carry):
+            zero = carry[0][0, 0] * 0.0
+            tainted = (arrs[0] + zero.astype(arrs[0].dtype),) + arrs[1:]
+            d_, i_ = step_with_offset(zero.astype(jnp.int32), *tainted)
+            return (d_,)
+        d0, _ = step_with_offset(jnp.int32(0), *arrs)
+        (d_,) = jax.lax.fori_loop(0, reps, body, (d0,))
+        return d_
+    np.asarray(chained(*arrays))  # compile + warm
+    t0 = time.perf_counter()
+    np.asarray(chained(*arrays))
+    return max((time.perf_counter() - t0 - ctx["rtt_s"]), 1e-3) \
+        / (reps + 1) * 1e3
+
+
+def sec_flat_headline(ctx):
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
 
     from weaviate_tpu.ops.topk import chunked_topk_distances
 
-    dev = jax.devices()[0]
-    log(f"device: {dev}, platform: {dev.platform}")
-    store_dtype = jnp.bfloat16 if os.environ.get("BENCH_DTYPE", "bf16") == "bf16" else jnp.float32
-    chunk = int(os.environ.get("BENCH_CHUNK", "65536"))
-    n_pad = -(-n // chunk) * chunk
-    padded = np.zeros((n_pad, dim), dtype=np.float32)
-    padded[:n] = corpus
-    x = jax.device_put(jnp.asarray(padded, dtype=store_dtype), dev)
-    norms = jnp.sum(jnp.asarray(x, dtype=jnp.float32) ** 2, axis=-1)
-    valid = jnp.asarray(np.arange(n_pad) < n)
+    n, k, batch, chunk = ctx["n"], ctx["k"], ctx["batch"], ctx["chunk"]
+    x, valid, norms, dev = ctx["x"], ctx["valid"], ctx["norms"], ctx["dev"]
 
     def step(qb):
         return chunked_topk_distances(
@@ -126,138 +300,165 @@ def main():
             valid=valid, x_sq_norms=norms, selection="approx",
         )
 
-    q0 = jax.device_put(jnp.asarray(queries[0]), dev)
+    q0 = jax.device_put(jnp.asarray(ctx["queries"][0]), dev)
     t0 = time.perf_counter()
     d, i = step(q0)
     jax.block_until_ready((d, i))
     log(f"first call (incl compile): {time.perf_counter()-t0:.1f}s")
 
-    ids = np.asarray(i)
-    recall = np.mean([
-        len(set(ids[r]) & set(gt_i[r])) / k for r in range(batch)
-    ])
-    log(f"recall@{k} vs exact f32: {recall:.4f}")
+    out = {}
+    if "gt_i" in ctx:
+        ids = np.asarray(i)
+        recall = np.mean([
+            len(set(ids[r]) & set(ctx["gt_i"][r])) / k for r in range(batch)
+        ])
+        log(f"recall@{k} vs exact f32: {recall:.4f}")
+        out["recall_at_10"] = round(float(recall), 4)
+        ctx["recall"] = recall
 
-    # timed runs (tunnel-inclusive, the round-1 headline methodology)
     times = []
-    for rep in range(3):
-        for bi in range(n_query_batches):
-            qb = jax.device_put(jnp.asarray(queries[bi]), dev)
+    for _rep in range(3):
+        for bi in range(ctx["n_query_batches"]):
+            qb = jax.device_put(jnp.asarray(ctx["queries"][bi]), dev)
             t0 = time.perf_counter()
             d, i = step(qb)
             jax.block_until_ready((d, i))
             times.append(time.perf_counter() - t0)
     times = np.asarray(times[1:])
     per_batch = float(np.median(times))
-    qps = batch / per_batch
-    log(f"median {per_batch*1e3:.2f} ms/batch of {batch} -> {qps:.0f} QPS; "
-        f"p95 {np.percentile(times,95)*1e3:.2f} ms")
+    ctx["qps"] = batch / per_batch
+    ctx["per_batch"] = per_batch
+    log(f"median {per_batch*1e3:.2f} ms/batch of {batch} -> "
+        f"{ctx['qps']:.0f} QPS; p95 {np.percentile(times, 95)*1e3:.2f} ms")
+    out.update(qps=round(ctx["qps"], 1),
+               p50_batch_ms=round(per_batch * 1e3, 2))
+    return out
 
-    # --- device-side steady state: R executions chained IN ONE program ------
-    # The tunnel's async dispatch/block_until_ready timing is unreliable;
-    # chaining R scans inside one jit (each iteration's id_offset depends
-    # on the previous result, forcing real sequential execution) and
-    # fetching the final result measures true device time per scan.
-    import functools as _ft
 
-    # One fetch over the tunnel costs a full RTT (~120 ms on this rig) —
-    # measure it and subtract, and amortize over enough chained reps that
-    # the residual error is <1% of the reading. (Round-2 used reps=10 and
-    # no subtraction, inflating every device number by ~11 ms — the "2-3%
-    # of peak" verdict was mostly the tunnel, not the chip.)
-    @jax.jit
-    def _triv(s):
-        return s + 1.0
+def sec_device_steady(ctx):
+    import jax
+    import jax.numpy as jnp
 
-    np.asarray(_triv(jnp.float32(0)))
-    _rtts = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        np.asarray(_triv(jnp.float32(1)))
-        _rtts.append(time.perf_counter() - t0)
-    rtt_s = float(np.median(_rtts))
-    log(f"tunnel RTT: {rtt_s*1e3:.1f} ms (subtracted from device timings)")
+    from weaviate_tpu.ops.topk import chunked_topk_distances
 
-    def chained_ms(step_with_offset, arrays, reps=100):
-        """step_with_offset(id_offset, *arrays) -> (d, i); ms/scan.
-        Arrays pass as jit ARGUMENTS — a closure would capture the corpus
-        as a compile-time constant and ship it through the compile RPC.
-        The carried distances TAINT the next iteration's QUERY (adding a
-        zero derived from them): id_offset alone only feeds the returned
-        ids, so distances would be loop-invariant and XLA could hoist the
-        whole scan out of the timing loop (observed: "scans" above HBM
-        peak bandwidth)."""
-        @jax.jit
-        def chained(*arrs):
-            def body(_i, carry):
-                zero = carry[0][0, 0] * 0.0
-                tainted = (arrs[0] + zero.astype(arrs[0].dtype),) + arrs[1:]
-                d_, i_ = step_with_offset(zero.astype(jnp.int32), *tainted)
-                return (d_,)
-            d0, _ = step_with_offset(jnp.int32(0), *arrs)
-            (d_,) = jax.lax.fori_loop(0, reps, body, (d0,))
-            return d_
-        np.asarray(chained(*arrays))  # compile + warm
-        t0 = time.perf_counter()
-        np.asarray(chained(*arrays))
-        return max((time.perf_counter() - t0 - rtt_s), 1e-3) / (reps + 1) * 1e3
-
-    def pipelined_ms(fn, reps=12):
-        out = fn()
-        jax.block_until_ready(out)  # compile + warm
-        t0 = time.perf_counter()
-        outs = [fn() for _ in range(reps)]
-        jax.block_until_ready(outs)
-        return (time.perf_counter() - t0) / reps * 1e3
-
+    k, chunk, n_pad, dim = ctx["k"], ctx["chunk"], ctx["n_pad"], ctx["dim"]
+    x, valid, norms = ctx["x"], ctx["valid"], ctx["norms"]
+    store_dtype = ctx["store_dtype"]
     device_stats = {}
-    bytes_bf16 = n_pad * dim * (2 if store_dtype == jnp.bfloat16 else 4)
+    bytes_scan = n_pad * dim * (2 if store_dtype == jnp.bfloat16 else 4)
     for b_dev in (64, 256, 1024):
-        qd = jax.device_put(jnp.asarray(queries[0][:b_dev]), dev)
-        ms = chained_ms(
+        if b_dev > ctx["batch"]:
+            continue
+        qd = jax.device_put(jnp.asarray(ctx["queries"][0][:b_dev]),
+                            ctx["dev"])
+        ms = _chained_ms(
+            ctx,
             lambda off, qd_, x_, v_, n_: chunked_topk_distances(
                 qd_, x_, k=k, chunk_size=chunk, metric="l2-squared",
                 valid=v_, x_sq_norms=n_, id_offset=off, selection="approx"),
             (qd, x, valid, norms))
-        gbps = bytes_bf16 / (ms / 1e3) / 1e9
+        gbps = bytes_scan / (ms / 1e3) / 1e9
         flops = 2.0 * b_dev * n_pad * dim / (ms / 1e3)
-        device_stats[f"flat_{'bf16' if store_dtype==jnp.bfloat16 else 'f32'}_b{b_dev}"] = {
+        tag = "bf16" if store_dtype == jnp.bfloat16 else "f32"
+        device_stats[f"flat_{tag}_b{b_dev}"] = {
             "device_batch_ms": round(ms, 3),
             "qps": round(b_dev / (ms / 1e3)),
             "hbm_gbps": round(gbps, 1),
             "tflops": round(flops / 1e12, 2),
         }
         log(f"[device] flat b={b_dev}: {ms:.2f} ms -> "
-            f"{b_dev/(ms/1e3):.0f} qps, {gbps:.0f} GB/s, {flops/1e12:.1f} TFLOP/s")
+            f"{b_dev/(ms/1e3):.0f} qps, {gbps:.0f} GB/s, "
+            f"{flops/1e12:.1f} TFLOP/s")
+    ctx["device_stats"] = device_stats
+    return {"stats": device_stats}
 
-    # --- quantized scans on clustered data + exact rescore ------------------
+
+def sec_selection_microbench(ctx):
+    """Fused vs approx vs exact selection on the SAME corpus/queries.
+
+    Reports per-batch device ms for each mode plus a k=1 fused floor
+    (distance scan with a near-free fold) so selection OVERHEAD — the time
+    above the raw scan — is separable. Acceptance gate (round 6): fused
+    overhead <= 0.5x the approx_max_k path's. On CPU backends the fused
+    kernel runs through the (jitted) Pallas interpreter — those numbers
+    validate mechanics, not perf; device numbers land here whenever a TPU
+    is reachable."""
+    import jax
+    import jax.numpy as jnp
+
+    from weaviate_tpu.ops.topk import chunked_topk_distances
+
+    on_tpu = jax.default_backend() == "tpu"
+    k, chunk = ctx["k"], ctx["chunk"]
+    # CPU: the interpreter is O(grid) jitted emulation — keep it small
+    n_sub = ctx["n_pad"] if on_tpu else min(ctx["n_pad"], 16384)
+    n_sub = -(-n_sub // chunk) * chunk if n_sub >= chunk else n_sub
+    x = ctx["x"][:n_sub]
+    valid = ctx["valid"][:n_sub]
+    norms = ctx["norms"][:n_sub]
+    b = min(256 if on_tpu else 32, ctx["batch"])
+    qd = jax.device_put(jnp.asarray(ctx["queries"][0][:b]), ctx["dev"])
+    cs = min(chunk, n_sub)
+
+    out = {"rows": int(n_sub), "batch": int(b), "k": k}
+
+    def time_mode(sel, kk):
+        return _chained_ms(
+            ctx,
+            lambda off, qd_, x_, v_, n_: chunked_topk_distances(
+                qd_, x_, k=kk, chunk_size=cs, metric="l2-squared",
+                valid=v_, x_sq_norms=n_, id_offset=off, selection=sel),
+            (qd, x, valid, norms),
+            reps=100 if on_tpu else 3)
+
+    ms = {sel: time_mode(sel, k) for sel in ("exact", "approx", "fused")}
+    floor = time_mode("fused", 1)  # ~pure distance scan
+    for sel, v in ms.items():
+        out[f"{sel}_ms"] = round(v, 3)
+        out[f"{sel}_selection_overhead_ms"] = round(max(v - floor, 0.0), 3)
+    out["scan_floor_ms"] = round(floor, 3)
+    approx_ov = max(ms["approx"] - floor, 1e-6)
+    fused_ov = max(ms["fused"] - floor, 0.0)
+    out["fused_over_approx_overhead"] = round(fused_ov / approx_ov, 3)
+    out["device_numbers"] = on_tpu
+    # correctness ride-along: fused == exact ids on this corpus
+    d_e, i_e = chunked_topk_distances(
+        qd, x, k=k, chunk_size=cs, metric="l2-squared", valid=valid,
+        x_sq_norms=norms, selection="exact")
+    d_f, i_f = chunked_topk_distances(
+        qd, x, k=k, chunk_size=cs, metric="l2-squared", valid=valid,
+        x_sq_norms=norms, selection="fused")
+    import numpy as np
+
+    match = float(np.mean(np.asarray(i_e) == np.asarray(i_f)))
+    out["fused_vs_exact_id_match"] = round(match, 4)
+    log(f"[selection] exact {ms['exact']:.2f} ms, approx "
+        f"{ms['approx']:.2f} ms, fused {ms['fused']:.2f} ms, floor "
+        f"{floor:.2f} ms -> fused/approx overhead "
+        f"{out['fused_over_approx_overhead']:.2f}, id match {match:.4f}")
+    return out
+
+
+def sec_quantized(ctx):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
     from weaviate_tpu.ops import bq as bq_ops
     from weaviate_tpu.ops import pq as pq_ops
+    from weaviate_tpu.ops.topk import chunked_topk_distances
+
+    n, dim, k, batch = ctx["n"], ctx["dim"], ctx["k"], ctx["batch"]
+    n_pad, chunk, dev = ctx["n_pad"], ctx["chunk"], ctx["dev"]
+    valid, rng = ctx["valid"], ctx["rng"]
 
     cl = clustered_corpus(rng, n, dim)
     cl_pad = np.zeros((n_pad, dim), dtype=np.float32)
     cl_pad[:n] = cl
-    # queries: near-duplicates of corpus points (realistic lookups)
     qcl = (cl[rng.integers(0, n, batch)]
            + 0.05 * rng.standard_normal((batch, dim))).astype(np.float32)
-    # ground truth on clustered corpus
-    def cpu_scan_cl(qb):
-        cn = (cl ** 2).sum(-1)
-        qn = (qb ** 2).sum(-1)[:, None]
-        best_d = np.full((len(qb), k), np.inf, np.float32)
-        best_i = np.zeros((len(qb), k), np.int64)
-        step_n = 131072
-        for s in range(0, n, step_n):
-            dmat = qn - 2.0 * qb @ cl[s:s+step_n].T + cn[None, s:s+step_n]
-            idx = np.argpartition(dmat, k, axis=1)[:, :k]
-            dd = np.take_along_axis(dmat, idx, axis=1)
-            cat_d = np.concatenate([best_d, dd], 1)
-            cat_i = np.concatenate([best_i, idx + s], 1)
-            sel = np.argpartition(cat_d, k, axis=1)[:, :k]
-            best_d = np.take_along_axis(cat_d, sel, 1)
-            best_i = np.take_along_axis(cat_i, sel, 1)
-        return best_i
-    gt_cl = cpu_scan_cl(qcl)
+    _, gt_cl = _cpu_exact_knn(cl, qcl, k)
 
     x_cl = jax.device_put(jnp.asarray(cl_pad, dtype=jnp.bfloat16), dev)
     norms_cl = jnp.sum(jnp.asarray(x_cl, dtype=jnp.float32) ** 2, axis=-1)
@@ -265,8 +466,8 @@ def main():
 
     quant = {}
 
-    def rescore_recall(cand_ids, k_eff=k):
-        """Exact f32 rescore of candidates on host, then recall@k."""
+    def rescore_recall(cand_ids, k_eff=None):
+        k_eff = k_eff or k
         cand = np.asarray(cand_ids)
         out = np.empty((len(cand), k_eff), np.int64)
         for r in range(len(cand)):
@@ -277,25 +478,17 @@ def main():
         return np.mean([len(set(out[r]) & set(gt_cl[r])) / k_eff
                         for r in range(len(cand))])
 
-    # bf16 flat on clustered (reference point for QPS comparisons)
-    def step_cl(qb):
-        return chunked_topk_distances(
-            qb, x_cl, k=k, chunk_size=chunk, metric="l2-squared",
-            valid=valid, x_sq_norms=norms_cl, selection="approx")
-    ms_bf16_cl = chained_ms(
+    ms_bf16_cl = _chained_ms(
+        ctx,
         lambda off, q_, x_, v_, n_: chunked_topk_distances(
             q_, x_, k=k, chunk_size=chunk, metric="l2-squared",
             valid=v_, x_sq_norms=n_, id_offset=off, selection="approx"),
         (q_cl_dev, x_cl, valid, norms_cl))
     quant["bf16_flat"] = {"device_batch_ms": round(ms_bf16_cl, 3),
                           "qps": round(batch / (ms_bf16_cl / 1e3))}
-    # f32 HIGHEST flat (the reference-exact path — the bar to beat)
     x_f32 = jax.device_put(jnp.asarray(cl_pad, dtype=jnp.float32), dev)
-    def step_f32(qb):
-        return chunked_topk_distances(
-            qb, x_f32, k=k, chunk_size=chunk, metric="l2-squared",
-            valid=valid, x_sq_norms=norms_cl, selection="approx")
-    ms_f32_cl = chained_ms(
+    ms_f32_cl = _chained_ms(
+        ctx,
         lambda off, q_, x_, v_, n_: chunked_topk_distances(
             q_, x_, k=k, chunk_size=chunk, metric="l2-squared",
             valid=v_, x_sq_norms=n_, id_offset=off, selection="approx"),
@@ -308,15 +501,14 @@ def main():
     k_cand = 100
     xw = bq_ops.bq_encode(jnp.asarray(cl_pad))
     qw = bq_ops.bq_encode(q_cl_dev)
-    def bq_step():
-        return bq_ops.bq_topk(qw, xw, k=k_cand, chunk_size=chunk,
-                              valid=valid, use_pallas=True)
-    ms_bq = chained_ms(
+    ms_bq = _chained_ms(
+        ctx,
         lambda off, qw_, xw_, v_: bq_ops.bq_topk(
             qw_, xw_, k=k_cand, chunk_size=chunk, valid=v_,
             use_pallas=True, id_offset=off),
         (qw, xw, valid))
-    d_, i_ = bq_step()
+    d_, i_ = bq_ops.bq_topk(qw, xw, k=k_cand, chunk_size=chunk,
+                            valid=valid, use_pallas=True)
     rec_bq = rescore_recall(i_)
     quant["bq_mxu"] = {"device_batch_ms": round(ms_bq, 3),
                        "qps": round(batch / (ms_bq / 1e3)),
@@ -325,18 +517,17 @@ def main():
         f"rescored recall@10 {rec_bq:.4f}")
 
     # PQ4 (16 centroids, m=d/4): LUT-matmul ADC
-    book = pq_ops.pq_fit(cl[:200_000], m=dim // 4, k=16, iters=8)
+    book = pq_ops.pq_fit(cl[:min(200_000, n)], m=dim // 4, k=16, iters=8)
     codes = jnp.asarray(pq_ops.pq_encode(book, cl_pad))
-    def pq4_step():
-        return pq_ops.pq4_topk(q_cl_dev, codes, book.centroids, k=k_cand,
-                               chunk_size=chunk, metric="l2-squared",
-                               valid=valid)
-    ms_pq4 = chained_ms(
+    ms_pq4 = _chained_ms(
+        ctx,
         lambda off, q_, c_, cent_, v_: pq_ops.pq4_topk(
             q_, c_, cent_, k=k_cand, chunk_size=chunk,
             metric="l2-squared", valid=v_, id_offset=off),
         (q_cl_dev, codes, book.centroids, valid))
-    d_, i_ = pq4_step()
+    d_, i_ = pq_ops.pq4_topk(q_cl_dev, codes, book.centroids, k=k_cand,
+                             chunk_size=chunk, metric="l2-squared",
+                             valid=valid)
     rec_pq4 = rescore_recall(i_)
     quant["pq4_lut"] = {"device_batch_ms": round(ms_pq4, 3),
                         "qps": round(batch / (ms_pq4 / 1e3)),
@@ -345,20 +536,17 @@ def main():
         f"rescored recall@10 {rec_pq4:.4f}")
 
     # two-stage PQ (r4 verdict item 6): 128-bit BQ sign prefix stage 1 ->
-    # gathered exact-ADC stage 2 (ops/pq.pq_topk_twostage). At d=128 the
-    # prefix is the full sign code, so stage 1 costs the BQ scan and the
-    # win over the exhaustive PQ4 ADC is dropping its inherent 4x FLOPs.
+    # gathered exact-ADC stage 2 (ops/pq.pq_topk_twostage)
     xp_t = jnp.transpose(xw[:, :4]).copy()
-    def pq2_step():
-        return pq_ops.pq_topk_twostage(
-            q_cl_dev, qw, codes, book.centroids, xp_t, k=k_cand,
-            refine=8, metric="l2-squared", valid=valid)
-    ms_pq2 = chained_ms(
+    ms_pq2 = _chained_ms(
+        ctx,
         lambda off, q_, qw_, c_, cent_, xp_, v_: pq_ops.pq_topk_twostage(
             q_, qw_, c_, cent_, xp_, k=k_cand, refine=8,
             metric="l2-squared", valid=v_, id_offset=off),
         (q_cl_dev, qw, codes, book.centroids, xp_t, valid))
-    d_, i_ = pq2_step()
+    d_, i_ = pq_ops.pq_topk_twostage(
+        q_cl_dev, qw, codes, book.centroids, xp_t, k=k_cand,
+        refine=8, metric="l2-squared", valid=valid)
     rec_pq2 = rescore_recall(i_)
     quant["pq_twostage128"] = {
         "device_batch_ms": round(ms_pq2, 3),
@@ -366,132 +554,187 @@ def main():
         "recall_at_10_rescored": round(float(rec_pq2), 4)}
     log(f"[quant] PQ 2-stage/128: {ms_pq2:.2f} ms, "
         f"{batch/(ms_pq2/1e3):.0f} qps, rescored recall@10 {rec_pq2:.4f}")
+    ctx["quant"] = quant
+    return {"stats": quant}
 
-    # --- compiled-kernel conformance on device ------------------------------
+
+def sec_conformance(ctx):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": "compiled (Mosaic) conformance needs a TPU"}
+
+    from weaviate_tpu.ops import bq as bq_ops
+    from weaviate_tpu.ops.pallas_kernels import (bq_mxu_block,
+                                                 distance_block,
+                                                 pq4_lut_block)
+
+    rng = ctx["rng"]
+    dim = ctx["dim"]
     conformance = "ok"
-    try:
-        from weaviate_tpu.ops.pallas_kernels import (bq_mxu_block,
-                                                     distance_block,
-                                                     pq4_lut_block)
+    cq = rng.standard_normal((8, dim)).astype(np.float32)
+    cx = rng.standard_normal((512, dim)).astype(np.float32)
+    out = np.asarray(distance_block(jnp.asarray(cq), jnp.asarray(cx),
+                                    metric="l2-squared", interpret=False))
+    ref = ((cq[:, None] - cx[None]) ** 2).sum(-1)
+    if not np.allclose(out, ref, rtol=1e-4, atol=1e-3):
+        conformance = f"distance_block mismatch {np.abs(out-ref).max()}"
+    qb_ = bq_ops.bq_encode(jnp.asarray(cq))
+    xb_ = bq_ops.bq_encode(jnp.asarray(cx))
+    out = np.asarray(bq_mxu_block(qb_, xb_, interpret=False))
+    ref = bq_ops.bq_hamming_np(
+        np.ascontiguousarray(np.asarray(qb_)),
+        np.ascontiguousarray(np.asarray(xb_)))
+    if not np.array_equal(out, ref):
+        conformance = f"bq_mxu_block mismatch {np.abs(out-ref).max()}"
+    m4 = dim // 4
+    lut = rng.standard_normal((8, m4, 16)).astype(np.float32)
+    codes4 = rng.integers(0, 16, (512, m4)).astype(np.uint8)
+    out = np.asarray(pq4_lut_block(jnp.asarray(lut), jnp.asarray(codes4),
+                                   interpret=False))
+    lut16 = np.asarray(jnp.asarray(lut, dtype=jnp.bfloat16), np.float32)
+    ref = np.zeros((8, 512), np.float32)
+    for s in range(m4):
+        ref += lut16[:, s, :][:, codes4[:, s]]
+    tol = 8e-3 * max(np.abs(ref).max(), 1.0)
+    if not np.allclose(out, ref, atol=tol):
+        conformance = f"pq4_lut_block mismatch {np.abs(out-ref).max()}"
+    # fused top-k kernel, compiled (Mosaic) vs numpy ground truth
+    from weaviate_tpu.ops.pallas_kernels import fused_topk_scan
 
-        cq = np.asarray(qcl[:8], np.float32)
-        cx = np.asarray(cl[:512], np.float32)
-        out = np.asarray(distance_block(jnp.asarray(cq), jnp.asarray(cx),
-                                        metric="l2-squared", interpret=False))
-        ref = ((cq[:, None] - cx[None]) ** 2).sum(-1)
-        if not np.allclose(out, ref, rtol=1e-4, atol=1e-3):
-            conformance = f"distance_block mismatch {np.abs(out-ref).max()}"
-        qb_ = bq_ops.bq_encode(jnp.asarray(cq))
-        xb_ = bq_ops.bq_encode(jnp.asarray(cx))
-        out = np.asarray(bq_mxu_block(qb_, xb_, interpret=False))
-        ref = bq_ops.bq_hamming_np(
-            np.ascontiguousarray(np.asarray(qb_)),
-            np.ascontiguousarray(np.asarray(xb_)))
-        if not np.array_equal(out, ref):
-            conformance = f"bq_mxu_block mismatch {np.abs(out-ref).max()}"
-        m4 = dim // 4
-        lut = rng.standard_normal((8, m4, 16)).astype(np.float32)
-        codes4 = rng.integers(0, 16, (512, m4)).astype(np.uint8)
-        out = np.asarray(pq4_lut_block(jnp.asarray(lut), jnp.asarray(codes4),
-                                       interpret=False))
-        lut16 = np.asarray(jnp.asarray(lut, dtype=jnp.bfloat16), np.float32)
-        ref = np.zeros((8, 512), np.float32)
-        for s in range(m4):
-            ref += lut16[:, s, :][:, codes4[:, s]]
-        # kernel emits bf16 distance tiles (candidates rescore exactly) —
-        # tolerance is bf16 epsilon relative to the sum's magnitude
-        tol = 8e-3 * max(np.abs(ref).max(), 1.0)
-        if not np.allclose(out, ref, atol=tol):
-            conformance = f"pq4_lut_block mismatch {np.abs(out-ref).max()}"
-    except Exception as e:  # noqa: BLE001
-        conformance = f"error: {e}"
+    fd, fi = fused_topk_scan(jnp.asarray(cq), jnp.asarray(cx), k=10,
+                             interpret=False)
+    dist = ((cq[:, None] - cx[None]) ** 2).sum(-1)
+    want_i = np.argsort(dist, axis=1, kind="stable")[:, :10]
+    if not np.array_equal(np.asarray(fi), want_i):
+        conformance = "fused_topk_scan id mismatch"
+    ctx["conformance"] = conformance
     log(f"kernel conformance (compiled, on-device): {conformance}")
+    return {"status": conformance}
 
-    # --- serving fabric (native data plane, null device) --------------------
-    # Isolates the C++ gRPC fabric — transport + coalescing + reply build
-    # — from both the device and the dev tunnel (bench_e2e --native-plane
-    # --null-device is the full-size version). Best-effort: absent
-    # libnghttp2, reports null.
-    fabric = None
+
+def sec_fabric(ctx):
+    """Serving fabric (native data plane, null device) — isolates the C++
+    gRPC fabric from both the device and the dev tunnel. Best-effort:
+    absent libnghttp2, reports skipped."""
+    import numpy as np
+
+    from weaviate_tpu.native import dataplane as dpn
+
+    if not dpn.available():
+        return {"skipped": "native dataplane unavailable"}
+    import tempfile
+
+    os.environ["WEAVIATE_TPU_NATIVE_DATAPLANE"] = "1"
+    from weaviate_tpu.api.grpc import v1_pb2 as pbv
+    from weaviate_tpu.config import ServerConfig
+    from weaviate_tpu.server import Server
+
+    srv = Server(ServerConfig(
+        data_path=tempfile.mkdtemp(prefix="bench-fabric-"),
+        rest_port=0, grpc_port=0, disable_telemetry=True)).start()
     try:
-        from weaviate_tpu.native import dataplane as dpn
+        if not hasattr(srv.grpc, "dp"):
+            return {"skipped": "no native plane on grpc server"}
+        col = srv.db.create_collection_from_dict({
+            "class": "Fab",
+            "vectorIndexType": "flat",
+            "properties": [
+                {"name": "seq", "dataType": ["int"]}],
+        }) if hasattr(srv.db, "create_collection_from_dict") else None
+        if col is None:
+            from weaviate_tpu.schema.config import CollectionConfig, Property
 
-        if dpn.available():
-            import tempfile
+            col = srv.db.create_collection(CollectionConfig(
+                name="Fab",
+                properties=[Property(name="seq", data_type="int")]))
+        fr = np.random.default_rng(0)
+        col.batch_put([
+            {"properties": {"seq": i},
+             "vector": fr.standard_normal(32).astype(np.float32)}
+            for i in range(5000)])
+        srv.grpc._maybe_register("Fab", warm=False)
+        srv.grpc.warm_collection("Fab")
+        shard = next(iter(col.shards.values()))
+        cid = np.tile(np.arange(10, dtype=np.int64), (256, 1))
+        cdd = np.tile(np.linspace(0.01, 0.1, 10, dtype=np.float32),
+                      (256, 1))
+        cnn = np.full(256, 10, np.int64)
+        shard.vector_search_batch = (
+            lambda qs, k2, vec_name="": (cid[:len(qs), :k2],
+                                         cdd[:len(qs), :k2],
+                                         cnn[:len(qs)]))
+        head = pbv.SearchRequest(collection="Fab", limit=10,
+                                 uses_123_api=True)
+        head.metadata.uuid = True
+        head.metadata.distance = True
+        st = dpn.bench(srv.grpc.port, conns=8, streams=8,
+                       duration_ms=4000, dim=32,
+                       request_head=head.SerializeToString())
+        fabric = {"qps": round(st["qps"]),
+                  "p50_ms": round(st["p50_ms"], 2),
+                  "p95_ms": round(st["p95_ms"], 2),
+                  "streams": 64, "errors": st["errors"]}
+        log(f"[fabric] native plane null-device: {fabric}")
+        ctx["fabric"] = fabric
+        return fabric
+    finally:
+        srv.stop()
 
-            os.environ["WEAVIATE_TPU_NATIVE_DATAPLANE"] = "1"
-            from weaviate_tpu.api.grpc import v1_pb2 as pbv
-            from weaviate_tpu.config import ServerConfig
-            from weaviate_tpu.server import Server
 
-            srv = Server(ServerConfig(
-                data_path=tempfile.mkdtemp(prefix="bench-fabric-"),
-                rest_port=0, grpc_port=0, disable_telemetry=True)).start()
-            if hasattr(srv.grpc, "dp"):
-                col = srv.db.create_collection_from_dict({
-                    "class": "Fab",
-                    "vectorIndexType": "flat",
-                    "properties": [
-                        {"name": "seq", "dataType": ["int"]}],
-                }) if hasattr(srv.db, "create_collection_from_dict") else None
-                if col is None:
-                    from weaviate_tpu.schema.config import (
-                        CollectionConfig,
-                        Property,
-                    )
+# (name, fn, ctx keys produced upstream that the section requires)
+SECTIONS = [
+    ("setup", sec_setup, ()),
+    ("cpu_baseline", sec_cpu_baseline, ("corpus", "queries")),
+    ("device_setup", sec_device_setup, ("corpus",)),
+    ("flat_headline", sec_flat_headline, ("x", "queries")),
+    ("device_steady", sec_device_steady, ("x", "rtt_s")),
+    ("selection_microbench", sec_selection_microbench, ("x", "rtt_s")),
+    ("quantized", sec_quantized, ("x", "rtt_s")),
+    ("kernel_conformance", sec_conformance, ("rng",)),
+    ("serving_fabric", sec_fabric, ()),
+]
 
-                    col = srv.db.create_collection(CollectionConfig(
-                        name="Fab",
-                        properties=[Property(name="seq",
-                                             data_type="int")]))
-                fr = np.random.default_rng(0)
-                col.batch_put([
-                    {"properties": {"seq": i},
-                     "vector": fr.standard_normal(32).astype(np.float32)}
-                    for i in range(5000)])
-                srv.grpc._maybe_register("Fab", warm=False)
-                srv.grpc.warm_collection("Fab")
-                shard = next(iter(col.shards.values()))
-                cid = np.tile(np.arange(10, dtype=np.int64), (256, 1))
-                cdd = np.tile(np.linspace(0.01, 0.1, 10,
-                                          dtype=np.float32), (256, 1))
-                cnn = np.full(256, 10, np.int64)
-                shard.vector_search_batch = (
-                    lambda qs, k2, vec_name="": (cid[:len(qs), :k2],
-                                                 cdd[:len(qs), :k2],
-                                                 cnn[:len(qs)]))
-                head = pbv.SearchRequest(collection="Fab", limit=10,
-                                         uses_123_api=True)
-                head.metadata.uuid = True
-                head.metadata.distance = True
-                st = dpn.bench(srv.grpc.port, conns=8, streams=8,
-                               duration_ms=4000, dim=32,
-                               request_head=head.SerializeToString())
-                fabric = {"qps": round(st["qps"]),
-                          "p50_ms": round(st["p50_ms"], 2),
-                          "p95_ms": round(st["p95_ms"], 2),
-                          "streams": 64, "errors": st["errors"]}
-                log(f"[fabric] native plane null-device: {fabric}")
-            srv.stop()
-    except Exception as e:  # noqa: BLE001
-        log(f"[fabric] skipped: {e}")
+
+def main():
+    wd = _watchdog(float(os.environ.get("BENCH_WATCHDOG_S", "1500")))
+    ctx: dict = {}
+    for name, fn, deps in SECTIONS:
+        run_section(name, fn, ctx, deps)
 
     wd.cancel()
-    print(json.dumps({
+    sections = RESULTS["sections"]
+    headline = sections.get("flat_headline", {})
+    cpu_qps = ctx.get("cpu_qps", 0.0)
+    qps = ctx.get("qps", 0.0)
+    final = {
         "metric": "flat_knn_qps_synth1M_128d_k10",
         "value": round(qps, 1),
         "unit": "qps",
-        "vs_baseline": round(qps / cpu_qps, 2),
-        "recall_at_10": round(float(recall), 4),
-        "p50_batch_ms": round(per_batch * 1e3, 2),
-        "batch": batch,
+        "vs_baseline": round(qps / cpu_qps, 2) if cpu_qps else 0.0,
+        "recall_at_10": headline.get("recall_at_10"),
+        "p50_batch_ms": headline.get("p50_batch_ms"),
+        "batch": ctx.get("batch"),
         "baseline_cpu_qps": round(cpu_qps, 1),
-        "device": device_stats,
-        "quantized_clustered_1M_128d": quant,
-        "kernel_conformance": conformance,
-        "serving_fabric_null_device": fabric,
-        "tunnel_rtt_ms": round(rtt_s * 1e3, 1),
-    }), flush=True)
+        "device": ctx.get("device_stats"),
+        "selection_microbench": sections.get("selection_microbench"),
+        "quantized_clustered_1M_128d": ctx.get("quant"),
+        "kernel_conformance": ctx.get("conformance"),
+        "serving_fabric_null_device": ctx.get("fabric"),
+        "tunnel_rtt_ms": round(ctx.get("rtt_s", 0.0) * 1e3, 1),
+        "sections": sections,
+    }
+    failed = [n for n, s in sections.items() if not s.get("ok")]
+    if failed:
+        final["failed_sections"] = failed
+    RESULTS.update(final)
+    _emit_partial()
+    print(json.dumps(final), flush=True)
+    # partial results are still results: rc=0 so the driver parses them
+    sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -24,7 +24,16 @@ from weaviate_tpu.engine.store import DeviceVectorStore
 
 class FlatIndex:
     """Implements the reference ``VectorIndex`` contract
-    (adapters/repos/db/vector_index.go:24-45) for brute-force search."""
+    (adapters/repos/db/vector_index.go:24-45) for brute-force search.
+
+    ``selection`` picks the scan's top-k strategy ("approx" | "exact" |
+    "fused" — ops/topk.chunked_topk_distances docstring); "fused" runs
+    selection inside the Pallas scan kernel so distances never round-trip
+    through HBM. With ``quantization`` set it passes through to the
+    quantized store's SURVIVOR selection, which supports "approx" and
+    "fused" only (the compressed scan itself is always the scan-reduce
+    kernel) and falls back to approx when rescore_limit*k exceeds the
+    256-wide fused carry."""
 
     index_type = "flat"
 
@@ -46,7 +55,7 @@ class FlatIndex:
             self.store = QuantizedVectorStore(
                 dim=dim, metric=metric, quantization=quantization,
                 capacity=capacity, chunk_size=chunk_size, mesh=mesh,
-                **quant_kwargs,
+                selection=selection, **quant_kwargs,
             )
         else:
             if quant_kwargs:

@@ -28,9 +28,12 @@ approximations).
 from __future__ import annotations
 
 import functools
+import logging
 import math
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 def _batched_heuristic(cand_d: np.ndarray, pair: np.ndarray, budget: int,
@@ -133,6 +136,11 @@ def _owner_dists(owner: np.ndarray, cands: np.ndarray, metric: str):
 # scan there was ~40 s of the build on one core; with the persistent
 # compile cache the device path's per-shape jit cost no longer recurs.
 _HOST_KNN_MAX = 8192
+# CPU-backend ceiling: the XLA chunked scan on CPU beats the naive
+# single-threaded numpy O(n^2 d) pass once layers get big (threaded
+# matmuls + fused running top-k with bounded [qb, chunk] transients), so
+# only modest layers keep the zero-compile host BLAS path there.
+_CPU_HOST_KNN_MAX = 65536
 _SELECT_DISPATCH_ROWS = 65536  # owners per host-level device dispatch
 
 
@@ -411,14 +419,25 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
     from weaviate_tpu.ops.topk import chunked_topk_distances
 
     n = len(sub)
-    cs = min(chunk_size, 1 << (n - 1).bit_length())
-    pad_rows = -(-n // cs) * cs - n
-    x = np.pad(sub, ((0, pad_rows), (0, 0)))
-    valid = np.arange(n + pad_rows) < n
 
     from weaviate_tpu.ops.pallas_kernels import recommended
 
     use_pallas = recommended()
+    # TPU: fold selection INTO the scan kernel (selection="fused" — the
+    # per-chunk approx_max_k pass plus its [qb, chunk] HBM round-trip was
+    # the dominant cost of the 1M bulk-build knn stage, VERDICT r5);
+    # chunked_topk_distances degrades it to "approx" if k_eff > the fused
+    # carry width. CPU backend: "approx" lowers to the exact XLA top_k.
+    selection = "fused" if use_pallas else "approx"
+    if not use_pallas:
+        # the XLA CPU scan materializes [qb, chunk] distance transients in
+        # RAM — bound them (~64 MB) for the large-layer CPU fallback path
+        query_block = min(query_block, 1024)
+        chunk_size = min(chunk_size, 16384)
+    cs = min(chunk_size, 1 << (n - 1).bit_length())
+    pad_rows = -(-n // cs) * cs - n
+    x = np.pad(sub, ((0, pad_rows), (0, 0)))
+    valid = np.arange(n + pad_rows) < n
     # host-level slices of a few query blocks each: one giant program over
     # 1M queries reproducibly crashes the TPU worker, and per-slice fetches
     # stay small. Queries are dynamic-sliced FROM the device-resident
@@ -450,7 +469,7 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
             _d, i = chunked_topk_distances(
                 qblk, xscan, k=k, chunk_size=cs,
                 metric=metric, valid=vd, x_sq_norms=norms,
-                selection="approx", use_pallas=use_pallas)
+                selection=selection, use_pallas=use_pallas)
             return i
         return jax.lax.map(one, qb).reshape(slice_rows, k)
 
@@ -496,12 +515,18 @@ def _knn_graph(vectors: np.ndarray, members: np.ndarray, knn_k: int,
     sub = vectors[members]
     n = len(sub)
     k_eff = min(knn_k + 1, n)
-    if n <= _HOST_KNN_MAX or metric not in (
-            "l2-squared", "dot", "cosine", "cosine-dot") \
-            or not _device_backend():
-        # CPU backends keep exact host BLAS at every size — the device
-        # path's approx per-chunk selection only earns its recall cost
-        # on a real accelerator
+    supported = metric in ("l2-squared", "dot", "cosine", "cosine-dot")
+    # host BLAS for small layers (zero compiles); device path above the
+    # backend's ceiling — on CPU backends that's the XLA chunked scan
+    # (exact top_k lowering), no longer the unconditional O(n^2 d) numpy
+    # pass that made large CPU builds crawl
+    host_cap = _HOST_KNN_MAX if _device_backend() else _CPU_HOST_KNN_MAX
+    if not supported or n <= host_cap:
+        if not supported and n > _CPU_HOST_KNN_MAX:
+            logger.warning(
+                "hnsw bulk build: %d-row layer falls back to the exact "
+                "O(n^2 d) host BLAS knn — metric %r has no device scan",
+                n, metric)
         out = _host_knn(sub, k_eff, metric)
     else:
         out = _device_knn(sub, k_eff, metric)

@@ -73,6 +73,20 @@ def _clear_slots(valid, slots):
     return valid.at[slots].set(False, mode="drop")
 
 
+def _probe_scatter(valid, slot: int) -> None:
+    """Force one element of a freshly-scattered valid mask to the host.
+
+    jax dispatch is async: ``_scatter_rows`` returning only means the work
+    was ENQUEUED. A tiny data-dependent fetch is the trustworthy completion
+    probe on the tunnel runtime (block_until_ready reports completion
+    before execution there, engine/hnsw_build.py:_t) — it surfaces an async
+    runtime failure (device OOM, preemption, poisoned buffer) as an
+    exception at the flush site, while the staged rows are still held and
+    re-flushable, instead of silently dropping rows whose add() already
+    returned success. Module-level so tests can inject async failures."""
+    bool(np.asarray(valid[slot]))
+
+
 class DeviceVectorStore:
     """Mutable (host-managed, device-resident) vector store.
 
@@ -98,9 +112,13 @@ class DeviceVectorStore:
         self.mesh = mesh
         self.chunk_size = chunk_size
         # "approx" = per-chunk approx_max_k candidates (4x oversampled) with
-        # exact carry merges — the flagship serving path (≥0.999 recall@10,
-        # ~10x less selection time at 1M rows). "exact" opts into bit-exact
-        # lax.top_k per chunk (and is what non-TPU backends lower to anyway).
+        # exact carry merges (≥0.999 recall@10, ~10x less selection time at
+        # 1M rows). "exact" opts into bit-exact lax.top_k per chunk (and is
+        # what non-TPU backends lower to anyway). "fused" folds EXACT
+        # selection into the Pallas scan kernel itself (ops/topk.py
+        # docstring) — [B, N] distances never round-trip through HBM; on
+        # non-TPU backends it runs through the Pallas interpreter, so keep
+        # it for tests/TPU serving, not CPU serving.
         self.selection = selection
         self.n_shards = 1 if mesh is None else mesh.shape[SHARD_AXIS]
         # cosine provider normalizes at insert (reference stores normalized
@@ -226,9 +244,13 @@ class DeviceVectorStore:
             self._placed_replicated(mask),
             normalize_rows=self.normalize_on_add,
         )
-        # drop the staging buffers only after the scatter dispatched — an
-        # exception above (OOM on the transfer, compile failure at a new
-        # bucket) must leave the rows re-flushable, not silently lost
+        # drop the staging buffers only after the scatter MATERIALIZED —
+        # dispatch is async, so an exception can surface here (transfer
+        # OOM, compile failure at a new bucket) or later on the device
+        # (runtime failure on the enqueued scatter). The probe forces the
+        # result before the rows stop being re-flushable; one host RTT per
+        # flush, amortized over >= _stage_limit staged rows.
+        _probe_scatter(self.valid, int(slots[m - 1]))
         self._staged_vecs.clear()
         self._staged_slots.clear()
         self._staged_rows = 0

@@ -52,7 +52,7 @@ def _auto_reduce_l(n: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("k", "chunk_size", "use_pallas",
-                                             "reduce_l"))
+                                             "reduce_l", "selection"))
 def bq_topk(
     q_words: jnp.ndarray,
     x_words: jnp.ndarray,
@@ -62,6 +62,7 @@ def bq_topk(
     id_offset: jnp.ndarray | int = 0,
     use_pallas: bool = False,
     reduce_l: int | None = None,
+    selection: str = "approx",
 ):
     """Hamming top-k over packed words: q [B, w] uint32, x [N, w] uint32.
 
@@ -78,11 +79,16 @@ def bq_topk(
     per ``reduce_l`` rows (a true top-k member is dropped whenever two
     winners share a block; birthday-bound loss ~k^2/(2*N/reduce_l)) and
     the survivor selection uses ``approx_max_k`` (recall~0.95 per spec).
-    ``reduce_l=1`` removes only the block-argmin loss — the approx_max_k
-    selection still applies, so the pallas path never matches the
-    fallback bit-for-bit; exact parity requires ``use_pallas=False``.
-    Production callers oversample + rescore as QuantizedVectorStore
-    does, which absorbs the loss (measured recall deltas in PARITY.md).
+    ``reduce_l=1`` removes only the block-argmin loss — with the default
+    ``selection="approx"`` the survivor selection still runs approx_max_k,
+    so the pallas path never matches the fallback bit-for-bit.
+    ``selection="fused"`` replaces that survivor pass with the exact
+    in-kernel running-carry fold (pallas_kernels.fused_topk_pairs), so the
+    only remaining loss is the block-argmin (and ``reduce_l=1`` + fused is
+    bit-exact); k above the 256-wide fused carry falls back to the approx
+    pass. Production callers oversample + rescore as
+    QuantizedVectorStore does, which absorbs the loss (measured recall
+    deltas in PARITY.md).
     """
     from weaviate_tpu.ops.distances import MASKED_DISTANCE
     from weaviate_tpu.ops.topk import topk_smallest
@@ -92,24 +98,12 @@ def bq_topk(
 
     if use_pallas:
         from weaviate_tpu.ops.pallas_kernels import bq_scan_reduce
+        from weaviate_tpu.ops.topk import select_survivors
 
         rl = reduce_l if reduce_l is not None else _auto_reduce_l(n)
         vals, ids = bq_scan_reduce(q_words, x_words, valid=valid,
                                    reduce_l=rl)
-        ncand = vals.shape[1]
-        kk = min(k, ncand)
-        if ncand > 4 * kk:
-            negd, pos = jax.lax.approx_max_k(-vals, min(4 * kk, ncand),
-                                             recall_target=0.95)
-            vals = -negd
-            ids = jnp.take_along_axis(ids, pos, axis=1)
-        fd, fi = topk_smallest(vals, ids, kk)
-        if kk < k:
-            fd = jnp.pad(fd, ((0, 0), (0, k - kk)),
-                         constant_values=MASKED_DISTANCE)
-            fi = jnp.pad(fi, ((0, 0), (0, k - kk)), constant_values=-1)
-        fi = jnp.where(fd >= MASKED_DISTANCE * 0.5, -1, fi + id_offset)
-        return fd, fi
+        return select_survivors(vals, ids, k, selection, id_offset)
 
     # XLA fallback: chunked XOR+popcount pass; pad odd sizes with dead rows
     # so peak memory stays O(B * chunk)
@@ -164,7 +158,8 @@ def bq_topk(
     return fd, fi
 
 
-@functools.partial(jax.jit, static_argnames=("k", "refine", "use_pallas"))
+@functools.partial(jax.jit, static_argnames=("k", "refine", "use_pallas",
+                                             "selection"))
 def bq_topk_twostage(
     q_words: jnp.ndarray,
     x_words: jnp.ndarray,
@@ -174,6 +169,7 @@ def bq_topk_twostage(
     valid: jnp.ndarray | None = None,
     id_offset: jnp.ndarray | int = 0,
     use_pallas: bool = True,
+    selection: str = "approx",
 ):
     """Two-stage BQ scan for the capacity regime.
 
@@ -185,7 +181,9 @@ def bq_topk_twostage(
     the row-major ``x_words`` [N, W] (contiguous row gathers) and scores
     exact hamming with one XOR+popcount over [B, R, W]. Exact top-k of
     stage 2 follows; the only approximation is stage-1 candidate recall
-    (tunable via ``refine`` and the prefix width).
+    (tunable via ``refine`` and the prefix width). ``selection="fused"``
+    makes the stage-1 refine exact too (fused_topk_pairs instead of
+    approx_max_k, refine*k <= its 256-wide carry).
     """
     from weaviate_tpu.ops.distances import MASKED_DISTANCE
     from weaviate_tpu.ops.topk import topk_smallest
@@ -201,9 +199,15 @@ def bq_topk_twostage(
             q_words[:, :wp], x_prefix_t, valid=valid,
             reduce_l=_auto_reduce_l(n), transposed=True)
         r = min(refine * k, vals1.shape[1])
-        negd, pos = jax.lax.approx_max_k(-vals1, r, recall_target=0.95)
-        cand_d1 = -negd
-        cand = jnp.take_along_axis(ids1, pos, axis=1)  # [B, R] global rows
+        if selection == "fused" and r <= 256:
+            from weaviate_tpu.ops.pallas_kernels import fused_topk_pairs
+
+            cand_d1, cand = fused_topk_pairs(vals1, ids1, k=r)
+            cand = jnp.where(cand < 0, 0, cand)  # unfilled: masked below
+        else:
+            negd, pos = jax.lax.approx_max_k(-vals1, r, recall_target=0.95)
+            cand_d1 = -negd
+            cand = jnp.take_along_axis(ids1, pos, axis=1)  # [B, R] rows
     else:
         # fallback top-k already returns the pruned candidate set, sorted
         cand_d1, ids1 = bq_topk(q_words[:, :wp], x_prefix_t.T,

@@ -284,7 +284,8 @@ def pq_lut(q: jnp.ndarray, centroids: jnp.ndarray, metric: str, m: int):
 
 @functools.partial(jax.jit, static_argnames=("k", "refine", "metric", "m",
                                              "use_pallas",
-                                             "chunk_budget_bytes"))
+                                             "chunk_budget_bytes",
+                                             "selection"))
 def pq_topk_twostage(
     q: jnp.ndarray,
     q_prefix_words: jnp.ndarray,
@@ -299,6 +300,7 @@ def pq_topk_twostage(
     m: int | None = None,
     use_pallas: bool = True,
     chunk_budget_bytes: int = 128 << 20,
+    selection: str = "approx",
 ):
     """Two-stage PQ scan (the r4 verdict's "extend the prefix idea to PQ").
 
@@ -331,9 +333,16 @@ def pq_topk_twostage(
             q_prefix_words, prefix_t, valid=valid,
             reduce_l=bq_ops._auto_reduce_l(n), transposed=True)
         r = min(refine * k, vals1.shape[1])
-        negd, pos = jax.lax.approx_max_k(-vals1, r, recall_target=0.95)
-        cand_d1 = -negd
-        cand = jnp.take_along_axis(ids1, pos, axis=1)  # [B, R] rows
+        if selection == "fused" and r <= 256:
+            # exact stage-1 refine via the in-kernel running-carry fold
+            from weaviate_tpu.ops.pallas_kernels import fused_topk_pairs
+
+            cand_d1, cand = fused_topk_pairs(vals1, ids1, k=r)
+            cand = jnp.where(cand < 0, 0, cand)  # unfilled: masked below
+        else:
+            negd, pos = jax.lax.approx_max_k(-vals1, r, recall_target=0.95)
+            cand_d1 = -negd
+            cand = jnp.take_along_axis(ids1, pos, axis=1)  # [B, R] rows
     else:
         cand_d1, ids1 = bq_ops.bq_topk(
             q_prefix_words, prefix_t.T, k=min(refine * k, n), valid=valid,
@@ -393,7 +402,7 @@ def pq_topk_twostage(
 
 
 @functools.partial(jax.jit, static_argnames=("k", "chunk_size", "metric", "m",
-                                             "reduce_l"))
+                                             "reduce_l", "selection"))
 def pq4_topk(
     q: jnp.ndarray,
     codes: jnp.ndarray,
@@ -405,34 +414,25 @@ def pq4_topk(
     id_offset: jnp.ndarray | int = 0,
     m: int | None = None,
     reduce_l: int | None = None,
+    selection: str = "approx",
 ):
     """Compressed brute-force top-k over 4-bit codes via the fused ADC scan
     kernel (pallas_kernels.pq4_scan_reduce: per-query int8 LUT, one-hot
-    int8 matmul, in-kernel strided block-argmin), then one approx_max_k
-    over the ~N/L survivors and an exact final top-k. Same contract as
-    pq_topk; ``chunk_size`` is accepted for API compatibility."""
+    int8 matmul, in-kernel strided block-argmin), then a survivor
+    selection over the ~N/L candidates and an exact final top-k.
+    ``selection="approx"`` (default) runs one approx_max_k over the
+    survivors; ``"fused"`` folds them through the exact in-kernel
+    running-carry top-k (pallas_kernels.fused_topk_pairs) instead. Same
+    contract as pq_topk; ``chunk_size`` is accepted for API
+    compatibility."""
     from weaviate_tpu.ops.bq import _auto_reduce_l
-    from weaviate_tpu.ops.distances import MASKED_DISTANCE
     from weaviate_tpu.ops.pallas_kernels import pq4_scan_reduce
-    from weaviate_tpu.ops.topk import topk_smallest
 
     m = m or centroids.shape[0]
     n = codes.shape[0]
-    b = q.shape[0]
     lut = pq_lut(q, centroids, metric, m)  # [B, m, k]
     rl = reduce_l if reduce_l is not None else _auto_reduce_l(n)
     vals, ids = pq4_scan_reduce(lut, codes, valid=valid, reduce_l=rl)
-    ncand = vals.shape[1]
-    kk = min(k, ncand)
-    if ncand > 4 * kk:
-        negd, pos = jax.lax.approx_max_k(-vals, min(4 * kk, ncand),
-                                         recall_target=0.95)
-        vals = -negd
-        ids = jnp.take_along_axis(ids, pos, axis=1)
-    fd, fi = topk_smallest(vals, ids, kk)
-    if kk < k:
-        fd = jnp.pad(fd, ((0, 0), (0, k - kk)),
-                     constant_values=MASKED_DISTANCE)
-        fi = jnp.pad(fi, ((0, 0), (0, k - kk)), constant_values=-1)
-    fi = jnp.where(fd >= MASKED_DISTANCE * 0.5, -1, fi + id_offset)
-    return fd, fi
+    from weaviate_tpu.ops.topk import select_survivors
+
+    return select_survivors(vals, ids, k, selection, id_offset)

@@ -54,6 +54,38 @@ def approx_topk_smallest(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
     return -neg_d, top_ids
 
 
+def select_survivors(vals, ids, k: int, selection: str = "approx",
+                     id_offset=0):
+    """Final selection over a scan-reduce survivor array: vals [B, M] f32
+    (dead entries at MASKED_DISTANCE), ids [B, M] i32 global rows.
+
+    The shared tail of the bq/pq4 fused-scan consumers: ``"approx"`` runs
+    one ``approx_max_k`` oversample (4x k) + exact merge; ``"fused"`` the
+    exact in-kernel running-carry fold (pallas_kernels.fused_topk_pairs,
+    k <= its 256-wide carry — larger k falls back to approx). Pads to
+    [B, k] with (MASKED_DISTANCE, -1) and applies ``id_offset`` to live
+    entries only."""
+    ncand = vals.shape[1]
+    kk = min(k, ncand)
+    if selection == "fused" and kk <= 256:
+        from weaviate_tpu.ops.pallas_kernels import fused_topk_pairs
+
+        fd, fi = fused_topk_pairs(vals, ids, k=kk)
+    else:
+        if ncand > 4 * kk:
+            negd, pos = jax.lax.approx_max_k(-vals, min(4 * kk, ncand),
+                                             recall_target=0.95)
+            vals = -negd
+            ids = jnp.take_along_axis(ids, pos, axis=1)
+        fd, fi = topk_smallest(vals, ids, kk)
+    if kk < k:
+        fd = jnp.pad(fd, ((0, 0), (0, k - kk)),
+                     constant_values=MASKED_DISTANCE)
+        fi = jnp.pad(fi, ((0, 0), (0, k - kk)), constant_values=-1)
+    fi = jnp.where(fd >= MASKED_DISTANCE * 0.5, -1, fi + id_offset)
+    return fd, fi
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def merge_topk(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
     """Merge candidate sets: dists [B, M], ids [B, M] -> top-k of the union.
@@ -105,9 +137,36 @@ def chunked_topk_distances(
       4x oversampling measured recall@10 vs exact is ≥0.999. On non-TPU
       backends XLA lowers approx_max_k to an exact top_k, so CPU tests see
       bit-exact results.
+    - ``"fused"``: selection happens INSIDE the Pallas scan kernel
+      (pallas_kernels.fused_topk_scan): each grid step folds its VMEM
+      distance tile into a per-query running top-k carry, so the [B, N]
+      distance matrix never round-trips through HBM and no per-chunk
+      top_k/approx_max_k pass exists at all. EXACT top-k semantics (ties
+      break like lax.top_k); unfilled slots surface as (MASKED, -1)
+      instead of arbitrary dead-row ids. Runs compiled on TPU and through
+      the Pallas interpreter elsewhere (tests; too slow to serve from on
+      CPU). Requires a Pallas metric and k <= 128 — other metrics fall
+      back to ``"exact"`` and k > 128 falls back to ``"approx"``
+      (``search_by_distance`` widens k past the carry width).
     """
     n = x.shape[0]
     assert n % chunk_size == 0, f"corpus rows {n} not a multiple of chunk {chunk_size}"
+    if selection == "fused":
+        from weaviate_tpu.ops.pallas_kernels import (
+            _FUSED_TOPK_MAX_K,
+            PALLAS_METRICS,
+            fused_topk_scan,
+        )
+
+        if metric in PALLAS_METRICS and k <= _FUSED_TOPK_MAX_K:
+            d, i = fused_topk_scan(
+                q, x, k=k, metric=metric, valid=valid,
+                x_sq_norms=x_sq_norms,
+            )
+            return d, jnp.where(i < 0, i, i + id_offset)
+        # degrade gracefully: non-Pallas metrics take the exact XLA scan,
+        # oversized k the approx per-chunk selection (same recall story)
+        selection = "approx" if metric in PALLAS_METRICS else "exact"
     num_chunks = n // chunk_size
     b = q.shape[0]
 

@@ -21,10 +21,26 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.6: top-level export, replication check renamed check_vma
+    from jax import shard_map as _shard_map_impl
+
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental home, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _SHARD_MAP_CHECK_KW = "check_rep"
 
 from weaviate_tpu.ops.topk import chunked_topk_distances, topk_smallest
 from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """Version-portable shard_map (the replication-check kwarg moved and
+    the symbol left jax.experimental between the pinned jax releases)."""
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_SHARD_MAP_CHECK_KW: check_vma})
 
 
 def _ici_merge_topk(d, ids, axis: str, k_out: int):
@@ -106,7 +122,7 @@ def sharded_topk(
     jax.jit,
     static_argnames=(
         "k", "k_out", "chunk_size", "quantization", "metric", "mesh", "axis",
-        "use_pallas",
+        "use_pallas", "selection",
     ),
 )
 def sharded_quantized_topk(
@@ -124,6 +140,7 @@ def sharded_quantized_topk(
     mesh: Mesh,
     axis: str = SHARD_AXIS,
     use_pallas: bool = False,
+    selection: str = "approx",
 ):
     """Compressed scan over a row-sharded code array, one SPMD program.
 
@@ -137,7 +154,11 @@ def sharded_quantized_topk(
     merge all_gathers only [n_shards, B, k] (distance, id) pairs over ICI.
 
     ``q`` is replicated f32 (pre-normalized for cosine); ``q_words`` packed
-    query bits for bq. Returns replicated (dists [B, k_out], global ids).
+    query bits for bq. ``selection`` picks the per-shard survivor selector
+    for the bq/pq4 scan-reduce paths ("approx" = approx_max_k, "fused" =
+    exact in-kernel running-carry top-k); the ICI merge contract is
+    unchanged either way. Returns replicated (dists [B, k_out], global
+    ids).
     """
     from weaviate_tpu.ops import bq as bq_ops
     from weaviate_tpu.ops import pq as pq_ops
@@ -153,12 +174,13 @@ def sharded_quantized_topk(
         if quantization == "bq":
             d_c, i_c = bq_ops.bq_topk(
                 qw_, codes_, k=min(k, local_rows), chunk_size=chunk_size,
-                valid=valid_, use_pallas=use_pallas,
+                valid=valid_, use_pallas=use_pallas, selection=selection,
             )
         elif quantization == "pq4":
             d_c, i_c = pq_ops.pq4_topk(
                 q_, codes_, cent_, k=min(k, local_rows),
                 chunk_size=chunk_size, metric=metric, valid=valid_,
+                selection=selection,
             )
         else:
             d_c, i_c = pq_ops.pq_topk(

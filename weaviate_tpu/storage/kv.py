@@ -1119,23 +1119,31 @@ class Bucket:
         """Batched replace-strategy point lookups: ONE layer snapshot for
         the whole batch instead of a lock + sealed-list copy per key (the
         per-object docid update-check was ~5 us/object of pure snapshot
-        overhead on the import path)."""
+        overhead on the import path).
+
+        The memtable probes run UNDER the lock, like ``get``'s — the
+        active memtable dict keeps mutating under concurrent writers, so
+        probing it unlocked could race a resize (and would let the two
+        paths diverge). Segments are immutable once listed, so the disk
+        lookups for memtable misses happen after the lock drops."""
         assert self.strategy == "replace"
+        misses: list[int] = []
+        out: list = []
         with self._lock:
             # newest first; replace memtables are always dict-backed
             mems = [m.data for m in [*self._sealed, self._mem][::-1]]
             segments = list(self._segments)[::-1]
-        out = []
-        for key in keys:
-            val = None
-            for m in mems:  # replace memtables are always dict-backed
-                v = m.get(key)
-                if v is not None:
-                    val = None if v is _TOMBSTONE else v
-                    break
-            else:
-                val = _replace_segment_lookup(segments, key)
-            out.append(val)
+            for idx, key in enumerate(keys):
+                for m in mems:
+                    v = m.get(key)
+                    if v is not None:
+                        out.append(None if v is _TOMBSTONE else v)
+                        break
+                else:
+                    out.append(None)
+                    misses.append(idx)
+        for idx in misses:
+            out[idx] = _replace_segment_lookup(segments, keys[idx])
         return out
 
     def get_set(self, key: bytes) -> set:
