@@ -439,6 +439,58 @@ def sec_selection_microbench(ctx):
     return out
 
 
+def sec_tracing_overhead(ctx):
+    """Per-query cost of the observability substrate (ISSUE 2 gate):
+    untraced calls pay only no-op contextvar reads through every span
+    point, and an UNSAMPLED trace adds no device synchronization — only
+    sampled traces (?trace=true / TRACE_SAMPLE_RATE) buy block_until_
+    ready device attribution. Host-dispatch-dominated sizing on purpose:
+    the overhead under test is Python-side, not kernel-side."""
+    import numpy as np
+
+    from weaviate_tpu.engine.flat import FlatIndex
+    from weaviate_tpu.runtime import tracing
+
+    rng = np.random.default_rng(7)
+    idx = FlatIndex(dim=64, capacity=8192)
+    idx.add_batch(np.arange(4096),
+                  rng.standard_normal((4096, 64)).astype(np.float32))
+    q = rng.standard_normal((8, 64)).astype(np.float32)
+    for _ in range(10):
+        idx.search_by_vector_batch(q, 10)
+
+    def best_ms(fn, reps=50, rounds=5):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1e3
+
+    plain = best_ms(lambda: idx.search_by_vector_batch(q, 10))
+
+    def traced(force):
+        with tracing.trace("bench.query", force=force):
+            idx.search_by_vector_batch(q, 10)
+
+    unsampled = best_ms(lambda: traced(False))
+    sampled = best_ms(lambda: traced(True))
+    tracing.clear_traces()
+    out = {
+        "plain_ms": round(plain, 4),
+        "unsampled_trace_ms": round(unsampled, 4),
+        "sampled_trace_ms": round(sampled, 4),
+        "unsampled_overhead_ms": round(unsampled - plain, 4),
+        "unsampled_overhead_frac": round(
+            max(unsampled - plain, 0.0) / max(plain, 1e-9), 4),
+    }
+    log(f"[tracing] plain {plain:.3f} ms, unsampled trace "
+        f"{unsampled:.3f} ms (+{out['unsampled_overhead_ms']:.3f}), "
+        f"sampled {sampled:.3f} ms")
+    return out
+
+
 def sec_quantized(ctx):
     import numpy as np
 
@@ -694,6 +746,7 @@ SECTIONS = [
     ("device_steady", sec_device_steady, ("x", "rtt_s")),
     ("selection_microbench", sec_selection_microbench, ("x", "rtt_s")),
     ("quantized", sec_quantized, ("x", "rtt_s")),
+    ("tracing_overhead", sec_tracing_overhead, ()),
     ("kernel_conformance", sec_conformance, ("rng",)),
     ("serving_fabric", sec_fabric, ()),
 ]

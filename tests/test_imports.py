@@ -1,0 +1,31 @@
+"""Import every weaviate_tpu module under the virtual-CPU platform.
+
+Import-time regressions (a moved jax symbol, a renamed kwarg, a missing
+guard around an optional dep — e.g. the pre-PR-1 shard_map breakage)
+previously surfaced as pytest COLLECTION errors, which
+--continue-on-collection-errors quietly skips past. This makes them a
+loud tier-1 failure naming the exact module.
+"""
+
+import importlib
+import pkgutil
+
+import weaviate_tpu
+
+
+def test_import_every_module():
+    failures = []
+    for mod in pkgutil.walk_packages(weaviate_tpu.__path__,
+                                     prefix="weaviate_tpu."):
+        name = mod.name
+        if name.endswith("__main__"):
+            continue  # importing it starts the server
+        if name.rsplit(".", 1)[-1].startswith("lib"):
+            # ctypes-loaded shared objects (libweaviate_native.so,
+            # libwvdataplane.so), not Python extension modules
+            continue
+        try:
+            importlib.import_module(name)
+        except Exception as e:  # noqa: BLE001 — collect them all
+            failures.append(f"{name}: {type(e).__name__}: {e}")
+    assert not failures, "modules failed to import:\n" + "\n".join(failures)

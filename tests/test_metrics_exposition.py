@@ -1,0 +1,151 @@
+"""Prometheus text-exposition correctness: escaping, histogram lines,
+and scrape-vs-writer concurrency (ISSUE 2 satellites)."""
+
+import re
+import threading
+
+from weaviate_tpu.runtime.metrics import (MetricsRegistry,
+                                          escape_label_value)
+
+
+def _unescape(v: str) -> str:
+    """Inverse of the text-format label escaping (what a Prometheus
+    parser applies)."""
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            n = v[i + 1]
+            if n == "\\":
+                out.append("\\")
+            elif n == '"':
+                out.append('"')
+            elif n == "n":
+                out.append("\n")
+            else:
+                out.append(c + n)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def test_label_escaping_round_trip():
+    nasty = 'a"b\\c\nd'
+    escaped = escape_label_value(nasty)
+    assert "\n" not in escaped  # a raw newline would corrupt the scrape
+    assert _unescape(escaped) == nasty
+
+    reg = MetricsRegistry()
+    c = reg.counter("objs", "objects", ("collection",))
+    c.labels(nasty).inc(2)
+    text = reg.expose()
+    # one sample line, no stray lines from the embedded newline
+    sample_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("objs{")]
+    assert len(sample_lines) == 1
+    m = re.match(r'objs\{collection="(.*)"\} 2\.0', sample_lines[0])
+    assert m, sample_lines[0]
+    assert _unescape(m.group(1)) == nasty
+
+
+def test_help_escaping():
+    reg = MetricsRegistry()
+    reg.counter("c", "line one\nline two \\ backslash").inc()
+    help_lines = [ln for ln in reg.expose().splitlines()
+                  if ln.startswith("# HELP c ")]
+    assert help_lines == ["# HELP c line one\\nline two \\\\ backslash"]
+
+
+def test_histogram_exposition_lines():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "latency", ("op",), buckets=(0.1, 1.0))
+    child = h.labels('scan"fast')
+    child.observe(0.05)
+    child.observe(0.5)
+    child.observe(5.0)
+    text = reg.expose()
+    esc = escape_label_value('scan"fast')
+    assert f'lat_bucket{{op="{esc}",le="0.1"}} 1' in text
+    assert f'lat_bucket{{op="{esc}",le="1.0"}} 2' in text
+    assert f'lat_bucket{{op="{esc}",le="+Inf"}} 3' in text
+    assert f'lat_count{{op="{esc}"}} 3' in text
+    sum_line = [ln for ln in text.splitlines()
+                if ln.startswith(f'lat_sum{{op="{esc}"}}')]
+    assert len(sum_line) == 1
+    assert abs(float(sum_line[0].rsplit(" ", 1)[1]) - 5.55) < 1e-9
+
+
+def test_concurrent_labels_vs_expose():
+    """labels() inserts racing expose() iteration must neither raise nor
+    emit malformed lines."""
+    reg = MetricsRegistry()
+    c = reg.counter("ops", "ops", ("who",))
+    stop = threading.Event()
+    errors = []
+
+    def writer(n):
+        i = 0
+        while not stop.is_set():
+            try:
+                c.labels(f"w{n}-{i % 50}").inc()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(n,))
+               for n in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(50):
+            text = reg.expose()
+            for ln in text.splitlines():
+                if ln.startswith("#") or not ln:
+                    continue
+                assert re.match(r'^[a-zA-Z_:][\w:]*(\{.*\})? \S+$', ln), ln
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(5)
+    assert not errors
+
+
+def test_rest_metrics_endpoint_serves_text(tmp_path):
+    import urllib.request
+
+    from weaviate_tpu.api.rest import RestServer
+    from weaviate_tpu.db.database import Database
+
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    try:
+        resp = urllib.request.urlopen(f"http://{srv.address}/v1/metrics")
+        ctype = resp.headers.get("Content-Type", "")
+        assert ctype.startswith("text/plain")
+        assert "version=0.0.4" in ctype
+        body = resp.read().decode()
+        assert "# TYPE weaviate_tpu_query_duration_seconds histogram" \
+            in body
+    finally:
+        srv.stop()
+        db.close()
+
+
+def test_machine_id_persists_across_boots(tmp_path):
+    from weaviate_tpu.runtime.telemetry import Telemeter
+
+    class _Db:
+        def list_collections(self):
+            return []
+
+    t1 = Telemeter(_Db(), data_dir=str(tmp_path))
+    t2 = Telemeter(_Db(), data_dir=str(tmp_path))
+    assert t1.machine_id == t2.machine_id
+    assert (tmp_path / "machine_id").read_text().strip() == t1.machine_id
+    # no data dir -> ephemeral, but still a valid uuid-ish string
+    t3 = Telemeter(_Db())
+    assert t3.machine_id and t3.machine_id != t1.machine_id

@@ -171,20 +171,20 @@ def test_cluster_statistics_standalone(tmp_path):
 def test_slow_query_logging(tmp_path, monkeypatch, caplog):
     import logging
 
-    import weaviate_tpu.db.collection as collection_mod
+    from weaviate_tpu.runtime import tracing
 
-    # parser unit checks
+    # parser unit checks (one source of truth: runtime/tracing.py)
     monkeypatch.setenv("QUERY_SLOW_LOG_ENABLED", "enabled")
     monkeypatch.setenv("QUERY_SLOW_LOG_THRESHOLD", "250ms")
-    assert collection_mod._slow_query_threshold() == pytest.approx(0.25)
+    assert tracing._compute_slow_threshold() == pytest.approx(0.25)
     monkeypatch.setenv("QUERY_SLOW_LOG_THRESHOLD", "3s")
-    assert collection_mod._slow_query_threshold() == pytest.approx(3.0)
+    assert tracing._compute_slow_threshold() == pytest.approx(3.0)
     monkeypatch.setenv("QUERY_SLOW_LOG_ENABLED", "false")
-    assert collection_mod._slow_query_threshold() == 0.0
+    assert tracing._compute_slow_threshold() == 0.0
     # env set AFTER import still applies (threshold is lazily cached)
     monkeypatch.setenv("QUERY_SLOW_LOG_ENABLED", "true")
     monkeypatch.setenv("QUERY_SLOW_LOG_THRESHOLD", "0.0001")
-    monkeypatch.setattr(collection_mod, "_SLOW_THRESHOLD", None)
+    tracing.reset_policy_for_tests()
     from weaviate_tpu.api.rest import config_from_json
     from weaviate_tpu.db.database import Database
 
@@ -201,3 +201,4 @@ def test_slow_query_logging(tmp_path, monkeypatch, caplog):
                    for r in caplog.records)
     finally:
         db.close()
+        tracing.reset_policy_for_tests()  # drop the cached 0.1ms threshold

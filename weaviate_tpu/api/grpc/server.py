@@ -312,7 +312,7 @@ class GrpcServer:
         method_handlers = {}
         for name, fn in handlers.items():
             method_handlers[name] = grpc.unary_unary_rpc_method_handler(
-                self._wrap(fn, verbs[name]),
+                self._wrap(fn, verbs[name], name),
                 request_deserializer=req_types[name].FromString,
                 response_serializer=lambda resp: resp.SerializeToString(),
             )
@@ -347,11 +347,24 @@ class GrpcServer:
 
     # -- plumbing -----------------------------------------------------------
 
-    def _wrap(self, fn, verb: str = "write"):
+    def _wrap(self, fn, verb: str = "write", rpc_name: str = "rpc"):
+        from weaviate_tpu.runtime import tracing
+
         def handler(request, context):
+            # request root trace; clients force device-time sampling by
+            # sending an "x-trace: true" metadata key (the gRPC analog
+            # of the REST ?trace=true param)
             try:
+                md = dict(context.invocation_metadata() or [])
+            except Exception:  # noqa: BLE001 — tests stub the context
+                md = {}
+            force = md.get("x-trace") == "true"
+            try:
+                # auth precedes the trace: rejected clients must not be
+                # able to fill the debug-trace ring
                 self._check_auth(context, verb)
-                return fn(request, context)
+                with tracing.trace(f"grpc.{rpc_name}", force=force):
+                    return fn(request, context)
             except ApiError as e:
                 context.abort(e.code, e.message)
             except KeyError as e:

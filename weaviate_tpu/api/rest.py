@@ -10,6 +10,7 @@ set and JSON shapes mirror the reference handlers
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import threading
@@ -24,6 +25,7 @@ from weaviate_tpu import __version__ as VERSION
 API_VERSION = "1.25.2"
 from weaviate_tpu.db.shard import ShardReadOnlyError
 from weaviate_tpu.filters.filters import Filter
+from weaviate_tpu.runtime import tracing
 from weaviate_tpu.schema.config import CollectionConfig, Property
 
 logger = logging.getLogger(__name__)
@@ -34,6 +36,39 @@ class ApiError(Exception):
         super().__init__(message)
         self.status = status
         self.message = message
+
+
+class RawResponse:
+    """Non-JSON dispatch result (e.g. Prometheus text exposition)."""
+
+    __slots__ = ("body", "content_type")
+
+    def __init__(self, body: bytes, content_type: str):
+        self.body = body
+        self.content_type = content_type
+
+
+# the fixed REST route classes — root-span names (which become
+# span_duration label values) must come from this closed set, never from
+# raw client paths, or a URL scanner inflates the metrics registry
+# without bound
+_ROUTE_CLASSES = frozenset((
+    ".well-known", "meta", "metrics", "nodes", "cluster",
+    "tenant-activity", "graphql", "schema", "objects", "batch",
+    "backups", "classifications", "debug"))
+# probe/scrape/introspection routes: health checks and metrics scrapes
+# arrive every few seconds in production and would evict real query
+# traces from the debug ring — they are not traced unless forced
+_UNTRACED_ROUTES = frozenset(
+    (".well-known", "meta", "metrics", "nodes", "debug", "unmatched"))
+
+
+def _route_class(path: str) -> str:
+    segs = [s for s in path.split("/") if s]
+    if segs and segs[0] == "v1":
+        segs = segs[1:]
+    head = segs[0] if segs else ".well-known"
+    return head if head in _ROUTE_CLASSES else "unmatched"
 
 
 def object_to_json(class_name: str, obj, tenant: str | None = None) -> dict:
@@ -356,6 +391,19 @@ class RestServer:
                           urllib.parse.parse_qs(parsed.query).items()}
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length) if length else b""
+                # every data-path request gets a root trace (cheap spans
+                # are always on); ?trace=true forces device-time
+                # sampling. Probe/scrape routes skip tracing (unless
+                # forced) so they can't flood the debug ring, and auth
+                # runs BEFORE the trace opens — unauthenticated clients
+                # must not be able to evict real traces from the ring.
+                force = params.get("trace") == "true"
+                route = _route_class(parsed.path)
+                if route in _UNTRACED_ROUTES and not force:
+                    trace_cm = contextlib.nullcontext()
+                else:
+                    trace_cm = tracing.trace(f"rest.{method} /{route}",
+                                             force=force)
                 try:
                     if outer.auth is not None and \
                             not parsed.path.startswith("/.well-known"):
@@ -364,20 +412,22 @@ class RestServer:
                             ForbiddenError,
                         )
 
-                        # POST /v1/graphql is query-only (this API has no
-                        # mutations) — same verb as gRPC Search
+                        # POST /v1/graphql is query-only (this API has
+                        # no mutations) — same verb as gRPC Search
                         verb = "read" if method in ("GET", "HEAD") \
                             or parsed.path == "/v1/graphql" else "write"
                         try:
                             outer.auth.check(
-                                self.headers.get("Authorization"), verb)
+                                self.headers.get("Authorization"),
+                                verb)
                         except AuthError as e:
                             raise ApiError(401, str(e))
                         except ForbiddenError as e:
                             raise ApiError(403, str(e))
-                    body = json.loads(raw) if raw else None
-                    status, payload = outer.dispatch(method, parsed.path,
-                                                     params, body)
+                    with trace_cm:
+                        body = json.loads(raw) if raw else None
+                        status, payload = outer.dispatch(
+                            method, parsed.path, params, body)
                 except ApiError as e:
                     status, payload = e.status, {"error": [{"message": e.message}]}
                 except (KeyError, FileNotFoundError) as e:
@@ -389,6 +439,15 @@ class RestServer:
                 except Exception as e:
                     logger.exception("REST %s %s failed", method, self.path)
                     status, payload = 500, {"error": [{"message": str(e)}]}
+                if isinstance(payload, RawResponse):
+                    self.send_response(status)
+                    self.send_header("Content-Type", payload.content_type)
+                    self.send_header("Content-Length",
+                                     str(len(payload.body)))
+                    self.end_headers()
+                    if method != "HEAD":
+                        self.wfile.write(payload.body)
+                    return
                 data = b"" if payload is None else json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -472,9 +531,22 @@ class RestServer:
                          "modules": self.modules.meta()
                          if self.modules is not None else {}}
         if seg == ["metrics"]:
+            # real Prometheus text exposition (the reference serves text
+            # on the monitoring port; serving it here too lets Prometheus
+            # scrape either port). A JSON wrapper would not parse.
             from weaviate_tpu.runtime.metrics import registry
 
-            return 200, {"text": registry.expose()}
+            return 200, RawResponse(
+                registry.expose().encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
+        if seg == ["debug", "traces"]:
+            # finished-trace ring buffer (tracing tentpole; sampled
+            # traces carry device_ms attribution)
+            try:
+                limit = int(params.get("limit", 50))
+            except ValueError:
+                raise ApiError(422, "limit must be an integer")
+            return 200, {"traces": tracing.recent_traces(limit)}
         if seg == ["nodes"]:
             verbose = params.get("output") == "verbose"
             return 200, {"nodes": self._nodes_payload(verbose=verbose)}
@@ -512,7 +584,17 @@ class RestServer:
         if seg == ["graphql"] and method == "POST":
             if self.graphql_executor is None:
                 raise ApiError(501, "graphql not enabled")
-            return 200, self.graphql_executor(body or {})
+            out = self.graphql_executor(body or {})
+            if isinstance(out, dict) and params.get("trace") == "true" \
+                    and tracing.is_sampled():
+                # the inline breakdown rides ONLY explicitly requested
+                # (?trace=true) responses — background TRACE_SAMPLE_RATE
+                # sampling must not change response shapes clients see
+                out["_debug"] = {
+                    "traceId": tracing.current_trace_id(),
+                    "timing": tracing.current_timing(),
+                }
+            return 200, out
         if seg[:1] == ["schema"]:
             return self._schema(method, seg[1:], body)
         if seg[:1] == ["objects"]:

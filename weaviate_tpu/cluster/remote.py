@@ -19,6 +19,7 @@ import logging
 import numpy as np
 
 from weaviate_tpu.cluster.transport import rpc
+from weaviate_tpu.runtime import tracing
 from weaviate_tpu.storage.objects import StorageObject
 
 logger = logging.getLogger(__name__)
@@ -34,9 +35,11 @@ class RemoteShardClient:
 
     def _call(self, node: str, collection: str, shard: str, op: str,
               payload: dict) -> dict:
-        return rpc(self.resolver(node),
-                   f"/indices/{collection}/{shard}/{op}", payload,
-                   timeout=self.timeout)
+        with tracing.span("remote.shard_op", op=op, node=node,
+                          shard=shard):
+            return rpc(self.resolver(node),
+                       f"/indices/{collection}/{shard}/{op}", payload,
+                       timeout=self.timeout)
 
     def search_shard(self, node: str, collection: str, shard: str, *,
                      vector=None, k: int = 10, vec_name: str = "",

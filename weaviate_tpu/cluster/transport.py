@@ -24,7 +24,33 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from weaviate_tpu.runtime import tracing
+
 logger = logging.getLogger(__name__)
+
+# response header carrying the serving node's finished spans back to the
+# caller (base64 json) so a distributed query stitches into ONE trace
+TRACE_SPANS_HEADER = "X-Trace-Spans"
+
+
+def _encode_spans(spans: list[dict] | None) -> str | None:
+    if not spans:
+        return None
+    try:
+        return base64.b64encode(
+            json.dumps(spans, separators=(",", ":")).encode()).decode()
+    except (TypeError, ValueError):
+        return None
+
+
+def _decode_spans(header: str | None) -> list[dict] | None:
+    if not header:
+        return None
+    try:
+        out = json.loads(base64.b64decode(header))
+        return out if isinstance(out, list) else None
+    except (ValueError, TypeError):
+        return None  # a corrupt trace header must never fail the RPC
 
 
 # -- numpy-aware JSON encoding -------------------------------------------------
@@ -93,9 +119,16 @@ class InternalServer:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length)
+                # adopt an incoming traceparent: spans recorded while
+                # handling chain to the caller's span and are exported
+                # back in the response for cross-node stitching
+                seg = None
                 try:
                     payload = loads(raw) if raw else {}
-                    result = outer.dispatch(self.path, payload)
+                    with tracing.remote_segment(
+                            self.headers.get("traceparent"),
+                            name="rpc.server", path=self.path) as seg:
+                        result = outer.dispatch(self.path, payload)
                     body = dumps(result)
                     code = 200
                 except KeyError as e:
@@ -108,6 +141,10 @@ class InternalServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                exported = _encode_spans(
+                    seg.export() if seg is not None else None)
+                if exported is not None:
+                    self.send_header(TRACE_SPANS_HEADER, exported)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -165,24 +202,35 @@ class RpcError(RuntimeError):
 
 def rpc(addr: str, path: str, payload=None, timeout: float = 10.0):
     """POST ``payload`` to http://addr/path; raises RpcError on transport
-    or handler failure."""
+    or handler failure. Inside a trace the call carries a ``traceparent``
+    header and absorbs the remote node's exported spans on return."""
     host, _, port = addr.partition(":")
     body = dumps(payload or {})
-    try:
-        conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    headers = {"Content-Type": "application/json"}
+    with tracing.span("rpc.client", addr=addr, path=path) as sp:
+        tp = tracing.current_traceparent()
+        if tp is not None:
+            headers["traceparent"] = tp
         try:
-            conn.request("POST", path, body=body,
-                         headers={"Content-Type": "application/json"})
-            resp = conn.getresponse()
-            raw = resp.read()
-        finally:
-            conn.close()
-    except (ConnectionError, socket.timeout, OSError) as e:
-        raise RpcError(f"rpc to {addr}{path} failed: {e}") from e
-    result = loads(raw)
-    if resp.status != 200:
-        raise RpcError(
-            result.get("error", f"status {resp.status}") if isinstance(result, dict)
-            else f"status {resp.status}",
-            status=resp.status)
-    return result
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=timeout)
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                remote_spans = _decode_spans(
+                    resp.getheader(TRACE_SPANS_HEADER))
+            finally:
+                conn.close()
+        except (ConnectionError, socket.timeout, OSError) as e:
+            raise RpcError(f"rpc to {addr}{path} failed: {e}") from e
+        if remote_spans:
+            tracing.absorb(remote_spans,
+                           base_ms=getattr(sp, "start_ms", 0.0))
+        result = loads(raw)
+        if resp.status != 200:
+            raise RpcError(
+                result.get("error", f"status {resp.status}")
+                if isinstance(result, dict) else f"status {resp.status}",
+                status=resp.status)
+        return result

@@ -20,6 +20,7 @@ import numpy as np
 from weaviate_tpu.db.shard import Shard
 from weaviate_tpu.db.sharding import ShardingState
 from weaviate_tpu.runtime import metrics as monitoring
+from weaviate_tpu.runtime import tracing
 from weaviate_tpu.schema.config import CollectionConfig
 from weaviate_tpu.storage.objects import StorageObject
 
@@ -48,52 +49,25 @@ def _remote_result(item: dict, shard_name: str) -> "SearchResult":
         object=StorageObject.from_bytes(raw) if raw else None)
 
 
-def _slow_query_threshold() -> float:
-    """Slow-query logging (reference: helpers/slow_queries.go — env
-    QUERY_SLOW_LOG_ENABLED + QUERY_SLOW_LOG_THRESHOLD). 0 = disabled."""
-    import os
-
-    from weaviate_tpu.config import _flag
-
-    if not _flag(os.environ, "QUERY_SLOW_LOG_ENABLED"):
-        return 0.0
-    raw = os.environ.get("QUERY_SLOW_LOG_THRESHOLD", "2s").strip()
-    try:
-        if raw.endswith("ms"):
-            return float(raw[:-2]) / 1000.0
-        if raw.endswith("s"):
-            return float(raw[:-1])
-        return float(raw)
-    except ValueError:
-        return 2.0
-
-
-# lazily cached on first query so env set after import still applies;
-# None = not yet computed
-_SLOW_THRESHOLD: float | None = None
-
-
-def _get_slow_threshold() -> float:
-    global _SLOW_THRESHOLD
-    if _SLOW_THRESHOLD is None:
-        _SLOW_THRESHOLD = _slow_query_threshold()
-    return _SLOW_THRESHOLD
-
-
 def _timed(query_type: str):
     """Record query latency per collection (reference: monitoring
     query-duration metric vecs, usecases/monitoring/prometheus.go) and
-    log queries slower than the configured threshold."""
+    log queries slower than the configured threshold (parsed once in
+    runtime/tracing.py — one source for QUERY_SLOW_LOG_*)."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, *args, **kwargs):
             t0 = time.perf_counter()
             with monitoring.query_duration.labels(self.config.name,
-                                                  query_type).time():
+                                                  query_type).time(), \
+                    tracing.span(f"query.{query_type}",
+                                 collection=self.config.name):
                 out = fn(self, *args, **kwargs)
-            threshold = _get_slow_threshold()
-            if threshold > 0:
+            threshold = tracing.get_slow_threshold()
+            # inside a trace the ROOT logs slow queries with the full
+            # span breakdown — logging here too would double-report
+            if threshold > 0 and not tracing.is_active():
                 took = time.perf_counter() - t0
                 if took >= threshold:
                     import logging
@@ -753,7 +727,7 @@ class Collection:
 
             names = self._target_shard_names(tenant)
             partials = [one(names[0])] if len(names) == 1 else \
-                list(self._pool.map(one, names))
+                list(self._pool.map(tracing.propagate(one), names))
         return finalize_aggregation(combine_partials(partials), requested,
                                     top_occurrences_limit)
 
@@ -767,17 +741,23 @@ class Collection:
         for r in results:
             if r.object is None:
                 missing.setdefault(r.shard, []).append(r)
-        for name, rs in missing.items():
-            if self._is_local(name):
-                shard = self._load_shard(name)
-                for r in rs:
-                    r.object = shard.get_object(r.uuid)
-            else:
-                raws = self._require_remote(name).get_objects(
-                    self._read_node(name), self.config.name, name,
-                    [r.uuid for r in rs])
-                for r, raw in zip(rs, raws):
-                    r.object = StorageObject.from_bytes(raw) if raw else None
+        if not missing:
+            return
+        with tracing.span("objects.fetch",
+                          n=sum(len(rs) for rs in missing.values()),
+                          shards=len(missing)):
+            for name, rs in missing.items():
+                if self._is_local(name):
+                    shard = self._load_shard(name)
+                    for r in rs:
+                        r.object = shard.get_object(r.uuid)
+                else:
+                    raws = self._require_remote(name).get_objects(
+                        self._read_node(name), self.config.name, name,
+                        [r.uuid for r in rs])
+                    for r, raw in zip(rs, raws):
+                        r.object = StorageObject.from_bytes(raw) \
+                            if raw else None
 
     @staticmethod
     def _and_masks(a, b) -> np.ndarray:
@@ -869,7 +849,7 @@ class Collection:
             return [_remote_result(i, name) for i in items]
 
         gathered = [one(names[0])] if len(names) == 1 else \
-            list(self._pool.map(one, names))
+            list(self._pool.map(tracing.propagate(one), names))
 
         merged = self._merge_by_distance(gathered, k)
         if max_distance is not None:
@@ -916,7 +896,7 @@ class Collection:
             return [_remote_result(i, name) for i in items]
 
         gathered = [one(names[0])] if len(names) == 1 else \
-            list(self._pool.map(one, names))
+            list(self._pool.map(tracing.propagate(one), names))
 
         merged = [r for results in gathered for r in results]
         merged.sort(key=lambda r: -r.score)
@@ -973,17 +953,20 @@ class Collection:
                 errors[name] = e
 
         # legs skip object fetch; only the fused top-k pays for it below
+        # (tracing.propagate: Thread targets don't inherit contextvars)
         threads = []
         if alpha < 1.0:
             threads.append(_threading.Thread(
-                target=run, args=("sparse", self.bm25, query, fetch,
-                                  properties, tenant, False, allow_by_shard,
-                                  where_down)))
+                target=tracing.propagate(run),
+                args=("sparse", self.bm25, query, fetch,
+                      properties, tenant, False, allow_by_shard,
+                      where_down)))
         if vector is not None and alpha > 0.0:
             threads.append(_threading.Thread(
-                target=run, args=("dense", self.near_vector, vector, fetch,
-                                  vec_name, tenant, False, allow_by_shard,
-                                  None, where_down)))
+                target=tracing.propagate(run),
+                args=("dense", self.near_vector, vector, fetch,
+                      vec_name, tenant, False, allow_by_shard,
+                      None, where_down)))
         for t in threads:
             t.start()
         for t in threads:

@@ -16,6 +16,7 @@ import threading
 import numpy as np
 
 from weaviate_tpu.engine.flat import FlatIndex
+from weaviate_tpu.runtime import tracing
 from weaviate_tpu.schema.config import CollectionConfig, VectorConfig
 from weaviate_tpu.storage.kv import KVStore
 from weaviate_tpu.storage.objects import StorageObject
@@ -534,6 +535,12 @@ class Shard:
         idx = self.vector_indexes.get(vec_name)
         if idx is None:
             return np.empty(0, np.int64), np.empty(0, np.float32)
+        with tracing.span("shard.vector_search", shard=self.name, k=k,
+                          filtered=allow_list is not None):
+            return self._vector_search_traced(idx, query, k, vec_name,
+                                              allow_list)
+
+    def _vector_search_traced(self, idx, query, k, vec_name, allow_list):
         # snapshot BEFORE the index search: every queued vector is either
         # in the snapshot or already drained into the index by the time
         # the index search runs — the union misses nothing (the reverse
@@ -670,13 +677,16 @@ class Shard:
         """(doc_ids, scores) keyword search (reference: shard ObjectSearch →
         inverted.BM25Searcher). ``allow_mask`` accepts either form the
         vector path does: bool mask or doc-id array."""
-        if allow_mask is not None:
-            allow_mask = np.asarray(allow_mask)
-            if allow_mask.dtype != np.bool_:
-                ids = allow_mask.astype(np.int64)
-                allow_mask = np.zeros(self.doc_id_space, dtype=bool)
-                allow_mask[ids[ids < len(allow_mask)]] = True
-        return self._inverted.bm25_search(query, k, properties, allow_mask)
+        with tracing.span("shard.bm25_search", shard=self.name, k=k,
+                          filtered=allow_mask is not None):
+            if allow_mask is not None:
+                allow_mask = np.asarray(allow_mask)
+                if allow_mask.dtype != np.bool_:
+                    ids = allow_mask.astype(np.int64)
+                    allow_mask = np.zeros(self.doc_id_space, dtype=bool)
+                    allow_mask[ids[ids < len(allow_mask)]] = True
+            return self._inverted.bm25_search(query, k, properties,
+                                              allow_mask)
 
     @property
     def doc_id_space(self) -> int:
@@ -691,8 +701,10 @@ class Shard:
             return None
         from weaviate_tpu.filters import compute_allow_mask
 
-        with self._lock:
-            return compute_allow_mask(where, self._inverted, self.doc_id_space)
+        with tracing.span("shard.allow_mask", shard=self.name):
+            with self._lock:
+                return compute_allow_mask(where, self._inverted,
+                                          self.doc_id_space)
 
     def set_read_only(self, value: bool) -> None:
         """Persisted so a restart keeps the freeze (reference persists

@@ -20,6 +20,7 @@ import numpy as np
 
 from weaviate_tpu import native
 from weaviate_tpu.engine.store import DeviceVectorStore
+from weaviate_tpu.runtime import tracing
 
 
 class FlatIndex:
@@ -141,21 +142,28 @@ class FlatIndex:
         """
         # The index lock spans search + id resolution so a concurrent
         # compact() can't remap slots between the scan and _resolve.
-        with self._lock:
-            allow_mask = self._allow_mask(allow_list)
-            d, slots = self.store.search(np.asarray(query), k, allow_mask)
-            return self._resolve(d, slots, k)
+        with tracing.span("flat.search", k=k,
+                          filtered=allow_list is not None):
+            with self._lock:
+                allow_mask = self._allow_mask(allow_list)
+                d, slots = self.store.search(np.asarray(query), k,
+                                             allow_mask)
+                return self._resolve(d, slots, k)
 
     def search_by_vector_batch(self, queries: np.ndarray, k: int,
                                allow_list: np.ndarray | None = None):
         """Batched query path — amortizes one matmul across B queries.
 
         Returns (doc_ids [B,k] int64 with -1 padding, dists [B,k])."""
-        with self._lock:
-            allow_mask = self._allow_mask(allow_list)
-            d, slots = self.store.search(np.asarray(queries), k, allow_mask)
-            ids = np.where(slots >= 0, self._slot_to_id_safe(slots), -1)
-            return ids, d
+        with tracing.span("flat.search_batch", k=k,
+                          queries=len(np.atleast_2d(queries))):
+            with self._lock:
+                allow_mask = self._allow_mask(allow_list)
+                d, slots = self.store.search(np.asarray(queries), k,
+                                             allow_mask)
+                ids = np.where(slots >= 0, self._slot_to_id_safe(slots),
+                               -1)
+                return ids, d
 
     def search_by_vector_distance(self, query: np.ndarray, max_distance: float,
                                   allow_list: np.ndarray | None = None):

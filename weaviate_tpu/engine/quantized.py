@@ -41,6 +41,7 @@ from weaviate_tpu.ops import bq as bq_ops
 from weaviate_tpu.ops import pq as pq_ops
 from weaviate_tpu.ops.distances import normalize
 from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
+from weaviate_tpu.runtime import tracing
 
 _DEFAULT_CHUNK = 8192
 
@@ -522,28 +523,37 @@ class QuantizedVectorStore:
             or (self.rescore == "device" and self.mesh is None)
             or (self.rescore == "none" and self.fetch_fn is not None)
         )
-        with self._lock:
-            if not self.trained:
-                raise RuntimeError("PQ store not trained; call train() first")
-            capacity = self.capacity
-            valid = self.valid
-            if allow_mask is not None:
-                full = np.zeros(capacity, dtype=bool)
-                full[: len(allow_mask)] = allow_mask[:capacity]
-                valid = jnp.logical_and(valid, self._placed(full))
-            if inline_rescore:
-                k_cand = min(max(k * self.rescore_limit, k), capacity)
-                k_out = min(k, capacity)
-            elif post_rescore:
-                k_cand = min(max(k * self.rescore_limit, k), capacity)
-                k_out = k_cand
-            else:
-                k_cand = min(k, capacity)
-                k_out = k_cand
-            d, i = self._scan(jnp.asarray(queries), k_cand, valid, k_out)
-        d_np, i_np = np.asarray(d), np.asarray(i, dtype=np.int64)
-        if post_rescore:
-            d_np, i_np = self._host_rescore(queries, i_np, k)
+        with tracing.span("store.quantized_scan", rows=self.capacity,
+                          queries=len(queries), k=k,
+                          quantization=self.quantization,
+                          sharded=self.mesh is not None) as sp:
+            with self._lock:
+                if not self.trained:
+                    raise RuntimeError(
+                        "PQ store not trained; call train() first")
+                capacity = self.capacity
+                valid = self.valid
+                if allow_mask is not None:
+                    full = np.zeros(capacity, dtype=bool)
+                    full[: len(allow_mask)] = allow_mask[:capacity]
+                    valid = jnp.logical_and(valid, self._placed(full))
+                if inline_rescore:
+                    k_cand = min(max(k * self.rescore_limit, k), capacity)
+                    k_out = min(k, capacity)
+                elif post_rescore:
+                    k_cand = min(max(k * self.rescore_limit, k), capacity)
+                    k_out = k_cand
+                else:
+                    k_cand = min(k, capacity)
+                    k_out = k_cand
+                d, i = self._scan(jnp.asarray(queries), k_cand, valid,
+                                  k_out)
+            tracing.device_sync(sp, d, i)  # outside the dispatch lock
+            d_np, i_np = np.asarray(d), np.asarray(i, dtype=np.int64)
+            if post_rescore:
+                with tracing.span("store.host_rescore",
+                                  candidates=int(i_np.shape[1])):
+                    d_np, i_np = self._host_rescore(queries, i_np, k)
         out_d = d_np[:, :k].astype(np.float32)
         out_i = i_np[:, :k]
         if squeeze:

@@ -32,6 +32,7 @@ import numpy as np
 
 from weaviate_tpu.ops.distances import normalize
 from weaviate_tpu.ops.topk import chunked_topk_distances
+from weaviate_tpu.runtime import tracing
 from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
 from weaviate_tpu.parallel.sharded_search import (
     replicate_array,
@@ -332,65 +333,90 @@ class DeviceVectorStore:
         squeeze = queries.ndim == 1
         if squeeze:
             queries = queries[None, :]
-        # Dispatch happens under the lock: writers *donate* the store buffers,
-        # which invalidates any handle a concurrent reader grabbed but hasn't
-        # dispatched against yet. Execution is async, so the lock only covers
-        # the (cheap) dispatch — materialization waits outside.
-        with self._lock:
-            self._flush_staged_locked()
-            vectors, valid, norms = self.vectors, self.valid, self.sq_norms
-            capacity = self.capacity
-            if allow_mask is not None:
-                allowed = np.flatnonzero(allow_mask)
-                # selectivity policy (measured, tools/bench_filtered.py —
-                # BASELINE r5, hoist-proof harness): masked full scan is
-                # selectivity-independent (~11.1 ms at 1M×128 B=256);
-                # gather is ~1.4 ms + linear (5.2 ms at 10%, 23 ms at
-                # 50%) — crossover ≈22%, policy cut at capacity/8 with a
-                # 1 GB transient-gather HBM budget computed on the
-                # PADDED pow2 bucket at the actual storage dtype
-                m_allowed = len(allowed)
-                bucket = 1 << max(7, (m_allowed - 1).bit_length()) \
-                    if m_allowed else 0
-                row_bytes = self.dim * jnp.dtype(
-                    self.vectors.dtype).itemsize
-                if (self.mesh is None and m_allowed > 0
-                        and m_allowed <= capacity // 8
-                        and bucket * row_bytes <= (1 << 30)):
-                    return self._search_gathered(queries, k, allowed,
-                                                 squeeze)
-                full = np.zeros(capacity, dtype=bool)
-                full[: len(allow_mask)] = allow_mask
-                valid = jnp.logical_and(valid, self._placed(full))
-            k_eff = min(k, capacity)
-            # cosine runs as "cosine" against rows normalized at insert
-            # (the query side is normalized inside the kernel)
-            metric = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
-            cs = min(self.chunk_size, capacity // self.n_shards)
-            if self.mesh is None:
-                d, i = chunked_topk_distances(
-                    jnp.asarray(queries), vectors, k=k_eff, chunk_size=cs,
-                    metric=metric, valid=valid, x_sq_norms=norms,
-                    use_pallas=self.use_pallas, selection=self.selection,
-                )
-            else:
-                d, i = sharded_topk(
-                    jnp.asarray(queries), vectors, valid, norms,
-                    k=k_eff, chunk_size=cs, metric=metric, mesh=self.mesh,
-                    use_pallas=self.use_pallas, selection=self.selection,
-                )
-        d_np, i_np = np.asarray(d), np.asarray(i)
+        with tracing.span("store.scan", rows=self.capacity,
+                          queries=len(queries), k=k,
+                          sharded=self.mesh is not None) as sp:
+            # Dispatch happens under the lock: writers *donate* the store
+            # buffers, which invalidates any handle a concurrent reader
+            # grabbed but hasn't dispatched against yet. Execution is
+            # async, so the lock only covers the (cheap) dispatch —
+            # materialization waits outside.
+            with self._lock:
+                self._flush_staged_locked()
+                vectors, valid, norms = (self.vectors, self.valid,
+                                         self.sq_norms)
+                capacity = self.capacity
+                if allow_mask is not None:
+                    allowed = np.flatnonzero(allow_mask)
+                    # selectivity policy (measured,
+                    # tools/bench_filtered.py — BASELINE r5, hoist-proof
+                    # harness): masked full scan is selectivity-
+                    # independent (~11.1 ms at 1M×128 B=256); gather is
+                    # ~1.4 ms + linear (5.2 ms at 10%, 23 ms at 50%) —
+                    # crossover ≈22%, policy cut at capacity/8 with a
+                    # 1 GB transient-gather HBM budget computed on the
+                    # PADDED pow2 bucket at the actual storage dtype
+                    m_allowed = len(allowed)
+                    bucket = 1 << max(7, (m_allowed - 1).bit_length()) \
+                        if m_allowed else 0
+                    row_bytes = self.dim * jnp.dtype(
+                        self.vectors.dtype).itemsize
+                    if (self.mesh is None and m_allowed > 0
+                            and m_allowed <= capacity // 8
+                            and bucket * row_bytes <= (1 << 30)):
+                        sp.set(path="gathered", allowed=m_allowed)
+                        d, i, slot_buf = self._dispatch_gathered(
+                            queries, k, allowed)
+                    else:
+                        full = np.zeros(capacity, dtype=bool)
+                        full[: len(allow_mask)] = allow_mask
+                        valid = jnp.logical_and(valid, self._placed(full))
+                        slot_buf = None
+                else:
+                    slot_buf = None
+                if slot_buf is None:
+                    k_eff = min(k, capacity)
+                    # cosine runs as "cosine" against rows normalized at
+                    # insert (the query side is normalized inside the
+                    # kernel)
+                    metric = ("cosine" if self.metric in ("cosine",
+                                                          "cosine-dot")
+                              else self.metric)
+                    cs = min(self.chunk_size, capacity // self.n_shards)
+                    if self.mesh is None:
+                        d, i = chunked_topk_distances(
+                            jnp.asarray(queries), vectors, k=k_eff,
+                            chunk_size=cs, metric=metric, valid=valid,
+                            x_sq_norms=norms, use_pallas=self.use_pallas,
+                            selection=self.selection,
+                        )
+                    else:
+                        d, i = sharded_topk(
+                            jnp.asarray(queries), vectors, valid, norms,
+                            k=k_eff, chunk_size=cs, metric=metric,
+                            mesh=self.mesh, use_pallas=self.use_pallas,
+                            selection=self.selection,
+                        )
+            # device-time attribution and materialization OUTSIDE the
+            # lock — a sync in the dispatch section would serialize
+            # concurrent readers (for the gathered path too)
+            tracing.device_sync(sp, d, i)
+            d_np, i_np = np.asarray(d), np.asarray(i)
+            if slot_buf is not None:
+                d_np, i_np = self._finish_gathered(d_np, i_np, slot_buf, k)
         if squeeze:
             return d_np[0], i_np[0]
         return d_np, i_np
 
-    def _search_gathered(self, queries: np.ndarray, k: int,
-                         allowed: np.ndarray, squeeze: bool):
+    def _dispatch_gathered(self, queries: np.ndarray, k: int,
+                           allowed: np.ndarray):
         """Filtered search at low selectivity: gather the allowed rows
         into a dense pow2-padded buffer on device and scan THAT
         (reference analog: flatSearchCutoff routes small filters to
         brute force over the allow list, hnsw/index.go:95). Called under
-        ``_lock`` by ``search``. Buckets bound compiled variants."""
+        ``_lock`` by ``search``; dispatch only — results materialize
+        outside the lock. Buckets bound compiled variants. Returns
+        (d_dev, i_dev, slot_buf)."""
         m = len(allowed)
         bucket = 1 << max(7, (m - 1).bit_length())
         slot_buf = np.zeros(bucket, dtype=np.int32)
@@ -411,15 +437,31 @@ class DeviceVectorStore:
             x_sq_norms=norms_g, use_pallas=self.use_pallas,
             selection=self.selection,
         )
-        d_np, i_np = np.asarray(d), np.asarray(i)
+        return d, i, slot_buf
+
+    @staticmethod
+    def _finish_gathered(d_np: np.ndarray, i_np: np.ndarray,
+                         slot_buf: np.ndarray, k: int):
+        """Host half of the gathered path: bucket-local indices back to
+        store slots, -1/inf padding up to search()'s [B, k] contract."""
+        bucket = len(slot_buf)
         live = i_np >= 0
         i_np = np.where(live, slot_buf[np.clip(i_np, 0, bucket - 1)], -1)
         if i_np.shape[1] < k:
-            # keep search()'s documented [B, k] shape when k > bucket
             pad = k - i_np.shape[1]
             i_np = np.pad(i_np, ((0, 0), (0, pad)), constant_values=-1)
             d_np = np.pad(d_np, ((0, 0), (0, pad)),
                           constant_values=np.float32(np.inf))
+        return d_np, i_np
+
+    def _search_gathered(self, queries: np.ndarray, k: int,
+                         allowed: np.ndarray, squeeze: bool):
+        """Dispatch + finish in one call (tools/bench_filtered.py drives
+        the gathered path directly through this)."""
+        with self._lock:
+            d, i, slot_buf = self._dispatch_gathered(queries, k, allowed)
+        d_np, i_np = self._finish_gathered(np.asarray(d), np.asarray(i),
+                                           slot_buf, k)
         if squeeze:
             return d_np[0], i_np[0]
         return d_np, i_np

@@ -33,6 +33,7 @@ except ImportError:  # jax 0.4.x: experimental home, check_rep
 
 from weaviate_tpu.ops.topk import chunked_topk_distances, topk_smallest
 from weaviate_tpu.parallel.mesh import SHARD_AXIS
+from weaviate_tpu.runtime import tracing
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
@@ -62,7 +63,7 @@ def _ici_merge_topk(d, ids, axis: str, k_out: int):
         "k", "chunk_size", "metric", "mesh", "axis", "use_pallas", "selection",
     ),
 )
-def sharded_topk(
+def _sharded_topk_jit(
     q: jnp.ndarray,
     x: jnp.ndarray,
     valid: jnp.ndarray,
@@ -118,6 +119,19 @@ def sharded_topk(
     return fn(q, x, valid, x_sq_norms)
 
 
+def sharded_topk(q, x, valid, x_sq_norms, *, k, chunk_size, metric, mesh,
+                 axis=SHARD_AXIS, use_pallas=False, selection="exact"):
+    """Span-wrapped dispatch of the SPMD scan + ICI top-k merge program
+    (spans can't live inside jit; the wrapper times the host-side
+    dispatch and device_sync at the store level attributes execution)."""
+    with tracing.span("spmd.sharded_topk", shards=mesh.shape[axis], k=k,
+                      rows=int(x.shape[0])):
+        return _sharded_topk_jit(
+            q, x, valid, x_sq_norms, k=k, chunk_size=chunk_size,
+            metric=metric, mesh=mesh, axis=axis, use_pallas=use_pallas,
+            selection=selection)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -125,7 +139,7 @@ def sharded_topk(
         "use_pallas", "selection",
     ),
 )
-def sharded_quantized_topk(
+def _sharded_quantized_topk_jit(
     q: jnp.ndarray,
     q_words: jnp.ndarray | None,
     codes: jnp.ndarray,
@@ -224,6 +238,21 @@ def sharded_quantized_topk(
         out_specs=(P(), P()), check_vma=False,
     )
     return sharded(*base_args, rescore_rows)
+
+
+def sharded_quantized_topk(q, q_words, codes, valid, rescore_rows,
+                           centroids, *, k, k_out, chunk_size,
+                           quantization, metric, mesh, axis=SHARD_AXIS,
+                           use_pallas=False, selection="approx"):
+    """Span-wrapped dispatch of the compressed SPMD scan + ICI merge."""
+    with tracing.span("spmd.quantized_topk", shards=mesh.shape[axis],
+                      k=k_out, rows=int(codes.shape[0]),
+                      quantization=quantization):
+        return _sharded_quantized_topk_jit(
+            q, q_words, codes, valid, rescore_rows, centroids, k=k,
+            k_out=k_out, chunk_size=chunk_size, quantization=quantization,
+            metric=metric, mesh=mesh, axis=axis, use_pallas=use_pallas,
+            selection=selection)
 
 
 def shard_array(arr, mesh: Mesh, axis: str = SHARD_AXIS, dim: int = 0):

@@ -12,6 +12,7 @@ import logging
 
 from weaviate_tpu.cluster.transport import RpcError, rpc
 from weaviate_tpu.replication.replicator import ConsistencyError, required_acks
+from weaviate_tpu.runtime import tracing
 from weaviate_tpu.storage.objects import StorageObject
 
 logger = logging.getLogger(__name__)
@@ -60,6 +61,12 @@ class Finder:
                    level: str = "QUORUM") -> StorageObject | None:
         """Read at a consistency level; repairs stale replicas as a side
         effect (reference: Finder.Pull + repairer)."""
+        with tracing.span("replication.read", shard=shard_name,
+                          level=level):
+            return self._get_object(uuid, shard_name, level)
+
+    def _get_object(self, uuid: str, shard_name: str,
+                    level: str) -> StorageObject | None:
         nodes = self.col.sharding.nodes_for(shard_name)
         need = required_acks(level, len(nodes))
         digests: dict[str, dict | None] = {}

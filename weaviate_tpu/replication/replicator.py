@@ -15,6 +15,7 @@ import threading
 import uuid as uuid_mod
 
 from weaviate_tpu.cluster.transport import RpcError, rpc
+from weaviate_tpu.runtime import tracing
 from weaviate_tpu.storage.objects import StorageObject
 
 logger = logging.getLogger(__name__)
@@ -120,20 +121,27 @@ class Replicator:
                     safe_abort(node)
 
         try:
-            prep_futs = {pool.submit(self._prepare, node, shard_name, rid,
-                                     task): node for node in nodes}
-            prepared: list[str] = []
-            errors: list[str] = []
-            pending = set(prep_futs)
-            while pending and len(prepared) < need \
-                    and len(errors) <= len(nodes) - need:
-                done, pending = wait(pending, return_when=FIRST_COMPLETED)
-                for f in done:
-                    node = prep_futs[f]
-                    if f.exception() is None:
-                        prepared.append(node)
-                    else:
-                        errors.append(f"{node}: {f.exception()}")
+            # tracing.propagate: the broadcast runs on pool threads, and
+            # each replica RPC must carry this request's traceparent so
+            # the write yields one stitched trace
+            with tracing.span("replication.prepare", shard=shard_name,
+                              replicas=len(nodes), need=need):
+                prep_futs = {pool.submit(tracing.propagate(self._prepare),
+                                         node, shard_name, rid,
+                                         task): node for node in nodes}
+                prepared: list[str] = []
+                errors: list[str] = []
+                pending = set(prep_futs)
+                while pending and len(prepared) < need \
+                        and len(errors) <= len(nodes) - need:
+                    done, pending = wait(pending,
+                                         return_when=FIRST_COMPLETED)
+                    for f in done:
+                        node = prep_futs[f]
+                        if f.exception() is None:
+                            prepared.append(node)
+                        else:
+                            errors.append(f"{node}: {f.exception()}")
             from weaviate_tpu.runtime.metrics import replication_phase_total
 
             replication_phase_total.labels(
@@ -157,22 +165,28 @@ class Replicator:
                     lambda fut, n=prep_futs[f]: commit_straggler(fut, n))
             # commit phase over the quorum set
 
-            commit_futs = {pool.submit(self._commit, node, shard_name, rid):
-                           node for node in prepared}
-            results: list = []
-            commit_errors: list[str] = []
-            pending = set(commit_futs)
-            while pending and len(results) < need:
-                done, pending = wait(pending, return_when=FIRST_COMPLETED)
-                for f in done:
-                    node = commit_futs[f]
-                    if f.exception() is None:
-                        results.append(f.result())
-                    else:
-                        commit_errors.append(f"{node}: {f.exception()}")
-                        # release any still-staged entry (idempotent if the
-                        # commit half-landed or the node is unreachable)
-                        pool.submit(safe_abort, node)
+            with tracing.span("replication.commit", shard=shard_name,
+                              replicas=len(prepared), need=need):
+                commit_futs = {pool.submit(tracing.propagate(self._commit),
+                                           node, shard_name, rid):
+                               node for node in prepared}
+                results: list = []
+                commit_errors: list[str] = []
+                pending = set(commit_futs)
+                while pending and len(results) < need:
+                    done, pending = wait(pending,
+                                         return_when=FIRST_COMPLETED)
+                    for f in done:
+                        node = commit_futs[f]
+                        if f.exception() is None:
+                            results.append(f.result())
+                        else:
+                            commit_errors.append(
+                                f"{node}: {f.exception()}")
+                            # release any still-staged entry (idempotent
+                            # if the commit half-landed or the node is
+                            # unreachable)
+                            pool.submit(safe_abort, node)
             for f in pending:  # commit stragglers: abort on failure
                 node = commit_futs[f]
                 f.add_done_callback(

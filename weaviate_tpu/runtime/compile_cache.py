@@ -20,6 +20,46 @@ import os
 logger = logging.getLogger(__name__)
 
 _done = False
+_metrics_installed = False
+
+
+def install_compile_metrics() -> None:
+    """Feed compile-time histograms and cache hit/miss counters from
+    jax's monitoring stream (idempotent; safe without jax).
+
+    jax emits ``record_event_duration_secs`` for every backend compile
+    ('/jax/core/compile/backend_compile_duration' and friends) and
+    ``record_event`` for persistent-cache outcomes ('/jax/compilation_
+    cache/cache_hits' | 'cache_misses' | 'task_disabled_cache'). The
+    event key IS the signature label — keys are a small fixed set, so
+    cardinality stays bounded while still splitting tracing/lowering/
+    backend-compile time."""
+    global _metrics_installed
+    if _metrics_installed:
+        return
+    _metrics_installed = True
+    try:
+        from jax import monitoring
+
+        from weaviate_tpu.runtime.metrics import (compile_cache_events,
+                                                  jit_compile_duration)
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if "compile" in event:
+                jit_compile_duration.labels(event).observe(duration)
+
+        def _on_event(event: str, **kw) -> None:
+            if "cache_hit" in event:
+                compile_cache_events.labels("hit").inc()
+            elif "cache_miss" in event:
+                compile_cache_events.labels("miss").inc()
+            elif "compilation_cache" in event:
+                compile_cache_events.labels("other").inc()
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+    except Exception as e:  # noqa: BLE001 — metrics are best-effort
+        logger.debug("compile metrics unavailable: %s", e)
 
 
 def ensure_compile_cache() -> None:
@@ -28,6 +68,7 @@ def ensure_compile_cache() -> None:
     if _done:
         return
     _done = True
+    install_compile_metrics()
     try:
         import jax
 

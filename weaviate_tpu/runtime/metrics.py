@@ -11,6 +11,19 @@ from __future__ import annotations
 import threading
 
 
+def escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline must be escaped or a value like ``a"b`` corrupts
+    the whole scrape (text format spec, "Escaping")."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(s: str) -> str:
+    """HELP lines escape backslash and newline (not quotes)."""
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class _Metric:
     kind = "untyped"
 
@@ -43,7 +56,8 @@ class _Metric:
     def _label_str(self, values: tuple) -> str:
         if not values:
             return ""
-        pairs = ",".join(f'{n}="{v}"' for n, v in zip(self.label_names, values))
+        pairs = ",".join(f'{n}="{escape_label_value(v)}"'
+                         for n, v in zip(self.label_names, values))
         return "{" + pairs + "}"
 
 
@@ -69,7 +83,8 @@ class Counter(_Metric):
         self._default().inc(amount)
 
     def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} counter"]
         with self._lock:  # labels() inserts race the scrape iteration
             children = sorted(self._children.items())
         for lv, child in children:
@@ -112,7 +127,8 @@ class Gauge(_Metric):
         self._default().dec(amount)
 
     def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} gauge"]
         with self._lock:
             children = sorted(self._children.items())
         for lv, child in children:
@@ -164,7 +180,8 @@ class Histogram(_Metric):
         return _Timer(self._default())
 
     def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} histogram"]
         with self._lock:
             children = sorted(self._children.items())
         for lv, child in children:
@@ -303,6 +320,31 @@ batcher_batch_size = registry.histogram(
     "weaviate_tpu_query_batcher_batch_size",
     "Queries coalesced per device dispatch", (),
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+batcher_wait_duration = registry.histogram(
+    "weaviate_tpu_query_batcher_wait_seconds",
+    "Time a query waits in the batcher queue before its dispatch starts")
+batcher_execute_duration = registry.histogram(
+    "weaviate_tpu_query_batcher_execute_seconds",
+    "Device dispatch+materialize time of the coalesced batch a query "
+    "rode in")
+
+# -- tracing (runtime/tracing.py feeds this on every finished span) -----------
+
+span_duration = registry.histogram(
+    "weaviate_tpu_span_duration_seconds",
+    "Trace span durations by span name", ("span",))
+
+# -- jit compilation (runtime/compile_cache.py installs the listeners) --------
+
+compile_cache_events = registry.counter(
+    "weaviate_tpu_compile_cache_events_total",
+    "Persistent compilation-cache lookups by outcome", ("event",))
+jit_compile_duration = registry.histogram(
+    "weaviate_tpu_jit_compile_seconds",
+    "Backend compile time per jit signature (jax monitoring event key)",
+    ("signature",),
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+             60.0, 120.0))
 
 
 def serve_metrics(host: str = "127.0.0.1", port: int = 2112):

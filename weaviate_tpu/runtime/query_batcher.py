@@ -21,12 +21,16 @@ flat_search_cutoff). Mixed k's batch together at max(k) and slice.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
+from weaviate_tpu.runtime import tracing
+
 
 class _Pending:
-    __slots__ = ("query", "k", "allow", "event", "ids", "dists", "error")
+    __slots__ = ("query", "k", "allow", "event", "ids", "dists", "error",
+                 "ctx", "t_exec_start", "t_exec_end", "batch_size")
 
     def __init__(self, query, k, allow):
         self.query = query
@@ -36,6 +40,13 @@ class _Pending:
         self.ids = None
         self.dists = None
         self.error: Exception | None = None
+        # trace context of the submitting request: the worker dispatches
+        # under ONE waiter's context (device spans land in that trace)
+        # and stamps exec timings every waiter records into its own
+        self.ctx = tracing.capture()
+        self.t_exec_start: float | None = None
+        self.t_exec_end: float | None = None
+        self.batch_size = 1
 
 
 class QueryBatcher:
@@ -71,11 +82,27 @@ class QueryBatcher:
                allow: np.ndarray | None = None):
         """Blocking per-request entry; coalesces under concurrency."""
         item = _Pending(np.asarray(query, dtype=np.float32), k, allow)
+        t_enqueue = time.perf_counter()
         with self._cv:
             self._queue.append(item)
             self._ensure_worker()
             self._cv.notify()
         item.event.wait()
+        # wait-vs-execute split, recorded into THIS request's trace from
+        # the worker's stamps (the worker thread has no request context)
+        if item.t_exec_start is not None:
+            tracing.record_span("batcher.wait", t_enqueue,
+                                item.t_exec_start)
+            tracing.record_span("batcher.execute", item.t_exec_start,
+                                item.t_exec_end or time.perf_counter(),
+                                batch=item.batch_size)
+            from weaviate_tpu.runtime.metrics import (
+                batcher_execute_duration, batcher_wait_duration)
+
+            batcher_wait_duration.observe(item.t_exec_start - t_enqueue)
+            if item.t_exec_end is not None:
+                batcher_execute_duration.observe(
+                    item.t_exec_end - item.t_exec_start)
         if item.error is not None:
             raise item.error
         return item.ids, item.dists
@@ -113,10 +140,14 @@ class QueryBatcher:
         masked = [it for it in drained if it.allow is not None]
         for it in masked:
             try:
-                ids, dists = self._batch_fn(it.query[None, :], it.k, it.allow)
+                it.t_exec_start = time.perf_counter()
+                ids, dists = tracing.run_in(
+                    it.ctx, self._batch_fn, it.query[None, :], it.k,
+                    it.allow)
                 it.ids, it.dists = ids[0], dists[0]
             except Exception as e:  # noqa: BLE001
                 it.error = e
+            it.t_exec_end = time.perf_counter()
             it.event.set()
         if not plain:
             return
@@ -124,14 +155,28 @@ class QueryBatcher:
         queries = np.stack([it.query for it in plain])
         self.dispatches += 1
         self.batched_queries += len(plain)
+        # the shared dispatch runs under ONE waiter's trace context (the
+        # first traced one) so device-level spans attribute somewhere
+        # real; every waiter still records its own wait/execute split
+        # from the stamps below
+        ctx = next((it.ctx for it in plain if it.ctx is not None), None)
+        t0 = time.perf_counter()
+        for it in plain:
+            it.t_exec_start = t0
+            it.batch_size = len(plain)
         try:
-            ids, dists = self._batch_fn(queries, k_max, None)
+            ids, dists = tracing.run_in(ctx, self._batch_fn, queries,
+                                        k_max, None)
         except Exception as e:  # noqa: BLE001
+            t1 = time.perf_counter()
             for it in plain:
+                it.t_exec_end = t1
                 it.error = e
                 it.event.set()
             return
+        t1 = time.perf_counter()
         for row, it in enumerate(plain):
+            it.t_exec_end = t1
             it.ids = ids[row, : it.k]
             it.dists = dists[row, : it.k]
             it.event.set()

@@ -35,16 +35,44 @@ def disabled(env=os.environ) -> bool:
 class Telemeter:
     def __init__(self, db, version: str = "dev",
                  endpoint: str | None = None,
-                 interval: float = 24 * 3600.0):
+                 interval: float = 24 * 3600.0,
+                 data_dir: str | None = None):
         self.db = db
         self.version = version
         self.endpoint = endpoint if endpoint is not None else \
             os.environ.get("TELEMETRY_ENDPOINT", DEFAULT_ENDPOINT)
         self.interval = interval
-        self.machine_id = str(uuid.uuid4())
+        # stable across restarts when a data dir is given (reference
+        # persists the machine id; a fresh uuid per boot would make every
+        # restart look like a new installation)
+        self.machine_id = self._load_machine_id(data_dir)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._warned = False
+
+    @staticmethod
+    def _load_machine_id(data_dir: str | None) -> str:
+        if not data_dir:
+            return str(uuid.uuid4())
+        path = os.path.join(data_dir, "machine_id")
+        try:
+            with open(path) as f:
+                mid = f.read().strip()
+            if mid:
+                return mid
+        except OSError:
+            pass
+        mid = str(uuid.uuid4())
+        try:
+            os.makedirs(data_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(mid + "\n")
+            os.replace(tmp, path)  # atomic: concurrent boots agree
+        except OSError as e:
+            logger.info("machine id not persisted (%s); using an "
+                        "ephemeral one", e)
+        return mid
 
     def build_payload(self, payload_type: str) -> dict:
         """Reference payload shape (telemetry.go buildPayload)."""

@@ -33,6 +33,7 @@ class Server:
         self.rest = None
         self.grpc = None
         self.telemeter = None
+        self.metrics_server = None
 
     # -- assembly (configure_api.go:456 ordering) -------------------------
 
@@ -156,7 +157,8 @@ class Server:
         if not cfg.disable_telemetry:
             from weaviate_tpu.runtime.telemetry import Telemeter
 
-            self.telemeter = Telemeter(self.db, version=VERSION)
+            self.telemeter = Telemeter(self.db, version=VERSION,
+                                       data_dir=cfg.data_path)
             self.telemeter.start()
 
         logger.info("weaviate-tpu %s serving REST on %s gRPC on :%s",
@@ -201,6 +203,12 @@ class Server:
         self._stop.set()
         if self.telemeter is not None:
             self.telemeter.stop()
+        if self.metrics_server is not None:
+            # release the monitoring port — a leaked listener makes an
+            # in-process restart fail with EADDRINUSE
+            self.metrics_server.shutdown()
+            self.metrics_server.server_close()
+            self.metrics_server = None
         if self.grpc is not None:
             self.grpc.stop()
         if self.node is not None:

@@ -42,6 +42,7 @@ import msgpack
 import numpy as np
 
 from weaviate_tpu import native
+from weaviate_tpu.runtime import tracing
 from weaviate_tpu.storage.wal import WriteAheadLog
 
 logger = logging.getLogger(__name__)
@@ -1129,22 +1130,23 @@ class Bucket:
         assert self.strategy == "replace"
         misses: list[int] = []
         out: list = []
-        with self._lock:
-            # newest first; replace memtables are always dict-backed
-            mems = [m.data for m in [*self._sealed, self._mem][::-1]]
-            segments = list(self._segments)[::-1]
-            for idx, key in enumerate(keys):
-                for m in mems:
-                    v = m.get(key)
-                    if v is not None:
-                        out.append(None if v is _TOMBSTONE else v)
-                        break
-                else:
-                    out.append(None)
-                    misses.append(idx)
-        for idx in misses:
-            out[idx] = _replace_segment_lookup(segments, keys[idx])
-        return out
+        with tracing.span("kv.get_many", bucket=self.name, n=len(keys)):
+            with self._lock:
+                # newest first; replace memtables are always dict-backed
+                mems = [m.data for m in [*self._sealed, self._mem][::-1]]
+                segments = list(self._segments)[::-1]
+                for idx, key in enumerate(keys):
+                    for m in mems:
+                        v = m.get(key)
+                        if v is not None:
+                            out.append(None if v is _TOMBSTONE else v)
+                            break
+                    else:
+                        out.append(None)
+                        misses.append(idx)
+            for idx in misses:
+                out[idx] = _replace_segment_lookup(segments, keys[idx])
+            return out
 
     def get_set(self, key: bytes) -> set:
         v = self.get(key)
