@@ -1,0 +1,329 @@
+"""Batched filtered search: per-query allow bitmasks inside the scan.
+
+Parity contract (ISSUE 3): bitmask-batched filtered top-k must match a
+NumPy masked-argsort reference exactly across metrics / storage dtypes /
+selectivities — including empty allow lists and k > allowed-count — and
+the QueryBatcher must serve a mixed filtered/unfiltered drain as ONE
+device dispatch padded to pow2 buckets.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.ops.pallas_kernels import (
+    MASK_BLOCK,
+    fused_topk_scan,
+    mask_pad_cols,
+    pack_allow_bitmask,
+    pack_allow_bitmask_jnp,
+    unpack_allow_bitmask,
+)
+
+DEAD = 1e37  # distances >= this are masked/dead slots
+
+
+def masked_ref(q, corpus, mask, k, metric="l2-squared"):
+    """NumPy masked-argsort reference: (ids, dists) of the <=k allowed
+    rows, ascending, ties by lower index (lax.top_k convention)."""
+    if metric == "l2-squared":
+        d = ((q[None, :] - corpus) ** 2).sum(-1)
+    elif metric == "dot":
+        d = -(corpus @ q)
+    else:  # cosine: both sides normalized
+        qn = q / max(np.linalg.norm(q), 1e-30)
+        cn = corpus / np.maximum(
+            np.linalg.norm(corpus, axis=1, keepdims=True), 1e-30)
+        d = 1.0 - cn @ qn
+    d = np.where(mask, d.astype(np.float32), np.inf)
+    order = np.argsort(d, kind="stable")[:k]
+    live = np.isfinite(d[order])
+    return order[live], d[order][live]
+
+
+def test_pack_unpack_roundtrip(rng):
+    for cols in (1, 31, 32, 500, 512, 513, 1300):
+        allow = rng.random((3, cols)) < 0.4
+        bits = pack_allow_bitmask(allow)
+        assert bits.dtype == np.uint32
+        assert bits.shape == (3, mask_pad_cols(cols) // 32)
+        back = np.asarray(unpack_allow_bitmask(bits, cols))
+        assert np.array_equal(back, allow), cols
+        # traceable packer agrees with the host packer
+        import jax.numpy as jnp
+
+        bits_dev = np.asarray(pack_allow_bitmask_jnp(jnp.asarray(allow)))
+        assert np.array_equal(bits_dev, bits), cols
+
+
+@pytest.mark.parametrize("metric", ["l2-squared", "dot", "cosine"])
+def test_fused_scan_masked_parity(rng, metric):
+    import jax.numpy as jnp
+
+    b, n, d, k = 6, 1100, 48, 9
+    q = rng.standard_normal((b, d)).astype(np.float32)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    allow = rng.random((b, n)) < 0.25
+    allow[0, :] = True          # unfiltered row
+    allow[1, :] = False         # empty allow list
+    allow[2, :3] = True
+    allow[2, 3:] = False        # k > allowed-count
+    bits = jnp.asarray(pack_allow_bitmask(allow))
+    xin = x
+    if metric == "cosine":
+        xin = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True),
+                             1e-30)
+    fd, fi = fused_topk_scan(jnp.asarray(q), jnp.asarray(xin), k=k,
+                             metric=metric, allow_bits=bits)
+    fd, fi = np.asarray(fd), np.asarray(fi)
+    for r in range(b):
+        ri, rd = masked_ref(q[r], x, allow[r], k, metric)
+        assert np.array_equal(fi[r, :len(ri)], ri), (r, fi[r], ri)
+        assert np.all(fi[r, len(ri):] == -1)
+        assert np.allclose(fd[r, :len(ri)], rd, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("selection", ["approx", "exact", "fused"])
+@pytest.mark.parametrize("dtype_name", ["float32", "bfloat16"])
+def test_store_batched_mask_parity(rng, selection, dtype_name):
+    import jax.numpy as jnp
+
+    from weaviate_tpu.engine.store import DeviceVectorStore
+
+    b, n, d, k = 5, 700, 32, 7
+    corpus = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((b, d)).astype(np.float32)
+    st = DeviceVectorStore(dim=d, capacity=1024, chunk_size=256,
+                           dtype=jnp.dtype(dtype_name),
+                           selection=selection)
+    st.add(corpus)
+    allow = rng.random((b, n)) < 0.3
+    allow[1, :] = False
+    allow[2, :2] = True
+    allow[2, 2:] = False
+    full = np.zeros((b, st.capacity), dtype=bool)
+    full[:, :n] = allow
+    dists, slots = st.search(q, k, allow_mask=full)
+    # the reference scans what the store scans: rows rounded to the
+    # storage dtype
+    stored = np.asarray(jnp.asarray(corpus).astype(st.dtype),
+                        dtype=np.float32)
+    for r in range(b):
+        ri, rd = masked_ref(q[r], stored, allow[r], k)
+        live = dists[r] < DEAD
+        assert live.sum() == len(ri), (selection, r, slots[r])
+        assert np.array_equal(slots[r][live], ri), (selection, r)
+        assert np.allclose(dists[r][live], rd, rtol=1e-3, atol=1e-3)
+        if selection == "fused":
+            assert np.all(slots[r][~live] == -1)
+
+
+def test_store_shared_mask_broadcast(rng):
+    """[1, capacity] and [capacity] masks are the same API; a [B, C] mask
+    of identical rows returns the same results as the shared form."""
+    from weaviate_tpu.engine.store import DeviceVectorStore
+
+    b, n, d, k = 4, 400, 16, 6
+    corpus = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((b, d)).astype(np.float32)
+    st = DeviceVectorStore(dim=d, capacity=512, selection="fused")
+    st.add(corpus)
+    shared = np.zeros(st.capacity, dtype=bool)
+    shared[:n] = rng.random(n) < 0.4
+    d1, i1 = st.search(q, k, allow_mask=shared)
+    d2, i2 = st.search(q, k, allow_mask=shared[None, :])
+    d3, i3 = st.search(q, k, allow_mask=np.broadcast_to(
+        shared, (b, st.capacity)))
+    assert np.array_equal(i1, i2) and np.array_equal(i1, i3)
+    assert np.allclose(d1, d2) and np.allclose(d1, d3)
+
+
+@pytest.mark.parametrize("quant,centroids", [("bq", 16), ("pq", 16),
+                                             ("pq", 256)])
+def test_quantized_batched_mask_parity(rng, quant, centroids):
+    """Per-query masks through the compressed scan kernels. With
+    rescore_limit covering the whole corpus the exact host rescore makes
+    results independent of scan approximations, so parity vs the NumPy
+    masked reference is exact — and disallowed rows must never even
+    appear as candidates."""
+    from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+    b, n, d, k = 4, 450, 32, 6
+    corpus = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((b, d)).astype(np.float32)
+    st = QuantizedVectorStore(dim=d, capacity=512, quantization=quant,
+                              pq_centroids=centroids, rescore_limit=100)
+    if quant == "pq":
+        st.train(corpus)
+    st.add(corpus)
+    allow = rng.random((b, n)) < 0.3
+    allow[1, :] = False
+    allow[2, :2] = True
+    allow[2, 2:] = False
+    full = np.zeros((b, st.capacity), dtype=bool)
+    full[:, :n] = allow
+    dists, slots = st.search(q, k, allow_mask=full)
+    for r in range(b):
+        ri, rd = masked_ref(q[r], corpus, allow[r], k)
+        live = slots[r] >= 0
+        assert live.sum() == len(ri), (quant, centroids, r)
+        assert np.array_equal(slots[r][live], ri), (quant, centroids, r)
+        assert np.allclose(dists[r][live], rd, rtol=1e-4, atol=1e-4)
+
+
+def test_sharded_store_batched_mask(rng):
+    """Mesh path: per-query masks shard column-wise, row-aligned with the
+    corpus; each device packs its slice locally; the ICI merge is
+    unchanged."""
+    from weaviate_tpu.engine.store import DeviceVectorStore
+    from weaviate_tpu.parallel.mesh import default_mesh
+
+    mesh = default_mesh()
+    if mesh is None:
+        pytest.skip("needs the multi-device virtual mesh")
+    b, n, d, k = 4, 600, 16, 5
+    corpus = rng.standard_normal((n, d)).astype(np.float32)
+    q = rng.standard_normal((b, d)).astype(np.float32)
+    st = DeviceVectorStore(dim=d, capacity=1024, chunk_size=64, mesh=mesh,
+                           selection="fused")
+    st.add(corpus)
+    allow = rng.random((b, n)) < 0.25
+    allow[0, :] = False
+    full = np.zeros((b, st.capacity), dtype=bool)
+    full[:, :n] = allow
+    dists, slots = st.search(q, k, allow_mask=full)
+    for r in range(b):
+        ri, _rd = masked_ref(q[r], corpus, allow[r], k)
+        live = dists[r] < DEAD
+        assert live.sum() == len(ri), r
+        assert np.array_equal(slots[r][live], ri), r
+
+
+def _make_batcher(idx):
+    from weaviate_tpu.runtime.query_batcher import QueryBatcher
+
+    calls = []
+    real = idx.search_by_vector_batch
+
+    def counting(qs, k, allow=None):
+        calls.append({"rows": len(qs), "k": k,
+                      "filtered": allow is not None,
+                      "per_query": isinstance(allow, (list, tuple))})
+        return real(qs, k, allow)
+
+    qb = QueryBatcher(counting, supports_filter_batching=True,
+                      capacity_fn=lambda: idx.store.capacity)
+    return qb, calls
+
+
+def test_batcher_mixed_drain_one_dispatch(rng):
+    """Mixed filtered + unfiltered requests drain into ONE device
+    dispatch, padded to pow2 B and k buckets; every request still gets
+    its own exact (per-filter) result."""
+    from weaviate_tpu.engine.flat import FlatIndex
+
+    n, d, k = 300, 16, 5
+    corpus = rng.standard_normal((n, d)).astype(np.float32)
+    idx = FlatIndex(dim=d, capacity=512, selection="fused")
+    idx.add_batch(np.arange(n), corpus)
+    qb, calls = _make_batcher(idx)
+    nreq = 11
+    queries = rng.standard_normal((nreq, d)).astype(np.float32)
+    allows = [None if j % 3 == 0 else
+              np.flatnonzero(rng.random(n) < 0.3).astype(np.int64)
+              for j in range(nreq)]
+
+    # block the first dispatch so the rest reliably coalesce behind it
+    gate = threading.Event()
+    first = threading.Event()
+    inner = qb._batch_fn
+
+    def slow_first(qs, kk, allow=None):
+        if not first.is_set():
+            first.set()
+            gate.wait(5.0)
+        return inner(qs, kk, allow)
+
+    qb._batch_fn = slow_first
+    results = [None] * nreq
+
+    def worker(j):
+        results[j] = qb.search(queries[j], k, allows[j])
+
+    threads = [threading.Thread(target=worker, args=(j,))
+               for j in range(nreq)]
+    threads[0].start()
+    time.sleep(0.1)
+    for t in threads[1:]:
+        t.start()
+    time.sleep(0.3)
+    gate.set()
+    for t in threads:
+        t.join()
+    qb.stop()
+
+    # the queued-up 10 requests (mixed filtered/unfiltered) shared ONE
+    # dispatch...
+    coalesced = [c for c in calls if c["rows"] > 1]
+    assert len(coalesced) == 1, calls
+    assert coalesced[0]["filtered"] and coalesced[0]["per_query"]
+    # ...padded to pow2 buckets (B and k)
+    assert coalesced[0]["rows"] == 16, calls  # next_pow2(10)
+    assert coalesced[0]["k"] == 8, calls      # next_pow2(5)
+    assert qb.filtered_batched > 0
+
+    # exact per-request results vs the direct path
+    for j in range(nreq):
+        ids, dists = results[j]
+        al = None if allows[j] is None else [allows[j]]
+        ref_i, _ = idx.search_by_vector_batch(
+            queries[j][None, :], k,
+            al if al is not None else None)
+        got = np.asarray(ids)
+        want = ref_i[0]
+        assert np.array_equal(got[got >= 0], want[want >= 0]), j
+        if allows[j] is not None:
+            live = got[got >= 0]
+            assert np.isin(live, allows[j]).all(), j
+
+
+def test_batcher_selective_filter_goes_solo(rng):
+    """The per-dispatch selectivity heuristic routes a highly selective
+    filter (<= capacity/64 allowed) to a solo dispatch where the store's
+    gathered cutover applies; broad filters stay batched."""
+    from weaviate_tpu.engine.flat import FlatIndex
+
+    n, d, k = 300, 16, 4
+    corpus = rng.standard_normal((n, d)).astype(np.float32)
+    idx = FlatIndex(dim=d, capacity=512, selection="fused")
+    idx.add_batch(np.arange(n), corpus)
+    qb, calls = _make_batcher(idx)
+
+    tiny = np.array([3, 7], dtype=np.int64)       # 2 <= 512 // 64
+    broad = np.flatnonzero(rng.random(n) < 0.5).astype(np.int64)
+    # drive _dispatch directly — no threads needed to pin the drain
+    from weaviate_tpu.runtime.query_batcher import _Pending
+
+    pend = [
+        _Pending(rng.standard_normal(d).astype(np.float32), k, tiny),
+        _Pending(rng.standard_normal(d).astype(np.float32), k, broad),
+        _Pending(rng.standard_normal(d).astype(np.float32), k, None),
+    ]
+    qb._dispatch(pend)
+    assert all(p.event.is_set() and p.error is None for p in pend)
+    solo = [c for c in calls if c["rows"] == 1]
+    coal = [c for c in calls if c["rows"] > 1]
+    assert len(solo) == 1 and not solo[0]["per_query"]  # tiny went solo
+    assert len(coal) == 1 and coal[0]["per_query"]      # broad batched
+    # solo result respects its filter (-1 padding when k > allowed count)
+    got = np.asarray(pend[0].ids)
+    assert np.isin(got[got >= 0], tiny).all()
+    assert (got >= 0).sum() == len(tiny)
+
+
+def test_mask_block_constant():
+    # every masked kernel unpacks whole 512-column blocks; the packers
+    # and kernels must agree on the constant
+    assert MASK_BLOCK == 512 and MASK_BLOCK % 32 == 0

@@ -434,8 +434,31 @@ class Shard:
         if b is None:
             from weaviate_tpu.runtime.query_batcher import QueryBatcher
 
-            b = self._query_batchers.setdefault(vec_name,
-                                                QueryBatcher(batch_fn))
+            # filtered requests coalesce (bitmask-batched) when the index
+            # supports per-query allow lists; the capacity hook powers
+            # the batcher's selectivity cutover and reports 0 (= never
+            # solo) unless the CURRENT store has a solo gathered path
+            # (single-device DeviceVectorStore) — elsewhere a solo
+            # dispatch is a full masked scan, strictly worse than riding
+            # the batch. Resolved per call: compress()/upgrade() swap
+            # idx.store after the batcher exists.
+            def _gathered_capacity(i=idx) -> int:
+                s = getattr(i, "store", None)
+                if (s is None or getattr(s, "mesh", None) is not None
+                        or not hasattr(s, "_dispatch_gathered")):
+                    return 0
+                return s.capacity
+
+            b = self._query_batchers.setdefault(
+                vec_name,
+                QueryBatcher(
+                    batch_fn,
+                    supports_filter_batching=bool(
+                        getattr(idx, "supports_batched_filters", False)),
+                    capacity_fn=_gathered_capacity,
+                    pad_pow2=bool(getattr(idx, "compiled_batch_shapes",
+                                          True)),
+                ))
         ids, dists = b.search(query, k, allow_list)
         live = ids >= 0
         return (np.asarray(ids)[live].astype(np.int64),

@@ -23,6 +23,16 @@ from weaviate_tpu.engine.store import DeviceVectorStore
 from weaviate_tpu.runtime import tracing
 
 
+def _per_query_allow(allow_list) -> bool:
+    """True when ``allow_list`` is a sequence of PER-QUERY allow lists
+    (entries None or array-like) rather than one shared filter. A plain
+    Python list of scalar doc ids — including the empty list (a filter
+    matching nothing) — keeps its historical shared-filter meaning."""
+    if not isinstance(allow_list, (list, tuple)) or len(allow_list) == 0:
+        return False
+    return any(a is None or np.ndim(a) > 0 for a in allow_list)
+
+
 class FlatIndex:
     """Implements the reference ``VectorIndex`` contract
     (adapters/repos/db/vector_index.go:24-45) for brute-force search.
@@ -37,6 +47,14 @@ class FlatIndex:
     256-wide fused carry."""
 
     index_type = "flat"
+    # the batched entry point accepts PER-QUERY allow lists (a sequence in
+    # the allow_list slot) and runs them as one bitmask-batched device
+    # program — the QueryBatcher keys on this to coalesce filtered
+    # requests instead of dispatching them solo
+    supports_batched_filters = True
+    # device scans compile one executable per (B, k) shape — the batcher
+    # pads drains to pow2 buckets to bound the variant count
+    compiled_batch_shapes = True
 
     def __init__(self, dim: int, metric: str = "l2-squared", mesh=None,
                  dtype=None, capacity: int = 8192, chunk_size: int = 8192,
@@ -151,16 +169,59 @@ class FlatIndex:
                 return self._resolve(d, slots, k)
 
     def search_by_vector_batch(self, queries: np.ndarray, k: int,
-                               allow_list: np.ndarray | None = None):
+                               allow_list=None):
         """Batched query path — amortizes one matmul across B queries.
 
-        Returns (doc_ids [B,k] int64 with -1 padding, dists [B,k])."""
-        with tracing.span("flat.search_batch", k=k,
-                          queries=len(np.atleast_2d(queries))):
+        ``allow_list`` is either ONE allow list shared by the whole batch
+        (bool mask over doc-id space or array of allowed doc ids — a
+        plain list of scalar ids still means this), or a list/tuple of B
+        per-query allow lists (entries None or array-like; None =
+        unfiltered). Per-query lists translate to slot masks and run as a
+        single bitmask-batched device program (engine/store.py). Returns
+        (doc_ids [B,k] int64 with -1 padding, dists [B,k])."""
+        queries = np.atleast_2d(np.asarray(queries))
+        per_query = _per_query_allow(allow_list)
+        with tracing.span("flat.search_batch", k=k, queries=len(queries),
+                          filtered=allow_list is not None,
+                          per_query_filters=per_query):
             with self._lock:
-                allow_mask = self._allow_mask(allow_list)
-                d, slots = self.store.search(np.asarray(queries), k,
-                                             allow_mask)
+                if per_query:
+                    if len(allow_list) != len(queries):
+                        raise ValueError(
+                            f"{len(allow_list)} allow lists != "
+                            f"{len(queries)} queries")
+                    masks = [self._allow_mask(a) for a in allow_list]
+                    if all(m is None for m in masks):
+                        allow_mask = None
+                    elif not self.supports_batched_filters:
+                        # store takes shared 1-D masks only (e.g. the
+                        # IVF probe) — serve per-query filters row by
+                        # row rather than crashing on a 2-D mask
+                        d = np.full((len(queries), k), np.float32(np.inf),
+                                    dtype=np.float32)
+                        slots = np.full((len(queries), k), -1,
+                                        dtype=np.int64)
+                        for r, m in enumerate(masks):
+                            dr, sr = self.store.search(
+                                queries[r:r + 1], k, m)
+                            kk = min(k, dr.shape[1])
+                            d[r, :kk] = dr[0, :kk]
+                            slots[r, :kk] = sr[0, :kk]
+                        ids = np.where(slots >= 0,
+                                       self._slot_to_id_safe(slots), -1)
+                        return ids, d
+                    else:
+                        # unfiltered rows get an all-ones mask (the store
+                        # still ANDs with its live-slot validity)
+                        allow_mask = np.ones(
+                            (len(masks), self.store.capacity), dtype=bool)
+                        for r, m in enumerate(masks):
+                            if m is not None:
+                                allow_mask[r, :] = False
+                                allow_mask[r, :len(m)] = m
+                else:
+                    allow_mask = self._allow_mask(allow_list)
+                d, slots = self.store.search(queries, k, allow_mask)
                 ids = np.where(slots >= 0, self._slot_to_id_safe(slots),
                                -1)
                 return ids, d

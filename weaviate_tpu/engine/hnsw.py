@@ -651,13 +651,27 @@ class HNSWIndex:
             return (np.asarray(out_ids, dtype=np.int64),
                     np.asarray(out_d, dtype=np.float32))
 
+    # per-query allow lists ride the per-row loop below — the batcher can
+    # coalesce filtered requests into one batch_fn call for this index too
+    supports_batched_filters = True
+    # the loop runs a REAL graph search per row, so pow2 batch padding
+    # would buy nothing and cost up to 2x work — the batcher skips it
+    compiled_batch_shapes = False
+
     def search_by_vector_batch(self, queries: np.ndarray, k: int,
-                               allow_list: np.ndarray | None = None):
+                               allow_list=None):
+        """``allow_list`` may be one shared allow list or a list/tuple of
+        per-query allow lists (entries None or array-like), matching the
+        FlatIndex batched contract."""
+        from weaviate_tpu.engine.flat import _per_query_allow
+
         queries = np.asarray(queries, dtype=np.float32)
         ids = np.full((len(queries), k), -1, dtype=np.int64)
         dists = np.full((len(queries), k), np.float32(np.inf), dtype=np.float32)
+        per_query = _per_query_allow(allow_list)
         for b, q in enumerate(queries):
-            i, d = self.search_by_vector(q, k, allow_list)
+            al = allow_list[b] if per_query else allow_list
+            i, d = self.search_by_vector(q, k, al)
             ids[b, : len(i)] = i
             dists[b, : len(d)] = d
         return ids, dists

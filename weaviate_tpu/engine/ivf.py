@@ -841,6 +841,9 @@ class IVFIndex(FlatIndex):
     contract docs (reference: vector_index.go:24-45)."""
 
     index_type = "ivf"
+    # IVFStore.search takes shared [capacity] masks only — the batcher
+    # keeps filtered requests on the solo path for this index type
+    supports_batched_filters = False
 
     def __init__(self, dim: int, metric: str = "l2-squared",
                  capacity: int = 8192, chunk_size: int = 8192,

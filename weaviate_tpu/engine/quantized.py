@@ -443,8 +443,13 @@ class QuantizedVectorStore:
         slots = np.atleast_1d(np.asarray(slots, dtype=np.int64))
         return self._vectors_for(slots).copy()
 
-    def _scan(self, queries_dev, k_cand: int, valid, k_out: int):
-        """Dispatch the compressed scan (single-device or SPMD)."""
+    def _scan(self, queries_dev, k_cand: int, valid, k_out: int,
+              allow_bits=None, allow_rows=None):
+        """Dispatch the compressed scan (single-device or SPMD).
+
+        ``allow_bits`` ([B, C/32] uint32 packed per-query masks) feeds the
+        single-device kernels; ``allow_rows`` ([B, C] bool, column-sharded)
+        feeds the SPMD path, which packs each shard's slice on device."""
         capacity = self.capacity
         cs = min(self.chunk_size, capacity // self.n_shards)
         metric = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
@@ -467,6 +472,7 @@ class QuantizedVectorStore:
                 k=per_dev_k, k_out=k_out, chunk_size=cs,
                 quantization=quant_key, metric=metric, mesh=self.mesh,
                 use_pallas=self.use_pallas, selection=self.selection,
+                allow_rows=allow_rows,
             )
         if quant_key in ("pq4", "pq"):
             if self.prefix_t is not None:
@@ -477,25 +483,29 @@ class QuantizedVectorStore:
                     k=k_cand, refine=max(2, self.rescore_limit // 2),
                     metric=metric, valid=valid, m=self.pq_segments,
                     use_pallas=self.use_pallas, selection=self.selection,
+                    allow_bits=allow_bits,
                 )
             if quant_key == "pq4":
                 return pq_ops.pq4_topk(
                     queries_dev, self.codes, cent, k=k_cand, chunk_size=cs,
                     metric=metric, valid=valid, selection=self.selection,
+                    allow_bits=allow_bits,
                 )
             return pq_ops.pq_topk(
                 queries_dev, self.codes, cent, k=k_cand, chunk_size=cs,
-                metric=metric, valid=valid,
+                metric=metric, valid=valid, allow_bits=allow_bits,
             )
         if self.prefix_t is not None:
             return bq_ops.bq_topk_twostage(
                 qw, self.codes, self.prefix_t, k=k_cand,
                 refine=max(2, self.rescore_limit // 2), valid=valid,
                 use_pallas=self.use_pallas, selection=self.selection,
+                allow_bits=allow_bits,
             )
         return bq_ops.bq_topk(
             qw, self.codes, k=k_cand, chunk_size=cs, valid=valid,
             use_pallas=self.use_pallas, selection=self.selection,
+            allow_bits=allow_bits,
         )
 
     def search(self, queries: np.ndarray, k: int, allow_mask: np.ndarray | None = None):
@@ -508,12 +518,21 @@ class QuantizedVectorStore:
         ``fetch_fn``) the oversampled candidates come back to the host for
         a vectorized exact rescore; plain ``"none"`` returns code-distance
         order directly.
+
+        ``allow_mask`` accepts the same two forms as
+        ``DeviceVectorStore.search``: a shared [capacity] bool mask, or
+        per-query [B, capacity] masks packed into a bitmask consumed
+        inside the compressed scan kernels (disallowed rows never even
+        become rescore candidates).
         """
+        from weaviate_tpu.engine.store import normalize_allow_mask
+
         queries = np.asarray(queries, dtype=np.float32)
         squeeze = queries.ndim == 1
         if squeeze:
             queries = queries[None, :]
         queries = self._maybe_norm(queries)
+        allow_mask = normalize_allow_mask(allow_mask, len(queries))
         # inline = exact rescore happens inside the SPMD program; post =
         # oversampled candidates come back for a host-side exact pass
         # (sourced from host rows, single-device HBM rows, or fetch_fn)
@@ -533,7 +552,15 @@ class QuantizedVectorStore:
                         "PQ store not trained; call train() first")
                 capacity = self.capacity
                 valid = self.valid
-                if allow_mask is not None:
+                allow_bits = allow_rows_dev = None
+                if allow_mask is not None and allow_mask.ndim == 2:
+                    from weaviate_tpu.engine.store import (
+                        batched_mask_operands)
+
+                    sp.set(path="bitmask_batched")
+                    allow_bits, allow_rows_dev = batched_mask_operands(
+                        allow_mask, len(queries), capacity, self.mesh)
+                elif allow_mask is not None:
                     full = np.zeros(capacity, dtype=bool)
                     full[: len(allow_mask)] = allow_mask[:capacity]
                     valid = jnp.logical_and(valid, self._placed(full))
@@ -547,7 +574,8 @@ class QuantizedVectorStore:
                     k_cand = min(k, capacity)
                     k_out = k_cand
                 d, i = self._scan(jnp.asarray(queries), k_cand, valid,
-                                  k_out)
+                                  k_out, allow_bits=allow_bits,
+                                  allow_rows=allow_rows_dev)
             tracing.device_sync(sp, d, i)  # outside the dispatch lock
             d_np, i_np = np.asarray(d), np.asarray(i, dtype=np.int64)
             if post_rescore:

@@ -50,6 +50,45 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def normalize_allow_mask(allow_mask, n_queries: int):
+    """Shared allow-mask intake for the plain and quantized stores:
+    [1, C] broadcasts to the shared [C] form (keeping the gathered
+    low-selectivity cutover); a [B, C] mask must match the query count."""
+    if allow_mask is None:
+        return None
+    allow_mask = np.asarray(allow_mask)
+    if allow_mask.ndim == 2 and allow_mask.shape[0] == 1:
+        allow_mask = allow_mask[0]
+    elif allow_mask.ndim == 2 and allow_mask.shape[0] != n_queries:
+        raise ValueError(
+            f"allow_mask rows {allow_mask.shape[0]} != "
+            f"queries {n_queries}")
+    return allow_mask
+
+
+def batched_mask_operands(allow_mask, n_queries: int, capacity: int, mesh):
+    """[B, capacity] per-query mask -> scan-kernel operands, under a
+    ``store.mask_pack`` span: single-device packs the bitmask on the host
+    (32x smaller transfer); a mesh ships the bool mask column-sharded so
+    each device packs its own row-aligned slice on device. Returns
+    (allow_bits, allow_rows_dev) — exactly one is non-None."""
+    with tracing.span("store.mask_pack", queries=n_queries):
+        if mesh is None:
+            from weaviate_tpu.ops.pallas_kernels import (mask_pad_cols,
+                                                         pack_allow_bitmask)
+
+            return jnp.asarray(pack_allow_bitmask(
+                allow_mask, mask_pad_cols(capacity))), None
+        if (allow_mask.shape == (n_queries, capacity)
+                and allow_mask.dtype == np.bool_):
+            full = allow_mask  # already the exact shape — no copy
+        else:
+            full = np.zeros((n_queries, capacity), dtype=bool)
+            w = min(allow_mask.shape[1], capacity)
+            full[:, :w] = allow_mask[:, :w]
+        return None, shard_array(jnp.asarray(full), mesh, dim=1)
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("normalize_rows",))
 def _scatter_rows(vectors, valid, sq_norms, slots, new_vecs, write_mask,
                   normalize_rows: bool = False):
@@ -325,17 +364,27 @@ class DeviceVectorStore:
         """Brute-force top-k. queries [B,d] (or [d]); returns (dists [B,k],
         slots [B,k]) as numpy, ascending by distance; dead slots never appear.
 
-        ``allow_mask`` is a [capacity] or [count] bool mask — the device-side
-        AllowList (reference: helpers/allow_list.go consumed at
-        hnsw/search.go / flat/index.go:319).
+        ``allow_mask`` is the device-side AllowList (reference:
+        helpers/allow_list.go consumed at hnsw/search.go /
+        flat/index.go:319) in one of two forms:
+
+        - [capacity] (or [count]) bool — ONE filter shared by the whole
+          batch; highly selective masks cut over to the gathered path.
+        - [B, capacity] bool — PER-QUERY filters. Rows pack into a
+          bitmask (uint32 [B, capacity/32], pallas_kernels.
+          pack_allow_bitmask) that the scan kernels unpack tile-locally,
+          so B differently-filtered requests still run as one device
+          program. A [1, capacity] mask broadcasts to the shared form.
         """
         queries = np.asarray(queries, dtype=np.float32)
         squeeze = queries.ndim == 1
         if squeeze:
             queries = queries[None, :]
+        allow_mask = normalize_allow_mask(allow_mask, len(queries))
         with tracing.span("store.scan", rows=self.capacity,
                           queries=len(queries), k=k,
-                          sharded=self.mesh is not None) as sp:
+                          sharded=self.mesh is not None,
+                          filtered=allow_mask is not None) as sp:
             # Dispatch happens under the lock: writers *donate* the store
             # buffers, which invalidates any handle a concurrent reader
             # grabbed but hasn't dispatched against yet. Execution is
@@ -346,7 +395,13 @@ class DeviceVectorStore:
                 vectors, valid, norms = (self.vectors, self.valid,
                                          self.sq_norms)
                 capacity = self.capacity
-                if allow_mask is not None:
+                allow_bits = allow_rows_dev = None
+                if allow_mask is not None and allow_mask.ndim == 2:
+                    slot_buf = None
+                    sp.set(path="bitmask_batched")
+                    allow_bits, allow_rows_dev = batched_mask_operands(
+                        allow_mask, len(queries), capacity, self.mesh)
+                elif allow_mask is not None:
                     allowed = np.flatnonzero(allow_mask)
                     # selectivity policy (measured,
                     # tools/bench_filtered.py — BASELINE r5, hoist-proof
@@ -389,6 +444,7 @@ class DeviceVectorStore:
                             chunk_size=cs, metric=metric, valid=valid,
                             x_sq_norms=norms, use_pallas=self.use_pallas,
                             selection=self.selection,
+                            allow_bits=allow_bits,
                         )
                     else:
                         d, i = sharded_topk(
@@ -396,6 +452,7 @@ class DeviceVectorStore:
                             k=k_eff, chunk_size=cs, metric=metric,
                             mesh=self.mesh, use_pallas=self.use_pallas,
                             selection=self.selection,
+                            allow_rows=allow_rows_dev,
                         )
             # device-time attribution and materialization OUTSIDE the
             # lock — a sync in the dispatch section would serialize

@@ -63,6 +63,7 @@ def bq_topk(
     use_pallas: bool = False,
     reduce_l: int | None = None,
     selection: str = "approx",
+    allow_bits: jnp.ndarray | None = None,
 ):
     """Hamming top-k over packed words: q [B, w] uint32, x [N, w] uint32.
 
@@ -89,6 +90,11 @@ def bq_topk(
     pass. Production callers oversample + rescore as
     QuantizedVectorStore does, which absorbs the loss (measured recall
     deltas in PARITY.md).
+
+    ``allow_bits`` [B, ceil(N_512/32)] uint32 adds a per-query allow
+    bitmask (pallas_kernels.pack_allow_bitmask layout): the pallas path
+    unpacks it subtile-locally in VMEM, the XLA fallback unpacks once and
+    folds a per-chunk where.
     """
     from weaviate_tpu.ops.distances import MASKED_DISTANCE
     from weaviate_tpu.ops.topk import topk_smallest
@@ -102,8 +108,14 @@ def bq_topk(
 
         rl = reduce_l if reduce_l is not None else _auto_reduce_l(n)
         vals, ids = bq_scan_reduce(q_words, x_words, valid=valid,
-                                   reduce_l=rl)
+                                   reduce_l=rl, allow_bits=allow_bits)
         return select_survivors(vals, ids, k, selection, id_offset)
+
+    allow_rows = None
+    if allow_bits is not None:
+        from weaviate_tpu.ops.pallas_kernels import unpack_allow_bitmask
+
+        allow_rows = unpack_allow_bitmask(allow_bits, n)
 
     # XLA fallback: chunked XOR+popcount pass; pad odd sizes with dead rows
     # so peak memory stays O(B * chunk)
@@ -113,23 +125,31 @@ def bq_topk(
         x_words = jnp.pad(x_words, ((0, pad), (0, 0)))
         valid = ((jnp.arange(n + pad) < n) if valid is None
                  else jnp.pad(valid.astype(bool), (0, pad)))
+        if allow_rows is not None:
+            allow_rows = jnp.pad(allow_rows, ((0, 0), (0, pad)))
         n += pad
     num_chunks = n // chunk_size
     x_chunks = x_words.reshape(num_chunks, chunk_size, w)
     valid_chunks = None if valid is None else valid.reshape(num_chunks, chunk_size)
+    allow_chunks = (
+        None if allow_rows is None
+        else jnp.moveaxis(
+            allow_rows.reshape(b, num_chunks, chunk_size), 1, 0))
 
     init_d = jnp.full((b, k), MASKED_DISTANCE, dtype=jnp.float32)
     init_i = jnp.full((b, k), -1, dtype=jnp.int32)
 
     def body(carry, inp):
         best_d, best_i = carry
-        chunk_idx, xc, vc = inp
+        chunk_idx, xc, vc, ac = inp
         x_or = jax.lax.bitwise_xor(q_words[:, None, :], xc[None, :, :])
         d = jnp.sum(
             jax.lax.population_count(x_or), axis=-1, dtype=jnp.int32
         ).astype(jnp.float32)
         if vc is not None:
             d = jnp.where(vc[None, :], d, MASKED_DISTANCE)
+        if ac is not None:
+            d = jnp.where(ac, d, MASKED_DISTANCE)
         ids = (
             chunk_idx * chunk_size
             + id_offset
@@ -149,11 +169,13 @@ def bq_topk(
         (fd, fi), _ = body(
             (init_d, init_i),
             (chunk_ids[0], x_chunks[0],
-             None if valid_chunks is None else valid_chunks[0]),
+             None if valid_chunks is None else valid_chunks[0],
+             None if allow_chunks is None else allow_chunks[0]),
         )
     else:
         (fd, fi), _ = jax.lax.scan(
-            body, (init_d, init_i), (chunk_ids, x_chunks, valid_chunks)
+            body, (init_d, init_i),
+            (chunk_ids, x_chunks, valid_chunks, allow_chunks)
         )
     return fd, fi
 
@@ -170,6 +192,7 @@ def bq_topk_twostage(
     id_offset: jnp.ndarray | int = 0,
     use_pallas: bool = True,
     selection: str = "approx",
+    allow_bits: jnp.ndarray | None = None,
 ):
     """Two-stage BQ scan for the capacity regime.
 
@@ -195,9 +218,12 @@ def bq_topk_twostage(
     if use_pallas:
         from weaviate_tpu.ops.pallas_kernels import bq_scan_reduce
 
+        # the per-query mask prunes in stage 1: disallowed rows never
+        # become candidates, so stage 2 inherits the filter for free
         vals1, ids1 = bq_scan_reduce(
             q_words[:, :wp], x_prefix_t, valid=valid,
-            reduce_l=_auto_reduce_l(n), transposed=True)
+            reduce_l=_auto_reduce_l(n), transposed=True,
+            allow_bits=allow_bits)
         r = min(refine * k, vals1.shape[1])
         if selection == "fused" and r <= 256:
             from weaviate_tpu.ops.pallas_kernels import fused_topk_pairs
@@ -212,7 +238,7 @@ def bq_topk_twostage(
         # fallback top-k already returns the pruned candidate set, sorted
         cand_d1, ids1 = bq_topk(q_words[:, :wp], x_prefix_t.T,
                                 k=min(refine * k, n), valid=valid,
-                                use_pallas=False)
+                                use_pallas=False, allow_bits=allow_bits)
         cand = jnp.where(ids1 < 0, 0, ids1)
         r = cand.shape[1]
     # stage 2: full-width exact hamming on the gathered candidates

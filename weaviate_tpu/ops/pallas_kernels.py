@@ -70,6 +70,126 @@ def _pad_to(n: int, m: int) -> int:
     return (n + m - 1) // m * m
 
 
+# -- per-query allow bitmasks -------------------------------------------------
+#
+# Filtered BATCHED search: each query row carries its own packed allow
+# bitmask so B filtered requests share one device program (the reference
+# consumes one AllowList per query inside the scan, helpers/allow_list.go).
+# A [B, N] f32 mask would multiply the kernel's per-tile input traffic by
+# B; packed words cost B*N/8 bytes total and unpack tile-locally in VMEM.
+#
+# Layout is BLOCK-STRIDED to match the kernels' in-VMEM unpack (the same
+# pltpu.repeat + lane-iota-shift idiom the BQ kernels use for bit planes):
+# within each MASK_BLOCK-column block, the block's W = MASK_BLOCK/32 words
+# hold   bit j of word w  =  allow[block_base + j*W + w],
+# so ``pltpu.repeat(words, 32, axis=1)`` (lane l -> word l % W) followed by
+# a ``lane_iota // W`` shift lands allow[block_base + l] on lane l exactly
+# — no in-kernel gather, no data permutation. Every masked kernel consumes
+# whole MASK_BLOCK-column blocks (tiles/subtiles are forced 512-aligned
+# when a mask is present), so one fixed layout serves them all.
+
+MASK_BLOCK = 512
+_MASK_WORDS = MASK_BLOCK // 32  # 16 words per block
+
+
+def mask_pad_cols(n: int) -> int:
+    """Packed-mask column count covering ``n`` corpus rows."""
+    return _pad_to(max(n, 1), MASK_BLOCK)
+
+
+def pack_allow_bitmask(allow, n_cols: int | None = None):
+    """Host-side packer: allow [B, C] (or [C]) bool -> uint32
+    [B, n_cols // 32] in block-strided order. Columns past C pack as 0
+    (disallowed — they are dead padding either way)."""
+    import numpy as np
+
+    allow = np.asarray(allow, dtype=bool)
+    if allow.ndim == 1:
+        allow = allow[None, :]
+    b, c = allow.shape
+    if n_cols is None:
+        n_cols = mask_pad_cols(c)
+    buf = np.zeros((b, n_cols), dtype=bool)
+    keep = min(c, n_cols)
+    buf[:, :keep] = allow[:, :keep]
+    a = buf.reshape(b, n_cols // MASK_BLOCK, 32, _MASK_WORDS)
+    shifts = np.arange(32, dtype=np.uint32)[None, None, :, None]
+    words = (a.astype(np.uint32) << shifts).sum(axis=2, dtype=np.uint32)
+    return words.reshape(b, n_cols // 32)
+
+
+def pack_allow_bitmask_jnp(allow: jnp.ndarray) -> jnp.ndarray:
+    """Traceable twin of ``pack_allow_bitmask`` for on-device packing
+    (the sharded path packs each shard's column slice locally)."""
+    b, c = allow.shape
+    n_cols = mask_pad_cols(c)
+    allow = allow.astype(bool)
+    if n_cols != c:
+        allow = jnp.pad(allow, ((0, 0), (0, n_cols - c)))
+    a = allow.reshape(b, n_cols // MASK_BLOCK, 32, _MASK_WORDS)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :, None]
+    words = jnp.sum(a.astype(jnp.uint32) << shifts, axis=2)
+    return words.astype(jnp.uint32).reshape(b, n_cols // 32)
+
+
+def unpack_allow_bitmask(bits: jnp.ndarray, n_cols: int | None = None):
+    """Inverse of the packer: [B, W] uint32 -> [B, n_cols] bool. Traceable
+    (the XLA fallback scans unpack once and apply a plain where)."""
+    b, w_total = bits.shape
+    total = w_total * 32
+    bits = jnp.asarray(bits)
+    a = bits.reshape(b, total // MASK_BLOCK, 1, _MASK_WORDS)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :, None]
+    cols = ((a >> shifts) & jnp.uint32(1)).reshape(b, total)
+    out = cols.astype(bool)
+    if n_cols is not None and n_cols != total:
+        out = (out[:, :n_cols] if n_cols < total else
+               jnp.pad(out, ((0, 0), (0, n_cols - total))))
+    return out
+
+
+def _fit_mask_words(allow_bits, b_pad: int, n_cols: int):
+    """Pad/slice packed words to [b_pad, n_cols // 32] int32 (Mosaic wants
+    signed lanes; bit extraction is sign-agnostic). Padding rows/columns
+    are zeros = disallowed, matching the dead-row masking."""
+    wn = n_cols // 32
+    ab = jnp.asarray(allow_bits)
+    if ab.shape[1] < wn:
+        ab = jnp.pad(ab, ((0, 0), (0, wn - ab.shape[1])))
+    elif ab.shape[1] > wn:
+        ab = ab[:, :wn]
+    if ab.shape[0] < b_pad:
+        ab = jnp.pad(ab, ((0, b_pad - ab.shape[0]), (0, 0)))
+    if ab.dtype == jnp.uint32:
+        ab = jax.lax.bitcast_convert_type(ab, jnp.int32)
+    return ab.astype(jnp.int32)
+
+
+def _mask_unpack_block(mw, interpret: bool):
+    """One packed block's words [B, W] int32 -> [B, 32W] 0/1 int32 with
+    lane l = allow[block_base + l] (see the layout note above)."""
+    if interpret:
+        rep = jnp.concatenate([mw] * 32, axis=1)
+    else:
+        rep = pltpu.repeat(mw, 32, axis=1)
+    shift = jax.lax.broadcasted_iota(jnp.int32, rep.shape, 1) // mw.shape[1]
+    return jax.lax.shift_right_logical(rep, shift) & 1
+
+
+def _mask_unpack_cols(mw, cols: int, interpret: bool):
+    """Unpack ``cols`` columns (a 512-multiple) from words [B, cols//32]:
+    per-block repeat+shift, lane-concat across blocks."""
+    nb = cols // MASK_BLOCK
+    if nb == 1:
+        return _mask_unpack_block(mw, interpret)
+    parts = [
+        _mask_unpack_block(
+            mw[:, i * _MASK_WORDS:(i + 1) * _MASK_WORDS], interpret)
+        for i in range(nb)
+    ]
+    return jnp.concatenate(parts, axis=1)
+
+
 def _distance_kernel(metric: str):
     """Build the tile kernel body for one metric.
 
@@ -593,13 +713,18 @@ def _fold_tile_topk(d, tile_ids, cd, ci, k, interpret):
     return cd, ci
 
 
-def _fused_topk_kernel(metric: str, k: int, interpret: bool):
+def _fused_topk_kernel(metric: str, k: int, interpret: bool,
+                       masked: bool = False):
     """Distance tile + in-VMEM top-k fold. refs: q [B,d], x [TILE,d],
-    valid [1,TILE] f32, xn [1,TILE] f32, outs [B,k] f32 / [B,k] i32,
-    scratch carries cd [B,k] f32 / ci [B,k] i32 (persist across the grid)."""
+    valid [1,TILE] f32, xn [1,TILE] f32, (masked: am [B,TILE/32] i32
+    packed per-query allow words), outs [B,k] f32 / [B,k] i32, scratch
+    carries cd [B,k] f32 / ci [B,k] i32 (persist across the grid)."""
 
-    def kernel(q_ref, x_ref, valid_ref, xn_ref, outd_ref, outi_ref,
-               cd_ref, ci_ref):
+    def kernel(q_ref, x_ref, valid_ref, xn_ref, *refs):
+        if masked:
+            am_ref, outd_ref, outi_ref, cd_ref, ci_ref = refs
+        else:
+            outd_ref, outi_ref, cd_ref, ci_ref = refs
         step = pl.program_id(0)
 
         @pl.when(step == 0)
@@ -628,8 +753,15 @@ def _fused_topk_kernel(metric: str, k: int, interpret: bool):
         # exclude dead/padded rows entirely (they can never enter the carry,
         # so k > live surfaces as (MASKED_DISTANCE, -1) — strictly cleaner
         # than the unfused path's arbitrary dead-row ids)
-        d = jnp.where(valid_ref[:] > 0.5, d, jnp.float32(MASKED_DISTANCE))
         b, t = d.shape
+        ok = valid_ref[:] > 0.5
+        if masked:
+            # per-query allow bitmask, unpacked tile-locally in VMEM and
+            # folded into the same validity epilogue — disallowed rows can
+            # never enter the carry, exactly like dead rows
+            bits = _mask_unpack_cols(am_ref[:], t, interpret)
+            ok = jnp.logical_and(ok, bits > 0)
+        d = jnp.where(ok, d, jnp.float32(MASKED_DISTANCE))
         base = step * t
         tile_ids = base + jax.lax.broadcasted_iota(jnp.int32, (b, t), 1)
         cd, ci = _fold_tile_topk(d, tile_ids, cd_ref[:], ci_ref[:], k,
@@ -643,22 +775,30 @@ def _fused_topk_kernel(metric: str, k: int, interpret: bool):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("metric", "k", "tile_n", "interpret"))
-def _fused_topk_tiled(q, x, valid_f, xn, metric, k, tile_n, interpret):
+    jax.jit, static_argnames=("metric", "k", "tile_n", "masked", "interpret"))
+def _fused_topk_tiled(q, x, valid_f, xn, am, metric, k, tile_n, masked,
+                      interpret):
     b, d = q.shape
     n = x.shape[0]
+    in_specs = [
+        pl.BlockSpec((b, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((tile_n, d), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, tile_n), lambda i: (0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, tile_n), lambda i: (0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = (q, x, valid_f, xn)
+    if masked:
+        in_specs.append(
+            pl.BlockSpec((b, tile_n // 32), lambda i: (0, i),
+                         memory_space=pltpu.VMEM))
+        operands = operands + (am,)
     return pl.pallas_call(
-        _fused_topk_kernel(metric, k, interpret),
+        _fused_topk_kernel(metric, k, interpret, masked),
         grid=(n // tile_n,),
-        in_specs=[
-            pl.BlockSpec((b, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile_n, d), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile_n), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile_n), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((b, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((b, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
@@ -674,11 +814,12 @@ def _fused_topk_tiled(q, x, valid_f, xn, metric, k, tile_n, interpret):
         cost_estimate=pl.CostEstimate(
             flops=2 * b * n * d,
             bytes_accessed=q.size * q.dtype.itemsize
-            + x.size * x.dtype.itemsize + 2 * b * k * 4,
+            + x.size * x.dtype.itemsize + 2 * b * k * 4
+            + (b * n // 8 if masked else 0),
             transcendentals=0,
         ),
         interpret=interpret,
-    )(q, x, valid_f, xn)
+    )(*operands)
 
 
 def fused_topk_scan(
@@ -690,6 +831,8 @@ def fused_topk_scan(
     x_sq_norms: jnp.ndarray | None = None,
     tile_n: int = 512,
     interpret: bool | None = None,
+    allow_bits: jnp.ndarray | None = None,
+    allow_rows: jnp.ndarray | None = None,
 ):
     """Fused masked distance scan + EXACT top-k: q [B,d] vs x [N,d] ->
     (dists [B,k] f32 ascending, row ids [B,k] i32, -1 where fewer than k
@@ -700,20 +843,31 @@ def fused_topk_scan(
     short result. Query batches above ``max_b`` are processed in
     independent blocks so the resident q + [blk, tile_n] distance tile +
     fold working set stay inside the ~16 MB VMEM budget at any serving
-    batch (the same cap hnsw_build applies to its query blocks)."""
+    batch (the same cap hnsw_build applies to its query blocks).
+
+    ``allow_bits`` [B, >=ceil(N_512/32)] uint32 adds a PER-QUERY allow
+    bitmask (``pack_allow_bitmask`` layout) unpacked tile-locally in VMEM
+    and folded into the validity epilogue; ``allow_rows`` [B, N] bool is
+    the unpacked convenience form (packed on device — the sharded path
+    uses it after slicing its local columns). Masked scans force
+    tile_n = MASK_BLOCK so tiles cover whole packed blocks."""
     if metric not in PALLAS_METRICS:
         raise ValueError(f"no fused top-k kernel for metric {metric!r}")
     if not 1 <= k <= _FUSED_TOPK_MAX_K:
         raise ValueError(f"fused top-k requires 1 <= k <= 128, got {k}")
     if interpret is None:
         interpret = not recommended()
+    if allow_bits is None and allow_rows is not None:
+        allow_bits = pack_allow_bitmask_jnp(allow_rows)
 
     max_b = 1024
     if q.shape[0] > max_b:
         parts = [
             fused_topk_scan(q[s:s + max_b], x, k, metric=metric,
                             valid=valid, x_sq_norms=x_sq_norms,
-                            tile_n=tile_n, interpret=interpret)
+                            tile_n=tile_n, interpret=interpret,
+                            allow_bits=(None if allow_bits is None
+                                        else allow_bits[s:s + max_b]))
             for s in range(0, q.shape[0], max_b)
         ]
         return (jnp.concatenate([p[0] for p in parts]),
@@ -730,7 +884,10 @@ def fused_topk_scan(
 
     pb = _pad_to(max(b, 1), _SUBLANE)
     pd = _pad_to(max(d, 1), _LANE)
-    tile_n = min(tile_n, _pad_to(max(n, 1), _LANE))
+    if allow_bits is not None:
+        tile_n = MASK_BLOCK  # tiles must cover whole packed mask blocks
+    else:
+        tile_n = min(tile_n, _pad_to(max(n, 1), _LANE))
     pn = _pad_to(max(n, 1), tile_n)
 
     if (pb, pd) != (b, d):
@@ -748,8 +905,11 @@ def fused_topk_scan(
     else:
         xn = jnp.pad(x_sq_norms.astype(jnp.float32), (0, pn - n))
 
+    am = (None if allow_bits is None
+          else _fit_mask_words(allow_bits, pb, pn))
     out_d, out_i = _fused_topk_tiled(
-        q, x, valid_f[None, :], xn[None, :], metric, k, tile_n, interpret)
+        q, x, valid_f[None, :], xn[None, :], am, metric, k, tile_n,
+        allow_bits is not None, interpret)
     return out_d[:b], out_i[:b]
 
 
@@ -836,8 +996,9 @@ def fused_topk_pairs(
 _SCAN_ID_BITS = 6  # slice-id field width: reduce_l <= 64 strided slices
 
 
-def _bq_scan_kernel(qmat_ref, x_ref, bias_ref, out_ref,
-                    *, w, subtiles, sub_rows, out_w, row_major, interpret):
+def _bq_scan_kernel(qmat_ref, x_ref, bias_ref, *refs,
+                    w, subtiles, sub_rows, out_w, row_major, masked,
+                    interpret):
     """Fused BQ scan supertile: ±1-int8 matmul hamming + strided block-argmin.
 
     Round-4 redesign of the BQ hot path. The ideas versus ``_bq_mxu_kernel``:
@@ -868,7 +1029,15 @@ def _bq_scan_kernel(qmat_ref, x_ref, bias_ref, out_ref,
     128/w of VMEM to T(8,128) lane padding — the round-4 OOM), bias
     [1, ST] int32. Emits packed int32 [B, ST/L]; driver unpacks
     vals = packed >> 6 (+qpop) and ids = (packed & 63)*out_w + column.
+
+    With ``masked``, an extra [B, ST/32] int32 ref carries per-query
+    packed allow words (pack_allow_bitmask layout); disallowed slots are
+    forced to INT32_MAX before the strided min so they can never win.
     """
+    if masked:
+        am_ref, out_ref = refs
+    else:
+        (out_ref,) = refs
     qmat = qmat_ref[:]
     slices_per_sub = sub_rows // out_w
     # loop-invariant: plane index of each unpacked row/lane
@@ -892,6 +1061,11 @@ def _bq_scan_kernel(qmat_ref, x_ref, bias_ref, out_ref,
             preferred_element_type=jnp.int32,
         )  # [B, sub] = (hamming - qpop) << 6
         packed = dots + bias_ref[:, pl.ds(j * sub_rows, sub_rows)]
+        if masked:
+            mw = am_ref[:, pl.ds(j * (sub_rows // 32), sub_rows // 32)]
+            bits = _mask_unpack_cols(mw, sub_rows, interpret)
+            packed = jnp.where(bits > 0, packed,
+                               jnp.iinfo(jnp.int32).max)
         for s in range(slices_per_sub):
             acc = jnp.minimum(acc, packed[:, s * out_w:(s + 1) * out_w])
         return acc
@@ -906,9 +1080,9 @@ def _bq_scan_kernel(qmat_ref, x_ref, bias_ref, out_ref,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "supertile", "sub_rows", "out_w", "row_major", "interpret"))
-def _bq_scan_tiled(qmat, x_t, bias, supertile, sub_rows, out_w,
-                   row_major, interpret):
+    "supertile", "sub_rows", "out_w", "row_major", "masked", "interpret"))
+def _bq_scan_tiled(qmat, x_t, bias, am, supertile, sub_rows, out_w,
+                   row_major, masked, interpret):
     b = qmat.shape[0]
     if row_major:
         n, w = x_t.shape
@@ -920,27 +1094,35 @@ def _bq_scan_tiled(qmat, x_t, bias, supertile, sub_rows, out_w,
                               memory_space=pltpu.VMEM)
     subtiles = supertile // sub_rows
     reduce_l = supertile // out_w
+    in_specs = [
+        pl.BlockSpec((b, 32 * w), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        x_spec,
+        pl.BlockSpec((1, supertile), lambda i: (0, i), memory_space=pltpu.VMEM),
+    ]
+    operands = (qmat, x_t, bias)
+    if masked:
+        in_specs.append(
+            pl.BlockSpec((b, supertile // 32), lambda i: (0, i),
+                         memory_space=pltpu.VMEM))
+        operands = operands + (am,)
     return pl.pallas_call(
         functools.partial(_bq_scan_kernel, w=w, subtiles=subtiles,
                           sub_rows=sub_rows, out_w=out_w,
-                          row_major=row_major, interpret=interpret),
+                          row_major=row_major, masked=masked,
+                          interpret=interpret),
         grid=(n // supertile,),
-        in_specs=[
-            pl.BlockSpec((b, 32 * w), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            x_spec,
-            pl.BlockSpec((1, supertile), lambda i: (0, i), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((b, out_w), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, n // reduce_l), jnp.int32),
         cost_estimate=pl.CostEstimate(
             flops=2 * b * n * 32 * w,
             bytes_accessed=qmat.size + x_t.size * 4
-            + b * (n // reduce_l) * 4,
+            + b * (n // reduce_l) * 4 + (b * n // 8 if masked else 0),
             transcendentals=0,
         ),
         interpret=interpret,
-    )(qmat, x_t, bias)
+    )(*operands)
 
 
 def bq_queries_to_pm1(q_bits: jnp.ndarray, w: int,
@@ -963,6 +1145,7 @@ def bq_scan_reduce(
     interpret: bool | None = None,
     transposed: bool = False,
     sub_rows: int | None = None,
+    allow_bits: jnp.ndarray | None = None,
 ):
     """Full-corpus BQ scan with in-kernel candidate reduction.
 
@@ -978,6 +1161,11 @@ def bq_scan_reduce(
     as huge values) and ids are global row indices; strided blocks keep one
     candidate each (see _bq_scan_kernel). Feed to approx/exact top-k, then
     rescore.
+
+    ``allow_bits`` [B, >=ceil(N_512/32)] uint32 adds a per-query allow
+    bitmask (pack_allow_bitmask layout); disallowed rows never surface,
+    and supertile/sub_rows are forced MASK_BLOCK-aligned so subtiles
+    unpack whole packed blocks.
     """
     if interpret is None:
         interpret = not recommended()
@@ -1019,6 +1207,13 @@ def bq_scan_reduce(
     out_w = min(max(128, st_cap // reduce_l), sub_rows)
     supertile = reduce_l * out_w
     sub_rows = min(sub_rows, supertile)
+    if allow_bits is not None:
+        # masked subtiles unpack whole 512-column packed blocks (all of
+        # out_w/sub_rows/supertile are pow2, so alignment = scaling up)
+        while supertile % MASK_BLOCK:
+            out_w *= 2
+            supertile = reduce_l * out_w
+        sub_rows = min(max(sub_rows, out_w, MASK_BLOCK), supertile)
     pn = _pad_to(max(n, 1), supertile)
     if pw != w:
         q_bits = jnp.pad(q_bits, ((0, 0), (0, pw - w)))
@@ -1048,8 +1243,11 @@ def bq_scan_reduce(
         ).astype(jnp.int32), axis=1).astype(jnp.float32)
     if x_t.dtype == jnp.uint32:
         x_t = jax.lax.bitcast_convert_type(x_t, jnp.int32)
-    packed = _bq_scan_tiled(qmat, x_t, bias[None, :], supertile,
-                            sub_rows, out_w, row_major, interpret)
+    am = (None if allow_bits is None
+          else _fit_mask_words(allow_bits, pb, pn))
+    packed = _bq_scan_tiled(qmat, x_t, bias[None, :], am, supertile,
+                            sub_rows, out_w, row_major,
+                            allow_bits is not None, interpret)
     vals = jax.lax.shift_right_arithmetic(packed, _SCAN_ID_BITS)
     slice_ids = jax.lax.bitwise_and(packed, (1 << _SCAN_ID_BITS) - 1)
     col = jnp.arange(pn // reduce_l, dtype=jnp.int32)
@@ -1064,8 +1262,9 @@ def bq_scan_reduce(
     return vals, ids[:b]
 
 
-def _pq4_scan_kernel(lut_ref, c_ref, bias_ref, out_ref,
-                     *, m, subtiles, sub_rows, out_w, row_major, interpret):
+def _pq4_scan_kernel(lut_ref, c_ref, bias_ref, *refs,
+                     m, subtiles, sub_rows, out_w, row_major, masked,
+                     interpret):
     """Fused 4-bit-PQ ADC scan supertile (the PQ twin of _bq_scan_kernel).
 
     lut [B, 16m] int8 CODE-MAJOR per-query tables (quantized with a
@@ -1073,7 +1272,13 @@ def _pq4_scan_kernel(lut_ref, c_ref, bias_ref, out_ref,
     [m, ST] transposed, bias [1, ST] int32 carrying the strided slice id
     (low 6 bits) and a dead-row offset. One int8 matmul against the
     in-VMEM one-hot gives integer ADC sums; merge is shift + add + min.
+    ``masked``: extra [B, ST/32] int32 ref of per-query packed allow
+    words, applied exactly like _bq_scan_kernel's.
     """
+    if masked:
+        am_ref, out_ref = refs
+    else:
+        (out_ref,) = refs
     lut = lut_ref[:]
     slices_per_sub = sub_rows // out_w
     rep_axis = 1 if row_major else 0
@@ -1097,6 +1302,11 @@ def _pq4_scan_kernel(lut_ref, c_ref, bias_ref, out_ref,
         )  # [B, sub] integer ADC sums
         packed = (jax.lax.shift_left(dots, _SCAN_ID_BITS)
                   + bias_ref[:, pl.ds(j * sub_rows, sub_rows)])
+        if masked:
+            mw = am_ref[:, pl.ds(j * (sub_rows // 32), sub_rows // 32)]
+            bits = _mask_unpack_cols(mw, sub_rows, interpret)
+            packed = jnp.where(bits > 0, packed,
+                               jnp.iinfo(jnp.int32).max)
         for s in range(slices_per_sub):
             acc = jnp.minimum(acc, packed[:, s * out_w:(s + 1) * out_w])
         return acc
@@ -1111,9 +1321,9 @@ def _pq4_scan_kernel(lut_ref, c_ref, bias_ref, out_ref,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "supertile", "sub_rows", "out_w", "row_major", "interpret"))
-def _pq4_scan_tiled(lut8, codes, bias, supertile, sub_rows, out_w,
-                    row_major, interpret):
+    "supertile", "sub_rows", "out_w", "row_major", "masked", "interpret"))
+def _pq4_scan_tiled(lut8, codes, bias, am, supertile, sub_rows, out_w,
+                    row_major, masked, interpret):
     b = lut8.shape[0]
     if row_major:
         n, m = codes.shape
@@ -1125,29 +1335,37 @@ def _pq4_scan_tiled(lut8, codes, bias, supertile, sub_rows, out_w,
                               memory_space=pltpu.VMEM)
     subtiles = supertile // sub_rows
     reduce_l = supertile // out_w
+    in_specs = [
+        pl.BlockSpec((b, 16 * m), lambda i: (0, 0),
+                     memory_space=pltpu.VMEM),
+        c_spec,
+        pl.BlockSpec((1, supertile), lambda i: (0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = (lut8, codes, bias)
+    if masked:
+        in_specs.append(
+            pl.BlockSpec((b, supertile // 32), lambda i: (0, i),
+                         memory_space=pltpu.VMEM))
+        operands = operands + (am,)
     return pl.pallas_call(
         functools.partial(_pq4_scan_kernel, m=m, subtiles=subtiles,
                           sub_rows=sub_rows, out_w=out_w,
-                          row_major=row_major, interpret=interpret),
+                          row_major=row_major, masked=masked,
+                          interpret=interpret),
         grid=(n // supertile,),
-        in_specs=[
-            pl.BlockSpec((b, 16 * m), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            c_spec,
-            pl.BlockSpec((1, supertile), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((b, out_w), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, n // reduce_l), jnp.int32),
         cost_estimate=pl.CostEstimate(
             flops=2 * b * n * 16 * m,
             bytes_accessed=lut8.size + codes.size
-            + b * (n // reduce_l) * 4,
+            + b * (n // reduce_l) * 4 + (b * n // 8 if masked else 0),
             transcendentals=0,
         ),
         interpret=interpret,
-    )(lut8, codes, bias)
+    )(*operands)
 
 
 def pq4_scan_reduce(
@@ -1158,6 +1376,7 @@ def pq4_scan_reduce(
     interpret: bool | None = None,
     transposed: bool = False,
     sub_rows: int | None = None,
+    allow_bits: jnp.ndarray | None = None,
 ):
     """Full-corpus 4-bit-PQ ADC scan with in-kernel candidate reduction.
 
@@ -1169,7 +1388,9 @@ def pq4_scan_reduce(
     the same packed (value|slice-id) strided-min merge as the BQ kernel.
 
     Returns (vals [B, ~N/L] f32 approximate ADC distances with dead rows
-    at MASKED_DISTANCE, ids [B, ~N/L] int32 global rows).
+    at MASKED_DISTANCE, ids [B, ~N/L] int32 global rows). ``allow_bits``
+    adds a per-query packed allow bitmask (same contract as
+    ``bq_scan_reduce``).
     """
     if interpret is None:
         interpret = not recommended()
@@ -1198,6 +1419,11 @@ def pq4_scan_reduce(
     out_w = min(max(128, st_cap // reduce_l), sub_rows)
     supertile = reduce_l * out_w
     sub_rows = min(sub_rows, supertile)
+    if allow_bits is not None:
+        while supertile % MASK_BLOCK:
+            out_w *= 2
+            supertile = reduce_l * out_w
+        sub_rows = min(max(sub_rows, out_w, MASK_BLOCK), supertile)
     pn = _pad_to(max(n, 1), supertile)
     if pm != m:
         lut = jnp.pad(lut, ((0, 0), (0, pm - m), (0, 0)))
@@ -1225,8 +1451,11 @@ def pq4_scan_reduce(
                                        constant_values=False))
         dead = jnp.logical_or(dead, pos >= n)
     bias = slice_id + jnp.where(dead, dead_off << _SCAN_ID_BITS, 0)
-    packed = _pq4_scan_tiled(lut8, codes, bias[None, :], supertile,
-                             sub_rows, out_w, row_major, interpret)
+    am = (None if allow_bits is None
+          else _fit_mask_words(allow_bits, pb, pn))
+    packed = _pq4_scan_tiled(lut8, codes, bias[None, :], am, supertile,
+                             sub_rows, out_w, row_major,
+                             allow_bits is not None, interpret)
     raw = jax.lax.shift_right_arithmetic(packed, _SCAN_ID_BITS)
     slice_ids = jax.lax.bitwise_and(packed, (1 << _SCAN_ID_BITS) - 1)
     col = jnp.arange(pn // reduce_l, dtype=jnp.int32)

@@ -172,11 +172,14 @@ def pq_topk(
     valid: jnp.ndarray | None = None,
     id_offset: jnp.ndarray | int = 0,
     m: int | None = None,
+    allow_bits: jnp.ndarray | None = None,
 ):
     """Compressed brute-force top-k: scan codes in chunks, reconstruct, score.
 
     Matches LUT-ADC results exactly for l2-squared/dot/cosine (orthogonal
     segments). Returns (dists [B,k], ids [B,k]) like chunked_topk.
+    ``allow_bits`` adds a per-query packed allow bitmask, unpacked once
+    and folded per chunk like the shared ``valid``.
     """
     from weaviate_tpu.ops.distances import MASKED_DISTANCE, pairwise_distance
     from weaviate_tpu.ops.topk import approx_topk_smallest, topk_smallest
@@ -189,17 +192,26 @@ def pq_topk(
 
     code_chunks = codes.reshape(num_chunks, chunk_size, m)
     valid_chunks = None if valid is None else valid.reshape(num_chunks, chunk_size)
+    allow_chunks = None
+    if allow_bits is not None:
+        from weaviate_tpu.ops.pallas_kernels import unpack_allow_bitmask
+
+        allow_chunks = jnp.moveaxis(
+            unpack_allow_bitmask(allow_bits, n).reshape(
+                b, num_chunks, chunk_size), 1, 0)
 
     init_d = jnp.full((b, k), MASKED_DISTANCE, dtype=jnp.float32)
     init_i = jnp.full((b, k), -1, dtype=jnp.int32)
 
     def body(carry, inp):
         best_d, best_i = carry
-        chunk_idx, cc, vc = inp
+        chunk_idx, cc, vc, ac = inp
         x_hat = pq_reconstruct(cc, centroids, m)
         d = pairwise_distance(q, x_hat, metric=metric)
         if vc is not None:
             d = jnp.where(vc[None, :], d, MASKED_DISTANCE)
+        if ac is not None:
+            d = jnp.where(ac, d, MASKED_DISTANCE)
         ids = (
             chunk_idx * chunk_size
             + id_offset
@@ -223,11 +235,13 @@ def pq_topk(
         (fd, fi), _ = body(
             (init_d, init_i),
             (chunk_ids[0], code_chunks[0],
-             None if valid_chunks is None else valid_chunks[0]),
+             None if valid_chunks is None else valid_chunks[0],
+             None if allow_chunks is None else allow_chunks[0]),
         )
     else:
         (fd, fi), _ = jax.lax.scan(
-            body, (init_d, init_i), (chunk_ids, code_chunks, valid_chunks)
+            body, (init_d, init_i),
+            (chunk_ids, code_chunks, valid_chunks, allow_chunks)
         )
     return fd, fi
 
@@ -301,6 +315,7 @@ def pq_topk_twostage(
     use_pallas: bool = True,
     chunk_budget_bytes: int = 128 << 20,
     selection: str = "approx",
+    allow_bits: jnp.ndarray | None = None,
 ):
     """Two-stage PQ scan (the r4 verdict's "extend the prefix idea to PQ").
 
@@ -329,9 +344,11 @@ def pq_topk_twostage(
     if use_pallas:
         from weaviate_tpu.ops.pallas_kernels import bq_scan_reduce
 
+        # per-query mask prunes in stage 1; stage 2 only sees allowed rows
         vals1, ids1 = bq_scan_reduce(
             q_prefix_words, prefix_t, valid=valid,
-            reduce_l=bq_ops._auto_reduce_l(n), transposed=True)
+            reduce_l=bq_ops._auto_reduce_l(n), transposed=True,
+            allow_bits=allow_bits)
         r = min(refine * k, vals1.shape[1])
         if selection == "fused" and r <= 256:
             # exact stage-1 refine via the in-kernel running-carry fold
@@ -346,7 +363,7 @@ def pq_topk_twostage(
     else:
         cand_d1, ids1 = bq_ops.bq_topk(
             q_prefix_words, prefix_t.T, k=min(refine * k, n), valid=valid,
-            use_pallas=False)
+            use_pallas=False, allow_bits=allow_bits)
         cand = jnp.where(ids1 < 0, 0, ids1)
         r = cand.shape[1]
 
@@ -415,6 +432,7 @@ def pq4_topk(
     m: int | None = None,
     reduce_l: int | None = None,
     selection: str = "approx",
+    allow_bits: jnp.ndarray | None = None,
 ):
     """Compressed brute-force top-k over 4-bit codes via the fused ADC scan
     kernel (pallas_kernels.pq4_scan_reduce: per-query int8 LUT, one-hot
@@ -432,7 +450,8 @@ def pq4_topk(
     n = codes.shape[0]
     lut = pq_lut(q, centroids, metric, m)  # [B, m, k]
     rl = reduce_l if reduce_l is not None else _auto_reduce_l(n)
-    vals, ids = pq4_scan_reduce(lut, codes, valid=valid, reduce_l=rl)
+    vals, ids = pq4_scan_reduce(lut, codes, valid=valid, reduce_l=rl,
+                                allow_bits=allow_bits)
     from weaviate_tpu.ops.topk import select_survivors
 
     return select_survivors(vals, ids, k, selection, id_offset)

@@ -113,6 +113,8 @@ def chunked_topk_distances(
     id_offset: jnp.ndarray | int = 0,
     use_pallas: bool = False,
     selection: str = "exact",
+    allow_bits: jnp.ndarray | None = None,
+    allow_rows: jnp.ndarray | None = None,
 ):
     """Brute-force top-k of ``q`` [B,d] against ``x`` [N,d], scanning in chunks.
 
@@ -122,6 +124,14 @@ def chunked_topk_distances(
     surface. ``id_offset`` shifts local row indices into global id space for
     sharded corpora. N must be a multiple of chunk_size (pad the store, not
     the query path). Returns (dists [B,k], ids [B,k]) ascending.
+
+    ``allow_bits`` adds a PER-QUERY allow bitmask ([B, ceil(N_512/32)]
+    uint32, ``pallas_kernels.pack_allow_bitmask`` layout) — the batched
+    filtered-search dataplane. The fused path unpacks it tile-locally in
+    VMEM; the XLA paths unpack once and fold a [B, chunk] where into each
+    tile. ``allow_rows`` ([B, N] bool) is the unpacked equivalent for
+    callers that already hold a sliced bool mask (the sharded local path);
+    pass at most one of the two.
 
     ``selection`` picks the per-chunk candidate selector:
 
@@ -161,7 +171,8 @@ def chunked_topk_distances(
         if metric in PALLAS_METRICS and k <= _FUSED_TOPK_MAX_K:
             d, i = fused_topk_scan(
                 q, x, k=k, metric=metric, valid=valid,
-                x_sq_norms=x_sq_norms,
+                x_sq_norms=x_sq_norms, allow_bits=allow_bits,
+                allow_rows=allow_rows,
             )
             return d, jnp.where(i < 0, i, i + id_offset)
         # degrade gracefully: non-Pallas metrics take the exact XLA scan,
@@ -170,10 +181,28 @@ def chunked_topk_distances(
     num_chunks = n // chunk_size
     b = q.shape[0]
 
+    if allow_rows is None and allow_bits is not None:
+        # one elementwise unpack pass; the per-chunk fold below is then a
+        # plain where like the shared-valid one
+        from weaviate_tpu.ops.pallas_kernels import unpack_allow_bitmask
+
+        allow_rows = unpack_allow_bitmask(allow_bits, n)
+    if allow_rows is not None:
+        allow_rows = allow_rows.astype(bool)
+        if allow_rows.shape[1] < n:
+            allow_rows = jnp.pad(
+                allow_rows, ((0, 0), (0, n - allow_rows.shape[1])))
+        allow_rows = allow_rows[:, :n]
+
     x_chunks = x.reshape(num_chunks, chunk_size, x.shape[1])
     valid_chunks = None if valid is None else valid.reshape(num_chunks, chunk_size)
     norm_chunks = (
         None if x_sq_norms is None else x_sq_norms.reshape(num_chunks, chunk_size)
+    )
+    allow_chunks = (
+        None if allow_rows is None
+        else jnp.moveaxis(
+            allow_rows.reshape(b, num_chunks, chunk_size), 1, 0)
     )
 
     init_d = jnp.full((b, k), MASKED_DISTANCE, dtype=jnp.float32)
@@ -181,7 +210,7 @@ def chunked_topk_distances(
 
     def body(carry, inp):
         best_d, best_i = carry
-        chunk_idx, xc, vc, nc = inp
+        chunk_idx, xc, vc, nc, ac = inp
         if use_pallas:
             # Fused Pallas tile kernel: MXU matmul + mask epilogue in VMEM
             # (ops/pallas_kernels.py) — the TPU stand-in for the reference's
@@ -196,6 +225,8 @@ def chunked_topk_distances(
             d = pairwise_distance(q, xc, metric=metric, x_sq_norms=nc)
             if vc is not None:
                 d = jnp.where(vc[None, :], d, MASKED_DISTANCE)
+        if ac is not None:
+            d = jnp.where(ac, d, MASKED_DISTANCE)
         local_ids = (
             chunk_idx * chunk_size
             + id_offset
@@ -215,7 +246,7 @@ def chunked_topk_distances(
         return (new_d, new_i), None
 
     chunk_ids = jnp.arange(num_chunks, dtype=jnp.int32)
-    xs = (chunk_ids, x_chunks, valid_chunks, norm_chunks)
+    xs = (chunk_ids, x_chunks, valid_chunks, norm_chunks, allow_chunks)
     if num_chunks == 1:
         # Avoid scan overhead for small corpora.
         (final_d, final_i), _ = body(
@@ -225,6 +256,7 @@ def chunked_topk_distances(
                 x_chunks[0],
                 None if valid_chunks is None else valid_chunks[0],
                 None if norm_chunks is None else norm_chunks[0],
+                None if allow_chunks is None else allow_chunks[0],
             ),
         )
     else:
@@ -233,7 +265,8 @@ def chunked_topk_distances(
 
 
 def chunked_topk(q, x, k, chunk_size=8192, metric="l2-squared", valid=None,
-                 x_sq_norms=None, id_offset=0, selection="exact"):
+                 x_sq_norms=None, id_offset=0, selection="exact",
+                 allow_bits=None, allow_rows=None):
     """Non-jit convenience wrapper (jit happens inside).
 
     Unlike the raw kernel, this accepts any corpus size: when ``chunk_size``
@@ -257,5 +290,5 @@ def chunked_topk(q, x, k, chunk_size=8192, metric="l2-squared", valid=None,
             )
     return chunked_topk_distances(
         q, x, k, chunk_size, metric, valid, x_sq_norms, id_offset,
-        selection=selection,
+        selection=selection, allow_bits=allow_bits, allow_rows=allow_rows,
     )

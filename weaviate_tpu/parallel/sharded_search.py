@@ -75,18 +75,24 @@ def _sharded_topk_jit(
     axis: str = SHARD_AXIS,
     use_pallas: bool = False,
     selection: str = "exact",
+    allow_rows: jnp.ndarray | None = None,
 ):
     """Top-k of q [B,d] against row-sharded corpus x [N,d].
 
     ``x``/``valid``/``x_sq_norms`` must be sharded over ``axis`` on their
-    leading dim; ``q`` is replicated. Returns replicated (dists [B,k],
-    global_ids [B,k]) where ids index the unsharded [N] row space.
+    leading dim; ``q`` is replicated. ``allow_rows`` ([B, N] bool —
+    per-query filter masks) is sharded over ``axis`` on its COLUMN dim,
+    row-aligned with the corpus: each device applies (and, for the fused
+    kernel, packs) only its own slice; the ICI merge is unchanged because
+    masked rows simply never become candidates. Returns replicated
+    (dists [B,k], global_ids [B,k]) where ids index the unsharded [N]
+    row space.
     """
     n = x.shape[0]
     n_shards = mesh.shape[axis]
     local_rows = n // n_shards
 
-    def local_search(q_, x_, valid_, norms_):
+    def local_search(q_, x_, valid_, norms_, allow_):
         shard_idx = jax.lax.axis_index(axis)
         d, i = chunked_topk_distances(
             q_,
@@ -99,6 +105,7 @@ def _sharded_topk_jit(
             id_offset=shard_idx * local_rows,
             use_pallas=use_pallas,
             selection=selection,
+            allow_rows=allow_,
         )
         return _ici_merge_topk(d, i, axis, k)
 
@@ -107,6 +114,7 @@ def _sharded_topk_jit(
         P(axis, None),  # x row-sharded
         P(axis),        # valid row-sharded
         P() if x_sq_norms is None else P(axis),
+        P() if allow_rows is None else P(None, axis),  # mask column-sharded
     )
     out_specs = (P(), P())
     fn = shard_map(
@@ -116,20 +124,22 @@ def _sharded_topk_jit(
         out_specs=out_specs,
         check_vma=False,
     )
-    return fn(q, x, valid, x_sq_norms)
+    return fn(q, x, valid, x_sq_norms, allow_rows)
 
 
 def sharded_topk(q, x, valid, x_sq_norms, *, k, chunk_size, metric, mesh,
-                 axis=SHARD_AXIS, use_pallas=False, selection="exact"):
+                 axis=SHARD_AXIS, use_pallas=False, selection="exact",
+                 allow_rows=None):
     """Span-wrapped dispatch of the SPMD scan + ICI top-k merge program
     (spans can't live inside jit; the wrapper times the host-side
     dispatch and device_sync at the store level attributes execution)."""
     with tracing.span("spmd.sharded_topk", shards=mesh.shape[axis], k=k,
-                      rows=int(x.shape[0])):
+                      rows=int(x.shape[0]),
+                      filtered=allow_rows is not None):
         return _sharded_topk_jit(
             q, x, valid, x_sq_norms, k=k, chunk_size=chunk_size,
             metric=metric, mesh=mesh, axis=axis, use_pallas=use_pallas,
-            selection=selection)
+            selection=selection, allow_rows=allow_rows)
 
 
 @functools.partial(
@@ -155,6 +165,7 @@ def _sharded_quantized_topk_jit(
     axis: str = SHARD_AXIS,
     use_pallas: bool = False,
     selection: str = "approx",
+    allow_rows: jnp.ndarray | None = None,
 ):
     """Compressed scan over a row-sharded code array, one SPMD program.
 
@@ -171,8 +182,10 @@ def _sharded_quantized_topk_jit(
     query bits for bq. ``selection`` picks the per-shard survivor selector
     for the bq/pq4 scan-reduce paths ("approx" = approx_max_k, "fused" =
     exact in-kernel running-carry top-k); the ICI merge contract is
-    unchanged either way. Returns replicated (dists [B, k_out], global
-    ids).
+    unchanged either way. ``allow_rows`` [B, N] bool per-query filter
+    masks are COLUMN-sharded row-aligned with the codes; each device
+    packs its slice to the kernel bitmask locally. Returns replicated
+    (dists [B, k_out], global ids).
     """
     from weaviate_tpu.ops import bq as bq_ops
     from weaviate_tpu.ops import pq as pq_ops
@@ -183,23 +196,31 @@ def _sharded_quantized_topk_jit(
     local_rows = n // n_shards
     b = q.shape[0]
 
-    def local_scan(q_, qw_, cent_, codes_, valid_, resc_):
+    def local_scan(q_, qw_, cent_, codes_, valid_, resc_, allow_=None):
         shard_idx = jax.lax.axis_index(axis)
+        ab_ = None
+        if allow_ is not None:
+            from weaviate_tpu.ops.pallas_kernels import (
+                pack_allow_bitmask_jnp)
+
+            ab_ = pack_allow_bitmask_jnp(allow_)
         if quantization == "bq":
             d_c, i_c = bq_ops.bq_topk(
                 qw_, codes_, k=min(k, local_rows), chunk_size=chunk_size,
                 valid=valid_, use_pallas=use_pallas, selection=selection,
+                allow_bits=ab_,
             )
         elif quantization == "pq4":
             d_c, i_c = pq_ops.pq4_topk(
                 q_, codes_, cent_, k=min(k, local_rows),
                 chunk_size=chunk_size, metric=metric, valid=valid_,
-                selection=selection,
+                selection=selection, allow_bits=ab_,
             )
         else:
             d_c, i_c = pq_ops.pq_topk(
                 q_, codes_, cent_, k=min(k, local_rows),
                 chunk_size=chunk_size, metric=metric, valid=valid_,
+                allow_bits=ab_,
             )
         if resc_ is not None:
             # exact rescore of local candidates against local bf16 rows:
@@ -219,40 +240,48 @@ def _sharded_quantized_topk_jit(
         gid = jnp.where(i_c >= 0, i_c + shard_idx * local_rows, -1)
         return _ici_merge_topk(d_c, gid, axis, k_out)
 
-    # assemble args/specs in Python (quantization and rescore presence are
-    # static): shard_map can't close over traced arrays and optional
-    # operands can't be None, so absent ones become tiny dummies
+    # assemble args/specs in Python (quantization and rescore/allow
+    # presence are static): shard_map can't close over traced arrays and
+    # optional operands can't be None, so absent ones become tiny dummies
     qw = q_words if q_words is not None else jnp.zeros((b, 1), jnp.uint32)
     cent = (centroids if centroids is not None
             else jnp.zeros((1, 1, 1), jnp.float32))
-    base_args = (q, qw, cent, codes, valid)
-    base_specs = (P(), P(), P(), P(axis, None), P(axis))
-    if rescore_rows is None:
-        def fn(q_, qw_, cent_, codes_, valid_):
-            return local_scan(q_, qw_, cent_, codes_, valid_, None)
-        sharded = shard_map(fn, mesh=mesh, in_specs=base_specs,
-                            out_specs=(P(), P()), check_vma=False)
-        return sharded(*base_args)
-    sharded = shard_map(
-        local_scan, mesh=mesh, in_specs=base_specs + (P(axis, None),),
-        out_specs=(P(), P()), check_vma=False,
-    )
-    return sharded(*base_args, rescore_rows)
+    has_resc = rescore_rows is not None
+    has_allow = allow_rows is not None
+    args = [q, qw, cent, codes, valid]
+    specs = [P(), P(), P(), P(axis, None), P(axis)]
+    if has_resc:
+        args.append(rescore_rows)
+        specs.append(P(axis, None))
+    if has_allow:
+        args.append(allow_rows)
+        specs.append(P(None, axis))  # mask column-sharded, row-aligned
+
+    def fn(q_, qw_, cent_, codes_, valid_, *rest):
+        resc_ = rest[0] if has_resc else None
+        allow_ = rest[-1] if has_allow else None
+        return local_scan(q_, qw_, cent_, codes_, valid_, resc_, allow_)
+
+    sharded = shard_map(fn, mesh=mesh, in_specs=tuple(specs),
+                        out_specs=(P(), P()), check_vma=False)
+    return sharded(*args)
 
 
 def sharded_quantized_topk(q, q_words, codes, valid, rescore_rows,
                            centroids, *, k, k_out, chunk_size,
                            quantization, metric, mesh, axis=SHARD_AXIS,
-                           use_pallas=False, selection="approx"):
+                           use_pallas=False, selection="approx",
+                           allow_rows=None):
     """Span-wrapped dispatch of the compressed SPMD scan + ICI merge."""
     with tracing.span("spmd.quantized_topk", shards=mesh.shape[axis],
                       k=k_out, rows=int(codes.shape[0]),
-                      quantization=quantization):
+                      quantization=quantization,
+                      filtered=allow_rows is not None):
         return _sharded_quantized_topk_jit(
             q, q_words, codes, valid, rescore_rows, centroids, k=k,
             k_out=k_out, chunk_size=chunk_size, quantization=quantization,
             metric=metric, mesh=mesh, axis=axis, use_pallas=use_pallas,
-            selection=selection)
+            selection=selection, allow_rows=allow_rows)
 
 
 def shard_array(arr, mesh: Mesh, axis: str = SHARD_AXIS, dim: int = 0):

@@ -327,6 +327,14 @@ batcher_execute_duration = registry.histogram(
     "weaviate_tpu_query_batcher_execute_seconds",
     "Device dispatch+materialize time of the coalesced batch a query "
     "rode in")
+batcher_filtered_batched = registry.counter(
+    "weaviate_tpu_query_batcher_filtered_batched_total",
+    "Filtered requests served inside a coalesced bitmask-batched "
+    "dispatch (instead of a solo device program)")
+batcher_compile_bucket = registry.counter(
+    "weaviate_tpu_query_batcher_compile_bucket_total",
+    "Coalesced dispatches by padded pow2 (batch, k) bucket — the bucket "
+    "set bounds the number of compiled program variants", ("b", "k"))
 
 # -- tracing (runtime/tracing.py feeds this on every finished span) -----------
 

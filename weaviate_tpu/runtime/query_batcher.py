@@ -12,10 +12,24 @@ worker drains the whole queue into ONE batched dispatch as soon as the
 device frees up. Under load the batch size self-tunes to the arrival
 rate, exactly like continuous batching in model serving.
 
-Only unfiltered requests coalesce: the scan kernel applies one validity
-mask per dispatch, so a request with an AllowList mask dispatches alone
-(the reference's filtered searches take a different path too —
-flat_search_cutoff). Mixed k's batch together at max(k) and slice.
+Filtered requests coalesce too (ISSUE 3): when the index advertises
+``supports_batched_filters`` the drain ships each request's allow list
+alongside its query row and the engine folds them into per-query packed
+bitmasks consumed INSIDE the scan kernels — one device program serves a
+mixed filtered/unfiltered drain (unfiltered rows ride an all-ones mask;
+a drain with no filters skips mask handling entirely). Two escape
+hatches stay on the solo path: index types without batched-filter
+support, and HIGHLY SELECTIVE filters, which the per-dispatch heuristic
+routes to the store's gathered cutover (engine/store.py: scanning a
+dense gather of the few allowed rows beats a full masked scan below
+~capacity/8; the batcher uses a stricter /64 cut because a solo dispatch
+also forfeits batching).
+
+Drained batches are padded to power-of-two B buckets and k is bucketed
+the same way, so the number of compiled program variants is bounded by
+log2(max_batch) * log2(max k) instead of one executable per observed
+(batch, k) combination. Mixed k's batch together at the k bucket and
+slice.
 """
 
 from __future__ import annotations
@@ -28,9 +42,17 @@ import numpy as np
 from weaviate_tpu.runtime import tracing
 
 
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 class _Pending:
     __slots__ = ("query", "k", "allow", "event", "ids", "dists", "error",
-                 "ctx", "t_exec_start", "t_exec_end", "batch_size")
+                 "ctx", "t_exec_start", "t_exec_end", "batch_size",
+                 "t_mask_start", "t_mask_end")
 
     def __init__(self, query, k, allow):
         self.query = query
@@ -46,18 +68,35 @@ class _Pending:
         self.ctx = tracing.capture()
         self.t_exec_start: float | None = None
         self.t_exec_end: float | None = None
+        self.t_mask_start: float | None = None
+        self.t_mask_end: float | None = None
         self.batch_size = 1
 
 
 class QueryBatcher:
     """Wraps one vector index's batched search entry point.
 
-    ``batch_fn(queries [B,d], k, allow) -> (ids [B,k], dists [B,k])``.
+    ``batch_fn(queries [B,d], k, allow) -> (ids [B,k], dists [B,k])``
+    where ``allow`` is None, one shared allow list, or — only when
+    ``supports_filter_batching`` — a list of per-request allow lists
+    (None entries = unfiltered). ``capacity_fn`` (optional, returns the
+    backing store's row capacity) powers the per-dispatch selectivity
+    heuristic that routes tiny filters to the solo/gathered path — wire
+    it ONLY when the store has a gathered cutover; otherwise solo is a
+    full masked scan and strictly worse than batching. ``pad_pow2``
+    pads drains to pow2 B/k buckets — right for jitted device programs
+    (bounds compiled variants), wasted work for per-row host indexes
+    like HNSW (padded rows run real graph searches), so those opt out.
     """
 
-    def __init__(self, batch_fn, max_batch: int = 256):
+    def __init__(self, batch_fn, max_batch: int = 256,
+                 supports_filter_batching: bool = False,
+                 capacity_fn=None, pad_pow2: bool = True):
         self._batch_fn = batch_fn
         self.max_batch = max_batch
+        self.filter_batching = supports_filter_batching
+        self._capacity_fn = capacity_fn
+        self.pad_pow2 = pad_pow2
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: list[_Pending] = []
@@ -66,6 +105,7 @@ class QueryBatcher:
         # observability (tools/bench_e2e asserts coalescing happens)
         self.dispatches = 0
         self.batched_queries = 0
+        self.filtered_batched = 0
 
     def _ensure_worker(self):
         if self._worker is None or not self._worker.is_alive():
@@ -93,6 +133,9 @@ class QueryBatcher:
         if item.t_exec_start is not None:
             tracing.record_span("batcher.wait", t_enqueue,
                                 item.t_exec_start)
+            if item.t_mask_start is not None:
+                tracing.record_span("batcher.mask_pack", item.t_mask_start,
+                                    item.t_mask_end or item.t_mask_start)
             tracing.record_span("batcher.execute", item.t_exec_start,
                                 item.t_exec_end or time.perf_counter(),
                                 batch=item.batch_size)
@@ -133,12 +176,41 @@ class QueryBatcher:
                         it.error = e
                         it.event.set()
 
+    def _allowed_count(self, allow) -> int:
+        """Selectivity of an allow list (bool mask over doc-id space or
+        array of allowed ids)."""
+        a = np.asarray(allow)
+        return int(np.count_nonzero(a)) if a.dtype == np.bool_ else a.size
+
+    def _prefer_solo(self, it: _Pending) -> bool:
+        """Per-dispatch selectivity heuristic: a HIGHLY selective filter
+        beats the batched masked scan by taking the store's gathered
+        cutover, which only exists on the solo (shared-mask) path. The
+        /64 cut is stricter than the store's /8 crossover because going
+        solo also gives up dispatch coalescing."""
+        if self._capacity_fn is None:
+            return False
+        try:
+            cap = int(self._capacity_fn())
+        except Exception:  # noqa: BLE001 — heuristic only, never fail a query
+            return False
+        if cap <= 0:
+            return False
+        return self._allowed_count(it.allow) <= cap // 64
+
     def _dispatch(self, drained: list[_Pending]):
-        # filtered requests run alone (one mask per device dispatch);
-        # unfiltered requests coalesce into one batched program
-        plain = [it for it in drained if it.allow is None]
-        masked = [it for it in drained if it.allow is not None]
-        for it in masked:
+        # split the drain: filtered requests coalesce with the plain ones
+        # into ONE bitmask-batched device program; only index types
+        # without batched-filter support and highly selective filters
+        # (gathered cutover) dispatch solo
+        solo, coal = [], []
+        for it in drained:
+            if it.allow is not None and (
+                    not self.filter_batching or self._prefer_solo(it)):
+                solo.append(it)
+            else:
+                coal.append(it)
+        for it in solo:
             try:
                 it.t_exec_start = time.perf_counter()
                 ids, dists = tracing.run_in(
@@ -149,34 +221,63 @@ class QueryBatcher:
                 it.error = e
             it.t_exec_end = time.perf_counter()
             it.event.set()
-        if not plain:
+        if not coal:
             return
-        k_max = max(it.k for it in plain)
-        queries = np.stack([it.query for it in plain])
+        b = len(coal)
+        # pow2 B/k buckets bound the number of compiled variants (one
+        # executable per bucket, not per observed batch size); padded
+        # query rows are zero vectors whose results are discarded
+        if self.pad_pow2:
+            b_pad = min(_next_pow2(b), max(self.max_batch, b))
+            k_bucket = _next_pow2(max(it.k for it in coal))
+        else:
+            b_pad = b
+            k_bucket = max(it.k for it in coal)
+        filtered = [it for it in coal if it.allow is not None]
+        t_mask0 = time.perf_counter()
+        allows = None
+        if filtered:
+            # per-request allow lists ride along row-aligned; unfiltered
+            # and padded rows are None (all-ones downstream)
+            allows = [it.allow for it in coal] + [None] * (b_pad - b)
+        queries = np.zeros((b_pad,) + coal[0].query.shape, dtype=np.float32)
+        for row, it in enumerate(coal):
+            queries[row] = it.query
+        t_mask1 = time.perf_counter()
         self.dispatches += 1
-        self.batched_queries += len(plain)
+        self.batched_queries += b
+        self.filtered_batched += len(filtered)
+        from weaviate_tpu.runtime.metrics import (
+            batcher_compile_bucket, batcher_filtered_batched)
+
+        batcher_compile_bucket.labels(b=str(b_pad), k=str(k_bucket)).inc()
+        if filtered:
+            batcher_filtered_batched.inc(len(filtered))
         # the shared dispatch runs under ONE waiter's trace context (the
         # first traced one) so device-level spans attribute somewhere
         # real; every waiter still records its own wait/execute split
         # from the stamps below
-        ctx = next((it.ctx for it in plain if it.ctx is not None), None)
+        ctx = next((it.ctx for it in coal if it.ctx is not None), None)
         t0 = time.perf_counter()
-        for it in plain:
+        for it in coal:
             it.t_exec_start = t0
-            it.batch_size = len(plain)
+            it.batch_size = b
+            if filtered:
+                it.t_mask_start, it.t_mask_end = t_mask0, t_mask1
         try:
             ids, dists = tracing.run_in(ctx, self._batch_fn, queries,
-                                        k_max, None)
+                                        k_bucket, allows)
         except Exception as e:  # noqa: BLE001
             t1 = time.perf_counter()
-            for it in plain:
+            for it in coal:
                 it.t_exec_end = t1
                 it.error = e
                 it.event.set()
             return
         t1 = time.perf_counter()
-        for row, it in enumerate(plain):
+        for row, it in enumerate(coal):
             it.t_exec_end = t1
-            it.ids = ids[row, : it.k]
-            it.dists = dists[row, : it.k]
+            kk = min(it.k, ids.shape[1])
+            it.ids = ids[row, :kk]
+            it.dists = dists[row, :kk]
             it.event.set()
