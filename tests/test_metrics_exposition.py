@@ -149,3 +149,43 @@ def test_machine_id_persists_across_boots(tmp_path):
     # no data dir -> ephemeral, but still a valid uuid-ish string
     t3 = Telemeter(_Db())
     assert t3.machine_id and t3.machine_id != t1.machine_id
+
+
+# -- metrics hygiene lint (tools/lint_metrics.py, ISSUE 4 satellite) ----------
+
+
+def _load_lint():
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "lint_metrics.py")
+    spec = importlib.util.spec_from_file_location("lint_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_registered_metrics_pass_lint():
+    """Every metric in the process registry has HELP text, snake_case
+    weaviate_tpu_-prefixed naming, and shows up in the exposition —
+    importing the runtime (and the modules that registered extra vecs in
+    this test process) first so the full live set is linted."""
+    import weaviate_tpu.runtime  # noqa: F401 — registers the standard set
+
+    lint = _load_lint()
+    assert lint.lint() == []
+
+
+def test_lint_catches_violations():
+    lint = _load_lint()
+    reg = MetricsRegistry()
+    reg.counter("weaviate_tpu_ok_total", "has help")
+    reg.counter("weaviate_tpu_no_help_total", "")
+    reg.gauge("camelCaseName", "bad name")
+    reg.gauge("weaviate_tpu_bad_label", "help", ("badLabel",))
+    problems = lint.lint(reg)
+    assert any("no_help_total" in p and "HELP" in p for p in problems)
+    assert any("camelCaseName" in p for p in problems)
+    assert any("badLabel" in p for p in problems)
+    assert not any("weaviate_tpu_ok_total" in p for p in problems)

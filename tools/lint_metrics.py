@@ -1,0 +1,64 @@
+"""Metrics hygiene lint: every metric registered in the process-wide
+registry must have HELP text, a snake_case ``weaviate_tpu_``-prefixed
+name, snake_case label names, and must actually appear in the text
+exposition. Run standalone (``python tools/lint_metrics.py``, exits
+non-zero on violations) or from the test suite
+(tests/test_metrics_exposition.py imports ``lint``).
+
+Why a lint and not a convention: Prometheus silently accepts malformed
+metric families and scrapers drop them one by one — a missing HELP or a
+camelCase name is invisible until a dashboard goes blank.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_PREFIX = "weaviate_tpu_"
+
+
+def lint(registry=None) -> list[str]:
+    """Returns a list of violation strings (empty = clean). Importing
+    the runtime package is enough to register the full standard metric
+    set — modules add their vecs at import time."""
+    if registry is None:
+        import weaviate_tpu.runtime  # registers the standard set  # noqa: F401
+        from weaviate_tpu.runtime.metrics import registry as registry
+
+    problems: list[str] = []
+    with registry._lock:
+        metrics = dict(registry._metrics)
+    exposition = registry.expose()
+    for name, m in sorted(metrics.items()):
+        if not m.help or not str(m.help).strip():
+            problems.append(f"{name}: missing HELP text")
+        if not _NAME_RE.match(name):
+            problems.append(f"{name}: not snake_case")
+        if not name.startswith(_PREFIX):
+            problems.append(f"{name}: missing {_PREFIX!r} prefix")
+        for ln in m.label_names:
+            if not _NAME_RE.match(ln):
+                problems.append(f"{name}: label {ln!r} not snake_case")
+        if f"# HELP {name} " not in exposition \
+                or f"# TYPE {name} " not in exposition:
+            problems.append(f"{name}: absent from the text exposition")
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        print(f"metrics-lint: {p}", file=sys.stderr)
+    if not problems:
+        print("metrics-lint: OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,7 @@ API_VERSION = "1.25.2"
 from weaviate_tpu.db.shard import ShardReadOnlyError
 from weaviate_tpu.filters.filters import Filter
 from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime.memwatch import InsufficientMemoryError
 from weaviate_tpu.schema.config import CollectionConfig, Property
 
 logger = logging.getLogger(__name__)
@@ -436,6 +437,18 @@ class RestServer:
                     status, payload = 422, {"error": [{"message": str(e)}]}
                 except ShardReadOnlyError as e:
                     status, payload = 422, {"error": [{"message": str(e)}]}
+                except InsufficientMemoryError as e:
+                    # typed 507 Insufficient Storage: admission control
+                    # refused BEFORE allocating (memwatch watermarks) —
+                    # the client should back off or free capacity, not
+                    # retry blindly
+                    status, payload = 507, {"error": [{
+                        "message": str(e),
+                        "code": "INSUFFICIENT_MEMORY",
+                        "projectedBytes": e.projected,
+                        "budgetBytes": e.budget,
+                        "usageSource": e.source,
+                    }]}
                 except Exception as e:
                     logger.exception("REST %s %s failed", method, self.path)
                     status, payload = 500, {"error": [{"message": str(e)}]}
@@ -539,6 +552,8 @@ class RestServer:
             return 200, RawResponse(
                 registry.expose().encode(),
                 "text/plain; version=0.0.4; charset=utf-8")
+        if seg == ["debug", "memory"]:
+            return 200, self._debug_memory()
         if seg == ["debug", "traces"]:
             # finished-trace ring buffer (tracing tentpole; sampled
             # traces carry device_ms attribution)
@@ -853,9 +868,41 @@ class RestServer:
             raise ApiError(422, str(e))
         raise KeyError("/v1/backups/" + "/".join(seg))
 
+    def _debug_memory(self) -> dict:
+        """GET /v1/debug/memory: the HBM ledger's labeled breakdown —
+        top allocations, per-collection rollup, and (when the backend
+        exposes allocator stats) the allocator-vs-ledger delta. The
+        ledger counts labeled data arrays only; the delta is
+        executables beyond the estimate, replication overhead, and XLA
+        scratch."""
+        from weaviate_tpu.runtime.hbm_ledger import ledger
+        from weaviate_tpu.runtime.memwatch import device_memory_stats
+
+        snap = ledger.snapshot()
+        mw = getattr(self.db, "memwatch", None)
+        budget = mw.device_budget() if mw is not None else None
+        out = {
+            "ledger": {**snap, "budgetBytes": budget},
+            "allocator": device_memory_stats(),
+        }
+        if mw is not None:
+            out["pressure"] = mw.under_pressure
+            out["highWatermark"] = mw.high_watermark
+            out["lowWatermark"] = mw.low_watermark
+        deltas = {}
+        for dev, stats in out["allocator"].items():
+            if stats.get("bytesInUse") is not None:
+                deltas[dev] = int(stats["bytesInUse"]) - snap["totalBytes"]
+        if deltas:
+            out["allocatorDelta"] = deltas
+        return out
+
     def _local_shard_details(self) -> list[dict]:
         """Per-shard breakdown for ?output=verbose (reference:
-        nodes/handler.go verbose output with shard object counts)."""
+        nodes/handler.go verbose output with shard object counts), plus
+        each shard's ledger-attributed device bytes."""
+        from weaviate_tpu.runtime.hbm_ledger import ledger
+
         out = []
         for cname in self.db.list_collections():
             col = self.db.get_collection(cname)
@@ -869,6 +916,7 @@ class RestServer:
                     if shard.read_only else "READY",
                     "vectorQueueLength": sum(
                         q.size() for q in shard._index_queues.values()),
+                    "hbmBytes": ledger.shard_bytes(cname, sname),
                 })
         return out
 
@@ -890,10 +938,13 @@ class RestServer:
                 device_memory_stats,
             )
 
+            from weaviate_tpu.runtime.hbm_ledger import ledger
+
             for n in nodes:
                 if n["name"] == self.db.local_node:
                     n["stats"] = {**(n.get("stats") or {}),
-                                  "deviceMemory": device_memory_stats()}
+                                  "deviceMemory": device_memory_stats(),
+                                  "hbmLedgerBytes": ledger.total_bytes()}
                     if verbose:
                         # shard details are known for THIS node (remote
                         # breakdowns would need an RPC fan-out, as in the
@@ -904,13 +955,15 @@ class RestServer:
         object_count = sum(
             s.object_count() for c in self.db.collections.values()
             for s in c.shards.values())
+        from weaviate_tpu.runtime.hbm_ledger import ledger
         from weaviate_tpu.runtime.memwatch import device_memory_stats
 
         node = {"name": self.db.local_node, "status": "HEALTHY",
                 "version": VERSION,
                 "stats": {"shardCount": shard_count,
                           "objectCount": object_count,
-                          "deviceMemory": device_memory_stats()}}
+                          "deviceMemory": device_memory_stats(),
+                          "hbmLedgerBytes": ledger.total_bytes()}}
         if verbose:
             node["shards"] = self._local_shard_details()
         return [node]

@@ -33,6 +33,19 @@ def _int(env, name: str, default: int) -> int:
         raise ValueError(f"{name} must be an integer, got {raw!r}")
 
 
+def _fraction(env, name: str, default: float) -> float:
+    raw = env.get(name)
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}")
+    if not 0.0 < v <= 1.0:
+        raise ValueError(f"{name} must be in (0, 1], got {v}")
+    return v
+
+
 @dataclass
 class ServerConfig:
     # persistence (PERSISTENCE_DATA_PATH, environment.go)
@@ -65,6 +78,13 @@ class ServerConfig:
     disable_telemetry: bool = False
     # resources (GOMEMLIMIT analog: device + host budgets for memwatch)
     memory_limit_bytes: int = 0  # 0 = unlimited
+    # HBM admission control (runtime/memwatch.py watermark gating):
+    # imports are refused with 507 past high*budget and accepted again
+    # under low*budget (hysteresis). The budget comes from allocator
+    # stats where available, else HBM_DEVICE_LIMIT_BYTES.
+    hbm_device_limit_bytes: int = 0  # 0 = allocator-reported / unlimited
+    hbm_high_watermark: float = 0.9
+    hbm_low_watermark: float = 0.8
     # backups
     backup_filesystem_path: str = ""
 
@@ -96,6 +116,9 @@ class ServerConfig:
             log_format=env.get("LOG_FORMAT", "text"),
             disable_telemetry=_flag(env, "DISABLE_TELEMETRY"),
             memory_limit_bytes=_int(env, "MEMORY_LIMIT_BYTES", 0),
+            hbm_device_limit_bytes=_int(env, "HBM_DEVICE_LIMIT_BYTES", 0),
+            hbm_high_watermark=_fraction(env, "HBM_HIGH_WATERMARK", 0.9),
+            hbm_low_watermark=_fraction(env, "HBM_LOW_WATERMARK", 0.8),
             backup_filesystem_path=env.get("BACKUP_FILESYSTEM_PATH", ""),
         )
         path = env.get("CONFIG_FILE", "")
@@ -129,6 +152,8 @@ class ServerConfig:
                     v = str(v).lower() in ("true", "1", "on")
                 elif isinstance(cur, int):
                     v = int(v)
+                elif isinstance(cur, float):
+                    v = float(v)
                 elif isinstance(cur, list) and isinstance(v, str):
                     v = [s.strip() for s in v.split(",") if s.strip()]
                 setattr(out, key, v)

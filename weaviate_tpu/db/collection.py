@@ -497,6 +497,12 @@ class Collection:
                 self._write_to_shard(shard_name, objs, consistency)
                 monitoring.objects_total.labels(self.config.name, "put"
                                                 ).inc(len(objs))
+            except MemoryError:
+                # admission rejection (memwatch watermark) must surface
+                # as the typed 507 at the API layer, not dissolve into
+                # per-object FAILED entries under an HTTP 200 — bulk
+                # import is the path capacity gating exists for
+                raise
             except Exception as e:
                 for i in metas[shard_name]:
                     results[i] = {"uuid": results[i]["uuid"], "status": "FAILED",

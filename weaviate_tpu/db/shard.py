@@ -248,9 +248,22 @@ class Shard:
         vc = self.config.vector_config(vec_name)
         if vc is None:
             vc = VectorConfig(name=vec_name)
-        idx = _make_vector_index(vc, dim, mesh=self.mesh)
+        # HBM-ledger owner scope: every device array the index (and its
+        # stores) allocates — now or on a later grow — is attributed to
+        # this (collection, shard, tenant)
+        from weaviate_tpu.runtime import hbm_ledger
+
+        with hbm_ledger.owner(self.collection_name, self.name,
+                              tenant=self._tenant_label()):
+            idx = _make_vector_index(vc, dim, mesh=self.mesh)
         self.vector_indexes[vec_name] = idx
         return idx
+
+    def _tenant_label(self) -> str:
+        """Tenants ARE shards in this layout (reference: partitioned
+        shards keyed by tenant name) — the ledger's tenant label is the
+        shard name iff multi-tenancy is on."""
+        return self.name if self.config.multi_tenancy.enabled else ""
 
     def _maybe_compress(self, vec_name: str, idx) -> None:
         vc = self.config.vector_config(vec_name)
@@ -334,7 +347,9 @@ class Shard:
                 # CheckAlloc gates imports): vectors land in device HBM
                 nbytes = sum(int(np.asarray(v).nbytes)
                              for o in objs for v in o.vectors.values())
-                self.memwatch.check_device_alloc(nbytes)
+                self.memwatch.check_device_alloc(
+                    nbytes,
+                    what=f"import {self.collection_name}/{self.name}")
             vec_batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
             # doc ids for the whole batch come from one counter bump (one
             # meta write instead of len(objs))
@@ -458,6 +473,9 @@ class Shard:
                     capacity_fn=_gathered_capacity,
                     pad_pow2=bool(getattr(idx, "compiled_batch_shapes",
                                           True)),
+                    owner={"collection": self.collection_name,
+                           "shard": self.name,
+                           "tenant": self._tenant_label()},
                 ))
         ids, dists = b.search(query, k, allow_list)
         live = ids >= 0

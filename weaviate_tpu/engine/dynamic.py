@@ -39,6 +39,12 @@ class DynamicIndex:
         self._nprobe = nprobe
         self._chunk_size = chunk_size
         self._lock = threading.RLock()
+        # captured so the runtime flat->IVF upgrade (which runs on an
+        # insert thread, outside any shard owner scope) keeps the new
+        # index's HBM-ledger attribution
+        from weaviate_tpu.runtime import hbm_ledger
+
+        self._hbm_owner = hbm_ledger.current_owner()
         self._impl = FlatIndex(dim=dim, metric=metric, mesh=mesh,
                                capacity=capacity, chunk_size=chunk_size,
                                **flat_kwargs)
@@ -69,11 +75,14 @@ class DynamicIndex:
             valid = snap["valid"]
             live = [s for s in range(min(len(slot_to_id), len(valid)))
                     if valid[s] and slot_to_id[s] >= 0]
-            ivf = IVFIndex(dim=self.dim, metric=self.metric,
-                           chunk_size=self._chunk_size, nlist=self._nlist,
-                           nprobe=self._nprobe,
-                           train_threshold=max(self.threshold, 256),
-                           dtype=getattr(flat.store, "dtype", None))
+            from weaviate_tpu.runtime import hbm_ledger
+
+            with hbm_ledger.owner(**self._hbm_owner):
+                ivf = IVFIndex(dim=self.dim, metric=self.metric,
+                               chunk_size=self._chunk_size,
+                               nlist=self._nlist, nprobe=self._nprobe,
+                               train_threshold=max(self.threshold, 256),
+                               dtype=getattr(flat.store, "dtype", None))
             if live:
                 ids = slot_to_id[live]
                 vecs = snap["vectors"][live]

@@ -269,17 +269,26 @@ class FlatIndex:
         update once enough data exists). Slot layout is preserved, so the
         id<->slot mapping carries over untouched."""
         from weaviate_tpu.engine.quantized import QuantizedVectorStore
+        from weaviate_tpu.runtime import hbm_ledger
 
         with self._lock:
             old = self.store
             if isinstance(old, QuantizedVectorStore):
                 raise RuntimeError("index is already compressed")
             snap = old.snapshot()
-            new = QuantizedVectorStore(
-                dim=self.dim, metric=self.metric, quantization=quantization,
-                capacity=old.capacity, chunk_size=old.chunk_size,
-                mesh=old.mesh, **quant_kwargs,
-            )
+            # the swapped-in store inherits the old store's HBM-ledger
+            # owner labels (compress runs outside the shard's owner
+            # scope); the old store's entries release via its finalizer
+            # once the swap drops the last reference
+            own = getattr(old, "_hbm_owner", None) or \
+                hbm_ledger.current_owner()
+            with hbm_ledger.owner(**own):
+                new = QuantizedVectorStore(
+                    dim=self.dim, metric=self.metric,
+                    quantization=quantization,
+                    capacity=old.capacity, chunk_size=old.chunk_size,
+                    mesh=old.mesh, **quant_kwargs,
+                )
             live = np.nonzero(snap["valid"])[0]
             live_vecs = snap["vectors"][live]
             if quantization == "pq" and new.codebook is None:

@@ -119,6 +119,33 @@ class HNSWIndex:
                     self._native = None
         self._native_dirty = self._count > 0
 
+        # HBM-ledger host-tier entry: the graph's arrays live in host
+        # RAM (placement="host" — excluded from device admission totals,
+        # visible in the /v1/debug/memory breakdown)
+        from weaviate_tpu.runtime import hbm_ledger
+
+        self._hbm_owner = hbm_ledger.current_owner()
+        self._hbm_keys: dict[str, int] = {}
+        import weakref
+
+        weakref.finalize(self, hbm_ledger.ledger.release_many,
+                         self._hbm_keys.values())
+        self._hbm_sync()
+
+    def _hbm_sync(self):
+        if not hasattr(self, "_hbm_keys"):
+            return  # _grow during WAL replay, before the ledger wiring
+        from weaviate_tpu.runtime import hbm_ledger
+
+        nbytes = sum(int(a.nbytes) for a in (
+            self._vecs, self._levels, self._doc_ids, self._tombstone,
+            self._visited))
+        if self._codes is not None:
+            nbytes += int(self._codes.nbytes)
+        hbm_ledger.ledger.set_keyed(
+            self._hbm_keys, "graph", nbytes, owner=self._hbm_owner,
+            dtype="float32", placement="host")
+
     # -- distance (host batch engine) ----------------------------------------
 
     def _norm(self, v: np.ndarray) -> np.ndarray:
@@ -182,6 +209,7 @@ class HNSWIndex:
         self._links.extend([] for _ in range(new_cap - cap))
         for i in range(cap, new_cap):
             self._links[i] = []
+        self._hbm_sync()
 
     # -- graph search core ----------------------------------------------------
 
@@ -736,6 +764,7 @@ class HNSWIndex:
             if self._count:
                 self._codes[: self._count] = pq_encode(
                     self._pq_codebook, self._vecs[: self._count])
+            self._hbm_sync()
             # durability: one condensed snapshot carries codes + codebook
             # (the reference logs an AddPQ record; a snapshot is the same
             # fixed point)
@@ -830,6 +859,7 @@ class HNSWIndex:
             idx._codes = np.zeros((len(idx._vecs), m), dtype=np.uint8)
             idx._codes[:n] = snap["pq_codes"]
         idx._native_dirty = True  # fields were set past the mirror
+        idx._hbm_sync()  # codes allocated after __init__'s sync
         return idx
 
     # -- commit log (reference commit_logger.go / condensor.go) ---------------

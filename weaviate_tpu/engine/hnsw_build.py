@@ -482,6 +482,12 @@ def _device_knn(sub: np.ndarray, k_eff: int, metric: str,
     # parity pinned by the bench ef sweep). The f32 knn scan was 47.8 s
     # of the 121 s 300k build (BASELINE r5).
     xscan = xd.astype(jnp.bfloat16) if use_pallas else xd
+    # build-time scratch is the dominant transient HBM consumer at 1M
+    # rows — ledger-tracked for exactly as long as the array lives, so
+    # peak watermarks and /v1/debug/memory see bulk builds
+    from weaviate_tpu.runtime.hbm_ledger import ledger as _hbm
+
+    _hbm.track("build_scratch", xscan)
     norms = jnp.sum(xd.astype(jnp.float32) ** 2, axis=-1)
     norms_arg = norms if metric == "l2-squared" else None
     if return_device:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import functools
 import math
 import threading
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +36,7 @@ import numpy as np
 
 from weaviate_tpu.engine.flat import FlatIndex
 from weaviate_tpu.engine.store import DeviceVectorStore, _next_pow2
+from weaviate_tpu.runtime import hbm_ledger
 from weaviate_tpu.ops.distances import MASKED_DISTANCE, normalize, pairwise_distance
 from weaviate_tpu.ops.kmeans import kmeans_assign, kmeans_fit
 from weaviate_tpu.ops.topk import topk_smallest
@@ -247,6 +249,13 @@ class IVFStore:
         self.normalize_on_add = metric in ("cosine", "cosine-dot")
         self._lock = threading.RLock()
         self._count = 0  # global slot high-water mark
+        # HBM ledger: centroid + posting-list tensors publish under the
+        # owner labels captured here; the delta store self-accounts (it
+        # is a DeviceVectorStore constructed in this same owner scope)
+        self._hbm_owner = hbm_ledger.current_owner()
+        self._hbm_keys: dict[str, int] = {}
+        weakref.finalize(self, hbm_ledger.ledger.release_many,
+                         self._hbm_keys.values())
         # delta buffer (exact scan); delta slot -> global slot
         self.delta = DeviceVectorStore(
             dim, metric, capacity=min(capacity, delta_threshold * 2),
@@ -263,6 +272,22 @@ class IVFStore:
         self.list_norms = None
         self.list_cap = 0
         self._fill: np.ndarray | None = None  # host per-list fill count
+
+    def _hbm_sync(self):
+        """Publish centroid + posting-list device bytes to the ledger
+        (the delta DeviceVectorStore accounts for itself)."""
+        cent = 0 if self.centroids is None else (
+            int(self.centroids.nbytes) + int(self._c_norms.nbytes))
+        hbm_ledger.ledger.set_keyed(
+            self._hbm_keys, "centroids", cent, owner=self._hbm_owner,
+            dtype="float32")
+        lists = sum(int(a.nbytes) for a in (
+            self.list_vecs, self.list_codes, self.list_norms,
+            self.list_valid, self.list_slots) if a is not None)
+        hbm_ledger.ledger.set_keyed(
+            self._hbm_keys, "lists", lists, owner=self._hbm_owner,
+            dtype=("uint8" if self.quantization
+                   else jnp.dtype(self.dtype).name))
 
     # -- properties mirrored from DeviceVectorStore ---------------------------
 
@@ -411,6 +436,7 @@ class IVFStore:
             self._rebuild_lists(vecs, slots)
             # delta fully absorbed
             self._reset_delta()
+            self._hbm_sync()
 
     def _all_live_host(self):
         """(vectors [L,d] f32, slots [L] int64) for every live slot."""
@@ -459,6 +485,7 @@ class IVFStore:
         self.list_valid = jnp.zeros((self.nlist, cap), dtype=jnp.bool_)
         self.list_slots = jnp.full((self.nlist, cap), -1, dtype=jnp.int32)
         self._fill = np.zeros(self.nlist, dtype=np.int64)
+        self._hbm_sync()
         self._scatter_assigned(vecs, slots, assign)
 
     def _scatter_assigned(self, vecs, slots, assign):
@@ -537,6 +564,7 @@ class IVFStore:
             [self.list_slots, jnp.full((self.nlist, pad), -1, dtype=jnp.int32)],
             axis=1)
         self.list_cap = new_cap
+        self._hbm_sync()
         # flat indices shift: old flat l*old_cap+p -> l*new_cap+p
         for s, loc in self._slot_loc.items():
             if loc[0] == "list":
@@ -571,10 +599,13 @@ class IVFStore:
             self._reset_delta()
 
     def _reset_delta(self):
-        self.delta = DeviceVectorStore(
-            self.dim, self.metric,
-            capacity=min(self.capacity, self.delta_threshold * 2),
-            chunk_size=self.chunk_size)
+        # rebuilt outside the shard's construction scope — re-enter the
+        # captured owner labels so the fresh delta store stays attributed
+        with hbm_ledger.owner(**self._hbm_owner):
+            self.delta = DeviceVectorStore(
+                self.dim, self.metric,
+                capacity=min(self.capacity, self.delta_threshold * 2),
+                chunk_size=self.chunk_size)
         self._delta_slots = {}
 
     # -- queries -------------------------------------------------------------
@@ -829,6 +860,7 @@ class IVFStore:
                 store.list_valid = jnp.zeros((store.nlist, cap), dtype=jnp.bool_)
                 store.list_slots = jnp.full((store.nlist, cap), -1, dtype=jnp.int32)
                 store._fill = np.zeros(store.nlist, dtype=np.int64)
+            store._hbm_sync()  # centroids set outside _rebuild_lists
         elif len(vecs):
             # untrained: everything back into the delta buffer
             store._add_to_delta(slots, vecs)

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -41,7 +42,7 @@ from weaviate_tpu.ops import bq as bq_ops
 from weaviate_tpu.ops import pq as pq_ops
 from weaviate_tpu.ops.distances import normalize
 from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import hbm_ledger, tracing
 
 _DEFAULT_CHUNK = 8192
 
@@ -183,6 +184,13 @@ class QuantizedVectorStore:
         self.use_pallas = recommended()
         self._lock = threading.RLock()
         self._count = 0
+        # HBM ledger wiring — same pattern as DeviceVectorStore: labels
+        # captured from the ambient owner scope, entries updated across
+        # grows, finalizer-released when the store is dropped
+        self._hbm_owner = hbm_ledger.current_owner()
+        self._hbm_keys: dict[str, int] = {}
+        weakref.finalize(self, hbm_ledger.ledger.release_many,
+                         self._hbm_keys.values())
         self.capacity = self._align(capacity)
         self._valid_np = np.zeros(self.capacity, dtype=bool)
         self._host_vectors = (
@@ -243,6 +251,30 @@ class QuantizedVectorStore:
             self._zeros((self.capacity, self.dim), jnp.bfloat16)
             if self.rescore == "device" else None
         )
+        self._hbm_sync()
+
+    def _hbm_sync(self):
+        """Publish the device footprint per component: codes (+valid),
+        the transposed prefix, bf16 rescore rows, and the PQ codebook."""
+        sharding = "sharded" if self.mesh is not None else "single"
+
+        def _set(component, nbytes, dtype=None):
+            hbm_ledger.ledger.set_keyed(
+                self._hbm_keys, component, nbytes, owner=self._hbm_owner,
+                dtype=dtype, sharding=sharding)
+
+        _set("codes", int(self.codes.nbytes) + int(self.valid.nbytes),
+             dtype=jnp.dtype(self._code_dtype()).name)
+        _set("prefix",
+             0 if self.prefix_t is None else int(self.prefix_t.nbytes),
+             dtype="uint32")
+        _set("rescore_rows",
+             0 if self.rescore_rows is None
+             else int(self.rescore_rows.nbytes), dtype="bfloat16")
+        _set("codebook",
+             0 if self.codebook is None
+             else int(np.asarray(self.codebook.centroids).nbytes),
+             dtype="float32")
 
     def _encode(self, vectors: np.ndarray) -> np.ndarray:
         if self.quantization == "pq":
@@ -277,6 +309,7 @@ class QuantizedVectorStore:
                 iters=iters, seed=seed,
             )
             self._reencode_all()
+            self._hbm_sync()
 
     def _vectors_for(self, slots: np.ndarray) -> np.ndarray:
         """Full-precision rows for given slots from whichever tier has them."""
@@ -407,6 +440,7 @@ class QuantizedVectorStore:
             self.rescore_rows = grow_rows(self.rescore_rows, pad, self.mesh)
         if self.prefix_t is not None:
             self.prefix_t = jnp.pad(self.prefix_t, ((0, 0), (0, pad)))
+        self._hbm_sync()
 
     def set_at_prenormalized(self, slots, vectors: np.ndarray):
         """set_at for vectors already normalized at their original insert
@@ -559,7 +593,8 @@ class QuantizedVectorStore:
 
                     sp.set(path="bitmask_batched")
                     allow_bits, allow_rows_dev = batched_mask_operands(
-                        allow_mask, len(queries), capacity, self.mesh)
+                        allow_mask, len(queries), capacity, self.mesh,
+                        owner=self._hbm_owner)
                 elif allow_mask is not None:
                     full = np.zeros(capacity, dtype=bool)
                     full[: len(allow_mask)] = allow_mask[:capacity]
@@ -713,4 +748,5 @@ class QuantizedVectorStore:
                         pt, ((0, 0),
                              (0, store.capacity - pt.shape[1]))))
         store._count = snap["count"]
+        store._hbm_sync()  # codebook/prefix set after __init__'s sync
         return store

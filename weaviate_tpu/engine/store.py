@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +33,7 @@ import numpy as np
 
 from weaviate_tpu.ops.distances import normalize
 from weaviate_tpu.ops.topk import chunked_topk_distances
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import hbm_ledger, tracing
 from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
 from weaviate_tpu.parallel.sharded_search import (
     replicate_array,
@@ -66,19 +67,25 @@ def normalize_allow_mask(allow_mask, n_queries: int):
     return allow_mask
 
 
-def batched_mask_operands(allow_mask, n_queries: int, capacity: int, mesh):
+def batched_mask_operands(allow_mask, n_queries: int, capacity: int, mesh,
+                          owner: dict | None = None):
     """[B, capacity] per-query mask -> scan-kernel operands, under a
     ``store.mask_pack`` span: single-device packs the bitmask on the host
     (32x smaller transfer); a mesh ships the bool mask column-sharded so
     each device packs its own row-aligned slice on device. Returns
-    (allow_bits, allow_rows_dev) — exactly one is non-None."""
+    (allow_bits, allow_rows_dev) — exactly one is non-None. ``owner``
+    labels the transient device buffer in the HBM ledger (weakref-
+    tracked: the entry lives exactly as long as the buffer)."""
+    owner = owner or {}
     with tracing.span("store.mask_pack", queries=n_queries):
         if mesh is None:
             from weaviate_tpu.ops.pallas_kernels import (mask_pad_cols,
                                                          pack_allow_bitmask)
 
-            return jnp.asarray(pack_allow_bitmask(
-                allow_mask, mask_pad_cols(capacity))), None
+            bits = jnp.asarray(pack_allow_bitmask(
+                allow_mask, mask_pad_cols(capacity)))
+            hbm_ledger.ledger.track("allow_bitmask", bits, **owner)
+            return bits, None
         if (allow_mask.shape == (n_queries, capacity)
                 and allow_mask.dtype == np.bool_):
             full = allow_mask  # already the exact shape — no copy
@@ -86,7 +93,11 @@ def batched_mask_operands(allow_mask, n_queries: int, capacity: int, mesh):
             full = np.zeros((n_queries, capacity), dtype=bool)
             w = min(allow_mask.shape[1], capacity)
             full[:, :w] = allow_mask[:, :w]
-        return None, shard_array(jnp.asarray(full), mesh, dim=1)
+        from weaviate_tpu.parallel.sharded_search import tracked_shard_array
+
+        return None, tracked_shard_array(
+            jnp.asarray(full), mesh, dim=1, component="allow_mask",
+            owner=owner)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnames=("normalize_rows",))
@@ -186,6 +197,15 @@ class DeviceVectorStore:
         self._staged_vecs: list[np.ndarray] = []
         self._staged_rows = 0
         self._stage_limit = max(4096, (32 << 20) // (dim * 4))
+        # HBM ledger wiring: the (collection, shard, tenant) labels are
+        # captured ONCE from the ambient owner scope the shard layer sets
+        # around index construction; grows/compacts update the same
+        # entries, and a finalizer releases them when the store is
+        # dropped (e.g. compress() swapping in a quantized store).
+        self._hbm_owner = hbm_ledger.current_owner()
+        self._hbm_keys: dict[str, int] = {}
+        weakref.finalize(self, hbm_ledger.ledger.release_many,
+                         self._hbm_keys.values())
         capacity = self._align(capacity)
         self.capacity = capacity
         self._alloc(capacity)
@@ -207,6 +227,18 @@ class DeviceVectorStore:
         self.vectors = self._placed(jnp.zeros((capacity, self.dim), dtype=self.dtype))
         self.valid = self._placed(jnp.zeros((capacity,), dtype=jnp.bool_))
         self.sq_norms = self._placed(jnp.zeros((capacity,), dtype=jnp.float32))
+        self._hbm_sync()
+
+    def _hbm_sync(self):
+        """(Re-)publish this store's device footprint into the ledger —
+        called after every (re)allocation so totals track capacity, not
+        just construction."""
+        nbytes = sum(int(a.nbytes)
+                     for a in (self.vectors, self.valid, self.sq_norms))
+        hbm_ledger.ledger.set_keyed(
+            self._hbm_keys, "corpus", nbytes, owner=self._hbm_owner,
+            dtype=jnp.dtype(self.dtype).name,
+            sharding="sharded" if self.mesh is not None else "single")
 
     def _grow(self, min_capacity: int):
         from weaviate_tpu.parallel.sharded_search import grow_rows
@@ -219,6 +251,7 @@ class DeviceVectorStore:
         self.vectors = grow_rows(self.vectors, pad, self.mesh)
         self.valid = grow_rows(self.valid, pad, self.mesh)
         self.sq_norms = grow_rows(self.sq_norms, pad, self.mesh)
+        self._hbm_sync()
 
     # -- mutation ------------------------------------------------------------
 
@@ -275,22 +308,34 @@ class DeviceVectorStore:
         slot_buf[:m] = slots
         mask = np.zeros(bucket, dtype=bool)
         mask[:m] = True
-        self.vectors, self.valid, self.sq_norms = _scatter_rows(
-            self.vectors,
-            self.valid,
-            self.sq_norms,
-            self._placed_replicated(slot_buf),
-            self._placed_replicated(padded),
-            self._placed_replicated(mask),
-            normalize_rows=self.normalize_on_add,
-        )
-        # drop the staging buffers only after the scatter MATERIALIZED —
-        # dispatch is async, so an exception can surface here (transfer
-        # OOM, compile failure at a new bucket) or later on the device
-        # (runtime failure on the enqueued scatter). The probe forces the
-        # result before the rows stop being re-flushable; one host RTT per
-        # flush, amortized over >= _stage_limit staged rows.
-        _probe_scatter(self.valid, int(slots[m - 1]))
+        # the transfer buffers for the scatter are a real (transient)
+        # device allocation — ledger-tracked for the duration of the
+        # flush so peak watermarks see import bursts
+        stage_key = hbm_ledger.ledger.register(
+            "staging", padded.nbytes + slot_buf.nbytes + mask.nbytes,
+            dtype=str(stage_dt),
+            sharding="replicated" if self.mesh is not None else "single",
+            **self._hbm_owner)
+        try:
+            self.vectors, self.valid, self.sq_norms = _scatter_rows(
+                self.vectors,
+                self.valid,
+                self.sq_norms,
+                self._placed_replicated(slot_buf),
+                self._placed_replicated(padded),
+                self._placed_replicated(mask),
+                normalize_rows=self.normalize_on_add,
+            )
+            # drop the staging buffers only after the scatter MATERIALIZED
+            # — dispatch is async, so an exception can surface here
+            # (transfer OOM, compile failure at a new bucket) or later on
+            # the device (runtime failure on the enqueued scatter). The
+            # probe forces the result before the rows stop being
+            # re-flushable; one host RTT per flush, amortized over
+            # >= _stage_limit staged rows.
+            _probe_scatter(self.valid, int(slots[m - 1]))
+        finally:
+            hbm_ledger.ledger.release(stage_key)
         self._staged_vecs.clear()
         self._staged_slots.clear()
         self._staged_rows = 0
@@ -400,7 +445,8 @@ class DeviceVectorStore:
                     slot_buf = None
                     sp.set(path="bitmask_batched")
                     allow_bits, allow_rows_dev = batched_mask_operands(
-                        allow_mask, len(queries), capacity, self.mesh)
+                        allow_mask, len(queries), capacity, self.mesh,
+                        owner=self._hbm_owner)
                 elif allow_mask is not None:
                     allowed = np.flatnonzero(allow_mask)
                     # selectivity policy (measured,

@@ -347,6 +347,20 @@ def replicate_array(arr, mesh: Mesh):
     return jax.device_put(arr, NamedSharding(mesh, P()))
 
 
+def tracked_shard_array(arr, mesh: Mesh, dim: int = 0,
+                        component: str = "sharded",
+                        owner: dict | None = None):
+    """shard_array + HBM-ledger registration tied to the array's
+    lifetime (weakref finalizer) — the placement helper for transient
+    sharded operands like per-query allow masks, where nobody holds a
+    release key but the peak watermark should still see the bytes."""
+    out = shard_array(arr, mesh, dim=dim)
+    from weaviate_tpu.runtime.hbm_ledger import ledger
+
+    ledger.track(component, out, sharding="sharded", **(owner or {}))
+    return out
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("k", "nprobe", "metric", "mesh", "axis"),

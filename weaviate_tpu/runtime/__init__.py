@@ -6,12 +6,15 @@ registry).
 """
 
 from weaviate_tpu.runtime.cyclemanager import CycleCallback, CycleManager
+from weaviate_tpu.runtime.hbm_ledger import HBMLedger, ledger
 from weaviate_tpu.runtime.memwatch import MemoryMonitor
 from weaviate_tpu.runtime.metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
 
 __all__ = [
     "CycleCallback",
     "CycleManager",
+    "HBMLedger",
+    "ledger",
     "MemoryMonitor",
     "Counter",
     "Gauge",

@@ -16,11 +16,45 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 
 logger = logging.getLogger(__name__)
 
 _done = False
 _metrics_installed = False
+
+# executable-footprint estimate: XLA keeps compiled programs resident in
+# HBM but exposes no per-executable size; each backend compile bumps one
+# ledger entry by a flat estimate (HBM_EXECUTABLE_ESTIMATE_BYTES,
+# default 4 MiB) so the allocator-vs-ledger delta in /v1/debug/memory
+# isn't silently dominated by executables. Explicitly labeled
+# sharding="estimate" — this is a planning number, not an exact count.
+_exec_key: int | None = None
+_exec_count = 0
+_exec_lock = threading.Lock()
+
+
+def _note_executable() -> None:
+    """Called from jax's monitoring callbacks, which fire on whatever
+    thread finished the compile — the lock keeps concurrent first
+    compiles from double-registering (and orphaning) ledger entries."""
+    global _exec_key, _exec_count
+    try:
+        from weaviate_tpu.runtime.hbm_ledger import ledger
+
+        est_each = int(os.environ.get("HBM_EXECUTABLE_ESTIMATE_BYTES",
+                                      str(4 << 20)))
+        with _exec_lock:
+            _exec_count += 1
+            if _exec_key is None:
+                _exec_key = ledger.register(
+                    "executables", est_each * _exec_count,
+                    collection="_runtime", shard="-", tenant="",
+                    sharding="estimate")
+            else:
+                ledger.update(_exec_key, est_each * _exec_count)
+    except Exception:  # noqa: BLE001 — accounting is best-effort
+        pass
 
 
 def install_compile_metrics() -> None:
@@ -47,6 +81,8 @@ def install_compile_metrics() -> None:
         def _on_duration(event: str, duration: float, **kw) -> None:
             if "compile" in event:
                 jit_compile_duration.labels(event).observe(duration)
+                if "backend_compile" in event:
+                    _note_executable()
 
         def _on_event(event: str, **kw) -> None:
             if "cache_hit" in event:

@@ -53,6 +53,13 @@ class _Metric:
             raise ValueError(f"{self.name} has labels; use .labels(...)")
         return self.labels()
 
+    def remove(self, *values) -> None:
+        """Drop one label child (a deleted collection/shard must not keep
+        exporting a stale 0-valued series forever)."""
+        values = tuple(str(v) for v in values)
+        with self._lock:
+            self._children.pop(values, None)
+
     def _label_str(self, values: tuple) -> str:
         if not values:
             return ""
@@ -335,6 +342,26 @@ batcher_compile_bucket = registry.counter(
     "weaviate_tpu_query_batcher_compile_bucket_total",
     "Coalesced dispatches by padded pow2 (batch, k) bucket — the bucket "
     "set bounds the number of compiled program variants", ("b", "k"))
+
+# -- HBM ledger (runtime/hbm_ledger.py keeps these current on every
+#    register/update/release; memwatch sets the budget + pressure) ------------
+
+hbm_bytes = registry.gauge(
+    "weaviate_tpu_hbm_bytes",
+    "Live device bytes registered in the HBM ledger",
+    ("collection", "shard", "component"))
+hbm_peak_bytes = registry.gauge(
+    "weaviate_tpu_hbm_peak_bytes",
+    "High-water mark of ledger-registered device bytes since process "
+    "start")
+hbm_budget_bytes = registry.gauge(
+    "weaviate_tpu_hbm_budget_bytes",
+    "Per-device HBM budget admission control gates against (0 = no "
+    "budget known)")
+memory_pressure_total = registry.counter(
+    "weaviate_tpu_memory_pressure_total",
+    "Admission-control memory-pressure events",
+    ("resource", "action"))
 
 # -- tracing (runtime/tracing.py feeds this on every finished span) -----------
 

@@ -91,12 +91,19 @@ class QueryBatcher:
 
     def __init__(self, batch_fn, max_batch: int = 256,
                  supports_filter_batching: bool = False,
-                 capacity_fn=None, pad_pow2: bool = True):
+                 capacity_fn=None, pad_pow2: bool = True,
+                 owner: dict | None = None):
+        from weaviate_tpu.runtime import hbm_ledger
+
         self._batch_fn = batch_fn
         self.max_batch = max_batch
         self.filter_batching = supports_filter_batching
         self._capacity_fn = capacity_fn
         self.pad_pow2 = pad_pow2
+        # HBM-ledger labels for the padded dispatch buffer (the shard
+        # layer passes its collection/shard; standalone batchers fall
+        # back to the ambient owner scope)
+        self._hbm_owner = owner or hbm_ledger.current_owner()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: list[_Pending] = []
@@ -264,6 +271,13 @@ class QueryBatcher:
             it.batch_size = b
             if filtered:
                 it.t_mask_start, it.t_mask_end = t_mask0, t_mask1
+        # the pow2-padded query block becomes a device upload inside
+        # batch_fn — ledger-registered for the dispatch's duration so
+        # peak watermarks see concurrent drains
+        from weaviate_tpu.runtime.hbm_ledger import ledger as _hbm
+
+        pad_key = _hbm.register("dispatch_pad", queries.nbytes,
+                                dtype="float32", **self._hbm_owner)
         try:
             ids, dists = tracing.run_in(ctx, self._batch_fn, queries,
                                         k_bucket, allows)
@@ -274,6 +288,8 @@ class QueryBatcher:
                 it.error = e
                 it.event.set()
             return
+        finally:
+            _hbm.release(pad_key)
         t1 = time.perf_counter()
         for row, it in enumerate(coal):
             it.t_exec_end = t1

@@ -65,12 +65,17 @@ class Server:
                 auth_cfg.readonly_users:
             auth = AuthStack(auth_cfg)
 
-        memwatch = None
-        if cfg.memory_limit_bytes:
-            from weaviate_tpu.runtime import MemoryMonitor
+        # always constructed: the device budget may come from allocator
+        # stats alone (TPU rigs report bytes_limit with zero config), so
+        # gating must not hinge on any HBM_* env being set — no budget
+        # discoverable means check_device_alloc is a no-op anyway
+        from weaviate_tpu.runtime import MemoryMonitor
 
-            memwatch = MemoryMonitor(
-                host_limit_bytes=cfg.memory_limit_bytes)
+        memwatch = MemoryMonitor(
+            host_limit_bytes=cfg.memory_limit_bytes or None,
+            device_limit_bytes=cfg.hbm_device_limit_bytes or None,
+            high_watermark=cfg.hbm_high_watermark,
+            low_watermark=cfg.hbm_low_watermark)
 
         cluster_mode = len(cfg.raft_join) > 1 or bool(cfg.cluster_join)
         if cluster_mode:
