@@ -1,0 +1,919 @@
+"""graftlint framework tests (ISSUE 5).
+
+Three layers:
+
+1. per-checker fixtures — every checker G1-G5 is exercised against
+   snippets with KNOWN positives and KNOWN negatives, so the contract
+   of each invariant is pinned by tests, not by whatever the tree
+   happens to contain;
+2. mechanics — inline/file suppressions, baseline matching, stale-
+   baseline detection, ``--update-baseline`` pruning, reason-required
+   validation, per-file caching;
+3. the whole-repo gate — ``weaviate_tpu/`` must produce ZERO
+   non-baselined violations and zero stale baseline entries. Runs under
+   tier-1 (pure AST: no device, no JAX import needed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.graftlint import core  # noqa: E402
+from tools.graftlint.core import run  # noqa: E402
+
+
+def write_tree(root, files: dict[str, str]):
+    for rel, src in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def lint_tree(root, files: dict[str, str], paths=None, **kwargs):
+    """Write fixture files under ``root`` and run graftlint over them."""
+    kwargs.setdefault("use_cache", False)
+    return run(paths or list(files), write_tree(root, files), **kwargs)
+
+
+def checks(res):
+    return [(v.check, v.line) for v in res.violations]
+
+
+# -- G1 host-sync -------------------------------------------------------------
+
+
+G1_POSITIVE = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def scan(q, x):
+        d = jnp.sum(q * x, axis=1)
+        jax.block_until_ready(d)            # P1: explicit sync
+        host = np.asarray(d)                # P2: transfer of device value
+        worst = float(d[0])                 # P3: scalar sync
+        got = jax.device_get(d)             # P4: device_get
+        n = d.sum().item()                  # P5: .item() on device chain
+        return host, worst, got, n
+"""
+
+G1_NEGATIVE = """
+    import numpy as np
+
+    def ingest(rows, ids):
+        rows = np.asarray(rows, dtype=np.float32)   # host -> host: fine
+        m = float(rows[0, 0])                       # numpy scalar: fine
+        k = int(ids.max())                          # numpy: fine
+        return rows, m, k
+"""
+
+
+def test_g1_flags_sync_on_device_values(tmp_path):
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/engine/fixture.py": G1_POSITIVE})
+    g1 = [v for v in res.violations if v.check == "G1"]
+    assert len(g1) >= 4  # block_until_ready, asarray, float, device_get
+    lines = {v.line for v in g1}
+    assert {8, 9, 10, 11} <= lines
+
+
+def test_g1_ignores_host_numpy(tmp_path):
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/engine/fixture.py": G1_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G1"] == []
+
+
+def test_g1_scope_excludes_tracing_and_cold_paths(tmp_path):
+    src = """
+        import jax
+
+        def device_sync(sp, *vals):
+            jax.block_until_ready(vals)
+    """
+    res = lint_tree(tmp_path, {
+        # the sanctioned sampled-sync site
+        "weaviate_tpu/runtime/tracing.py": src,
+        # same code outside the hot-path dirs: not G1's business
+        "weaviate_tpu/api/rest_fixture.py": src,
+    })
+    assert [v for v in res.violations if v.check == "G1"] == []
+
+
+def test_g1_taint_flows_through_assignment(tmp_path):
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(q):
+            a = jnp.dot(q, q)
+            b = a * 2 + 1
+            c = b[0]
+            return np.asarray(c)
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/ops/fixture.py": src})
+    assert [v.check for v in res.violations] == ["G1"]
+
+
+def test_g1_boundary_kill_frees_downstream_host_reads(tmp_path):
+    """One suppressed boundary transfer must be enough: after
+    ``a = np.asarray(a)`` the name is host, so later float()/indexing
+    need no bogus extra suppressions — while the boundary call itself
+    still flags (here: unsuppressed, so exactly one G1)."""
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(q):
+            a = jnp.dot(q, q)
+            a = np.asarray(a)
+            return float(a[0]) + float(a[1])
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/ops/fixture.py": src})
+    g1 = [v for v in res.violations if v.check == "G1"]
+    assert len(g1) == 1 and g1[0].line == 7  # only the transfer itself
+
+
+def test_g1_numpy_ufunc_on_device_value_is_a_sink(tmp_path):
+    """np.sqrt(jnp_val) / np.where(dev_mask, ...) coerce the operand to
+    host — same sync as asarray, must flag."""
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(x, a, b):
+            y = np.sqrt(jnp.sum(x))
+            mask = jnp.greater(x, 0)
+            return y, np.where(mask, a, b)
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/engine/fixture.py": src})
+    g1 = [v for v in res.violations if v.check == "G1"]
+    assert {v.line for v in g1} == {6, 8}
+
+
+def test_g1_no_false_positive_before_first_device_assignment(tmp_path):
+    """A name used for host values early and rebound to a device value
+    LATER must not taint the earlier reads (straight-line order)."""
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(self, key, q):
+            res = self.cache_lookup(key)
+            if res is not None:
+                return np.asarray(res)      # host branch: clean
+            res = jnp.dot(q, q)
+            return np.asarray(res)          # the real transfer: flags
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/engine/fixture.py": src})
+    g1 = [v for v in res.violations if v.check == "G1"]
+    assert [v.line for v in g1] == [10]
+
+
+def test_g1_loop_carried_taint_still_caught(tmp_path):
+    """Device taint flowing around a loop back-edge (use textually
+    before the device rebind) must still reach the sink."""
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(x, n):
+            for _ in range(n):
+                y = np.asarray(x)
+                x = jnp.sin(x)
+            return y
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/engine/fixture.py": src})
+    g1 = [v for v in res.violations if v.check == "G1"]
+    assert [v.line for v in g1] == [7]
+
+
+# -- G2 retrace-hazard --------------------------------------------------------
+
+
+G2_POSITIVE = """
+    import functools
+    import jax
+
+    STATICS = ("k",)
+
+    @functools.partial(jax.jit, static_argnames=STATICS)
+    def bad_statics(x, k):                      # P1: computed static set
+        return x
+
+    @functools.partial(jax.jit, static_argnames=("kk",))
+    def typo(x, k):                             # P2: no param named kk
+        return x
+
+    @jax.jit
+    def branchy(x):
+        if x > 0:                               # P3: value branch on tracer
+            return x
+        return -x
+"""
+
+G2_NEGATIVE = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("k", "metric"))
+    def good(x, mask, k, metric):
+        if k > 4 and metric == "dot":           # static args: fine
+            x = x * 2
+        if x.shape[0] > 8:                      # shape: static under trace
+            x = x[:8]
+        if mask is None:                        # identity vs None: fine
+            return x
+        return x * mask
+"""
+
+
+def test_g2_flags_retrace_hazards(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/ops/fixture.py": G2_POSITIVE})
+    g2 = [v for v in res.violations if v.check == "G2"]
+    msgs = " | ".join(v.message for v in g2)
+    assert len(g2) == 3
+    assert "literal" in msgs            # computed static_argnames
+    assert "'kk'" in msgs               # typo'd static name
+    assert "VALUE of traced argument" in msgs
+
+
+def test_g2_accepts_static_shape_and_none_branches(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/ops/fixture.py": G2_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G2"] == []
+
+
+# -- G3 pallas-invariants -----------------------------------------------------
+
+
+G3_POSITIVE = """
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def masked_scan(q, x, allow_bits, tile_n: int = 384):   # P1: 384 % 512
+        return q
+
+    def kernel_loop(q_ref, n_ref, out_ref):
+        for i in range(n_ref[0]):                           # P2: traced loop
+            out_ref[i] = q_ref[i]
+
+    def big_scratch(q, x):
+        return pl.pallas_call(
+            kernel_loop,
+            grid=(1,),
+            scratch_shapes=[pltpu.VMEM((2048, 2048), jnp.float32)],  # P3: 16MB
+        )(q, x)
+"""
+
+G3_NEGATIVE = """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def plain_scan(q, x, tile_n: int = 512):        # lane-aligned default
+        return q
+
+    def masked_scan(q, x, allow_bits, tile_n: int = 1024):  # 1024 % 512 == 0
+        return q
+
+    def kernel(q_ref, x_ref, out_ref):
+        for j in range(32):                          # literal bound: fine
+            out_ref[:] = q_ref[:] + j
+        nb = 4
+        for i in range(nb):                          # local static: fine
+            out_ref[:] = x_ref[:] * i
+
+    def small_scratch(q):
+        return pl.pallas_call(
+            kernel,
+            grid=(1,),
+            scratch_shapes=[pltpu.VMEM((256, 128), jnp.float32)],
+        )(q, q)
+"""
+
+
+def test_g3_flags_pallas_invariants(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/ops/fixture.py": G3_POSITIVE})
+    g3 = [v for v in res.violations if v.check == "G3"]
+    msgs = " | ".join(v.message for v in g3)
+    assert len(g3) == 3
+    assert "not a multiple of 512" in msgs
+    assert "for-loop over a traced value" in msgs
+    assert "exceeds" in msgs and "VMEM" in msgs
+
+
+def test_g3_accepts_aligned_tiles_and_static_loops(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/ops/fixture.py": G3_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G3"] == []
+
+
+# -- G4 lock-discipline -------------------------------------------------------
+
+
+G4_POSITIVE = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0            # __init__: exempt
+
+        def add(self, n):
+            with self._lock:
+                self._count += n
+
+        def reset_unlocked(self):
+            self._count = 0            # P1: write outside the lock
+
+        def grow(self, n):
+            if n > 0:
+                self._cap = n          # P2: nested-statement write
+"""
+
+G4_NEGATIVE = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self._count = 0
+
+        def add(self, n):
+            with self._lock:
+                self._count += n
+
+        def add_cv(self, n):
+            with self._cv:             # Condition aliases the same lock
+                self._count += n
+
+        def _grow(self, n):
+            \"\"\"Caller holds ``_lock``.\"\"\"
+            self._count = n
+
+        def rename(self, s):
+            self.title = s             # public attr: out of G4's scope
+"""
+
+G4_ABBA_A = """
+    import threading
+
+    class Alpha:
+        def __init__(self, beta):
+            self._lock = threading.Lock()
+            self._beta = beta
+
+        def ping(self):
+            with self._lock:
+                self._beta.poke()
+
+        def poke_back(self):
+            with self._lock:
+                pass
+"""
+
+G4_ABBA_B = """
+    import threading
+
+    class Beta:
+        def __init__(self, alpha):
+            self._lock = threading.Lock()
+            self._alpha = alpha
+
+        def poke(self):
+            with self._lock:
+                pass
+
+        def pong(self):
+            with self._lock:
+                self._alpha.poke_back()
+"""
+
+
+def test_g4_flags_unlocked_underscore_writes(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/runtime/fx.py": G4_POSITIVE})
+    g4 = [v for v in res.violations if v.check == "G4"]
+    assert len(g4) == 2
+    assert {"_count", "_cap"} == {v.message.split("self.")[1].split(" ")[0]
+                                  for v in g4}
+
+
+def test_g4_accepts_locked_cv_and_caller_holds(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/runtime/fx.py": G4_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G4"] == []
+
+
+def test_g4_cross_module_lock_order_inversion(tmp_path):
+    res = lint_tree(tmp_path, {
+        "weaviate_tpu/runtime/alpha.py": G4_ABBA_A,
+        "weaviate_tpu/runtime/beta.py": G4_ABBA_B,
+    })
+    cyc = [v for v in res.violations if "inversion" in v.message]
+    assert len(cyc) == 1
+    assert "Alpha._lock" in cyc[0].message
+    assert "Beta._lock" in cyc[0].message
+
+
+def test_g4_no_inversion_for_consistent_order(tmp_path):
+    # both nestings go Alpha -> Beta: a DAG, not a cycle
+    consistent = G4_ABBA_B.replace(
+        "                self._alpha.poke_back()", "                pass")
+    res = lint_tree(tmp_path, {
+        "weaviate_tpu/runtime/alpha.py": G4_ABBA_A,
+        "weaviate_tpu/runtime/beta.py": consistent,
+    })
+    assert [v for v in res.violations if "inversion" in v.message] == []
+
+
+def test_g4_caller_holds_helper_contributes_graph_edges(tmp_path):
+    # the nested acquisition happens inside a "Caller holds" helper —
+    # the graph must still see holder -> inner (kv.py's WAL append idiom)
+    helper_a = """
+        import threading
+
+        class Alpha:
+            def __init__(self, beta):
+                self._lock = threading.Lock()
+                self._beta = beta
+
+            def ping(self):
+                with self._lock:
+                    self._tail()
+
+            def _tail(self):
+                \"\"\"Caller holds ``_lock``.\"\"\"
+                self._beta.poke()
+
+            def poke_back(self):
+                with self._lock:
+                    pass
+    """
+    res = lint_tree(tmp_path, {
+        "weaviate_tpu/runtime/alpha.py": helper_a,
+        "weaviate_tpu/runtime/beta.py": G4_ABBA_B,
+    })
+    cyc = [v for v in res.violations if "inversion" in v.message]
+    assert len(cyc) == 1
+
+
+def test_g4_tuple_unpack_write_outside_lock(tmp_path):
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def clear(self):
+                self._head, self._tail = None, None   # two torn writes
+
+            def swap(self):
+                with self._lock:
+                    t, self._head = self._head, None  # held: fine
+                return t
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/runtime/fx.py": src})
+    g4 = [v for v in res.violations if v.check == "G4"]
+    assert len(g4) == 2
+    assert all(v.line == 9 for v in g4)
+
+
+def test_g4_innocuous_under_phrase_is_not_an_exemption(tmp_path):
+    """'under _normal operating conditions' is prose, not a lock claim —
+    the unlocked write must still flag."""
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                \"\"\"Runs fine under _normal operating conditions.\"\"\"
+                self._n = 1
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/runtime/fx.py": src})
+    assert [v.check for v in res.violations] == ["G4"]
+
+
+def test_g4_multi_item_with_orders_left_to_right(tmp_path):
+    """``with self._a, self._b:`` acquires a then b — an opposite
+    nesting elsewhere is a real ABBA and must flag."""
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a, self._b:
+                    pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/runtime/fx.py": src})
+    assert any("inversion" in v.message for v in res.violations)
+
+
+def test_g4_docstring_lock_names_match_whole_tokens():
+    """A 'Caller holds ``_flush_lock``' doc must not seed ``_lock`` as
+    held (substring!) — phantom held-edges would fabricate inversions."""
+    import ast as _ast
+
+    from tools.graftlint.core import FileContext
+    from tools.graftlint.g4_locks import LockDisciplineChecker, _ClassLocks
+
+    src = textwrap.dedent("""
+        import threading
+
+        class Bucket:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._flush_lock = threading.Lock()
+    """)
+    cls = _ast.parse(src).body[1]
+    cl = _ClassLocks(cls, "weaviate_tpu/storage/fx.py")
+    held = LockDisciplineChecker()._held_from_docstring(
+        "Caller holds ``_flush_lock``.", cl)
+    assert held == ["weaviate_tpu/storage/fx.py:Bucket._flush_lock"]
+    # naming _lock itself still resolves to _lock only
+    held2 = LockDisciplineChecker()._held_from_docstring(
+        "Caller holds ``_lock``.", cl)
+    assert held2 == ["weaviate_tpu/storage/fx.py:Bucket._lock"]
+
+
+def test_g3_partial_scratch_still_exceeds_budget(tmp_path):
+    """Resolved entries alone over budget must flag even when another
+    entry cannot be sized — total is a lower bound."""
+    src = """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def kern(q_ref, x_ref, out_ref):
+            out_ref[:] = q_ref[:]
+
+        def big(q, n, d):
+            return pl.pallas_call(
+                kern,
+                grid=(1,),
+                scratch_shapes=[pltpu.VMEM((2048, 2048), jnp.float32),
+                                pltpu.VMEM((n, d), jnp.float32)],
+            )(q, q)
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/ops/fixture.py": src})
+    assert any("VMEM" in v.message for v in res.violations
+               if v.check == "G3")
+
+
+def test_g3_requires_a_real_pallas_import(tmp_path):
+    """A comment mentioning pallas must not subject host-side helpers
+    (or their block_rows-style params) to kernel alignment rules."""
+    src = """
+        # we route scans through the pallas kernels when on TPU
+
+        def plan(n, block_rows: int = 100, tile_n: int = 100):
+            return n // block_rows
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/engine/fixture.py": src})
+    assert [v for v in res.violations if v.check == "G3"] == []
+
+
+def test_g3_host_side_param_names_not_dragged_in(tmp_path):
+    """Only the exact kernel tile params are alignment-checked — a
+    host chunking knob named block_rows is not a tile."""
+    src = """
+        from jax.experimental import pallas as pl
+
+        def plan(n, block_rows: int = 100):
+            return n // block_rows
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/engine/fixture.py": src})
+    assert [v for v in res.violations if v.check == "G3"] == []
+
+
+def test_cache_is_keyed_on_checker_set(tmp_path):
+    """A run with a checkers subset must not poison the full run."""
+    from tools.graftlint.g4_locks import LockDisciplineChecker
+
+    root = write_tree(tmp_path, {"weaviate_tpu/engine/fx.py": BASE_SRC})
+    res_sub = run(["weaviate_tpu"], root, use_cache=True,
+                  checkers=[LockDisciplineChecker()])
+    assert res_sub.violations == []  # G4 sees nothing here
+    res_full = run(["weaviate_tpu"], root, use_cache=True)
+    assert [v.check for v in res_full.violations] == ["G1"]
+
+
+# -- G5 metrics-conventions ---------------------------------------------------
+
+
+G5_POSITIVE = """
+    from weaviate_tpu.runtime.metrics import registry
+
+    ok = registry.counter("weaviate_tpu_good_total", "documented")
+    bad_name = registry.gauge("camelCaseGauge", "help")          # P1
+    bad_prefix = registry.counter("other_ns_total", "help")      # P2
+    no_help = registry.counter("weaviate_tpu_nohelp_total", "")  # P3
+    bad_label = registry.histogram(
+        "weaviate_tpu_lat_seconds", "help", ("badLabel",))       # P4
+"""
+
+G5_NEGATIVE = """
+    from weaviate_tpu.runtime.metrics import registry
+
+    a = registry.counter("weaviate_tpu_reqs_total", "requests served",
+                         ("collection", "shard"))
+    b = registry.histogram("weaviate_tpu_lat_seconds", "latency", ("op",))
+
+    def dynamic(name):
+        return registry.counter(name, "runtime lint covers dynamics")
+"""
+
+
+def test_g5_flags_bad_registrations(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/runtime/fx.py": G5_POSITIVE})
+    g5 = [v for v in res.violations if v.check == "G5"]
+    msgs = " | ".join(v.message for v in g5)
+    # camelCaseGauge violates naming AND prefix -> 5 findings for 4 sites
+    assert len(g5) == 5
+    assert "camelCaseGauge" in msgs and "not snake_case" in msgs
+    assert "weaviate_tpu_" in msgs        # prefix rule
+    assert "HELP" in msgs
+    assert "badLabel" in msgs
+
+
+def test_g5_accepts_clean_and_skips_dynamic(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/runtime/fx.py": G5_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G5"] == []
+
+
+def test_g5_runtime_lint_reexported_through_shim():
+    """tools/lint_metrics.py stays a working standalone module (the
+    metrics-exposition tests load it by file path)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics_shim", os.path.join(REPO_ROOT, "tools",
+                                          "lint_metrics.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert callable(mod.lint) and callable(mod.main)
+    from tools.graftlint.g5_metrics import lint as g5_lint
+    assert mod.lint is g5_lint
+
+
+# -- suppression mechanics ----------------------------------------------------
+
+
+def test_inline_suppression_exact_line(tmp_path):
+    src = """
+        import jax
+
+        def f(d):
+            jax.block_until_ready(d)  # graftlint: disable=G1 — boundary
+            jax.block_until_ready(d)
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/engine/fx.py": src})
+    g1 = [v for v in res.violations if v.check == "G1"]
+    assert len(g1) == 1 and g1[0].line == 6  # only the unsuppressed one
+
+
+def test_file_level_suppression(tmp_path):
+    src = """
+        # graftlint: disable-file=G1
+        import jax
+
+        def f(d):
+            jax.block_until_ready(d)
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/engine/fx.py": src})
+    assert res.violations == []
+
+
+def test_suppression_is_per_check_id(tmp_path):
+    src = """
+        import jax
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self, d):
+                self._d = jax.block_until_ready(d)  # graftlint: disable=G4
+    """
+    res = lint_tree(tmp_path, {"weaviate_tpu/engine/fx.py": src})
+    # G4 suppressed on that line; the G1 violation must survive
+    assert [v.check for v in res.violations] == ["G1"]
+
+
+# -- baseline mechanics -------------------------------------------------------
+
+
+BASE_SRC = """
+    import jax
+
+    def f(d):
+        jax.block_until_ready(d)
+"""
+
+
+def _baseline_for(res):
+    return [{**v.to_dict(), "reason": "grandfathered for the test"}
+            for v in res.violations]
+
+
+def test_baseline_grandfathers_by_fingerprint(tmp_path):
+    root = write_tree(tmp_path, {"weaviate_tpu/engine/fx.py": BASE_SRC})
+    res = run(["weaviate_tpu"], root, use_cache=False)
+    assert len(res.violations) == 1
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(_baseline_for(res)))
+    res2 = run(["weaviate_tpu"], root, use_cache=False,
+               baseline_path=str(bl))
+    assert res2.violations == [] and len(res2.baselined) == 1
+    assert res2.stale == [] and res2.clean
+
+
+def test_baseline_survives_pure_line_motion(tmp_path):
+    root = write_tree(tmp_path, {"weaviate_tpu/engine/fx.py": BASE_SRC})
+    res = run(["weaviate_tpu"], root, use_cache=False)
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(_baseline_for(res)))
+    # shift the violation down: same fingerprint, different line
+    (tmp_path / "weaviate_tpu/engine/fx.py").write_text(
+        "# a new leading comment\n# another\n"
+        + textwrap.dedent(BASE_SRC))
+    res2 = run(["weaviate_tpu"], root, use_cache=False,
+               baseline_path=str(bl))
+    assert res2.violations == [] and res2.stale == []
+
+
+def test_stale_baseline_entry_fails_the_gate(tmp_path):
+    root = write_tree(tmp_path, {"weaviate_tpu/engine/fx.py": BASE_SRC})
+    res = run(["weaviate_tpu"], root, use_cache=False)
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(_baseline_for(res)))
+    # fix the violation: the baseline entry is now stale -> gate fails
+    (tmp_path / "weaviate_tpu/engine/fx.py").write_text(
+        "def f(d):\n    return d\n")
+    res2 = run(["weaviate_tpu"], root, use_cache=False,
+               baseline_path=str(bl))
+    assert res2.violations == []
+    assert len(res2.stale) == 1
+    assert not res2.clean
+
+
+def test_update_baseline_prunes_stale_entries(tmp_path):
+    root = write_tree(tmp_path, {"weaviate_tpu/engine/fx.py": BASE_SRC})
+    res = run(["weaviate_tpu"], root, use_cache=False)
+    entries = _baseline_for(res)
+    entries.append({"check": "G1", "path": "weaviate_tpu/engine/gone.py",
+                    "scope": "f", "message": "[host-sync] whatever",
+                    "reason": "file was deleted"})
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(entries))
+    res2 = run(["weaviate_tpu"], root, use_cache=False,
+               baseline_path=str(bl))
+    assert len(res2.stale) == 1
+    pruned = core.update_baseline(res2.baselined + res2.violations,
+                                  str(bl))
+    assert pruned == 1
+    kept = json.loads(bl.read_text())
+    assert len(kept) == 1 and kept[0]["path"].endswith("fx.py")
+    res3 = run(["weaviate_tpu"], root, use_cache=False,
+               baseline_path=str(bl))
+    assert res3.stale == [] and res3.violations == []
+
+
+DOUBLE_SRC = """
+    import jax
+
+    def f(d):
+        jax.block_until_ready(d)
+        jax.block_until_ready(d)
+"""
+
+
+def test_baseline_count_gates_extra_identical_violations(tmp_path):
+    """One entry grandfathers ONE occurrence: a second identical sync in
+    the same scope must surface as NEW, not ride the existing entry."""
+    root = write_tree(tmp_path, {"weaviate_tpu/engine/fx.py": BASE_SRC})
+    res = run(["weaviate_tpu"], root, use_cache=False)
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(_baseline_for(res)))
+    # duplicate the violation: same fingerprint, two occurrences
+    (tmp_path / "weaviate_tpu/engine/fx.py").write_text(
+        textwrap.dedent(DOUBLE_SRC))
+    res2 = run(["weaviate_tpu"], root, use_cache=False,
+               baseline_path=str(bl))
+    assert len(res2.baselined) == 1 and len(res2.violations) == 1
+    assert not res2.clean
+    # count: 2 covers both; fixing one makes the entry stale again
+    entries = json.loads(bl.read_text())
+    entries[0]["count"] = 2
+    bl.write_text(json.dumps(entries))
+    res3 = run(["weaviate_tpu"], root, use_cache=False,
+               baseline_path=str(bl))
+    assert res3.clean and len(res3.baselined) == 2
+    (tmp_path / "weaviate_tpu/engine/fx.py").write_text(
+        textwrap.dedent(BASE_SRC))
+    res4 = run(["weaviate_tpu"], root, use_cache=False,
+               baseline_path=str(bl))
+    assert len(res4.stale) == 1 and not res4.clean
+    # --update-baseline shrinks the count instead of dropping the entry
+    dropped = core.update_baseline(res4.baselined + res4.violations,
+                                   str(bl))
+    assert dropped == 0
+    kept = json.loads(bl.read_text())
+    assert len(kept) == 1 and "count" not in kept[0]
+    res5 = run(["weaviate_tpu"], root, use_cache=False,
+               baseline_path=str(bl))
+    assert res5.clean
+
+
+def test_baseline_entries_require_reasons(tmp_path):
+    root = write_tree(tmp_path, {"weaviate_tpu/engine/fx.py": BASE_SRC})
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps([{
+        "check": "G1", "path": "weaviate_tpu/engine/fx.py",
+        "scope": "f", "message": "[host-sync] x"}]))  # no reason
+    res = run(["weaviate_tpu"], root, use_cache=False,
+              baseline_path=str(bl))
+    assert any("reason" in e for e in res.errors)
+    assert not res.clean
+
+
+# -- caching ------------------------------------------------------------------
+
+
+def test_cache_reuses_and_invalidates_on_change(tmp_path):
+    root = write_tree(tmp_path, {"weaviate_tpu/engine/fx.py": BASE_SRC})
+    res1 = run(["weaviate_tpu"], root, use_cache=True)
+    assert len(res1.violations) == 1
+    assert os.path.exists(os.path.join(root, ".graftlint_cache.json"))
+    # cached second run: same result
+    res2 = run(["weaviate_tpu"], root, use_cache=True)
+    assert checks(res2) == checks(res1)
+    # edit the file: cache must invalidate, violation disappears
+    (tmp_path / "weaviate_tpu/engine/fx.py").write_text(
+        "def f(d):\n    return d\n")
+    res3 = run(["weaviate_tpu"], root, use_cache=True)
+    assert res3.violations == []
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_json_output_and_exit_codes(tmp_path):
+    root = write_tree(tmp_path, {"weaviate_tpu/engine/fx.py": BASE_SRC})
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--json", "--no-cache",
+         "--root", root, "weaviate_tpu"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT)
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["violations"] and \
+        payload["violations"][0]["check"] == "G1"
+
+
+# -- the whole-repo tier-1 gate ----------------------------------------------
+
+
+def test_repo_gate_zero_nonbaselined_violations():
+    """Every future PR runs this: the production tree must be clean
+    modulo the checked-in baseline, and the baseline must not be stale."""
+    res = run(["weaviate_tpu"], REPO_ROOT, use_cache=False,
+              baseline_path=core.default_baseline_path(REPO_ROOT))
+    assert res.errors == []
+    assert res.stale == [], (
+        "stale baseline entries — the violation was fixed; run "
+        "python -m tools.graftlint --update-baseline")
+    assert res.violations == [], (
+        "new graftlint violations:\n" + "\n".join(
+            f"{v.path}:{v.line}: {v.check} {v.message}"
+            for v in res.violations))
+    assert res.files > 50  # sanity: the walk really saw the tree
+
+
+def test_repo_baseline_entries_all_have_reasons():
+    entries = core.load_baseline(core.default_baseline_path(REPO_ROOT))
+    for e in entries:
+        assert str(e.get("reason", "")).strip(), e

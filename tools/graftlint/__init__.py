@@ -1,0 +1,30 @@
+"""graftlint: repo-native static analysis for TPU hot-path and
+lock-discipline invariants.
+
+Five checkers over the repo's own idioms (the Python analog of the
+reference relying on `go vet` + the race detector — bug classes that
+pytest structurally cannot see because they need production concurrency
+or a real TPU to fire):
+
+- G1 host-sync        stray device->host synchronization in serving hot
+                      paths (block_until_ready / device_get / np.asarray
+                      / float() on device values)
+- G2 retrace-hazard   jax.jit call sites with non-literal static args,
+                      typo'd static_argnames, and value-dependent Python
+                      control flow on traced arguments
+- G3 pallas-invariants tile/mask alignment, VMEM scratch budget, and
+                      Python loops over traced values inside kernels
+- G4 lock-discipline  self._* writes reachable outside the owning lock,
+                      and cross-module lock-order inversions from the
+                      static acquisition graph
+- G5 metrics-conventions Prometheus naming / HELP rules at registration
+                      call sites (the lint_metrics seed, folded in)
+
+Run: ``python -m tools.graftlint [--json] [--update-baseline] paths...``
+Suppress: ``# graftlint: disable=G1`` on the violating line (give a
+reason in a trailing comment), ``# graftlint: disable-file=G4`` anywhere
+in a file, or a ``tools/graftlint/baseline.json`` entry with a
+``reason`` for grandfathered findings that need real redesign.
+"""
+
+from tools.graftlint.core import Violation, main, run  # noqa: F401

@@ -1,0 +1,537 @@
+"""graftlint driver: shared AST walk, suppressions, cache, baseline, CLI.
+
+The driver parses each file ONCE and hands the tree to every applicable
+checker. Checkers return per-file violations plus (optionally) JSON-able
+"facts" consumed by a cross-file ``finalize`` pass — that is how the G4
+lock-acquisition graph spans modules without re-parsing. Per-file results
+are cached by content hash (keyed also on the graftlint sources
+themselves, so editing a checker invalidates everything).
+
+Reporting pipeline, in order:
+
+1. inline suppressions   ``# graftlint: disable=G1[,G4]`` on the exact
+                         violating line; ``# graftlint: disable-file=ID``
+                         (or ``=all``) anywhere in the file
+2. baseline              ``baseline.json`` entries grandfather known
+                         violations by (check, path, scope, message)
+                         fingerprint — line-number independent, so pure
+                         code motion does not churn the baseline. Every
+                         entry MUST carry a non-empty ``reason``.
+3. stale detection       a baseline entry matching nothing is itself an
+                         error (the violation was fixed: delete the
+                         entry, or run ``--update-baseline`` to prune).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import hashlib
+import json
+import os
+import re
+import sys
+from dataclasses import asdict, dataclass, field
+
+CHECK_IDS = ("G1", "G2", "G3", "G4", "G5")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass
+class Violation:
+    check: str          # "G1".."G5"
+    path: str           # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    scope: str = ""     # innermost enclosing Class.func qualname
+
+    def fingerprint(self) -> tuple:
+        return (self.check, self.path, self.scope, self.message)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Violation":
+        return cls(**d)
+
+
+class FileContext:
+    """One parsed file, shared by every checker."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path            # repo-relative posix path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._scopes: list[tuple[int, int, str]] | None = None
+
+    def scope_at(self, line: int) -> str:
+        """Innermost Class.func qualname containing ``line``."""
+        if self._scopes is None:
+            spans: list[tuple[int, int, str]] = []
+
+            def visit(node, prefix):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        name = (prefix + "." + child.name
+                                if prefix else child.name)
+                        end = getattr(child, "end_lineno", child.lineno)
+                        spans.append((child.lineno, end, name))
+                        visit(child, name)
+                    else:
+                        visit(child, prefix)
+
+            visit(self.tree, "")
+            self._scopes = spans
+        best = ""
+        best_span = None
+        for lo, hi, name in self._scopes:
+            if lo <= line <= hi:
+                if best_span is None or hi - lo <= best_span:
+                    best, best_span = name, hi - lo
+        return best
+
+
+def walk_shallow(body):
+    """Walk statements without descending into nested function/class
+    definitions (each nested def is analyzed as its own unit)."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+class Checker:
+    """Base checker. ``check`` returns per-file violations; ``facts``
+    returns an optional JSON-able per-file record for ``finalize``, the
+    cross-file pass (violations it returns must carry real path/line so
+    inline suppressions still apply)."""
+
+    id = "G0"
+    name = "base"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py")
+
+    def check(self, ctx: FileContext) -> list[Violation]:
+        return []
+
+    def facts(self, ctx: FileContext):
+        return None
+
+    def finalize(self, facts: dict[str, object]) -> list[Violation]:
+        return []
+
+
+def all_checkers() -> list[Checker]:
+    from tools.graftlint.g1_host_sync import HostSyncChecker
+    from tools.graftlint.g2_retrace import RetraceChecker
+    from tools.graftlint.g3_pallas import PallasChecker
+    from tools.graftlint.g4_locks import LockDisciplineChecker
+    from tools.graftlint.g5_metrics import MetricsConventionChecker
+
+    return [HostSyncChecker(), RetraceChecker(), PallasChecker(),
+            LockDisciplineChecker(), MetricsConventionChecker()]
+
+
+# -- suppressions -------------------------------------------------------------
+
+
+def _parse_ids(blob: str) -> set[str]:
+    return {p.strip().upper() for p in blob.split(",") if p.strip()}
+
+
+def suppressions(ctx: FileContext) -> tuple[set[str], dict[int, set[str]]]:
+    """(file-level disabled ids, line -> disabled ids). ``all`` (or
+    ``ALL``) disables every checker."""
+    file_ids: set[str] = set()
+    line_ids: dict[int, set[str]] = {}
+    for i, line in enumerate(ctx.lines, start=1):
+        if "graftlint" not in line:
+            continue
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            file_ids |= _parse_ids(m.group(1))
+            continue
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            line_ids.setdefault(i, set()).update(_parse_ids(m.group(1)))
+    return file_ids, line_ids
+
+
+def apply_suppressions(ctx: FileContext,
+                       violations: list[Violation]) -> list[Violation]:
+    file_ids, line_ids = suppressions(ctx)
+    if "ALL" in file_ids:
+        return []
+    out = []
+    for v in violations:
+        if v.check in file_ids:
+            continue
+        ids = line_ids.get(v.line, ())
+        if v.check in ids or "ALL" in ids:
+            continue
+        out.append(v)
+    return out
+
+
+# -- cache --------------------------------------------------------------------
+
+
+def _tool_hash() -> str:
+    """Hash of the graftlint sources: editing any checker invalidates the
+    whole cache."""
+    h = hashlib.sha1()
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(pkg)):
+        if fn.endswith(".py"):
+            with open(os.path.join(pkg, fn), "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+class Cache:
+    def __init__(self, path: str | None, checker_ids: tuple = ()):
+        self.path = path
+        # keyed on the graftlint sources AND the active checker set — a
+        # run with a checkers subset must not poison a later full run
+        self.tool = _tool_hash() + ":" + ",".join(sorted(checker_ids))
+        self.data: dict = {}
+        self.dirty = False
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    loaded = json.load(f)
+                if loaded.get("tool") == self.tool:
+                    self.data = loaded.get("files", {})
+            except (OSError, ValueError):
+                self.data = {}
+
+    def get(self, relpath: str, sha: str):
+        ent = self.data.get(relpath)
+        if ent and ent.get("sha") == sha:
+            return ([Violation.from_dict(d) for d in ent["violations"]],
+                    ent.get("facts", {}))
+        return None
+
+    def put(self, relpath: str, sha: str, violations: list[Violation],
+            facts: dict) -> None:
+        self.data[relpath] = {
+            "sha": sha,
+            "violations": [v.to_dict() for v in violations],
+            "facts": facts,
+        }
+        self.dirty = True
+
+    def save(self) -> None:
+        if not self.path or not self.dirty:
+            return
+        try:
+            with open(self.path, "w") as f:
+                json.dump({"tool": self.tool, "files": self.data}, f)
+        except OSError:
+            pass
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: str) -> list[dict]:
+    if not path or not os.path.exists(path):
+        return []
+    with open(path) as f:
+        entries = json.load(f)
+    if not isinstance(entries, list):
+        raise BaselineError(f"{path}: baseline must be a JSON list")
+    for e in entries:
+        for k in ("check", "path", "message", "reason"):
+            if not str(e.get(k, "")).strip():
+                raise BaselineError(
+                    f"{path}: baseline entry {e!r} missing {k!r} "
+                    "(every grandfathered violation needs a reason)")
+        if not isinstance(e.get("count", 1), int) or e.get("count", 1) < 1:
+            raise BaselineError(
+                f"{path}: baseline entry {e!r} has invalid count")
+    return entries
+
+
+def _entry_fingerprint(e: dict) -> tuple:
+    return (e["check"], e["path"], e.get("scope", ""), e["message"])
+
+
+def split_baseline(violations: list[Violation], entries: list[dict]):
+    """-> (new_violations, baselined_violations, stale_entries).
+
+    Each entry grandfathers exactly ``count`` occurrences (default 1) of
+    its fingerprint. MORE live occurrences than count = the excess are
+    NEW violations (adding a second identical sync next to a baselined
+    one must not ride its entry); FEWER = some were fixed, so the entry
+    is STALE until ``--update-baseline`` rewrites its count."""
+    budget = {}
+    for e in entries:
+        fp = _entry_fingerprint(e)
+        budget[fp] = budget.get(fp, 0) + int(e.get("count", 1))
+    live_counts: dict[tuple, int] = {}
+    new, old = [], []
+    for v in sorted(violations, key=lambda v: (v.path, v.line, v.col)):
+        fp = v.fingerprint()
+        live_counts[fp] = live_counts.get(fp, 0) + 1
+        if live_counts[fp] <= budget.get(fp, 0):
+            old.append(v)
+        else:
+            new.append(v)
+    stale = [e for e in entries
+             if live_counts.get(_entry_fingerprint(e), 0)
+             < budget[_entry_fingerprint(e)]]
+    return new, old, stale
+
+
+# -- runner -------------------------------------------------------------------
+
+
+@dataclass
+class Result:
+    violations: list[Violation] = field(default_factory=list)  # non-baselined
+    baselined: list[Violation] = field(default_factory=list)
+    stale: list[dict] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)  # parse failures etc.
+    files: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations and not self.stale and not self.errors
+
+
+def discover(paths: list[str], root: str) -> list[str]:
+    """Expand files/dirs into a sorted list of repo-relative .py paths."""
+    out: set[str] = set()
+    for p in paths:
+        absp = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(absp):
+            out.add(os.path.relpath(absp, root).replace(os.sep, "/"))
+        elif os.path.isdir(absp):
+            for dirpath, dirnames, filenames in os.walk(absp):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(os.path.join(dirpath, fn),
+                                              root)
+                        out.add(rel.replace(os.sep, "/"))
+    return sorted(out)
+
+
+def run(paths: list[str], root: str, *, use_cache: bool = True,
+        baseline_path: str | None = None,
+        checkers: list[Checker] | None = None) -> Result:
+    """Analyze ``paths`` (files or directories, relative to ``root``)."""
+    checkers = all_checkers() if checkers is None else checkers
+    res = Result()
+    cache = Cache(os.path.join(root, ".graftlint_cache.json")
+                  if use_cache else None,
+                  checker_ids=tuple(c.id for c in checkers))
+    all_violations: list[Violation] = []
+    # facts survive even for cached files — finalize always sees the
+    # whole project's graph
+    project_facts: dict[str, dict[str, object]] = {c.id: {}
+                                                   for c in checkers}
+    for rel in discover(paths, root):
+        absp = os.path.join(root, rel)
+        try:
+            with open(absp, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            res.errors.append(f"{rel}: unreadable ({e})")
+            continue
+        sha = hashlib.sha1(source.encode()).hexdigest()
+        res.files += 1
+        cached = cache.get(rel, sha)
+        if cached is not None:
+            violations, facts = cached
+            all_violations.extend(violations)
+            for cid, fact in facts.items():
+                if fact is not None:
+                    project_facts.setdefault(cid, {})[rel] = fact
+            continue
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            res.errors.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        ctx = FileContext(rel, source, tree)
+        violations: list[Violation] = []
+        facts: dict[str, object] = {}
+        for c in checkers:
+            if not c.applies_to(rel):
+                continue
+            for v in c.check(ctx):
+                if not v.scope:
+                    v.scope = ctx.scope_at(v.line)
+                violations.append(v)
+            fact = c.facts(ctx)
+            if fact is not None:
+                facts[c.id] = fact
+                project_facts[c.id][rel] = fact
+        violations = apply_suppressions(ctx, violations)
+        cache.put(rel, sha, violations, facts)
+        all_violations.extend(violations)
+    # cross-file pass (lock-order graph): re-apply inline suppressions at
+    # the reported site
+    ctx_by_path: dict[str, FileContext] = {}
+    for c in checkers:
+        extra = c.finalize(project_facts.get(c.id, {}))
+        for v in extra:
+            ctx = ctx_by_path.get(v.path)
+            if ctx is None:
+                try:
+                    with open(os.path.join(root, v.path),
+                              encoding="utf-8") as f:
+                        src = f.read()
+                    ctx = FileContext(v.path, src, ast.parse(src))
+                except (OSError, SyntaxError):
+                    ctx = None
+                ctx_by_path[v.path] = ctx
+            if ctx is not None:
+                if not v.scope:
+                    v.scope = ctx.scope_at(v.line)
+                if not apply_suppressions(ctx, [v]):
+                    continue
+            all_violations.append(v)
+    cache.save()
+
+    try:
+        entries = load_baseline(baseline_path) if baseline_path else []
+    except BaselineError as e:
+        res.errors.append(str(e))
+        entries = []
+    new, old, stale = split_baseline(all_violations, entries)
+    new.sort(key=lambda v: (v.path, v.line, v.check))
+    res.violations, res.baselined, res.stale = new, old, stale
+    return res
+
+
+def update_baseline(live_violations: list[Violation],
+                    baseline_path: str) -> int:
+    """Prune: drop entries whose violation no longer exists and shrink
+    counts down to the live occurrence count. Never grows an entry —
+    excess new occurrences must be fixed or baselined by hand with a
+    reason. Returns how many entries were dropped outright."""
+    entries = load_baseline(baseline_path)
+    live: dict[tuple, int] = {}
+    for v in live_violations:
+        live[v.fingerprint()] = live.get(v.fingerprint(), 0) + 1
+    kept, dropped = [], 0
+    for e in entries:
+        fp = _entry_fingerprint(e)
+        have = int(e.get("count", 1))
+        n = min(have, live.get(fp, 0))
+        live[fp] = live.get(fp, 0) - n  # consume for duplicate entries
+        if n == 0:
+            dropped += 1
+            continue
+        e = dict(e)
+        if n == 1:
+            e.pop("count", None)
+        else:
+            e["count"] = n
+        kept.append(e)
+    with open(baseline_path, "w") as f:
+        json.dump(kept, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return dropped
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(root, "tools", "graftlint", "baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="Repo-native static analysis: TPU hot-path and "
+                    "lock-discipline invariants (G1..G5).")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: weaviate_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="prune baseline entries whose violation no "
+                         "longer exists")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default tools/graftlint/"
+                         "baseline.json)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and don't write the per-file cache")
+    ap.add_argument("--root", default=None,
+                    help="tree root for path scoping (default: this "
+                         "checkout; paths are reported relative to it)")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    paths = args.paths or ["weaviate_tpu"]
+    baseline_path = args.baseline or default_baseline_path(root)
+    res = run(paths, root, use_cache=not args.no_cache,
+              baseline_path=baseline_path)
+
+    if args.update_baseline and os.path.exists(baseline_path):
+        pruned = update_baseline(res.baselined + res.violations,
+                                 baseline_path)
+        res.stale = []
+        if not args.as_json:
+            print(f"graftlint: pruned {pruned} stale baseline "
+                  f"entr{'y' if pruned == 1 else 'ies'}")
+
+    if args.as_json:
+        print(json.dumps({
+            "files": res.files,
+            "violations": [v.to_dict() for v in res.violations],
+            "baselined": [v.to_dict() for v in res.baselined],
+            "stale_baseline": res.stale,
+            "errors": res.errors,
+        }, indent=2))
+    else:
+        for v in res.violations:
+            print(f"{v.path}:{v.line}:{v.col}: {v.check} {v.message}")
+        for e in res.stale:
+            print(f"{e['path']}: stale baseline entry for {e['check']} "
+                  f"({e['message']!r}) — violation no longer exists; "
+                  "delete it or run --update-baseline")
+        for e in res.errors:
+            print(f"graftlint: error: {e}", file=sys.stderr)
+        n = len(res.violations)
+        print(f"graftlint: {res.files} files, {n} violation"
+              f"{'' if n == 1 else 's'}"
+              + (f", {len(res.baselined)} baselined"
+                 if res.baselined else "")
+              + (f", {len(res.stale)} STALE baseline entries"
+                 if res.stale else ""))
+    return 0 if res.clean else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

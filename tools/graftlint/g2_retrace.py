@@ -1,0 +1,233 @@
+"""G2 retrace-hazard: jit call sites that silently recompile or blow up
+only at trace time.
+
+Three bug classes, all invisible to CPU tests that happen to hit one
+shape:
+
+1. ``static_argnames`` / ``static_argnums`` built from non-literal
+   expressions — a computed static arg set means the jit cache key is
+   whatever that expression evaluated to at import time, and an
+   unhashable value raises only when the call site finally runs.
+2. A literal ``static_argnames`` naming a parameter the function does
+   not have — jax raises at the FIRST CALL, i.e. in production if tests
+   don't reach that wrapper (the classic typo'd-kwarg trap).
+3. Value-dependent Python control flow on a traced argument inside a
+   jitted function (``if x > 0:`` where ``x`` is traced) — a
+   TracerBoolConversionError on paths tests never exercise. Shape/dtype
+   tests (``x.shape[0]``, ``x.ndim``), ``x is None`` checks, and
+   conditions on static args are all fine and excluded. This is the bug
+   class the pow2 B/k bucketing in runtime/query_batcher.py exists to
+   keep OUT of the dispatch path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (Checker, FileContext, Violation,
+                                  walk_shallow)
+
+#: attribute reads on a traced value that are static at trace time
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize",
+                "sharding", "aval", "weak_type"}
+#: call wrappers through which a traced param may safely reach an `if`
+STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type",
+                "callable"}
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts[::-1]
+
+
+def _is_jit_func(node: ast.AST) -> bool:
+    """True for ``jax.jit`` / bare ``jit`` references."""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        chain = _attr_chain(node)
+        return chain[-1:] == ["jit"] and (len(chain) == 1
+                                          or chain[0] == "jax")
+    return False
+
+
+def _jit_call(node: ast.Call):
+    """Recognize ``jax.jit(...)``, ``functools.partial(jax.jit, ...)``
+    and ``partial(jax.jit, ...)``; returns the kwargs list or None."""
+    fn = node.func
+    if _is_jit_func(fn):
+        return node.keywords
+    chain = _attr_chain(fn) if isinstance(fn, ast.Attribute) else (
+        [fn.id] if isinstance(fn, ast.Name) else [])
+    if chain[-1:] == ["partial"] and node.args \
+            and _is_jit_func(node.args[0]):
+        return node.keywords
+    return None
+
+
+def _literal_static(value: ast.AST):
+    """-> (is_literal, names-or-nums list) for a static_arg* value."""
+    if isinstance(value, ast.Constant) \
+            and isinstance(value.value, (str, int)):
+        return True, [value.value]
+    if isinstance(value, (ast.Tuple, ast.List)):
+        items = []
+        for el in value.elts:
+            if isinstance(el, ast.Constant) \
+                    and isinstance(el.value, (str, int)):
+                items.append(el.value)
+            else:
+                return False, []
+        return True, items
+    return False, []
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class RetraceChecker(Checker):
+    id = "G2"
+    name = "retrace-hazard"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py") and "test" not in path.rsplit("/", 1)[-1]
+
+    def check(self, ctx: FileContext) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                kws = _jit_call(node)
+                if kws is not None:
+                    out.extend(self._check_jit_kwargs(ctx, node, kws))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                statics = self._decorated_statics(node)
+                if statics is not None:
+                    out.extend(self._check_static_names(ctx, node,
+                                                        statics))
+                    out.extend(self._check_traced_branches(ctx, node,
+                                                           statics))
+        return out
+
+    def _check_jit_kwargs(self, ctx, call: ast.Call,
+                          kws) -> list[Violation]:
+        out = []
+        for kw in kws:
+            if kw.arg in ("static_argnames", "static_argnums",
+                          "donate_argnums", "donate_argnames"):
+                ok, _ = _literal_static(kw.value)
+                if not ok:
+                    out.append(Violation(
+                        self.id, ctx.path, kw.value.lineno,
+                        kw.value.col_offset,
+                        f"[retrace-hazard] {kw.arg} must be a literal "
+                        "str/int or tuple of literals — a computed value "
+                        "makes the jit cache key unpredictable and an "
+                        "unhashable one raises only at call time"))
+        return out
+
+    # -- decorated function analysis ------------------------------------------
+
+    def _decorated_statics(self, fn) -> set[str] | None:
+        """If ``fn`` is jit-decorated, the set of static param names
+        (positions resolved); else None."""
+        for dec in fn.decorator_list:
+            statics: set[str] = set()
+            found = False
+            if _is_jit_func(dec):
+                found = True
+            elif isinstance(dec, ast.Call):
+                kws = _jit_call(dec)
+                if kws is not None:
+                    found = True
+                    params = _param_names(fn)
+                    for kw in kws:
+                        if kw.arg in ("static_argnames",
+                                      "static_argnums"):
+                            ok, items = _literal_static(kw.value)
+                            if not ok:
+                                continue
+                            for it in items:
+                                if isinstance(it, str):
+                                    statics.add(it)
+                                elif 0 <= it < len(params):
+                                    statics.add(params[it])
+            if found:
+                return statics
+        return None
+
+    def _check_static_names(self, ctx, fn, statics) -> list[Violation]:
+        params = set(_param_names(fn))
+        out = []
+        for name in sorted(statics):
+            if name not in params:
+                out.append(Violation(
+                    self.id, ctx.path, fn.lineno, fn.col_offset,
+                    f"[retrace-hazard] static_argnames names "
+                    f"{name!r} but {fn.name}() has no such parameter — "
+                    "jax raises at the first real call"))
+        return out
+
+    def _check_traced_branches(self, ctx, fn, statics) -> list[Violation]:
+        traced = {p for p in _param_names(fn)} - statics - {"self", "cls"}
+        out = []
+        for node in walk_shallow(fn.body):
+            test = None
+            if isinstance(node, (ast.If, ast.IfExp, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.Assert):
+                test = node.test
+            if test is None:
+                continue
+            bad = self._traced_value_refs(test, traced)
+            for name, ref in bad:
+                out.append(Violation(
+                    self.id, ctx.path, ref.lineno, ref.col_offset,
+                    f"[retrace-hazard] branch on the VALUE of traced "
+                    f"argument {name!r} inside jitted {fn.name}() — "
+                    "TracerBoolConversionError on the first input that "
+                    "takes this path (branch on .shape/.dtype, mark the "
+                    "arg static, or use lax.cond/jnp.where)"))
+        return out
+
+    def _traced_value_refs(self, test: ast.AST, traced: set[str]):
+        """Name refs of traced params used by VALUE in a condition.
+        Excludes static metadata (.shape and friends), identity tests
+        against None, and len()/isinstance()-style wrappers."""
+        bad = []
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(test):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name) and node.id in traced):
+                continue
+            p = parents.get(node)
+            # x.shape / x.dtype ... — static under trace
+            if isinstance(p, ast.Attribute) and p.attr in STATIC_ATTRS:
+                continue
+            # len(x), isinstance(x, ...) — python-level, static
+            if isinstance(p, ast.Call) and isinstance(p.func, ast.Name) \
+                    and p.func.id in STATIC_CALLS and node in p.args:
+                continue
+            # x is None / x is not None — identity, not value
+            if isinstance(p, ast.Compare) \
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in p.ops) \
+                    and any(isinstance(c, ast.Constant)
+                            and c.value is None
+                            for c in [p.left] + p.comparators):
+                continue
+            bad.append((node.id, node))
+        return bad

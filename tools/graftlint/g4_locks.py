@@ -1,0 +1,461 @@
+"""G4 lock-discipline: the race detector we can't have, approximated
+statically.
+
+Two passes over the repo's own locking idiom (every threaded class owns
+a ``threading.Lock/RLock/Condition`` created in ``__init__`` and guards
+state with ``with self._lock:`` blocks):
+
+1. **Unlocked writes** — in a lock-owning class, any ``self._*``
+   attribute rebind reachable outside a ``with <lock>`` block. Helpers
+   that run under the caller's lock declare it in their docstring
+   ("Caller holds ``_lock``." / "... under ``_lock``"), the same
+   convention storage/kv.py already uses; ``__init__`` is exempt (the
+   object is not shared yet). This is exactly the bug class Go's
+   ``-race`` flags and pytest cannot: a torn publish only matters under
+   production concurrency.
+
+2. **Lock-order inversions** — a static acquisition graph: an edge
+   A -> B for every ``with B`` nested (syntactically, or through a call
+   to a method that is unambiguously known to take B) inside a ``with
+   A`` block, collected across every module; any cycle is a potential
+   ABBA deadlock that fires only under load. Condition variables alias
+   to their underlying lock (``Condition(self._lock)``), so cv/lock
+   pairs don't produce false self-edges. Call edges resolve by method
+   name only when EXACTLY ONE lock-acquiring method in the repo has
+   that name — ambiguity is skipped, not guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import Checker, FileContext, Violation
+
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+              "BoundedSemaphore"}
+
+#: docstring convention marking a helper that runs under the caller's
+#: lock. The "under X" branch requires X to be a lock-ish token
+#: (ends in lock/cv/mutex) — a doc saying "under _normal operating
+#: conditions" must NOT silently exempt the method
+CALLER_HOLDS_RE = re.compile(
+    r"caller\s+(?:must\s+)?hold|held\s+by\s+(?:the\s+)?caller"
+    r"|under\s+`{0,2}(?:self\.)?_?\w*(?:lock|cv|mutex)\b"
+    r"|while\s+holding|with\s+`{0,2}_?\w*(?:lock|cv)`{0,2}\s+held",
+    re.IGNORECASE)
+
+#: method names too generic to resolve by NAME ALONE on an untyped
+#: receiver — file objects, lists, metric children and half the stdlib
+#: answer to these, so a name-only match would wire phantom edges into
+#: the graph (e.g. ``self._f.flush()`` is not ``Bucket.flush``). Calls
+#: on receivers whose class is statically known still resolve.
+UNTYPED_STOPLIST = {
+    "append", "add", "get", "put", "set", "write", "read", "flush",
+    "close", "open", "reset", "clear", "pop", "remove", "update",
+    "extend", "insert", "send", "recv", "join", "acquire", "release",
+    "wait", "notify", "notify_all", "items", "keys", "values", "copy",
+    "index", "count", "sort", "labels", "observe", "inc", "dec", "tell",
+    "seek", "info", "debug", "warning", "error", "run", "start", "stop",
+    "submit", "result", "cancel", "render", "encode", "decode", "next",
+    "register", "track", "search", "delete", "exists", "list", "load",
+    "save", "sync", "commit", "apply", "replace", "split", "strip",
+}
+
+
+def _lock_ctor(node: ast.AST) -> str | None:
+    """'Lock'/'RLock'/'Condition'/... if node is threading.X(...)."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in LOCK_CTORS \
+            and isinstance(fn.value, ast.Name) \
+            and fn.value.id in ("threading", "mt", "thread"):
+        return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in LOCK_CTORS:
+        return fn.id
+    return None
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassLocks:
+    def __init__(self, cls: ast.ClassDef, path: str):
+        self.cls = cls
+        self.path = path
+        self.attrs: set[str] = set()        # canonical lock attrs
+        self.aliases: dict[str, str] = {}   # cv attr -> underlying lock
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            ctor = _lock_ctor(node.value)
+            if ctor is None:
+                continue
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                call = node.value
+                if ctor == "Condition" and call.args:
+                    inner = _self_attr(call.args[0])
+                    if inner is not None:
+                        self.aliases[attr] = inner
+                        continue
+                self.attrs.add(attr)
+        # alias targets must exist as locks; otherwise treat the cv as
+        # its own lock
+        for cv, inner in list(self.aliases.items()):
+            if inner not in self.attrs:
+                self.aliases.pop(cv)
+                self.attrs.add(cv)
+
+    def canonical(self, attr: str) -> str | None:
+        if attr in self.aliases:
+            attr = self.aliases[attr]
+        return attr if attr in self.attrs else None
+
+    def node_id(self, attr: str) -> str:
+        return f"{self.path}:{self.cls.name}.{attr}"
+
+
+class LockDisciplineChecker(Checker):
+    id = "G4"
+    name = "lock-discipline"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py") \
+            and "test" not in path.rsplit("/", 1)[-1]
+
+    # -- per-file -------------------------------------------------------------
+
+    def check(self, ctx: FileContext) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cl = _ClassLocks(node, ctx.path)
+                if cl.attrs:
+                    out.extend(self._check_class_writes(ctx, cl))
+        return out
+
+    def _check_class_writes(self, ctx, cl: _ClassLocks) -> list[Violation]:
+        out = []
+        for meth in cl.cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name in ("__init__", "__new__"):
+                continue
+            args = meth.args.posonlyargs + meth.args.args
+            if not args or args[0].arg != "self":
+                continue  # staticmethod / classmethod: no instance state
+            doc = ast.get_docstring(meth) or ""
+            if CALLER_HOLDS_RE.search(doc):
+                continue
+            out.extend(self._walk_writes(ctx, cl, meth.body, held=False))
+        return out
+
+    def _acquires_class_lock(self, cl: _ClassLocks, item) -> bool:
+        attr = _self_attr(item.context_expr)
+        return attr is not None and cl.canonical(attr) is not None
+
+    def _walk_writes(self, ctx, cl, body, held: bool) -> list[Violation]:
+        out = []
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now_held = held or any(
+                    self._acquires_class_lock(cl, it)
+                    for it in stmt.items)
+                out.extend(self._walk_writes(ctx, cl, stmt.body,
+                                             now_held))
+                continue
+            if not held:
+                targets = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [stmt.target]
+                # flatten (nested) tuple/list unpack targets:
+                # `self._a, self._b = ...` is two writes, not zero
+                flat = []
+                stack = list(targets)
+                while stack:
+                    tgt = stack.pop()
+                    if isinstance(tgt, (ast.Tuple, ast.List,
+                                        ast.Starred)):
+                        stack.extend(getattr(tgt, "elts", None)
+                                     or [tgt.value])
+                    else:
+                        flat.append(tgt)
+                for tgt in flat:
+                    attr = _self_attr(tgt)
+                    if attr is not None and attr.startswith("_"):
+                        out.append(Violation(
+                            self.id, ctx.path, tgt.lineno,
+                            tgt.col_offset,
+                            f"[lock-discipline] self.{attr} written "
+                            f"outside any 'with' on {cl.cls.name}'s "
+                            "lock(s) — a torn publish under concurrency; "
+                            "take the lock, or document the invariant "
+                            "with a \"Caller holds ...\" docstring"))
+            # recurse into compound statements (if/for/try/while bodies)
+            for child_body in self._child_bodies(stmt):
+                out.extend(self._walk_writes(ctx, cl, child_body, held))
+        return out
+
+    def _child_bodies(self, stmt):
+        for field in ("body", "orelse", "finalbody"):
+            b = getattr(stmt, field, None)
+            if isinstance(b, list) and b \
+                    and isinstance(stmt, (ast.If, ast.For, ast.While,
+                                          ast.Try, ast.AsyncFor)):
+                yield b
+        for h in getattr(stmt, "handlers", []) or []:
+            yield h.body
+
+    # -- facts for the cross-module acquisition graph -------------------------
+
+    def _attr_types(self, cls: ast.ClassDef) -> dict[str, str]:
+        """self.<attr> -> ClassName, from ``self.x = ClassName(...)``
+        assignments and ``self.x = self._maker()`` where ``_maker``'s
+        returns are all ``ClassName(...)`` constructor calls."""
+        maker_returns: dict[str, str | None] = {}
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            rets = [n for n in ast.walk(meth)
+                    if isinstance(n, ast.Return) and n.value is not None]
+            names = set()
+            for r in rets:
+                if isinstance(r.value, ast.Call) \
+                        and isinstance(r.value.func, ast.Name) \
+                        and r.value.func.id[:1].isupper():
+                    names.add(r.value.func.id)
+                else:
+                    names.add(None)
+            if len(names) == 1 and None not in names:
+                maker_returns[meth.name] = names.pop()
+        types: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                v = node.value
+                if isinstance(v, ast.Call):
+                    if isinstance(v.func, ast.Name) \
+                            and v.func.id[:1].isupper():
+                        types[attr] = v.func.id
+                    elif isinstance(v.func, ast.Attribute) \
+                            and _self_attr(v.func) is not None \
+                            and v.func.attr in maker_returns:
+                        types[attr] = maker_returns[v.func.attr]
+        return types
+
+    def _held_from_docstring(self, doc: str, cl: _ClassLocks) -> list[str]:
+        """For a "Caller holds ..." helper, which class locks its body
+        runs under: the lock attrs named in the docstring, else all.
+        Whole-token match only — ``_lock`` must not match inside
+        ``_flush_lock`` or the graph grows phantom held-edges."""
+        named = [a for a in sorted(cl.attrs | set(cl.aliases))
+                 if re.search(rf"(?<![A-Za-z0-9]){re.escape(a)}"
+                              rf"(?![A-Za-z0-9_])", doc)]
+        attrs = named or sorted(cl.attrs)
+        out = []
+        for a in attrs:
+            canon = cl.canonical(a)
+            if canon:
+                out.append(cl.node_id(canon))
+        return out
+
+    def facts(self, ctx: FileContext):
+        module_locks: dict[str, str] = {}   # local name -> node id
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and _lock_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        module_locks[tgt.id] = f"{ctx.path}:{tgt.id}"
+        classes = {node.name: _ClassLocks(node, ctx.path)
+                   for node in ctx.tree.body
+                   if isinstance(node, ast.ClassDef)}
+        attr_types = {name: self._attr_types(cl.cls)
+                      for name, cl in classes.items()}
+
+        edges: list[list] = []        # [holder, inner, line]
+        # [holder, receiver ("T:Class" | "F" | "?"), method, line]
+        call_edges: list[list] = []
+        # ClassName -> {method -> [lock ids]}; "" -> module functions
+        acquirers: dict[str, dict[str, list[str]]] = {}
+
+        def record_acquirer(cls_name: str, fn_name: str, lid: str):
+            meths = acquirers.setdefault(cls_name, {})
+            locks = meths.setdefault(fn_name, [])
+            if lid not in locks:
+                locks.append(lid)
+
+        def lock_id(expr, cl: _ClassLocks | None) -> str | None:
+            attr = _self_attr(expr)
+            if attr is not None and cl is not None:
+                canon = cl.canonical(attr)
+                return cl.node_id(canon) if canon else None
+            if isinstance(expr, ast.Name):
+                return module_locks.get(expr.id)
+            return None
+
+        def receiver(fn: ast.AST, cl: _ClassLocks | None):
+            """(kind, method) for a call target, kind one of
+            T:Class / F / ? — or None for unresolvable syntax."""
+            if isinstance(fn, ast.Name):
+                return "F", fn.id
+            if not isinstance(fn, ast.Attribute):
+                return None
+            method = fn.attr
+            base = fn.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and cl is not None:
+                return f"T:{cl.cls.name}", method
+            battr = _self_attr(base)
+            if battr is not None and cl is not None:
+                t = attr_types.get(cl.cls.name, {}).get(battr)
+                if t:
+                    return f"T:{t}", method
+            return "?", method
+
+        def visit(node, held: list[str], cl, fn_name: str | None):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                doc = ast.get_docstring(node) or ""
+                seed: list[str] = []
+                if cl is not None and CALLER_HOLDS_RE.search(doc):
+                    seed = self._held_from_docstring(doc, cl)
+                for child in node.body:
+                    visit(child, seed, cl, node.name)
+                return
+            if isinstance(node, ast.ClassDef):
+                inner_cl = classes.get(node.name)
+                for child in node.body:
+                    visit(child, [], inner_cl, None)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for it in node.items:
+                    lid = lock_id(it.context_expr, cl)
+                    if lid is not None:
+                        acquired.append(lid)
+                for idx, lid in enumerate(acquired):
+                    if fn_name is not None:
+                        record_acquirer(cl.cls.name if cl else "",
+                                        fn_name, lid)
+                    for h in held:
+                        if h != lid:
+                            edges.append([h, lid, node.lineno])
+                    # `with a, b:` acquires left-to-right — successive
+                    # items order exactly like nested withs
+                    for prev in acquired[:idx]:
+                        if prev != lid:
+                            edges.append([prev, lid, node.lineno])
+                new_held = held + acquired
+                for child in node.body:
+                    visit(child, new_held, cl, fn_name)
+                return
+            if isinstance(node, ast.Call) and held:
+                r = receiver(node.func, cl)
+                if r is not None:
+                    kind, method = r
+                    for h in held:
+                        call_edges.append([h, kind, method, node.lineno])
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, cl, fn_name)
+
+        for top in ctx.tree.body:
+            visit(top, [], None, None)
+        if not (edges or call_edges or acquirers):
+            return None
+        return {"edges": edges, "call_edges": call_edges,
+                "acquirers": acquirers}
+
+    # -- cross-module pass ----------------------------------------------------
+
+    def finalize(self, facts: dict[str, dict]) -> list[Violation]:
+        # 1. merge acquirer indexes: class -> method -> locks, plus a
+        #    name-only view for untyped receivers (resolved only when
+        #    globally unambiguous and not a generic stdlib name)
+        class_index: dict[str, dict[str, list[str]]] = {}
+        by_name: dict[str, set[str]] = {}
+        for fact in facts.values():
+            for cls, meths in fact.get("acquirers", {}).items():
+                idx = class_index.setdefault(cls, {})
+                for m, locks in meths.items():
+                    idx.setdefault(m, [])
+                    for lk in locks:
+                        if lk not in idx[m]:
+                            idx[m].append(lk)
+                    by_name.setdefault(m, set()).update(locks)
+        graph: dict[str, dict[str, tuple[str, int]]] = {}
+
+        def add_edge(a: str, b: str, path: str, line: int):
+            if a != b:
+                graph.setdefault(a, {}).setdefault(b, (path, line))
+
+        for path, fact in facts.items():
+            for a, b, line in fact.get("edges", []):
+                add_edge(a, b, path, line)
+            for a, kind, method, line in fact.get("call_edges", []):
+                if kind.startswith("T:"):
+                    locks = class_index.get(kind[2:], {}).get(method, [])
+                elif kind == "F":
+                    locks = class_index.get("", {}).get(method, [])
+                else:  # untyped receiver: name-only, guarded
+                    if method in UNTYPED_STOPLIST:
+                        continue
+                    locks = sorted(by_name.get(method, set()))
+                    if len(locks) != 1:
+                        continue
+                for b in locks:
+                    add_edge(a, b, path, line)
+
+        # 2. cycles = potential ABBA deadlocks; DFS back-edge detection,
+        #    each cycle reported once (deduped by node set)
+        out: list[Violation] = []
+        seen_cycles: set[frozenset] = set()
+        color: dict[str, int] = {}
+        stack: list[str] = []
+
+        def short(n: str) -> str:
+            return n.split("/")[-1]
+
+        def dfs(node: str):
+            color[node] = 1
+            stack.append(node)
+            for nxt, (path, line) in sorted(graph.get(node, {}).items()):
+                if color.get(nxt, 0) == 1:
+                    cyc = stack[stack.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        chain = " -> ".join(short(c) for c in cyc)
+                        out.append(Violation(
+                            self.id, path, line, 0,
+                            "[lock-discipline] lock-order inversion: "
+                            f"{chain} — two threads taking these locks "
+                            "in opposite order deadlock under load; "
+                            "pick one global order"))
+                elif color.get(nxt, 0) == 0:
+                    dfs(nxt)
+            stack.pop()
+            color[node] = 2
+
+        for node in sorted(graph):
+            if color.get(node, 0) == 0:
+                dfs(node)
+        return out

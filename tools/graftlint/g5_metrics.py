@@ -1,0 +1,124 @@
+"""G5 metrics-conventions: Prometheus hygiene at the registration site.
+
+The lint_metrics seed (PR 4) checks the LIVE registry — right for the
+exposition-presence rule, but it only sees metrics whatever process
+imported. The static half rides the graftlint driver instead: every
+``registry.counter/gauge/histogram("name", "help", (labels,))`` call
+with literal arguments is checked for snake_case ``weaviate_tpu_``
+naming, non-empty HELP, and snake_case labels — so a camelCase metric
+in a module no test imports still fails the gate. Non-literal
+registrations (the registry's own internals, dynamic names) are skipped,
+not guessed at; the runtime lint still covers those.
+
+``lint(registry)`` below is the runtime half, kept verbatim from
+tools/lint_metrics.py so that file can become a thin shim without
+changing tests/test_metrics_exposition.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.core import Checker, FileContext, Violation
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_PREFIX = "weaviate_tpu_"
+_REGISTER_METHODS = ("counter", "gauge", "histogram", "summary")
+
+
+# -- runtime lint (the lint_metrics seed, unchanged semantics) ----------------
+
+
+def lint(registry=None) -> list[str]:
+    """Returns a list of violation strings (empty = clean). Importing
+    the runtime package is enough to register the full standard metric
+    set — modules add their vecs at import time."""
+    if registry is None:
+        import weaviate_tpu.runtime  # registers the standard set  # noqa: F401
+        from weaviate_tpu.runtime.metrics import registry as registry
+
+    problems: list[str] = []
+    with registry._lock:
+        metrics = dict(registry._metrics)
+    exposition = registry.expose()
+    for name, m in sorted(metrics.items()):
+        if not m.help or not str(m.help).strip():
+            problems.append(f"{name}: missing HELP text")
+        if not _NAME_RE.match(name):
+            problems.append(f"{name}: not snake_case")
+        if not name.startswith(_PREFIX):
+            problems.append(f"{name}: missing {_PREFIX!r} prefix")
+        for ln in m.label_names:
+            if not _NAME_RE.match(ln):
+                problems.append(f"{name}: label {ln!r} not snake_case")
+        if f"# HELP {name} " not in exposition \
+                or f"# TYPE {name} " not in exposition:
+            problems.append(f"{name}: absent from the text exposition")
+    return problems
+
+
+# -- static checker -----------------------------------------------------------
+
+
+class MetricsConventionChecker(Checker):
+    id = "G5"
+    name = "metrics-conventions"
+
+    def applies_to(self, path: str) -> bool:
+        # production modules only: tests/benches register throwaway
+        # metrics on private registries on purpose
+        return path.endswith(".py") and path.startswith("weaviate_tpu/")
+
+    def check(self, ctx: FileContext) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTER_METHODS):
+                continue
+            out.extend(self._check_registration(ctx, node))
+        return out
+
+    def _violation(self, ctx, node, msg) -> Violation:
+        return Violation(self.id, ctx.path, node.lineno, node.col_offset,
+                         f"[metrics-conventions] {msg}")
+
+    def _check_registration(self, ctx, call: ast.Call) -> list[Violation]:
+        args = list(call.args)
+        kwargs = {kw.arg: kw.value for kw in call.keywords}
+        name_node = args[0] if args else kwargs.get("name")
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            return []  # dynamic registration — runtime lint's job
+        name = name_node.value
+        out = []
+        if not _NAME_RE.match(name):
+            out.append(self._violation(
+                ctx, name_node,
+                f"metric {name!r} is not snake_case — Prometheus "
+                "scrapers drop malformed families silently"))
+        if not name.startswith(_PREFIX):
+            out.append(self._violation(
+                ctx, name_node,
+                f"metric {name!r} missing the {_PREFIX!r} namespace "
+                "prefix"))
+        help_node = args[1] if len(args) > 1 else kwargs.get("help_text")
+        if help_node is None or (isinstance(help_node, ast.Constant)
+                                 and not str(help_node.value).strip()):
+            out.append(self._violation(
+                ctx, call,
+                f"metric {name!r} registered without HELP text — a "
+                "blank HELP is invisible until a dashboard goes blank"))
+        labels_node = (args[2] if len(args) > 2
+                       else kwargs.get("label_names"))
+        if isinstance(labels_node, (ast.Tuple, ast.List)):
+            for el in labels_node.elts:
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, str) \
+                        and not _NAME_RE.match(el.value):
+                    out.append(self._violation(
+                        ctx, el,
+                        f"metric {name!r} label {el.value!r} is not "
+                        "snake_case"))
+        return out

@@ -1,9 +1,15 @@
-"""Metrics hygiene lint: every metric registered in the process-wide
-registry must have HELP text, a snake_case ``weaviate_tpu_``-prefixed
-name, snake_case label names, and must actually appear in the text
-exposition. Run standalone (``python tools/lint_metrics.py``, exits
-non-zero on violations) or from the test suite
-(tests/test_metrics_exposition.py imports ``lint``).
+"""Metrics hygiene lint — thin shim over the graftlint G5 checker.
+
+The implementation moved to ``tools/graftlint/g5_metrics.py`` (the G5
+metrics-conventions checker carries the static half; the runtime
+``lint()`` here is the same function, re-exported so both entry points
+keep working unchanged):
+
+- standalone CLI: ``python tools/lint_metrics.py`` (exits non-zero on
+  violations)
+- test suite: tests/test_metrics_exposition.py imports ``lint``
+- full framework: ``python -m tools.graftlint`` runs G5 (and G1-G4)
+  statically over the tree
 
 Why a lint and not a convention: Prometheus silently accepts malformed
 metric families and scrapers drop them one by one — a missing HELP or a
@@ -13,42 +19,12 @@ camelCase name is invisible until a dashboard goes blank.
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-_PREFIX = "weaviate_tpu_"
-
-
-def lint(registry=None) -> list[str]:
-    """Returns a list of violation strings (empty = clean). Importing
-    the runtime package is enough to register the full standard metric
-    set — modules add their vecs at import time."""
-    if registry is None:
-        import weaviate_tpu.runtime  # registers the standard set  # noqa: F401
-        from weaviate_tpu.runtime.metrics import registry as registry
-
-    problems: list[str] = []
-    with registry._lock:
-        metrics = dict(registry._metrics)
-    exposition = registry.expose()
-    for name, m in sorted(metrics.items()):
-        if not m.help or not str(m.help).strip():
-            problems.append(f"{name}: missing HELP text")
-        if not _NAME_RE.match(name):
-            problems.append(f"{name}: not snake_case")
-        if not name.startswith(_PREFIX):
-            problems.append(f"{name}: missing {_PREFIX!r} prefix")
-        for ln in m.label_names:
-            if not _NAME_RE.match(ln):
-                problems.append(f"{name}: label {ln!r} not snake_case")
-        if f"# HELP {name} " not in exposition \
-                or f"# TYPE {name} " not in exposition:
-            problems.append(f"{name}: absent from the text exposition")
-    return problems
+from tools.graftlint.g5_metrics import _NAME_RE, _PREFIX, lint  # noqa: E402,F401
 
 
 def main() -> int:

@@ -126,18 +126,30 @@ class Membership:
         return joined
 
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name=f"gossip-{self.name}")
-        self._thread.start()
+        # under _lock: two concurrent start()s would otherwise both see
+        # _thread is None and run two gossip loops for one node
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name=f"gossip-{self.name}")
+            self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(2.0)
-            self._thread = None
+        # read the handle under _lock, join OUTSIDE it — the gossip loop
+        # takes _lock on every tick and could never exit otherwise. Keep
+        # the handle if the join times out, so a later start() cannot
+        # clear _stop under a still-live loop and double it.
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(2.0)
+            if not t.is_alive():
+                with self._lock:
+                    if self._thread is t:
+                        self._thread = None
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):

@@ -195,7 +195,8 @@ class RaftNode:
     def _recompute_peers(self) -> None:
         """Peer set = snapshot/bootstrap peers + conf entries in the log.
         Deterministic in the log prefix, so truncation reverts cleanly and
-        conf changes take effect at APPEND time (Raft §6)."""
+        conf changes take effect at APPEND time (Raft §6). Caller holds
+        ``_lock`` (or runs during single-threaded restore)."""
         peers = set(self.bootstrap_peers)
         for e in self.log:
             op = e.get("op") or {}
@@ -279,18 +280,31 @@ class RaftNode:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name=f"raft-{self.name}")
-        self._thread.start()
+        # under _lock: two concurrent start()s would otherwise both see
+        # _thread is None and spawn two raft loops against one log
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name=f"raft-{self.name}")
+            self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(2.0)
-            self._thread = None
+        # read the handle under _lock but join OUTSIDE it — the loop
+        # thread takes _lock every tick and could never exit otherwise.
+        # On a timed-out join KEEP the handle: dropping it would let a
+        # later start() clear _stop (un-stopping the live loop) and
+        # spawn a second one against the same log.
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(2.0)
+            if not t.is_alive():
+                with self._lock:
+                    if self._thread is t:
+                        self._thread = None
 
     def _new_deadline(self) -> float:
         return time.monotonic() + random.uniform(*self.election_timeout)
@@ -349,6 +363,7 @@ class RaftNode:
                 self._become_leader()
 
     def _become_leader(self) -> None:
+        """Caller holds ``_lock`` (vote-count section of the election)."""
         logger.info("raft %s: leader for term %d", self.name, self.current_term)
         self.role = LEADER
         self.leader_id = self.name
@@ -362,6 +377,7 @@ class RaftNode:
         self._persist_log(n)
 
     def _become_follower(self, term: int) -> None:
+        """Caller holds ``_lock`` (every RPC reply / handler section)."""
         if term > self.current_term:
             self.current_term = term
             self.voted_for = None

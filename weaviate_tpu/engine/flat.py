@@ -130,6 +130,8 @@ class FlatIndex:
                     self._slot_to_id[int(s)] = int(i)
 
     def _ensure_slot_map(self):
+        """Grow the slot->id reverse map with store capacity. Caller
+        holds ``_lock``."""
         if len(self._slot_to_id) < self.store.capacity:
             grown = np.full(self.store.capacity, -1, dtype=np.int64)
             grown[: len(self._slot_to_id)] = self._slot_to_id

@@ -186,6 +186,7 @@ class HNSWIndex:
     # -- capacity -------------------------------------------------------------
 
     def _grow(self, need: int):
+        """Capacity-double every parallel array. Caller holds ``_lock``."""
         cap = len(self._vecs)
         if need <= cap:
             return
@@ -219,7 +220,9 @@ class HNSWIndex:
         searchLayerByVectorWithDistancer, search.go:173-341). Entry/exit is
         a list of (dist, slot) tuples. Tombstoned nodes are traversed but
         returned too — callers filter; pruning them here would disconnect
-        regions behind tombstones (same reason the reference keeps them)."""
+        regions behind tombstones (same reason the reference keeps them).
+        Caller holds ``_lock`` (the epoch-stamped visited marks are
+        exactly why: two unlocked searches would share an epoch)."""
         if (self._native is not None and not self._native_dirty
                 and self._adc_lut is None):
             d, s = self._native.search_layer(
@@ -287,7 +290,8 @@ class HNSWIndex:
         """Re-upload the whole graph to the native mirror in one batched
         pass — the recovery path after mutations that bypass the
         incremental mirror (bulk_build's direct link writes, restore,
-        WAL replay). O(count) once; incremental afterward."""
+        WAL replay). O(count) once; incremental afterward. Caller
+        holds ``_lock``."""
         nat = self._native
         if nat is None:
             return
@@ -461,6 +465,7 @@ class HNSWIndex:
                     code=None if batch_codes is None else batch_codes[j])
 
     def _insert_one(self, doc_id: int, vec: np.ndarray, code=None):
+        """Graph insert core. Caller holds ``_lock`` (add_batch/replay)."""
         old = self._id_to_slot.get(doc_id)
         if old is not None:
             # update = tombstone old node + fresh insert (the reference
@@ -577,6 +582,8 @@ class HNSWIndex:
             return len(dead)
 
     def _elect_entrypoint(self):
+        """Re-pick ep/max_level after the old entrypoint died. Caller
+        holds ``_lock`` (tombstone cleanup)."""
         live = [s for s in range(self._count)
                 if self._doc_ids[s] >= 0 and not self._tombstone[s]]
         if not live:
@@ -884,6 +891,8 @@ class HNSWIndex:
             self._log.reset()
 
     def _replay(self, log_dir: str):
+        """Caller holds ``_lock`` — or, the common case, runs from
+        __init__ before the index is shared with any other thread."""
         snap_path = os.path.join(log_dir, "hnsw.snap")
         if os.path.exists(snap_path):
             with open(snap_path, "rb") as f:

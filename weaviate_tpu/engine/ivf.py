@@ -37,7 +37,8 @@ import numpy as np
 from weaviate_tpu.engine.flat import FlatIndex
 from weaviate_tpu.engine.store import DeviceVectorStore, _next_pow2
 from weaviate_tpu.runtime import hbm_ledger
-from weaviate_tpu.ops.distances import MASKED_DISTANCE, normalize, pairwise_distance
+from weaviate_tpu.ops.distances import (MASKED_DISTANCE, normalize,
+                                        normalize_np, pairwise_distance)
 from weaviate_tpu.ops.kmeans import kmeans_assign, kmeans_fit
 from weaviate_tpu.ops.topk import topk_smallest
 
@@ -325,11 +326,11 @@ class IVFStore:
 
     def _remember_rows(self, slots: np.ndarray, vectors: np.ndarray):
         """PQ mode keeps an f32 host mirror (codes are lossy): rescore +
-        retrain + rebuild all read from here."""
+        retrain + rebuild all read from here. Caller holds ``_lock``."""
         if self._host_rows is None or len(slots) == 0:
             return
         if self.normalize_on_add:
-            vectors = np.asarray(normalize(jnp.asarray(vectors)))
+            vectors = normalize_np(vectors)
         mx = int(np.max(slots))
         if mx >= len(self._host_rows):
             grown = np.zeros((_next_pow2(mx + 1), self.dim), np.float32)
@@ -425,7 +426,7 @@ class IVFStore:
             cents = kmeans_fit(train_vecs, nlist, iters=10)
             if self.normalize_on_add:
                 # keep centroids on the sphere so probe distances stay comparable
-                cents = np.asarray(normalize(jnp.asarray(cents)))
+                cents = normalize_np(cents)
             self.centroids = jnp.asarray(cents)
             self._c_norms = jnp.sum(self.centroids * self.centroids, axis=1)
             if self.quantization:
@@ -467,7 +468,8 @@ class IVFStore:
         return np.concatenate(out_v), np.concatenate(out_s)
 
     def _rebuild_lists(self, vecs: np.ndarray, slots: np.ndarray):
-        """Assign + scatter everything into fresh list tensors."""
+        """Assign + scatter everything into fresh list tensors.
+        Caller holds ``_lock`` (train/retrain section)."""
         assign = (kmeans_assign(vecs, np.asarray(self.centroids))
                   if len(vecs) else np.empty(0, np.int64))
         counts = np.bincount(assign, minlength=self.nlist)
@@ -599,6 +601,7 @@ class IVFStore:
             self._reset_delta()
 
     def _reset_delta(self):
+        """Swap in a fresh delta store. Caller holds ``_lock``."""
         # rebuilt outside the shard's construction scope — re-enter the
         # captured owner labels so the fresh delta store stays attributed
         with hbm_ledger.owner(**self._hbm_owner):
@@ -616,7 +619,7 @@ class IVFStore:
         query side for cosine; mirror rows were normalized at insert."""
         q = queries
         if self.normalize_on_add:
-            q = np.asarray(normalize(jnp.asarray(q)))
+            q = normalize_np(q)
         b, kc = cand_slots.shape
         safe = np.clip(cand_slots, 0, len(self._host_rows) - 1)
         cand = self._host_rows[safe]  # [B, kc, d]

@@ -40,7 +40,7 @@ import numpy as np
 
 from weaviate_tpu.ops import bq as bq_ops
 from weaviate_tpu.ops import pq as pq_ops
-from weaviate_tpu.ops.distances import normalize
+from weaviate_tpu.ops.distances import normalize_np
 from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
 from weaviate_tpu.runtime import hbm_ledger, tracing
 
@@ -285,7 +285,7 @@ class QuantizedVectorStore:
 
     def _maybe_norm(self, vectors: np.ndarray) -> np.ndarray:
         if self.normalize_on_add:
-            return np.asarray(normalize(jnp.asarray(vectors)))
+            return normalize_np(vectors)
         return vectors
 
     # -- training ------------------------------------------------------------
@@ -419,6 +419,7 @@ class QuantizedVectorStore:
                 self._placed_replicated(rbuf), mask_dev)
 
     def _grow(self, min_capacity: int):
+        """Capacity-double codes/valid/mirrors. Caller holds ``_lock``."""
         new_cap = self._align(_next_pow2(min_capacity))
         if new_cap <= self.capacity:
             return

@@ -286,6 +286,8 @@ class DeviceVectorStore:
             self._flush_staged_locked()
 
     def _flush_staged_locked(self) -> None:
+        """Scatter the staged rows to HBM. Caller holds ``_lock`` (the
+        _locked suffix is the contract; this lint-checks it too)."""
         m = self._staged_rows
         if m == 0:
             return

@@ -49,6 +49,17 @@ def normalize(v: jnp.ndarray, eps: float = 1e-30) -> jnp.ndarray:
     return v / jnp.where(norm > eps, norm, 1.0)
 
 
+def normalize_np(v, eps: float = 1e-30):
+    """Host-side twin of ``normalize`` for numpy operands that STAY on
+    the host (IVF's f32 mirror, PQ rescore queries): same zero-vector
+    semantics, no device round-trip — ``np.asarray(normalize(
+    jnp.asarray(v)))`` costs two transfers and a dispatch just to divide
+    by a norm (graftlint G1 catches exactly that pattern)."""
+    v = np.asarray(v, dtype=np.float32)
+    norm = np.linalg.norm(v, axis=-1, keepdims=True)
+    return v / np.where(norm > eps, norm, np.float32(1.0))
+
+
 def _dot_matrix(q: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     """[B,d]x[N,d] -> [B,N] inner products, f32 accumulation on the MXU.
 

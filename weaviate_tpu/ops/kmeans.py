@@ -63,7 +63,9 @@ def kmeans_fit(vectors: np.ndarray, k: int, iters: int = 10,
             counts = counts + cc
         fresh = sums / jnp.maximum(counts, 1.0)[:, None]
         centroids = jnp.where((counts > 0)[:, None], fresh, centroids)
-    return np.asarray(jax.block_until_ready(centroids))
+    # np.asarray already materializes (and therefore waits for) the
+    # result; the extra block_until_ready was a redundant second sync
+    return np.asarray(centroids)  # graftlint: disable=G1 — training-time boundary: callers consume host centroids
 
 
 def kmeans_assign(vectors: np.ndarray, centroids: np.ndarray,

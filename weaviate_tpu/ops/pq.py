@@ -128,7 +128,10 @@ def pq_fit(
     x = jnp.asarray(vectors)
     for _ in range(iters):
         centroids = _lloyd_step(x, centroids, m, k)
-    return PQCodebook(centroids=jax.block_until_ready(centroids))
+    # the codebook stays a device array (pq_encode reads it on device) —
+    # blocking here only serialized training against the host for no
+    # reader; any deferred device error surfaces at first encode
+    return PQCodebook(centroids=centroids)
 
 
 def pq_encode(codebook: PQCodebook, vectors: np.ndarray, batch: int = 65536) -> np.ndarray:

@@ -82,25 +82,34 @@ class CycleManager:
             self._callbacks.pop(name, None)
 
     def start(self) -> None:
-        if self._thread is not None and self._thread.is_alive():
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="cyclemanager")
-        self._thread.start()
+        # under _lock: two concurrent start()s would otherwise both see a
+        # dead handle and run two schedulers against the same buckets
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="cyclemanager")
+            self._thread.start()
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            if self._thread.is_alive():
+        # read the handle under _lock, join OUTSIDE it — the loop takes
+        # _lock around every callback scan and could never exit otherwise
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
                 # a long compaction is still draining; keep the handle so a
                 # subsequent start() can't spawn a second scheduler against
                 # the same buckets
                 logger.warning("cyclemanager did not stop within %.1fs", timeout)
             else:
-                self._thread = None
+                with self._lock:
+                    if self._thread is t:
+                        self._thread = None
 
     def trigger(self, name: str) -> None:
         """Force a callback to run at the next tick (tests, shutdown flush)."""

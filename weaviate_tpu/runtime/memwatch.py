@@ -133,10 +133,14 @@ class MemoryMonitor:
         stats = device_memory_stats() if stats is None else stats
         in_use = [d["bytesInUse"] for d in stats.values()
                   if d.get("bytesInUse") is not None]
+        # _last_source is read by the rejection path on other threads —
+        # publish it under the monitor lock (callers never hold it here)
         if in_use:
-            self._last_source = "allocator"
+            with self._lock:
+                self._last_source = "allocator"
             return max(in_use)
-        self._last_source = "ledger"
+        with self._lock:
+            self._last_source = "ledger"
         return self.ledger.total_bytes()
 
     def check_device_alloc(self, nbytes: int, what: str = "") -> None:

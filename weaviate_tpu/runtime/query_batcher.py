@@ -115,6 +115,7 @@ class QueryBatcher:
         self.filtered_batched = 0
 
     def _ensure_worker(self):
+        """Caller holds ``_cv`` (search() enqueues under it)."""
         if self._worker is None or not self._worker.is_alive():
             self._worker = threading.Thread(
                 target=self._run, name="query-batcher", daemon=True)

@@ -649,6 +649,8 @@ class Bucket:
     # -- startup -------------------------------------------------------------
 
     def _load_segments(self):
+        """Open every on-disk segment. Caller holds ``_lock`` — in
+        practice __init__, before the bucket is shared."""
         segs = sorted(
             f for f in os.listdir(self.dir)
             if f.startswith("segment-") and f.endswith(".db")
@@ -685,13 +687,16 @@ class Bucket:
                          postings_schema=self.postings_schema)
 
     def _new_wal(self) -> WriteAheadLog:
+        """Mint the next WAL file. Caller holds ``_lock`` (seal path)
+        or runs during single-threaded __init__."""
         path = os.path.join(self.dir, f"wal-{self._wal_seq:06d}.bin")
         self._wal_seq += 1
         return WriteAheadLog(path, sync=self.sync_wal)
 
     def _recover_wals(self) -> None:
         """Replay every WAL (sealed-but-unflushed + active) into the active
-        memtable, oldest first; a single round-1 ``wal.bin`` replays too."""
+        memtable, oldest first; a single round-1 ``wal.bin`` replays too.
+        Caller holds ``_lock`` — __init__, before the bucket is shared."""
         names = sorted(
             f for f in os.listdir(self.dir)
             if (f.startswith("wal-") or f == "wal.bin") and f.endswith(".bin")
@@ -750,6 +755,8 @@ class Bucket:
     # -- write path ----------------------------------------------------------
 
     def _log_and_apply(self, key: bytes, value) -> None:
+        """Single-record write tail: WAL append, memtable apply, seal
+        check. Caller holds ``_lock``."""
         packed = None if value is _TOMBSTONE else _pack_value(self.strategy, value)
         payload = msgpack.packb({"k": key, "v": packed}, use_bin_type=True)
         self._wal_bytes_metric.inc(len(payload))
@@ -1275,6 +1282,9 @@ class Bucket:
         return len(self._segments)
 
     def _write_segment(self, items: list[tuple[bytes, bytes]]):
+        """Write one segment file. Caller holds ``_flush_lock`` (flush/
+        compaction serialization) or runs during single-threaded
+        __init__ recovery; ``_next_seq`` is only touched under those."""
         path = os.path.join(self.dir, f"segment-{self._next_seq:06d}.db")
         self._next_seq += 1
         return _Segment.write(path, items)
