@@ -25,11 +25,24 @@ Sections run through ``run_section``: each one retries with backoff on
 transient remote-compile/tunnel errors, and the accumulated results JSON
 is emitted incrementally after every section (stderr line + optional
 BENCH_JSON_PATH file), so a mid-run infra failure still exits rc=0 with
-every completed section in the final stdout JSON. Knobs:
+every completed section in the final stdout JSON.
+
+Every section entry carries ATTRIBUTION fields benchkeeper (the perf
+gate, tools/benchkeeper) compares across runs: ``wall_ms`` (section wall
+clock), ``device_ms`` (summed block_until_ready time of the section's
+timed device fetches, recorded through the PR 2 tracing machinery —
+run_section opens a forced-sampled trace and the timed helpers attach
+``tracing.device_sync`` spans), ``host_ms`` (wall - device: Python,
+numpy, and tunnel/RTT noise), ``transient_retries`` /
+``attempts_used`` / ``attempt_wall_ms`` (noise telemetry: how hard the
+rig fought back), and ``env_fingerprint`` (jax version, platform,
+device count, mesh shape, dtype — runs are only ever compared
+like-for-like). Knobs:
 
   BENCH_N / BENCH_BATCH / BENCH_CHUNK / BENCH_DTYPE   sizing
   BENCH_SECTIONS=a,b,c     run only these sections
   BENCH_SECTION_RETRIES=2  attempts = retries + 1
+  BENCH_REPEATS=1          median-of-N for every timed device measurement
   BENCH_FAIL_SECTION=name  inject a persistent failure (resilience tests)
   BENCH_JSON_PATH=path     also write partial results JSON atomically
 
@@ -41,6 +54,7 @@ detail on stderr.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -90,6 +104,54 @@ def clustered_corpus(rng, n, dim, n_clusters=65536, spread=0.35):
 
 RESULTS: dict = {"sections": {}}
 
+#: run-level environment fingerprint; benchkeeper refuses to compare two
+#: runs whose fingerprints differ (a CPU smoke run gated against TPU
+#: baselines would "regress" by 1000x of pure noise)
+_FINGERPRINT: dict | None = None
+
+
+def _env_fingerprint() -> dict:
+    """jax version / platform / device count / mesh shape / store dtype.
+    Touches the backend only if something already initialized it — the
+    fingerprint must not claim the TPU earlier than sec_device_setup
+    (the watchdog exists because that claim can hang). ONE dict, updated
+    IN PLACE once jax is up: sections recorded before device setup hold
+    a reference to it, so the final (and every later partial) JSON shows
+    the real platform on every entry, not a pre-jax stub."""
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        _FINGERPRINT = {"jax": "unknown", "platform": "uninitialized",
+                        "device_count": 0, "mesh_shape": [],
+                        "dtype": os.environ.get("BENCH_DTYPE", "bf16")}
+    if _FINGERPRINT["platform"] == "uninitialized" \
+            and "jax" in sys.modules:
+        try:
+            import jax
+
+            _FINGERPRINT.update(jax=jax.__version__,
+                                platform=jax.default_backend(),
+                                device_count=len(jax.devices()),
+                                mesh_shape=[len(jax.devices())])
+        except Exception:  # backend init failed: keep the stub
+            pass
+    return _FINGERPRINT
+
+
+def _tracing():
+    """The PR 2 tracing module, or None when the package is unimportable
+    (bench must degrade to wall-clock-only, not crash)."""
+    try:
+        from weaviate_tpu.runtime import tracing
+
+        return tracing
+    except Exception:
+        return None
+
+
+#: sections that measure the tracing substrate itself — wrapping them in
+#: the harness's forced trace would contaminate their "plain" baselines
+UNTRACED_SECTIONS = {"tracing_overhead"}
+
 
 def _emit_partial():
     """Incremental results: atomically rewrite BENCH_JSON_PATH (if set)
@@ -128,21 +190,45 @@ def run_section(name: str, fn, ctx: dict, deps: tuple = ()) -> bool:
     retries = int(os.environ.get("BENCH_SECTION_RETRIES", "2"))
     last: BaseException | None = None
     _TRANSIENT["count"] = 0  # per-section inner-retry tally
+    # attempt-level wall clocks, INCLUDING attempts that died partway —
+    # crashed runs still contribute noise statistics to benchkeeper
+    attempt_wall_ms: list[float] = []
+    tracing = None if name in UNTRACED_SECTIONS else _tracing()
     for attempt in range(retries + 1):
+        t0 = time.perf_counter()
         try:
             if os.environ.get("BENCH_FAIL_SECTION") == name:
                 raise RuntimeError(f"injected failure in section {name!r}")
-            t0 = time.perf_counter()
-            out = fn(ctx) or {}
+            # forced-sampled trace: the timed helpers hang device_sync
+            # spans off it, so device time is attributed separately from
+            # host/tunnel wall time (the r05 postmortem gap)
+            trace_cm = (tracing.trace(f"bench.{name}", force=True)
+                        if tracing else contextlib.nullcontext())
+            with trace_cm:
+                out = fn(ctx) or {}
+                spans = tracing.current_timing() if tracing else []
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            attempt_wall_ms.append(round(wall_ms, 3))
+            # only the harness's own bench.* spans carry device_ms here
+            # (engine-internal device_sync spans would double-count time
+            # already inside an enclosing bench span)
+            device_ms = sum(
+                s.get("attrs", {}).get("device_ms", 0.0) for s in spans
+                if str(s.get("name", "")).startswith("bench."))
             # rc + retry accounting (the BENCH_r05 postmortem need:
             # which sections survived only via retries, and how many):
             # rc 0/1 per section, section-level attempts used, and the
             # count of transient device-call retries _retry_transient
             # absorbed inside this section
             entry = {"ok": True, "rc": 0,
-                     "seconds": round(time.perf_counter() - t0, 2),
+                     "seconds": round(wall_ms / 1e3, 2),
+                     "wall_ms": round(wall_ms, 3),
+                     "device_ms": round(float(device_ms), 3),
+                     "host_ms": round(max(wall_ms - device_ms, 0.0), 3),
                      "attempts_used": attempt + 1,
-                     "transient_retries": _TRANSIENT["count"]}
+                     "attempt_wall_ms": attempt_wall_ms,
+                     "transient_retries": _TRANSIENT["count"],
+                     "env_fingerprint": _env_fingerprint()}
             entry.update(out)
             RESULTS["sections"][name] = entry
             log(json.dumps({"section": name, **entry}))
@@ -151,6 +237,8 @@ def run_section(name: str, fn, ctx: dict, deps: tuple = ()) -> bool:
         except BaseException as e:  # noqa: BLE001 — record, retry, move on
             if isinstance(e, (KeyboardInterrupt, SystemExit)):
                 raise
+            attempt_wall_ms.append(
+                round((time.perf_counter() - t0) * 1e3, 3))
             last = e
             log(f"[section {name}] attempt {attempt + 1}/{retries + 1} "
                 f"failed: {e!r}")
@@ -160,7 +248,9 @@ def run_section(name: str, fn, ctx: dict, deps: tuple = ()) -> bool:
     RESULTS["sections"][name] = {"ok": False, "rc": 1, "error": repr(last),
                                  "attempts": retries + 1,
                                  "attempts_used": retries + 1,
-                                 "transient_retries": _TRANSIENT["count"]}
+                                 "attempt_wall_ms": attempt_wall_ms,
+                                 "transient_retries": _TRANSIENT["count"],
+                                 "env_fingerprint": _env_fingerprint()}
     log(json.dumps({"section": name, "ok": False, "error": repr(last)}))
     _emit_partial()
     return False
@@ -241,7 +331,12 @@ def sec_device_setup(ctx):
     n_pad = -(-n // chunk) * chunk
     padded = np.zeros((n_pad, dim), dtype=np.float32)
     padded[:n] = ctx["corpus"]
-    x = jax.device_put(jnp.asarray(padded, dtype=store_dtype), dev)
+    # the corpus upload is the single largest tunnel transfer of the run
+    # — a transient failure here killed the whole r05 class of runs
+    x = _retry_transient(
+        lambda: jax.device_put(jnp.asarray(padded, dtype=store_dtype),
+                               dev),
+        what="corpus upload")
     ctx.update(
         dev=dev, store_dtype=store_dtype, chunk=chunk, n_pad=n_pad, x=x,
         norms=jnp.sum(jnp.asarray(x, dtype=jnp.float32) ** 2, axis=-1),
@@ -254,12 +349,16 @@ def sec_device_setup(ctx):
     def _triv(s):
         return s + 1.0
 
-    np.asarray(_triv(jnp.float32(0)))
-    rtts = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        np.asarray(_triv(jnp.float32(1)))
-        rtts.append(time.perf_counter() - t0)
+    def _measure_rtt():
+        np.asarray(_triv(jnp.float32(0)))  # compile + warm
+        rtts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(_triv(jnp.float32(1)))
+            rtts.append(time.perf_counter() - t0)
+        return rtts
+
+    rtts = _retry_transient(_measure_rtt, what="tunnel RTT probe")
     ctx["rtt_s"] = float(np.median(rtts))
     log(f"tunnel RTT: {ctx['rtt_s']*1e3:.1f} ms (subtracted from device "
         f"timings)")
@@ -294,17 +393,32 @@ def _retry_transient(fn, attempts: int = 3, what: str = "compile/warm"):
             time.sleep(min(2.0 * 2 ** attempt, 15.0))
 
 
+def _bench_repeats() -> int:
+    """Median-of-N repeat count for every timed device measurement
+    (BENCH_REPEATS; the benchkeeper --update-baseline flow raises it so
+    baseline reference numbers are medians, not single noisy draws)."""
+    return max(1, int(os.environ.get("BENCH_REPEATS", "1")))
+
+
 def _chained_ms(ctx, step_with_offset, arrays, reps=100):
     """step_with_offset(id_offset, *arrays) -> (d, i); ms/scan, device
     time, chained inside ONE jit so async dispatch can't lie. The carried
     distances TAINT the next iteration's query (adding a zero derived from
     them): id_offset alone only feeds the returned ids, so distances would
     be loop-invariant and XLA could hoist the whole scan out of the timing
-    loop (observed: "scans" above HBM peak bandwidth)."""
+    loop (observed: "scans" above HBM peak bandwidth).
+
+    Each timed fetch splits dispatch / device / D2H-fetch time: the
+    device part rides a ``bench.chained_scan`` tracing span (device_sync
+    = block_until_ready under the section's forced-sampled trace), which
+    is what run_section rolls up into the section's ``device_ms``.
+    Repeated BENCH_REPEATS times; the median wall clock is the reading."""
     import numpy as np
 
     import jax
     import jax.numpy as jnp
+
+    tracing = _tracing()
 
     @jax.jit
     def chained(*arrs):
@@ -319,14 +433,39 @@ def _chained_ms(ctx, step_with_offset, arrays, reps=100):
     _retry_transient(lambda: np.asarray(chained(*arrays)))  # compile + warm
 
     def _timed():
-        t0 = time.perf_counter()
-        np.asarray(chained(*arrays))
-        return time.perf_counter() - t0
+        # exactly ONE synchronization inside the timed window (one
+        # tunnel round trip, matching the single rtt_s subtraction):
+        # device_sync blocks under the section's forced-sampled trace
+        # and attributes the time; the block_until_ready after it is a
+        # no-op then, and IS the sync when tracing is unavailable. The
+        # [b, k] result is deliberately not fetched — its D2H transfer
+        # is a second round trip of pure tunnel noise. NOTE this is a
+        # method CHANGE vs the r04-era `np.asarray(chained(...))`
+        # readings, which paid that extra RTT inside the window: on a
+        # remote rig the first run against an r04-seeded baseline reads
+        # ~RTT/(reps+1) fast per scan and is expected to flag STALE ->
+        # --update-baseline (see tools/benchkeeper/baseline.json notes).
+        span_cm = (tracing.span("bench.chained_scan")
+                   if tracing else contextlib.nullcontext())
+        with span_cm as sp:
+            t0 = time.perf_counter()
+            out = chained(*arrays)               # async dispatch (host)
+            t_disp = time.perf_counter()
+            if tracing:
+                tracing.device_sync(sp, out)     # block: device time
+            jax.block_until_ready(out)
+            elapsed = time.perf_counter() - t0
+            if tracing and sp is not None:
+                sp.set(wall_ms=round(elapsed * 1e3, 3),
+                       dispatch_ms=round((t_disp - t0) * 1e3, 3))
+        return elapsed
 
     # the timed fetch itself retries too — BENCH_r05 died on a tunnel
     # error AFTER warmup; a retry re-times from scratch so the reading
     # stays honest
-    elapsed = _retry_transient(_timed, what="timed device scan")
+    samples = [_retry_transient(_timed, what="timed device scan")
+               for _ in range(_bench_repeats())]
+    elapsed = float(np.median(samples))
     return max((elapsed - ctx["rtt_s"]), 1e-3) / (reps + 1) * 1e3
 
 
@@ -347,7 +486,9 @@ def sec_flat_headline(ctx):
             valid=valid, x_sq_norms=norms, selection="approx",
         )
 
-    q0 = jax.device_put(jnp.asarray(ctx["queries"][0]), dev)
+    q0 = _retry_transient(
+        lambda: jax.device_put(jnp.asarray(ctx["queries"][0]), dev),
+        what="headline query upload")
     t0 = time.perf_counter()
     d, i = _retry_transient(
         lambda: jax.block_until_ready(step(q0)), what="headline compile")
@@ -355,7 +496,10 @@ def sec_flat_headline(ctx):
 
     out = {}
     if "gt_i" in ctx:
-        ids = np.asarray(i)
+        # the recall id fetch is a full D2H transfer — r05-class tunnel
+        # errors hit unretried fetches exactly like this one
+        ids = _retry_transient(lambda: np.asarray(i),
+                               what="recall id fetch")
         recall = np.mean([
             len(set(ids[r]) & set(ctx["gt_i"][r])) / k for r in range(batch)
         ])
@@ -363,15 +507,28 @@ def sec_flat_headline(ctx):
         out["recall_at_10"] = round(float(recall), 4)
         ctx["recall"] = recall
 
+    tracing = _tracing()
     times = []
     for _rep in range(3):
         for bi in range(ctx["n_query_batches"]):
-            qb = jax.device_put(jnp.asarray(ctx["queries"][bi]), dev)
+            qb = _retry_transient(
+                lambda bi=bi: jax.device_put(
+                    jnp.asarray(ctx["queries"][bi]), dev),
+                what="query upload")
 
             def _timed(qb=qb):
-                t0 = time.perf_counter()
-                jax.block_until_ready(step(qb))
-                return time.perf_counter() - t0
+                span_cm = (tracing.span("bench.headline_scan")
+                           if tracing else contextlib.nullcontext())
+                with span_cm as sp:
+                    t0 = time.perf_counter()
+                    res = step(qb)            # async dispatch
+                    if tracing:
+                        tracing.device_sync(sp, res)  # device time
+                    jax.block_until_ready(res)
+                    elapsed = time.perf_counter() - t0
+                    if tracing and sp is not None:
+                        sp.set(wall_ms=round(elapsed * 1e3, 3))
+                return elapsed
 
             times.append(_retry_transient(_timed, what="headline scan"))
     times = np.asarray(times[1:])
@@ -399,8 +556,10 @@ def sec_device_steady(ctx):
     for b_dev in (64, 256, 1024):
         if b_dev > ctx["batch"]:
             continue
-        qd = jax.device_put(jnp.asarray(ctx["queries"][0][:b_dev]),
-                            ctx["dev"])
+        qd = _retry_transient(
+            lambda b_dev=b_dev: jax.device_put(
+                jnp.asarray(ctx["queries"][0][:b_dev]), ctx["dev"]),
+            what="steady query upload")
         ms = _chained_ms(
             ctx,
             lambda off, qd_, x_, v_, n_: chunked_topk_distances(
@@ -447,7 +606,10 @@ def sec_selection_microbench(ctx):
     valid = ctx["valid"][:n_sub]
     norms = ctx["norms"][:n_sub]
     b = min(256 if on_tpu else 32, ctx["batch"])
-    qd = jax.device_put(jnp.asarray(ctx["queries"][0][:b]), ctx["dev"])
+    qd = _retry_transient(
+        lambda: jax.device_put(jnp.asarray(ctx["queries"][0][:b]),
+                               ctx["dev"]),
+        what="selection query upload")
     cs = min(chunk, n_sub)
 
     out = {"rows": int(n_sub), "batch": int(b), "k": k}
@@ -471,16 +633,20 @@ def sec_selection_microbench(ctx):
     fused_ov = max(ms["fused"] - floor, 0.0)
     out["fused_over_approx_overhead"] = round(fused_ov / approx_ov, 3)
     out["device_numbers"] = on_tpu
-    # correctness ride-along: fused == exact ids on this corpus
-    d_e, i_e = chunked_topk_distances(
-        qd, x, k=k, chunk_size=cs, metric="l2-squared", valid=valid,
-        x_sq_norms=norms, selection="exact")
-    d_f, i_f = chunked_topk_distances(
-        qd, x, k=k, chunk_size=cs, metric="l2-squared", valid=valid,
-        x_sq_norms=norms, selection="fused")
+    # correctness ride-along: fused == exact ids on this corpus (timed
+    # device fetches — retried like every other r05-class tunnel read)
     import numpy as np
 
-    match = float(np.mean(np.asarray(i_e) == np.asarray(i_f)))
+    def _id_match():
+        d_e, i_e = chunked_topk_distances(
+            qd, x, k=k, chunk_size=cs, metric="l2-squared", valid=valid,
+            x_sq_norms=norms, selection="exact")
+        d_f, i_f = chunked_topk_distances(
+            qd, x, k=k, chunk_size=cs, metric="l2-squared", valid=valid,
+            x_sq_norms=norms, selection="fused")
+        return float(np.mean(np.asarray(i_e) == np.asarray(i_f)))
+
+    match = _retry_transient(_id_match, what="selection id-match fetch")
     out["fused_vs_exact_id_match"] = round(match, 4)
     log(f"[selection] exact {ms['exact']:.2f} ms, approx "
         f"{ms['approx']:.2f} ms, fused {ms['fused']:.2f} ms, floor "
@@ -522,7 +688,10 @@ def sec_filtered_scan(ctx):
     norms = ctx["norms"][:n_sub]
     cs = min(chunk, n_sub)
     b = min(256 if on_tpu else 16, ctx["batch"])
-    qd = jax.device_put(jnp.asarray(ctx["queries"][0][:b]), ctx["dev"])
+    qd = _retry_transient(
+        lambda: jax.device_put(jnp.asarray(ctx["queries"][0][:b]),
+                               ctx["dev"]),
+        what="filtered query upload")
     # fused = the TPU serving operating point; the interpreter makes it
     # pathological on CPU, where approx lowers to exact top_k anyway
     sel = "fused" if on_tpu else "approx"
@@ -583,12 +752,17 @@ def sec_filtered_scan(ctx):
     # batched-bitmask results must respect each query's own filter
     sel_masks = rng.random((b, n_sub)) < 0.01
     sel_masks[:, 0] = True
-    d_c, i_c = chunked_topk_distances(
-        qd, x, k=k, chunk_size=cs, metric="l2-squared", valid=valid,
-        x_sq_norms=norms, selection=sel,
-        allow_bits=jnp.asarray(pack_allow_bitmask(
-            sel_masks, mask_pad_cols(n_sub))))
-    i_np, d_np = np.asarray(i_c), np.asarray(d_c)
+
+    def _masked_fetch():
+        d_c, i_c = chunked_topk_distances(
+            qd, x, k=k, chunk_size=cs, metric="l2-squared", valid=valid,
+            x_sq_norms=norms, selection=sel,
+            allow_bits=jnp.asarray(pack_allow_bitmask(
+                sel_masks, mask_pad_cols(n_sub))))
+        return np.asarray(i_c), np.asarray(d_c)
+
+    i_np, d_np = _retry_transient(_masked_fetch,
+                                  what="filtered ride-along fetch")
     live = (i_np >= 0) & (d_np < 1e37)
     violations = int(sum(
         (~sel_masks[r][i_np[r][live[r]]]).sum() for r in range(b)))
@@ -670,9 +844,14 @@ def sec_quantized(ctx):
            + 0.05 * rng.standard_normal((batch, dim))).astype(np.float32)
     _, gt_cl = _cpu_exact_knn(cl, qcl, k)
 
-    x_cl = jax.device_put(jnp.asarray(cl_pad, dtype=jnp.bfloat16), dev)
+    x_cl = _retry_transient(
+        lambda: jax.device_put(jnp.asarray(cl_pad, dtype=jnp.bfloat16),
+                               dev),
+        what="clustered corpus upload")
     norms_cl = jnp.sum(jnp.asarray(x_cl, dtype=jnp.float32) ** 2, axis=-1)
-    q_cl_dev = jax.device_put(jnp.asarray(qcl), dev)
+    q_cl_dev = _retry_transient(
+        lambda: jax.device_put(jnp.asarray(qcl), dev),
+        what="clustered query upload")
 
     quant = {}
 
@@ -696,7 +875,10 @@ def sec_quantized(ctx):
         (q_cl_dev, x_cl, valid, norms_cl))
     quant["bf16_flat"] = {"device_batch_ms": round(ms_bf16_cl, 3),
                           "qps": round(batch / (ms_bf16_cl / 1e3))}
-    x_f32 = jax.device_put(jnp.asarray(cl_pad, dtype=jnp.float32), dev)
+    x_f32 = _retry_transient(
+        lambda: jax.device_put(jnp.asarray(cl_pad, dtype=jnp.float32),
+                               dev),
+        what="f32 corpus upload")
     ms_f32_cl = _chained_ms(
         ctx,
         lambda off, q_, x_, v_, n_: chunked_topk_distances(
@@ -717,9 +899,11 @@ def sec_quantized(ctx):
             qw_, xw_, k=k_cand, chunk_size=chunk, valid=v_,
             use_pallas=True, id_offset=off),
         (qw, xw, valid))
-    d_, i_ = bq_ops.bq_topk(qw, xw, k=k_cand, chunk_size=chunk,
-                            valid=valid, use_pallas=True)
-    rec_bq = rescore_recall(i_)
+    rec_bq = _retry_transient(
+        lambda: rescore_recall(bq_ops.bq_topk(
+            qw, xw, k=k_cand, chunk_size=chunk, valid=valid,
+            use_pallas=True)[1]),
+        what="bq recall fetch")
     quant["bq_mxu"] = {"device_batch_ms": round(ms_bq, 3),
                        "qps": round(batch / (ms_bq / 1e3)),
                        "recall_at_10_rescored": round(float(rec_bq), 4)}
@@ -735,10 +919,11 @@ def sec_quantized(ctx):
             q_, c_, cent_, k=k_cand, chunk_size=chunk,
             metric="l2-squared", valid=v_, id_offset=off),
         (q_cl_dev, codes, book.centroids, valid))
-    d_, i_ = pq_ops.pq4_topk(q_cl_dev, codes, book.centroids, k=k_cand,
-                             chunk_size=chunk, metric="l2-squared",
-                             valid=valid)
-    rec_pq4 = rescore_recall(i_)
+    rec_pq4 = _retry_transient(
+        lambda: rescore_recall(pq_ops.pq4_topk(
+            q_cl_dev, codes, book.centroids, k=k_cand, chunk_size=chunk,
+            metric="l2-squared", valid=valid)[1]),
+        what="pq4 recall fetch")
     quant["pq4_lut"] = {"device_batch_ms": round(ms_pq4, 3),
                         "qps": round(batch / (ms_pq4 / 1e3)),
                         "recall_at_10_rescored": round(float(rec_pq4), 4)}
@@ -754,10 +939,11 @@ def sec_quantized(ctx):
             q_, qw_, c_, cent_, xp_, k=k_cand, refine=8,
             metric="l2-squared", valid=v_, id_offset=off),
         (q_cl_dev, qw, codes, book.centroids, xp_t, valid))
-    d_, i_ = pq_ops.pq_topk_twostage(
-        q_cl_dev, qw, codes, book.centroids, xp_t, k=k_cand,
-        refine=8, metric="l2-squared", valid=valid)
-    rec_pq2 = rescore_recall(i_)
+    rec_pq2 = _retry_transient(
+        lambda: rescore_recall(pq_ops.pq_topk_twostage(
+            q_cl_dev, qw, codes, book.centroids, xp_t, k=k_cand,
+            refine=8, metric="l2-squared", valid=valid)[1]),
+        what="pq twostage recall fetch")
     quant["pq_twostage128"] = {
         "device_batch_ms": round(ms_pq2, 3),
         "qps": round(batch / (ms_pq2 / 1e3)),
@@ -787,14 +973,19 @@ def sec_conformance(ctx):
     conformance = "ok"
     cq = rng.standard_normal((8, dim)).astype(np.float32)
     cx = rng.standard_normal((512, dim)).astype(np.float32)
-    out = np.asarray(distance_block(jnp.asarray(cq), jnp.asarray(cx),
-                                    metric="l2-squared", interpret=False))
+    out = _retry_transient(
+        lambda: np.asarray(distance_block(
+            jnp.asarray(cq), jnp.asarray(cx), metric="l2-squared",
+            interpret=False)),
+        what="conformance distance fetch")
     ref = ((cq[:, None] - cx[None]) ** 2).sum(-1)
     if not np.allclose(out, ref, rtol=1e-4, atol=1e-3):
         conformance = f"distance_block mismatch {np.abs(out-ref).max()}"
     qb_ = bq_ops.bq_encode(jnp.asarray(cq))
     xb_ = bq_ops.bq_encode(jnp.asarray(cx))
-    out = np.asarray(bq_mxu_block(qb_, xb_, interpret=False))
+    out = _retry_transient(
+        lambda: np.asarray(bq_mxu_block(qb_, xb_, interpret=False)),
+        what="conformance bq fetch")
     ref = bq_ops.bq_hamming_np(
         np.ascontiguousarray(np.asarray(qb_)),
         np.ascontiguousarray(np.asarray(xb_)))
@@ -803,8 +994,10 @@ def sec_conformance(ctx):
     m4 = dim // 4
     lut = rng.standard_normal((8, m4, 16)).astype(np.float32)
     codes4 = rng.integers(0, 16, (512, m4)).astype(np.uint8)
-    out = np.asarray(pq4_lut_block(jnp.asarray(lut), jnp.asarray(codes4),
-                                   interpret=False))
+    out = _retry_transient(
+        lambda: np.asarray(pq4_lut_block(
+            jnp.asarray(lut), jnp.asarray(codes4), interpret=False)),
+        what="conformance pq4 fetch")
     lut16 = np.asarray(jnp.asarray(lut, dtype=jnp.bfloat16), np.float32)
     ref = np.zeros((8, 512), np.float32)
     for s in range(m4):
@@ -816,21 +1009,25 @@ def sec_conformance(ctx):
     from weaviate_tpu.ops.pallas_kernels import (fused_topk_scan,
                                                  pack_allow_bitmask)
 
-    fd, fi = fused_topk_scan(jnp.asarray(cq), jnp.asarray(cx), k=10,
-                             interpret=False)
+    fi = _retry_transient(
+        lambda: np.asarray(fused_topk_scan(
+            jnp.asarray(cq), jnp.asarray(cx), k=10, interpret=False)[1]),
+        what="conformance fused fetch")
     dist = ((cq[:, None] - cx[None]) ** 2).sum(-1)
     want_i = np.argsort(dist, axis=1, kind="stable")[:, :10]
-    if not np.array_equal(np.asarray(fi), want_i):
+    if not np.array_equal(fi, want_i):
         conformance = "fused_topk_scan id mismatch"
     # masked variant: per-query allow bitmask unpacked in VMEM (compiled)
     allow = rng.random((8, 512)) < 0.3
     allow[:, :16] = True  # never fewer than k allowed
-    fd, fi = fused_topk_scan(
-        jnp.asarray(cq), jnp.asarray(cx), k=10, interpret=False,
-        allow_bits=jnp.asarray(pack_allow_bitmask(allow)))
+    fi = _retry_transient(
+        lambda: np.asarray(fused_topk_scan(
+            jnp.asarray(cq), jnp.asarray(cx), k=10, interpret=False,
+            allow_bits=jnp.asarray(pack_allow_bitmask(allow)))[1]),
+        what="conformance masked fused fetch")
     want_m = np.argsort(np.where(allow, dist, np.inf), axis=1,
                         kind="stable")[:, :10]
-    if not np.array_equal(np.asarray(fi), want_m):
+    if not np.array_equal(fi, want_m):
         conformance = "fused_topk_scan masked id mismatch"
     ctx["conformance"] = conformance
     log(f"kernel conformance (compiled, on-device): {conformance}")
@@ -949,6 +1146,8 @@ def main():
         "kernel_conformance": ctx.get("conformance"),
         "serving_fabric_null_device": ctx.get("fabric"),
         "tunnel_rtt_ms": round(ctx.get("rtt_s", 0.0) * 1e3, 1),
+        "env_fingerprint": _env_fingerprint(),
+        "bench_repeats": _bench_repeats(),
         "sections": sections,
     }
     failed = [n for n, s in sections.items() if not s.get("ok")]

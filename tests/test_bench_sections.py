@@ -1,7 +1,13 @@
 """bench.py section harness: a mid-run section failure must not take down
 the run — rc=0, every completed section present in the final stdout JSON,
 and the partial-results file updated incrementally (the BENCH_r05 failure
-mode was rc=1 / parsed: null after one transient tunnel error)."""
+mode was rc=1 / parsed: null after one transient tunnel error).
+
+Plus the ISSUE 6 attribution contract: every section entry carries
+{wall_ms, device_ms, host_ms, transient_retries, attempt_wall_ms,
+env_fingerprint}, failed sections still emit their per-attempt wall
+timings, and `python -m tools.benchkeeper --smoke` (the perf-gate
+machinery self-test over a REAL tiny bench run) is green on CPU."""
 
 import json
 import os
@@ -43,6 +49,12 @@ def test_bench_partial_results_on_injected_failure(tmp_path):
     assert secs["cpu_baseline"]["ok"] is False
     assert "injected" in secs["cpu_baseline"]["error"]
     assert secs["cpu_baseline"]["attempts"] == 2  # retried with backoff
+    # a section that exhausts retries still emits its per-attempt wall
+    # timings — crashed runs contribute noise statistics to benchkeeper
+    failed_walls = secs["cpu_baseline"]["attempt_wall_ms"]
+    assert len(failed_walls) == 2
+    assert all(isinstance(w, (int, float)) and w >= 0 for w in failed_walls)
+    assert "env_fingerprint" in secs["cpu_baseline"]
     # sections after the failure still ran and landed in the JSON
     assert secs["device_setup"]["ok"] is True
     assert secs["flat_headline"]["ok"] is True
@@ -69,3 +81,48 @@ def test_bench_selection_microbench_section(tmp_path):
     # fused selection is exact: ids match the exact path bit-for-bit
     assert mb["fused_vs_exact_id_match"] == 1.0
     assert mb["device_numbers"] is False  # CPU CI — interpret mechanics
+    # ISSUE 6 attribution contract on a successful section: device time
+    # (summed bench.* device_sync spans) split from host wall time
+    for sec in (mb, out["sections"]["device_setup"]):
+        assert sec["wall_ms"] > 0
+        assert sec["device_ms"] >= 0
+        assert sec["host_ms"] >= 0
+        assert sec["wall_ms"] >= sec["device_ms"]
+        assert abs(sec["wall_ms"] - sec["device_ms"] - sec["host_ms"]) < 0.01
+        assert sec["attempt_wall_ms"] == [sec["wall_ms"]]
+        fp = sec["env_fingerprint"]
+        assert fp["platform"] == "cpu" and fp["device_count"] >= 1
+        assert fp["dtype"] == "bf16" and fp["jax"]
+    # the chained-scan device fetches actually attributed device time
+    assert mb["device_ms"] > 0
+    # run-level fingerprint for benchkeeper's like-for-like refusal
+    assert out["env_fingerprint"]["platform"] == "cpu"
+
+
+def test_benchkeeper_smoke_gate_end_to_end(tmp_path):
+    """`python -m tools.benchkeeper --smoke`: a REAL tiny bench run on
+    CPU feeds the gate battery (self-compare passes, doctored device_ms
+    regression fails reasoned+attributed, stale improvement flagged,
+    fingerprint mismatch refused, exit codes correct). The ISSUE 6
+    acceptance criterion, verbatim."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_N="2048",
+        BENCH_BATCH="64",
+        BENCH_CHUNK="1024",
+        BENCH_SECTION_RETRIES="0",
+        BENCH_WATCHDOG_S="500",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.benchkeeper", "--smoke"],
+        capture_output=True, text=True, timeout=540, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "smoke OK" in proc.stderr
+    # the injected regression leg produced a reasoned, section-
+    # attributed report splitting device time from wall/tunnel time
+    assert "FAIL regression" in proc.stdout
+    assert "device-timed" in proc.stdout
+    assert "section noise" in proc.stdout
+    assert "STALE improvement" in proc.stdout
+    assert "REFUSED" in proc.stdout

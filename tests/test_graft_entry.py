@@ -22,3 +22,10 @@ def test_entry_jits_and_runs():
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_dryrun_multichip():
     ge.dryrun_multichip(8)
+
+
+def test_dryrun_benchkeeper():
+    """The perf-gate machinery self-test is part of the driver contract
+    (ISSUE 6): parsing, band math, stale detection, fingerprint refusal
+    and exit codes all behave on a synthetic run — no device needed."""
+    ge.dryrun_benchkeeper()

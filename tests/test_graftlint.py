@@ -663,6 +663,86 @@ def test_g5_accepts_clean_and_skips_dynamic(tmp_path):
     assert [v for v in res.violations if v.check == "G5"] == []
 
 
+G5_TIMING_POSITIVE = """
+    from weaviate_tpu.runtime.metrics import registry
+
+    # P1: timing metric, no unit suffix, no unit in HELP
+    lat = registry.histogram("weaviate_tpu_scan_duration",
+                             "how long scans take")
+
+    def record(sp):
+        entry = {
+            "wall_s": 1.2,          # P2: ambiguous unit suffix
+            "device_seconds": 0.5,  # P3: nonstandard timing unit
+            "qps": 1000.0,          # fine
+        }
+        entry["host_time"] = 0.7    # P4: unit stated nowhere
+        sp.set(dev_ms=0.5)          # P5: device_ms alias forks schema
+        return entry
+"""
+
+G5_TIMING_NEGATIVE = """
+    from weaviate_tpu.runtime.metrics import registry
+
+    # unit in the name suffix
+    a = registry.histogram("weaviate_tpu_scan_duration_seconds", "scans")
+    # unit stated in HELP instead of the name
+    b = registry.gauge("weaviate_tpu_scan_latency",
+                       "p50 scan latency in milliseconds")
+
+    def record(sp, rows):
+        entry = {
+            "wall_ms": 1200.0,      # repo convention: _ms
+            "device_ms": 500.0,     # THE device-attributed field
+            "device_batch_ms": 0.5, # historical bench key, unit stated
+            "attempt_wall_ms": [1.0],
+            "rtt_ms": 3.0,
+        }
+        entry["host_ms"] = 700.0
+        sp.set(device_ms=0.5, wall_ms=1.2, dispatch_ms=0.1)
+        return entry
+"""
+
+
+def test_g5_timing_conventions_flag_ambiguous_units(tmp_path):
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/runtime/fx.py": G5_TIMING_POSITIVE})
+    g5 = [v for v in res.violations if v.check == "G5"]
+    msgs = " | ".join(v.message for v in g5)
+    assert len(g5) == 5, msgs
+    assert "weaviate_tpu_scan_duration" in msgs      # P1 registration
+    assert "'wall_s'" in msgs and "'wall_ms'" in msgs  # P2 + suggestion
+    assert "'device_seconds'" in msgs                # P3
+    assert "'host_time'" in msgs                     # P4 subscript assign
+    assert "'dev_ms'" in msgs and "device_ms" in msgs  # P5 alias
+
+
+def test_g5_timing_conventions_accept_repo_idiom(tmp_path):
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/runtime/fx.py": G5_TIMING_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G5"] == []
+
+
+def test_g5_timing_fields_gate_bench_and_benchkeeper(tmp_path):
+    """bench.py and tools/benchkeeper are in G5 scope (their JSON is
+    benchkeeper's wire format); tests stay excluded."""
+    src = """
+        def section():
+            return {"device_seconds": 0.5}
+    """
+    res = lint_tree(tmp_path, {
+        "bench.py": src,
+        "tools/benchkeeper/core.py": src,
+        "tests/test_fx.py": src,          # out of scope
+        "tools/bench_e2e.py": src,        # legacy bench scripts too
+    })
+    g5 = [(v.check, v.path) for v in res.violations if v.check == "G5"]
+    assert ("G5", "bench.py") in g5
+    assert ("G5", "tools/benchkeeper/core.py") in g5
+    assert all(p not in ("tests/test_fx.py", "tools/bench_e2e.py")
+               for _, p in g5)
+
+
 def test_g5_runtime_lint_reexported_through_shim():
     """tools/lint_metrics.py stays a working standalone module (the
     metrics-exposition tests load it by file path)."""
@@ -899,8 +979,11 @@ def test_cli_json_output_and_exit_codes(tmp_path):
 
 def test_repo_gate_zero_nonbaselined_violations():
     """Every future PR runs this: the production tree must be clean
-    modulo the checked-in baseline, and the baseline must not be stale."""
-    res = run(["weaviate_tpu"], REPO_ROOT, use_cache=False,
+    modulo the checked-in baseline, and the baseline must not be stale.
+    bench.py and tools/benchkeeper ride the gate too — their JSON
+    fields are the perf gate's wire format (G5 timing conventions)."""
+    res = run(["weaviate_tpu", "bench.py", "tools/benchkeeper"],
+              REPO_ROOT, use_cache=False,
               baseline_path=core.default_baseline_path(REPO_ROOT))
     assert res.errors == []
     assert res.stale == [], (

@@ -1,0 +1,6 @@
+"""``python -m tools.benchkeeper`` — see core.main for the CLI."""
+
+from tools.benchkeeper.core import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

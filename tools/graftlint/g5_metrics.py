@@ -1,4 +1,5 @@
-"""G5 metrics-conventions: Prometheus hygiene at the registration site.
+"""G5 metrics-conventions: Prometheus hygiene at the registration site,
+plus timing-metric unit conventions.
 
 The lint_metrics seed (PR 4) checks the LIVE registry — right for the
 exposition-presence rule, but it only sees metrics whatever process
@@ -9,6 +10,27 @@ naming, non-empty HELP, and snake_case labels — so a camelCase metric
 in a module no test imports still fails the gate. Non-literal
 registrations (the registry's own internals, dynamic names) are skipped,
 not guessed at; the runtime lint still covers those.
+
+Timing conventions (the benchkeeper tentpole made these load-bearing:
+the perf gate compares fields by NAME across runs, so an ambiguous
+unit is a silent 1000x comparison error):
+
+- a registered metric whose name says it measures time (``*duration*``,
+  ``*latency*``, ``*elapsed*``) must state its unit — a ``_seconds`` /
+  ``_ms`` / ``_us`` / ``_ns`` name suffix, or an explicit unit word in
+  the HELP text;
+- bench/trace timing FIELDS (dict keys, ``sp.set(...)`` attrs) must
+  not use ambiguous or nonstandard unit suffixes: ``wall_s`` /
+  ``device_seconds`` / ``host_time`` etc. are flagged — the repo
+  convention is ``*_ms``;
+- device-attributed timings are named exactly ``device_ms`` (that is
+  the field run_section rolls up, benchkeeper gates on, and
+  tracing.device_sync emits) — aliases like ``dev_ms`` /
+  ``device_time_ms`` fork the schema.
+
+This checker also covers ``bench.py`` and ``tools/benchkeeper/`` —
+the bench JSON is the perf gate's wire format, so its field hygiene
+is as production as the runtime's.
 
 ``lint(registry)`` below is the runtime half, kept verbatim from
 tools/lint_metrics.py so that file can become a thin shim without
@@ -25,6 +47,24 @@ from tools.graftlint.core import Checker, FileContext, Violation
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _PREFIX = "weaviate_tpu_"
 _REGISTER_METHODS = ("counter", "gauge", "histogram", "summary")
+
+# -- timing conventions -------------------------------------------------------
+
+#: a metric NAME that claims to measure time
+_TIMEY_NAME_RE = re.compile(r"(duration|latency|elapsed)")
+#: unit-stating name suffixes accepted for timing metrics
+_UNIT_SUFFIX_RE = re.compile(r"_(seconds|ms|us|ns|minutes)$")
+#: unit words accepted in HELP text when the name carries no suffix
+_UNIT_HELP_RE = re.compile(
+    r"\b(seconds|milliseconds|microseconds|nanoseconds|ms|us|ns)\b",
+    re.IGNORECASE)
+#: bench/trace timing fields with an ambiguous or nonstandard unit
+#: suffix — the repo convention is ``<what>_ms``
+_AMBIG_FIELD_RE = re.compile(
+    r"^(wall|host|device|tunnel|e2e|elapsed|dispatch|fetch)"
+    r"_(s|sec|secs|seconds|millis|milliseconds|time|duration)$")
+#: device-attributed timing aliases that fork the ``device_ms`` schema
+_DEVICE_ALIAS_RE = re.compile(r"^(dev_ms|device_time_ms|device_timing_ms)$")
 
 
 # -- runtime lint (the lint_metrics seed, unchanged semantics) ----------------
@@ -66,18 +106,68 @@ class MetricsConventionChecker(Checker):
     name = "metrics-conventions"
 
     def applies_to(self, path: str) -> bool:
-        # production modules only: tests/benches register throwaway
-        # metrics on private registries on purpose
-        return path.endswith(".py") and path.startswith("weaviate_tpu/")
+        # production modules, plus the bench harness and the perf gate
+        # — their JSON fields are benchkeeper's wire format (tests
+        # still register throwaway metrics on private registries on
+        # purpose and stay excluded)
+        return path.endswith(".py") and (
+            path.startswith("weaviate_tpu/")
+            or path == "bench.py"
+            or path.startswith("tools/benchkeeper/"))
 
     def check(self, ctx: FileContext) -> list[Violation]:
         out: list[Violation] = []
         for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _REGISTER_METHODS):
-                continue
-            out.extend(self._check_registration(ctx, node))
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _REGISTER_METHODS:
+                out.extend(self._check_registration(ctx, node))
+            out.extend(self._check_timing_fields(ctx, node))
+        return out
+
+    # -- timing-field conventions ---------------------------------------------
+
+    def _field_sites(self, node):
+        """(key_string, anchor_node) pairs for the places bench/trace
+        timing fields are born: dict literals, constant-key subscript
+        assignments, and ``.set(...)``/``.update(...)`` keyword attrs."""
+        if isinstance(node, ast.Dict):
+            for key in node.keys:
+                if isinstance(key, ast.Constant) \
+                        and isinstance(key.value, str):
+                    yield key.value, key
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.slice, ast.Constant) \
+                        and isinstance(tgt.slice.value, str):
+                    yield tgt.slice.value, tgt
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("set", "update"):
+            for kw in node.keywords:
+                if kw.arg:
+                    yield kw.arg, kw.value
+
+    def _check_timing_fields(self, ctx, node) -> list[Violation]:
+        out = []
+        for key, anchor in self._field_sites(node):
+            if _DEVICE_ALIAS_RE.match(key):
+                out.append(self._violation(
+                    ctx, anchor,
+                    f"device-attributed timing field {key!r} must be "
+                    "named 'device_ms' — benchkeeper and run_section "
+                    "compare that exact field across runs; an alias "
+                    "forks the schema"))
+            elif _AMBIG_FIELD_RE.match(key):
+                want = key.split("_", 1)[0] + "_ms"
+                out.append(self._violation(
+                    ctx, anchor,
+                    f"timing field {key!r} has an ambiguous or "
+                    f"nonstandard unit — name it {want!r} (repo "
+                    "convention: timing fields state their unit as "
+                    "_ms; an unstated unit is a silent 1000x "
+                    "comparison error in the perf gate)"))
         return out
 
     def _violation(self, ctx, node, msg) -> Violation:
@@ -110,6 +200,18 @@ class MetricsConventionChecker(Checker):
                 ctx, call,
                 f"metric {name!r} registered without HELP text — a "
                 "blank HELP is invisible until a dashboard goes blank"))
+        if _TIMEY_NAME_RE.search(name) \
+                and not _UNIT_SUFFIX_RE.search(name):
+            help_txt = (help_node.value
+                        if isinstance(help_node, ast.Constant)
+                        and isinstance(help_node.value, str) else "")
+            if not _UNIT_HELP_RE.search(help_txt):
+                out.append(self._violation(
+                    ctx, name_node,
+                    f"timing metric {name!r} states its unit nowhere — "
+                    "suffix the name (_seconds/_ms/_us/_ns) or name "
+                    "the unit in HELP; dashboards comparing unitless "
+                    "timings are off by 1000x silently"))
         labels_node = (args[2] if len(args) > 2
                        else kwargs.get("label_names"))
         if isinstance(labels_node, (ast.Tuple, ast.List)):

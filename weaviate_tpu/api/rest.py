@@ -547,13 +547,28 @@ class RestServer:
             # real Prometheus text exposition (the reference serves text
             # on the monitoring port; serving it here too lets Prometheus
             # scrape either port). A JSON wrapper would not parse.
+            from weaviate_tpu.runtime import perfgate
             from weaviate_tpu.runtime.metrics import registry
 
+            # pick up a fresh benchkeeper verdict so a scrape-only
+            # Prometheus setup sees the perf-gate gauges (mtime-cached;
+            # must never fail the scrape)
+            try:
+                perfgate.refresh()
+            except Exception:
+                pass
             return 200, RawResponse(
                 registry.expose().encode(),
                 "text/plain; version=0.0.4; charset=utf-8")
         if seg == ["debug", "memory"]:
             return 200, self._debug_memory()
+        if seg == ["debug", "perf"]:
+            # last benchkeeper gate verdict + per-section trend deltas
+            # (tools/benchkeeper persists the artifact; perfgate loads
+            # it and republishes the weaviate_tpu_bench_* gauges)
+            from weaviate_tpu.runtime import perfgate
+
+            return 200, perfgate.snapshot()
         if seg == ["debug", "traces"]:
             # finished-trace ring buffer (tracing tentpole; sampled
             # traces carry device_ms attribution)

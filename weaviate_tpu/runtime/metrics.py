@@ -369,6 +369,31 @@ span_duration = registry.histogram(
     "weaviate_tpu_span_duration_seconds",
     "Trace span durations by span name", ("span",))
 
+# -- perf gate (runtime/perfgate.py republishes these from the last
+#    persisted benchkeeper verdict; see tools/benchkeeper) --------------------
+
+bench_gate_ok = registry.gauge(
+    "weaviate_tpu_bench_gate_ok",
+    "1 when the last benchkeeper perf-gate verdict passed, 0 when it "
+    "failed (regression, stale baseline, or missing metric)")
+bench_gate_regressions = registry.gauge(
+    "weaviate_tpu_bench_gate_regressions",
+    "Out-of-band regressions in the last benchkeeper verdict")
+bench_gate_stale = registry.gauge(
+    "weaviate_tpu_bench_gate_stale_entries",
+    "Baseline entries flagged stale (unexplained improvement beyond "
+    "band) in the last benchkeeper verdict")
+bench_metric_value = registry.gauge(
+    "weaviate_tpu_bench_metric_value",
+    "Last benchkeeper-checked value per baseline entry; the unit label "
+    "carries the entry's unit (ms for device-attributed timings, qps, "
+    "...)", ("entry", "unit"))
+bench_delta_frac = registry.gauge(
+    "weaviate_tpu_bench_delta_frac",
+    "Fractional delta vs the baseline reference per entry, normalized "
+    "so positive = regressing direction (slower scan / lower qps)",
+    ("entry",))
+
 # -- jit compilation (runtime/compile_cache.py installs the listeners) --------
 
 compile_cache_events = registry.counter(
@@ -396,6 +421,15 @@ def serve_metrics(host: str = "127.0.0.1", port: int = 2112):
                 self.send_header("Content-Length", "0")
                 self.end_headers()
                 return
+            # benchkeeper verdict pickup (mtime-cached) — the perf-gate
+            # gauges must appear on the monitoring port without anyone
+            # reading /v1/debug/perf first
+            try:
+                from weaviate_tpu.runtime import perfgate
+
+                perfgate.refresh()
+            except Exception:
+                pass
             body = registry.expose().encode()
             self.send_response(200)
             self.send_header("Content-Type",
