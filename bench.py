@@ -1034,6 +1034,92 @@ def sec_conformance(ctx):
     return {"status": conformance}
 
 
+def sec_served_pipeline(ctx):
+    """Served-path pipeline microbench (ISSUE 7): the SAME continuous
+    batcher driven by closed-loop concurrent clients, sync (the worker
+    fetches batch N's results before dispatching N+1) vs the
+    double-buffered zero-sync pipeline (batch N drains D2H on the
+    transfer thread while N+1's program is already on the device).
+    CPU-runnable — the overlap it measures is dispatch-vs-drain
+    concurrency, which exists on every async-dispatch backend; on the
+    TPU rig the drained window also covers the tunnel transfer, which
+    is where the 40x serving gap lives."""
+    import threading
+
+    import numpy as np
+
+    from weaviate_tpu.engine.flat import FlatIndex
+    from weaviate_tpu.runtime.query_batcher import QueryBatcher
+
+    rng = np.random.default_rng(7)
+    n, dim, k = (int(os.environ.get("BENCH_SERVED_ROWS", "32768")), 64,
+                 10)
+    idx = FlatIndex(dim=dim, capacity=n, chunk_size=8192)
+    idx.add_batch(np.arange(n),
+                  rng.standard_normal((n, dim)).astype(np.float32))
+    queries = rng.standard_normal((2048, dim)).astype(np.float32)
+    duration = float(os.environ.get("BENCH_SERVED_S", "2.0"))
+    clients = int(os.environ.get("BENCH_SERVED_CLIENTS", "8"))
+    # warm the pow2 (B, k) buckets both modes will hit so neither run
+    # pays jit compiles inside its timed window
+    b = 1
+    while b <= min(64, clients * 2):
+        _retry_transient(lambda b=b: idx.search_by_vector_batch(
+            np.tile(queries[:1], (b, 1)), 16), what=f"warm b={b}")
+        b *= 2
+
+    def drive(qb):
+        stop_at = time.perf_counter() + duration
+        counts = [0] * clients
+
+        def worker(j):
+            i = j
+            while time.perf_counter() < stop_at:
+                ids, _ = qb.search(queries[i % len(queries)], k)
+                assert len(ids) == k
+                counts[j] += 1
+                i += clients
+
+        ths = [threading.Thread(target=worker, args=(j,))
+               for j in range(clients)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return sum(counts), time.perf_counter() - t0
+
+    out = {"rows": n, "dim": dim, "k": k, "clients": clients,
+           "duration_s": duration}
+    for mode in ("sync", "async"):
+        qb = QueryBatcher(
+            idx.search_by_vector_batch, max_batch=64,
+            async_batch_fn=(idx.search_by_vector_batch_async
+                            if mode == "async" else None))
+        try:
+            qb.search(queries[0], k)  # settle the worker thread
+            done, wall = drive(qb)
+            out[mode] = {
+                "qps": round(done / wall, 1),
+                "dispatches": qb.dispatches,
+                "mean_batch": round(qb.batched_queries
+                                    / max(qb.dispatches, 1), 2),
+            }
+            if mode == "async":
+                out[mode]["async_dispatches"] = qb.async_dispatches
+                out[mode]["overlapped_dispatches"] = \
+                    qb.overlapped_dispatches
+        finally:
+            qb.stop()
+    out["async_over_sync"] = round(
+        out["async"]["qps"] / max(out["sync"]["qps"], 1e-9), 3)
+    log(f"[served_pipeline] sync {out['sync']['qps']} qps, async "
+        f"{out['async']['qps']} qps ({out['async_over_sync']}x), "
+        f"{out['async']['overlapped_dispatches']} overlapped dispatches")
+    ctx["served_pipeline"] = out
+    return out
+
+
 def sec_fabric(ctx):
     """Serving fabric (native data plane, null device) — isolates the C++
     gRPC fabric from both the device and the dev tunnel. Best-effort:
@@ -1085,6 +1171,10 @@ def sec_fabric(ctx):
             lambda qs, k2, vec_name="": (cid[:len(qs), :k2],
                                          cdd[:len(qs), :k2],
                                          cnn[:len(qs)]))
+        # force the plane's sync fallback so the null-device stub above
+        # is what actually serves (the pipelined path would dispatch the
+        # real index and contaminate the fabric-only measurement)
+        shard.vector_search_batch_async = lambda qs, k2, vec_name="": None
         head = pbv.SearchRequest(collection="Fab", limit=10,
                                  uses_123_api=True)
         head.metadata.uuid = True
@@ -1115,6 +1205,7 @@ SECTIONS = [
     ("quantized", sec_quantized, ("x", "rtt_s")),
     ("tracing_overhead", sec_tracing_overhead, ()),
     ("kernel_conformance", sec_conformance, ("rng",)),
+    ("served_pipeline", sec_served_pipeline, ()),
     ("serving_fabric", sec_fabric, ()),
 ]
 
@@ -1153,11 +1244,59 @@ def main():
     failed = [n for n, s in sections.items() if not s.get("ok")]
     if failed:
         final["failed_sections"] = failed
+    final["perf_gate"] = _self_gate(RESULTS | final)
     RESULTS.update(final)
     _emit_partial()
     print(json.dumps(final), flush=True)
     # partial results are still results: rc=0 so the driver parses them
+    # (the embedded perf_gate verdict + __graft_entry__.bench_gate /
+    # `python -m tools.benchkeeper BENCH_rNN.json` carry the gate)
     sys.exit(0)
+
+
+def _self_gate(run: dict) -> dict:
+    """Self-gating (ROADMAP item 5 leftover): every bench round compares
+    itself against tools/benchkeeper/baseline.json and EMBEDS the
+    verdict summary, so a regression can't land silently even when the
+    driver forgets the standalone `python -m tools.benchkeeper` step.
+    A fingerprint refusal (e.g. this run is a CPU smoke, the baseline
+    names the TPU rig) is recorded as refused, not failed. BENCH_GATE=0
+    opts out."""
+    if os.environ.get("BENCH_GATE", "1").lower() in ("0", "false", "off"):
+        return {"skipped": "BENCH_GATE=0"}
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.benchkeeper import core as bk
+
+        path = bk.default_baseline_path()
+        verdict = bk.compare(run, bk.load_baseline(path),
+                             baseline_path=path)
+        bk.render(verdict, out=sys.stderr)
+        if verdict.get("refused") is None:
+            # same artifact the CLI writes — /v1/debug/perf and the
+            # bench gauges pick this round up without a second command
+            bk.write_verdict(verdict, bk.default_verdict_path())
+        return {
+            # a REFUSED comparison (cross-rig fingerprint) is not a gate
+            # failure — benchkeeper keeps the states distinct (exit 1 vs
+            # exit 2), and a driver keying on perf_gate["ok"] must not
+            # fail every CPU smoke round against the TPU baseline; the
+            # refusal itself rides the "refused" field
+            "ok": bool(verdict["ok"]) or bool(verdict.get("refused")),
+            "refused": (verdict["refused"] or {}).get("mismatched")
+            if verdict.get("refused") else None,
+            "checked": verdict.get("checked", 0),
+            "regressions": verdict.get("regressions", 0),
+            "stale": verdict.get("stale", 0),
+            "missing": verdict.get("missing", 0),
+            "failing_entries": [
+                {"id": e["id"], "status": e["status"],
+                 "gate_reason": e.get("gate_reason")}
+                for e in verdict.get("entries", [])
+                if e.get("status") not in (None, "pass")],
+        }
+    except Exception as e:  # noqa: BLE001 — the gate must not eat the run
+        return {"error": repr(e)}
 
 
 if __name__ == "__main__":

@@ -183,6 +183,9 @@ struct DP {
     std::deque<WorkItem*> py_q;
     std::deque<DoneItem*> done_q;
     std::atomic<uint64_t> served_fast{0}, served_fallback{0};
+    // per-doc reply-cache accounting (dp_cache_stats): how much of the
+    // hot path's property fetch is served without re-entering Python
+    std::atomic<uint64_t> cache_hits{0}, cache_misses{0};
 };
 
 DP* g_dp = nullptr;
@@ -888,6 +891,7 @@ int64_t dp_post_batch(int32_t coll_id, int64_t count,
         c = dp->colls[coll_id];
     }
     int64_t misses = 0;
+    uint64_t doc_hits = 0, doc_misses = 0;
     std::shared_lock<std::shared_mutex> lk(c->mtx);
     std::string result, meta, msg;
     std::deque<DoneItem*> done;
@@ -901,9 +905,11 @@ int64_t dp_post_batch(int32_t coll_id, int64_t count,
             if (doc < 0) continue;
             auto it = c->cache.find(doc);
             if (it == c->cache.end()) {
+                doc_misses++;
                 miss = true;
                 break;
             }
+            doc_hits++;
             const CacheEntry& e = it->second;
             meta.clear();
             pb_len(meta, 1, e.uuid.data(), e.uuid.size());  // id
@@ -931,6 +937,8 @@ int64_t dp_post_batch(int32_t coll_id, int64_t count,
         std::lock_guard<std::mutex> qlk(dp->q_mtx);
         for (DoneItem* d : done) dp->done_q.push_back(d);
     }
+    dp->cache_hits.fetch_add(doc_hits, std::memory_order_relaxed);
+    dp->cache_misses.fetch_add(doc_misses, std::memory_order_relaxed);
     dp->served_fast.fetch_add((uint64_t)(count - misses),
                               std::memory_order_relaxed);
     uint64_t one = 1;
@@ -956,6 +964,28 @@ void dp_stats(uint64_t* fast, uint64_t* fallback) {
     if (dp == nullptr) { *fast = *fallback = 0; return; }
     *fast = dp->served_fast.load();
     *fallback = dp->served_fallback.load();
+}
+
+// Reply-cache accounting: `entries` = docs cached for coll_id (-1 = all
+// collections), hits/misses = per-doc lookups across dp_post_batch
+// calls. A hot path fully fed from the LSM-warmed cache shows
+// misses == 0 after the warm pass.
+void dp_cache_stats(int32_t coll_id, int64_t* entries, uint64_t* hits,
+                    uint64_t* misses) {
+    DP* dp = g_dp;
+    *entries = 0;
+    if (dp == nullptr) { *hits = *misses = 0; return; }
+    {
+        std::lock_guard<std::mutex> lk(dp->reg_mtx);
+        for (size_t i = 0; i < dp->colls.size(); ++i) {
+            if (coll_id >= 0 && (size_t)coll_id != i) continue;
+            Collection* c = dp->colls[i];
+            std::shared_lock<std::shared_mutex> clk(c->mtx);
+            *entries += (int64_t)c->cache.size();
+        }
+    }
+    *hits = dp->cache_hits.load();
+    *misses = dp->cache_misses.load();
 }
 
 }  // extern "C"

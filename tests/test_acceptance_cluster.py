@@ -111,7 +111,11 @@ def test_nodes_and_statistics_endpoints(cluster):
     payload = clients[0].nodes()
     assert len(payload) == 3
     assert all(n["status"] == "HEALTHY" for n in payload)
-    stats = clients[1].request("GET", "/v1/cluster/statistics")
+    # raft settles asynchronously; under full-suite load the leader's
+    # heartbeat round can lag the HTTP probe, so poll instead of
+    # asserting the first snapshot
+    stats = _wait(lambda: (lambda s: s if s.get("synchronized") else None)(
+        clients[1].request("GET", "/v1/cluster/statistics")))
     assert stats["synchronized"] is True
     assert stats["statistics"][0]["raft"]["term"] >= 1
 

@@ -82,17 +82,30 @@ def test_dynamic_query_batching_coalesces_concurrent_searches(tmp_path):
         ids, _ = shard.vector_search(queries[0], 5)  # instantiate batcher
         b = shard._query_batchers[""]
     real_fn = b._batch_fn
+    real_async = b._async_fn
     import time as _time
 
     first = threading.Event()
 
-    def slow_first(q, k, allow):
+    def _stall_once():
         if not first.is_set():
             first.set()
             _time.sleep(0.15)
+
+    def slow_first(q, k, allow):
+        _stall_once()
         return real_fn(q, k, allow)
 
+    def slow_first_async(q, k, allow):
+        # the zero-sync pipeline dispatches through _async_fn — stall
+        # that one too, or the delay never happens and coalescing is
+        # timing-dependent again
+        _stall_once()
+        return real_async(q, k, allow)
+
     b._batch_fn = slow_first
+    if real_async is not None:
+        b._async_fn = slow_first_async
     d0, q0 = b.dispatches, b.batched_queries
     results = [None] * len(queries)
 
@@ -107,6 +120,7 @@ def test_dynamic_query_batching_coalesces_concurrent_searches(tmp_path):
     for t in threads:
         t.join()
     b._batch_fn = real_fn
+    b._async_fn = real_async
     assert results == expected
 
     # coalescing happened: the queued-up requests shared dispatches

@@ -1000,3 +1000,21 @@ def test_repo_baseline_entries_all_have_reasons():
     entries = core.load_baseline(core.default_baseline_path(REPO_ROOT))
     for e in entries:
         assert str(e.get("reason", "")).strip(), e
+
+
+def test_g1_baseline_stays_empty_for_engine():
+    """ISSUE 7 acceptance: the two engine/store.py G1 entries (search
+    result transfer, live_count int()) were retired by REDESIGN — the
+    transfer moved behind DeviceResultHandle/tracing.d2h at the API
+    boundary and live_count became a host counter. A host sync creeping
+    back into engine/ must be FIXED (async handle, or routed through the
+    sanctioned boundary), never re-baselined."""
+    entries = core.load_baseline(core.default_baseline_path(REPO_ROOT))
+    g1_engine = [e for e in entries
+                 if e.get("check") == "G1"
+                 and str(e.get("path", "")).startswith(
+                     "weaviate_tpu/engine/")]
+    assert g1_engine == [], (
+        "G1 host-sync baseline entries for engine/ are not allowed "
+        "anymore — fix the sync instead of grandfathering it:\n"
+        + "\n".join(str(e) for e in g1_engine))

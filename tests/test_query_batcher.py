@@ -1,0 +1,443 @@
+"""Zero-sync serving pipeline (ISSUE 7): the query batcher's
+double-buffered async path.
+
+Covers the tentpole's contract points:
+
+1. overlap actually occurs — dispatch N+1 starts while batch N's D2H
+   fetch is still in flight (the device-idle gap the pipeline removes);
+2. results match the sync path BIT-EXACTLY for identical drains across
+   filtered/unfiltered mixes (same program, same padding, same slicing —
+   only WHERE the transfer happens moves);
+3. an error raised on the transfer thread propagates to exactly the
+   failing batch's waiters, and the batcher keeps serving afterwards;
+4. clean shutdown with in-flight handles — waiters get results, not
+   hangs, and post-stop submissions fail loudly;
+
+plus the engine-level handle parity (store/quantized/flat async twins,
+gathered-path finish, shard-level queued-tail merge).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.engine.flat import FlatIndex
+from weaviate_tpu.runtime.query_batcher import QueryBatcher, _Pending
+from weaviate_tpu.runtime.transfer import (DeviceResultHandle,
+                                           TransferPipeline)
+
+
+def _corpus_index(n=512, dim=16, seed=0, **kw):
+    rng = np.random.default_rng(seed)
+    idx = FlatIndex(dim=dim, capacity=max(n, 64), **kw)
+    idx.add_batch(np.arange(n),
+                  rng.standard_normal((n, dim)).astype(np.float32))
+    return idx, rng
+
+
+# -- 1. overlap ---------------------------------------------------------------
+
+
+def test_dispatch_overlaps_inflight_fetch():
+    """Batch N+1's dispatch must start BEFORE batch N's fetch completes:
+    the first batch's handle blocks in the transfer thread while the
+    worker launches the second."""
+    dispatched = []
+    release_first = threading.Event()
+
+    def async_fn(queries, k, allow):
+        b = len(queries)
+        seq = len(dispatched)
+        dispatched.append(time.perf_counter())
+
+        def fin():
+            if seq == 0:
+                assert release_first.wait(timeout=10.0)
+            return (np.arange(b * k, dtype=np.int64).reshape(b, k),
+                    np.zeros((b, k), np.float32))
+
+        return DeviceResultHandle((), finish=fin)
+
+    def sync_fn(queries, k, allow):  # pragma: no cover — must not run
+        raise AssertionError("sync path used")
+
+    qb = QueryBatcher(sync_fn, async_batch_fn=async_fn)
+    try:
+        out = [None, None]
+
+        def client(j):
+            out[j] = qb.search(np.zeros(4, np.float32), 3)
+
+        t0 = threading.Thread(target=client, args=(0,))
+        t0.start()
+        deadline = time.time() + 5.0
+        while len(dispatched) < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert len(dispatched) == 1
+        # first batch is now stuck in its D2H window; a second request
+        # must still dispatch (double buffering)
+        t1 = threading.Thread(target=client, args=(1,))
+        t1.start()
+        while len(dispatched) < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        assert len(dispatched) == 2, \
+            "second dispatch did not start while the first fetch was " \
+            "in flight"
+        assert not t0.is_alive() or out[0] is None  # first still waiting
+        release_first.set()
+        t0.join(timeout=5.0)
+        t1.join(timeout=5.0)
+        assert out[0] is not None and out[1] is not None
+        assert qb.async_dispatches == 2
+        assert qb.overlapped_dispatches >= 1
+    finally:
+        release_first.set()
+        qb.stop()
+
+
+def test_pipeline_pacing_keeps_coalescing():
+    """With the transfer window full, the worker must WAIT (requests
+    keep coalescing) instead of racing ahead with single-query
+    dispatches — the pacing that keeps the batching win alongside the
+    overlap win."""
+    release = threading.Event()
+    batches = []
+
+    def async_fn(queries, k, allow):
+        batches.append(len(queries))
+
+        def fin(b=len(queries)):
+            assert release.wait(timeout=10.0)
+            return (np.zeros((b, k), np.int64),
+                    np.zeros((b, k), np.float32))
+
+        return DeviceResultHandle((), finish=fin)
+
+    # pad_pow2 off so ``batches`` records REAL coalesced sizes (the
+    # padded block would count pad rows and break the sum below)
+    qb = QueryBatcher(lambda *a: (_ for _ in ()).throw(AssertionError()),
+                      async_batch_fn=async_fn, transfer_depth=2,
+                      pad_pow2=False)
+    try:
+        threads = [threading.Thread(
+            target=lambda: qb.search(np.zeros(4, np.float32), 3))
+            for _ in range(12)]
+        threads[0].start()
+        deadline = time.time() + 5.0
+        while len(batches) < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        for t in threads[1:]:
+            t.start()
+        # give the stragglers time to enqueue; the window (depth 2)
+        # fills after at most two more dispatches, then the rest MUST
+        # coalesce into one final drain once released
+        time.sleep(0.3)
+        assert len(batches) <= 3, batches
+        release.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert sum(batches) == 12  # every request served, none lost
+        # some drain carried a real coalesced backlog (vs 12 x b=1)
+        assert max(batches) >= 4, batches
+    finally:
+        release.set()
+        qb.stop()
+
+
+# -- 2. sync/async parity -----------------------------------------------------
+
+
+def _drain_through(qb, reqs):
+    """Push one fixed drain through ``_dispatch`` — identical batch
+    composition for both modes, so results must be bit-exact."""
+    items = [_Pending(np.asarray(q, np.float32), k, allow)
+             for q, k, allow in reqs]
+    qb._dispatch(items)
+    for it in items:
+        assert it.event.wait(timeout=10.0)
+        assert it.error is None, it.error
+    return [(np.asarray(it.ids), np.asarray(it.dists)) for it in items]
+
+
+@pytest.mark.parametrize("quantization", [None, "bq"])
+def test_async_results_bit_exact_vs_sync_mixed_drains(quantization):
+    kw = {"quantization": quantization} if quantization else {}
+    idx, rng = _corpus_index(**kw)
+    qs = rng.standard_normal((8, 16)).astype(np.float32)
+    # mixed drain: unfiltered rows + per-request allow lists of very
+    # different selectivity, mixed k
+    reqs = [
+        (qs[0], 5, None),
+        (qs[1], 5, np.arange(0, 400, 3, dtype=np.int64)),
+        (qs[2], 3, None),
+        (qs[3], 7, np.arange(100, 140, dtype=np.int64)),
+        (qs[4], 5, np.array([7, 9, 11, 13, 400], dtype=np.int64)),
+        (qs[5], 5, None),
+    ]
+    qb_sync = QueryBatcher(idx.search_by_vector_batch,
+                           supports_filter_batching=True)
+    qb_async = QueryBatcher(idx.search_by_vector_batch,
+                            supports_filter_batching=True,
+                            async_batch_fn=idx.search_by_vector_batch_async)
+    try:
+        a = _drain_through(qb_sync, reqs)
+        b = _drain_through(qb_async, reqs)
+        for (ia, da), (ib, db) in zip(a, b):
+            np.testing.assert_array_equal(ia, ib)
+            np.testing.assert_array_equal(da, db)
+        assert qb_async.async_dispatches == 1
+        assert qb_sync.async_dispatches == 0
+    finally:
+        qb_sync.stop()
+        qb_async.stop()
+
+
+def test_unbatchable_async_falls_back_to_sync_path():
+    """An async_batch_fn returning None (index can't serve this drain
+    async) must fall back to batch_fn transparently."""
+    idx, rng = _corpus_index()
+    calls = {"sync": 0}
+
+    def sync_fn(queries, k, allow):
+        calls["sync"] += 1
+        return idx.search_by_vector_batch(queries, k, allow)
+
+    qb = QueryBatcher(sync_fn, async_batch_fn=lambda *a: None)
+    try:
+        q = rng.standard_normal(16).astype(np.float32)
+        ids, dists = qb.search(q, 5)
+        assert len(ids) == 5 and calls["sync"] == 1
+        assert qb.async_dispatches == 0
+    finally:
+        qb.stop()
+
+
+# -- 3. transfer-thread error propagation -------------------------------------
+
+
+def test_transfer_error_reaches_only_its_batch_waiters():
+    boom = RuntimeError("device fell over mid-transfer")
+    gate = threading.Event()
+    n_dispatch = [0]
+
+    def async_fn(queries, k, allow):
+        b = len(queries)
+        seq = n_dispatch[0]
+        n_dispatch[0] += 1
+
+        def fin():
+            if seq == 0:
+                assert gate.wait(timeout=10.0)
+                raise boom
+            return (np.zeros((b, k), np.int64),
+                    np.zeros((b, k), np.float32))
+
+        return DeviceResultHandle((), finish=fin)
+
+    qb = QueryBatcher(lambda *a: None, async_batch_fn=async_fn)
+    try:
+        errs = [None, None]
+
+        def client(j):
+            try:
+                qb.search(np.zeros(4, np.float32), 3)
+            except Exception as e:  # noqa: BLE001
+                errs[j] = e
+
+        t0 = threading.Thread(target=client, args=(0,))
+        t0.start()
+        deadline = time.time() + 5.0
+        while n_dispatch[0] < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        t1 = threading.Thread(target=client, args=(1,))
+        t1.start()
+        while n_dispatch[0] < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        gate.set()
+        t0.join(timeout=5.0)
+        t1.join(timeout=5.0)
+        assert errs[0] is boom, errs[0]   # failing batch's waiter
+        assert errs[1] is None            # later batch unaffected
+    finally:
+        gate.set()
+        qb.stop()
+
+
+# -- 4. clean shutdown --------------------------------------------------------
+
+
+def test_stop_drains_inflight_handles_then_rejects_new_work():
+    release = threading.Event()
+
+    def async_fn(queries, k, allow):
+        b = len(queries)
+
+        def fin():
+            assert release.wait(timeout=10.0)
+            return (np.zeros((b, k), np.int64),
+                    np.zeros((b, k), np.float32))
+
+        return DeviceResultHandle((), finish=fin)
+
+    qb = QueryBatcher(lambda *a: None, async_batch_fn=async_fn)
+    got = []
+
+    def client():
+        got.append(qb.search(np.zeros(4, np.float32), 3))
+
+    t = threading.Thread(target=client)
+    t.start()
+    deadline = time.time() + 5.0
+    while qb.async_dispatches < 1 and time.time() < deadline:
+        time.sleep(0.005)
+    stopper = threading.Thread(target=qb.stop)
+    stopper.start()
+    time.sleep(0.05)
+    release.set()  # in-flight transfer completes during shutdown
+    t.join(timeout=5.0)
+    stopper.join(timeout=5.0)
+    assert not t.is_alive() and got, "in-flight waiter hung on stop()"
+    with pytest.raises(RuntimeError):
+        qb.search(np.zeros(4, np.float32), 3)
+
+
+def test_malformed_async_result_errors_waiters_instead_of_hanging():
+    """An async_batch_fn whose handle resolves to an out-of-contract
+    shape must surface the routing failure to the batch's waiters — the
+    transfer thread swallows callback exceptions to protect later
+    batches, so without the _deliver guard every client would block
+    forever on an event that is never set."""
+    def async_fn(queries, k, allow):
+        # 1-D ids: _deliver's ids.shape[1] slicing raises
+        return DeviceResultHandle((), finish=lambda: (
+            np.zeros(len(queries), np.int64),
+            np.zeros(len(queries), np.float32)))
+
+    qb = QueryBatcher(lambda *a: None, async_batch_fn=async_fn)
+    try:
+        with pytest.raises(Exception):
+            qb.search(np.zeros(4, np.float32), 3)
+    finally:
+        qb.stop()
+
+
+def test_dispatch_after_stop_cannot_create_a_transfer_pipeline():
+    """stop() only stops the pipeline it can see — a dispatch racing
+    shutdown must NOT lazily create one afterwards (leaked drain
+    thread, post-stop submissions silently succeeding); it errors its
+    waiters instead."""
+    qb = QueryBatcher(
+        lambda *a: None,
+        async_batch_fn=lambda q, k, a: DeviceResultHandle(
+            (), finish=lambda: (np.zeros((len(q), k), np.int64),
+                                np.zeros((len(q), k), np.float32))))
+    qb.stop()
+    it = _Pending(np.zeros(4, np.float32), 3, None)
+    qb._dispatch([it])  # the racing worker's drain, post-stop
+    assert it.event.wait(timeout=5.0)
+    assert isinstance(it.error, RuntimeError)
+    assert qb._transfer is None, "stop() race created a drain pipeline"
+
+
+def test_transfer_pipeline_stop_without_thread_is_clean():
+    tp = TransferPipeline()
+    tp.stop()  # never started a thread — must not raise
+    with pytest.raises(RuntimeError):
+        tp.submit(DeviceResultHandle.ready(1), lambda *a: None)
+
+
+# -- engine-level handle parity ----------------------------------------------
+
+
+def test_store_search_async_matches_sync_incl_gathered():
+    idx, rng = _corpus_index()
+    store = idx.store
+    qs = rng.standard_normal((4, 16)).astype(np.float32)
+    d1, i1 = store.search(qs, 6)
+    d2, i2 = store.search_async(qs, 6).result()
+    np.testing.assert_array_equal(i1, i2)
+    np.testing.assert_array_equal(d1, d2)
+    # gathered path (highly selective shared mask) rides the finish step
+    mask = np.zeros(store.capacity, bool)
+    mask[:9] = True
+    d3, i3 = store.search(qs, 4, mask)
+    d4, i4 = store.search_async(qs, 4, mask).result()
+    np.testing.assert_array_equal(i3, i4)
+    np.testing.assert_array_equal(d3, d4)
+    assert set(i4.ravel().tolist()) <= set(range(9)) | {-1}
+
+
+def test_quantized_async_rescore_pins_dispatch_time_layout():
+    """A compact() landing while the handle sits in the transfer window
+    must NOT change what the finish step's host rescore resolves: the
+    candidates were scanned against the dispatch-time row layout, so the
+    rescore reads the dispatch-time capacity + full-precision tier (the
+    pipelined drain widens the old microsecond race to a whole
+    overlapped batch)."""
+    from weaviate_tpu.engine.quantized import QuantizedVectorStore
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((600, 32)).astype(np.float32)
+    store = QuantizedVectorStore(dim=32, quantization="bq", capacity=1024,
+                                 rescore="host")
+    store.train(x)
+    store.add(x)
+    qs = rng.standard_normal((3, 32)).astype(np.float32)
+    d_sync, i_sync = store.search(qs, 5)
+    handle = store.search_async(qs, 5)
+    # shrink + remap the store while the handle is "in flight"
+    store.delete(np.arange(0, 600, 2))
+    store.compact()
+    d_async, i_async = handle.result()
+    np.testing.assert_array_equal(i_sync, i_async)
+    np.testing.assert_array_equal(d_sync, d_async)
+
+
+def test_handle_result_is_idempotent_and_caches_errors():
+    h = DeviceResultHandle((), finish=lambda: [1, 2, 3])
+    assert h.result() == [1, 2, 3]
+    assert h.result() is h.result()
+
+    calls = [0]
+
+    def bad():
+        calls[0] += 1
+        raise ValueError("once")
+
+    h2 = DeviceResultHandle((), finish=bad)
+    with pytest.raises(ValueError):
+        h2.result()
+    with pytest.raises(ValueError):
+        h2.result()
+    assert calls[0] == 1  # cached, not re-raised from a re-run
+
+
+def test_shard_batch_async_merges_queued_tail(tmp_path):
+    """ASYNC_INDEXING queued vectors must merge into pipelined batch
+    results exactly like the sync path (snapshot-before-dispatch)."""
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.schema.config import CollectionConfig
+
+    db = Database(str(tmp_path))
+    try:
+        col = db.create_collection(CollectionConfig(name="QBA"))
+        rng = np.random.default_rng(1)
+        vecs = rng.standard_normal((60, 8)).astype(np.float32)
+        for i in range(60):
+            col.put_object({"i": i}, vector=vecs[i])
+        shard = next(iter(col.shards.values()))
+        qs = vecs[:5]
+        h = shard.vector_search_batch_async(qs, 4)
+        assert h is not None
+        ids_a, dists_a, counts_a = h.result()
+        ids_s, dists_s, counts_s = shard.vector_search_batch(qs, 4)
+        np.testing.assert_array_equal(ids_a, ids_s)
+        np.testing.assert_array_equal(counts_a, counts_s)
+        # self-hit first
+        assert [int(ids_a[r, 0]) for r in range(5)] == list(range(5))
+    finally:
+        db.close()

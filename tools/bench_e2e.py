@@ -221,6 +221,7 @@ def main():
             for shard in col.shards.values():
                 for b_ in shard._query_batchers.values():
                     b_._batch_fn = _null_batch
+                    b_._async_fn = None  # null device = sync null path
                 if args.native_plane:
                     _cid = _np.tile(_np.arange(args.k, dtype=_np.int64),
                                     (256, 1))
@@ -234,6 +235,10 @@ def main():
                         return _i[:b, :k], _d[:b, :k], _n[:b]
 
                     shard.vector_search_batch = _null_batch2
+                    # the pipelined plane tries the async twin first —
+                    # null it so the patched sync path is taken
+                    shard.vector_search_batch_async = (
+                        lambda qs, k, vec_name="": None)
     stream_counts = [int(c) for c in str(args.concurrency).split(",")
                      if int(c) > 0]
     if args.native_plane and server is not None and not hasattr(

@@ -3,12 +3,18 @@
 Pairs csrc/dataplane.cpp (epoll + libnghttp2 transport, fast-path
 Search parse, batch coalescing, C++ reply building) with this dispatcher:
 
-- search batches -> ONE Shard.vector_search_batch device dispatch for the
-  whole coalesced batch; results go back via dp_post_batch, which builds
-  every reply in C++ from the docid -> (uuid, PropertiesResult bytes)
-  cache. Cache misses come back here, get answered through the real
-  protobuf path, and seed the cache — the plane self-warms, no import
-  hook needed (docids are never reused, so entries can't go stale).
+- search batches -> ONE Shard device dispatch for the whole coalesced
+  batch. The dispatch loop is PIPELINED (ISSUE 7): it launches batch N
+  via ``Shard.vector_search_batch_async`` (device-resident
+  DeviceResultHandle) and hands the handle to a transfer thread, then
+  immediately waits for batch N+1 — while N's results drain D2H, N+1's
+  program is already on the device. Results go back via dp_post_batch,
+  which builds every reply in C++ from the docid -> (uuid,
+  PropertiesResult bytes) cache. Cache misses come back here, get
+  answered through ONE batched LSM read (``Shard.objects_by_doc_ids``
+  -> ``kv.get_many``) that also seeds the cache — the plane self-warms,
+  no import hook needed (docids are never reused, so entries can't go
+  stale). The warm pass reads through the same batched LSM feed.
 - everything else (filters, hybrid, tenants, BatchObjects, ...) arrives
   as raw request bytes and is answered by the SAME servicer methods the
   Python gRPC server uses (GrpcServer handlers), so behavior is
@@ -31,6 +37,7 @@ import numpy as np
 
 from weaviate_tpu.api.grpc import v1_pb2 as pb
 from weaviate_tpu.native import dataplane as dpn
+from weaviate_tpu.runtime.transfer import TransferPipeline
 
 logger = logging.getLogger(__name__)
 
@@ -74,6 +81,10 @@ class NativeDataPlane:
         self._warm_threads: dict[str, threading.Thread] = {}
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # double-buffered D2H drain for the pipelined dispatch loop:
+        # depth 2 = batch N draining + batch N+1 dispatched; the
+        # dispatcher blocks before launching N+2 (backpressure)
+        self._transfer = TransferPipeline(depth=2, name="dp-transfer")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -86,6 +97,9 @@ class NativeDataPlane:
 
     def stop(self, grace: float = 0.5):
         self._stop.set()
+        # drain in-flight transfers FIRST so queued replies still post
+        # through the live C++ plane, then stop it
+        self._transfer.stop(timeout=grace + 1.0)
         self.dp.stop()
         for t in self._threads:
             t.join(timeout=grace + 1.0)
@@ -127,12 +141,15 @@ class NativeDataPlane:
                 self._registered.add(name)
             if warm:
                 # bulk-warm the reply cache off the dispatch thread;
-                # misses self-seed in the meantime
+                # misses self-seed in the meantime. Started UNDER the
+                # lock so warm_collection() can never observe (and
+                # join) a published-but-unstarted thread; the warm
+                # thread itself re-takes the lock only after start.
                 t = threading.Thread(target=self._warm_once, args=(name,),
                                      name=f"dp-warm-{name}", daemon=True)
                 with self._reg_lock:
                     self._warm_threads[name] = t
-                t.start()
+                    t.start()
 
     def wait_registered(self, name: str, timeout: float = 10.0) -> bool:
         """Block until `name` is fast-path registered (registration runs
@@ -159,7 +176,9 @@ class NativeDataPlane:
     def _warm_once(self, name: str, chunk: int = 2048) -> bool:
         """One O(corpus) pass populating the C++ docid -> (uuid,
         PropertiesResult) reply cache; after it, plain nearVector
-        queries never touch Python per-query."""
+        queries never touch Python per-query. Objects come out of the
+        LSM side in ``chunk``-sized ``kv.get_many`` batches (one layer
+        snapshot per chunk) instead of a point lookup per doc."""
         cid = None
         with self._reg_lock:
             items = list(self._coll_by_id.items())
@@ -171,24 +190,24 @@ class NativeDataPlane:
         col = self.db.get_collection(name)
         shard = next(iter(col.shards.values()))
         dtype_of = {p.name: p.data_type for p in col.config.properties}
-        ids: list[int] = []
-        uuids: list[str] = []
-        props: list[bytes] = []
-        for doc_id in list(shard._doc_to_uuid.keys()):
-            obj = shard.object_by_doc_id(doc_id)
-            if obj is None:
-                continue
-            out = pb.SearchResult()
-            self.server._fill_result(col, out, obj, None, _FAST_META, None,
-                                     dtype_of)
-            ids.append(doc_id)
-            uuids.append(obj.uuid)
-            props.append(out.properties.SerializeToString())
-            if len(ids) >= chunk:
+        all_docs = list(shard._doc_to_uuid.keys())
+        for s in range(0, len(all_docs), chunk):
+            doc_chunk = all_docs[s:s + chunk]
+            ids: list[int] = []
+            uuids: list[str] = []
+            props: list[bytes] = []
+            for doc_id, obj in zip(doc_chunk,
+                                   shard.objects_by_doc_ids(doc_chunk)):
+                if obj is None:
+                    continue
+                out = pb.SearchResult()
+                self.server._fill_result(col, out, obj, None, _FAST_META,
+                                         None, dtype_of)
+                ids.append(doc_id)
+                uuids.append(obj.uuid)
+                props.append(out.properties.SerializeToString())
+            if ids:
                 self.dp.cache_put(cid, ids, uuids, props)
-                ids, uuids, props = [], [], []
-        if ids:
-            self.dp.cache_put(cid, ids, uuids, props)
         return True
 
     # -- dispatch -------------------------------------------------------------
@@ -229,13 +248,65 @@ class NativeDataPlane:
         col = self.db.get_collection(name)
         shard = next(iter(col.shards.values()))
         kmax = int(batch.ks.max())
-        ids, dists, counts = shard.vector_search_batch(batch.queries, kmax)
-        took = time.perf_counter() - t0
+        # pipelined path: dispatch-and-go — the handle drains on the
+        # transfer thread while this loop returns to dp.wait() and
+        # launches the NEXT batch's program
+        handle = shard.vector_search_batch_async(batch.queries, kmax)
+        if handle is None:
+            ids, dists, counts = shard.vector_search_batch(
+                batch.queries, kmax)
+            self._finish_batch(batch, col, shard, ids, dists, counts,
+                               time.perf_counter() - t0)
+            return
+
+        def _done(res, err, _t_fetch0, _t_fetch1, _batch=batch, _col=col,
+                  _shard=shard, _t0=t0):
+            if err is not None:
+                logger.error("pipelined batch failed", exc_info=err)
+                for tok in _batch.tokens.tolist():
+                    try:
+                        self.dp.post_raw(int(tok), b"", 13,
+                                         "internal error")
+                    except Exception:  # noqa: BLE001
+                        pass
+                return
+            ids, dists, counts = res
+            try:
+                self._finish_batch(_batch, _col, _shard, ids, dists,
+                                   counts, time.perf_counter() - _t0)
+            except Exception:  # noqa: BLE001 — clients must not hang
+                logger.exception("pipelined reply build failed")
+                for tok in _batch.tokens.tolist():
+                    try:
+                        self.dp.post_raw(int(tok), b"", 13,
+                                         "internal error")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._transfer.submit(handle, _done)
+
+    def _finish_batch(self, batch: dpn.SearchBatch, col, shard, ids,
+                      dists, counts, took: float):
+        """Host half of a coalesced Search batch: post to the C++ reply
+        builder; answer its cache misses from ONE batched LSM read
+        (``objects_by_doc_ids`` -> ``kv.get_many``) and seed the cache
+        so the next occurrence of those docs never leaves C++."""
         miss = self.dp.post_batch(batch, ids, dists, counts, took)
         if len(miss) == 0:
             return
-        # cache misses: answer via real protobuf and seed the cache
         tok_pos = {int(t): i for i, t in enumerate(batch.tokens)}
+        # one get_many for every doc the missed replies need, deduped
+        need: list[int] = []
+        seen: set[int] = set()
+        for t in miss:
+            i = tok_pos[int(t)]
+            n = int(min(counts[i], batch.ks[i]))
+            for j in range(n):
+                doc = int(ids[i, j])
+                if doc >= 0 and doc not in seen:
+                    seen.add(doc)
+                    need.append(doc)
+        objs = dict(zip(need, shard.objects_by_doc_ids(need)))
         seed_ids: list[int] = []
         seed_uuids: list[str] = []
         seed_props: list[bytes] = []
@@ -246,7 +317,7 @@ class NativeDataPlane:
             n = int(min(counts[i], batch.ks[i]))
             for j in range(n):
                 doc = int(ids[i, j])
-                obj = shard.object_by_doc_id(doc)
+                obj = objs.get(doc)
                 if obj is None:
                     continue
                 out = reply.results.add()

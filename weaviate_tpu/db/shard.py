@@ -145,6 +145,13 @@ class Shard:
         self.dynamic_batching = os.environ.get(
             "QUERY_DYNAMIC_BATCHING", "true").lower() in (
                 "true", "1", "on", "enabled")
+        # zero-sync serving pipeline (ISSUE 7): batched dispatches return
+        # device-resident handles and drain D2H on a transfer thread
+        # while the next batch dispatches. QUERY_ASYNC_PIPELINE=false
+        # opts back into worker-synchronous fetches.
+        self.async_pipeline = os.environ.get(
+            "QUERY_ASYNC_PIPELINE", "true").lower() in (
+                "true", "1", "on", "enabled")
         self._query_batchers: dict[str, "QueryBatcher"] = {}
         # READONLY shard status (reference: PUT /v1/schema/{c}/shards/{s}
         # — schema_shards handlers flip writes off per shard); persisted
@@ -464,6 +471,14 @@ class Shard:
                     return 0
                 return s.capacity
 
+            # zero-sync pipeline: resolved through getattr PER CALL so a
+            # compress()/DynamicIndex.upgrade() swapping the impl under
+            # the cached batcher degrades to the sync path (None) instead
+            # of pinning a stale bound method
+            def _async_batch(queries, k2, allow=None, i=idx):
+                fn = getattr(i, "search_by_vector_batch_async", None)
+                return None if fn is None else fn(queries, k2, allow)
+
             b = self._query_batchers.setdefault(
                 vec_name,
                 QueryBatcher(
@@ -473,6 +488,8 @@ class Shard:
                     capacity_fn=_gathered_capacity,
                     pad_pow2=bool(getattr(idx, "compiled_batch_shapes",
                                           True)),
+                    async_batch_fn=(_async_batch if self.async_pipeline
+                                    else None),
                     owner={"collection": self.collection_name,
                            "shard": self.name,
                            "tenant": self._tenant_label()},
@@ -564,7 +581,26 @@ class Shard:
         return None if uuid is None else self.get_object(uuid)
 
     def objects_by_doc_ids(self, doc_ids) -> list[StorageObject | None]:
-        return [self.object_by_doc_id(d) for d in doc_ids]
+        """Batched doc-id -> object resolution: ONE ``kv.get_many``
+        layer snapshot for the whole id list instead of a point lookup
+        (lock + sealed-list copy) per doc — the native data plane's
+        reply-building feed (warm pass + cache-miss fill) reads through
+        here, so property fetch on the hot path is one LSM batch per
+        reply batch."""
+        uuids = [self._doc_to_uuid.get(int(d)) for d in doc_ids]
+        keys = [u.encode() for u in uuids if u is not None]
+        if not keys:
+            return [None] * len(uuids)
+        raws = iter(self.objects.get_many(keys))
+        out: list[StorageObject | None] = []
+        for u in uuids:
+            if u is None:
+                out.append(None)
+                continue
+            raw = next(raws)
+            out.append(None if raw is None
+                       else StorageObject.from_bytes(raw))
+        return out
 
     def vector_search(self, query: np.ndarray, k: int, vec_name: str = "",
                       allow_list: np.ndarray | None = None):
@@ -631,13 +667,52 @@ class Shard:
         queue = self._index_queues.get(vec_name)
         pending = queue.snapshot() if queue is not None else []
         ids, dists = idx.search_by_vector_batch(queries, k)
+        return self._finish_batch_results(ids, dists, pending, queries,
+                                          idx.metric, k)
+
+    def vector_search_batch_async(self, queries: np.ndarray, k: int,
+                                  vec_name: str = ""):
+        """Dispatch-only twin of ``vector_search_batch`` for the native
+        data plane's pipelined loop (ISSUE 7): returns a
+        ``DeviceResultHandle`` resolving to the same (ids, dists,
+        counts), or ``None`` when the index has no async path — the
+        plane then falls back to the synchronous call. The queued-tail
+        snapshot is taken BEFORE the index dispatch (same ordering
+        invariant as ``_vector_search_traced``) and merged in the
+        handle's host finish step."""
+        idx = self.vector_indexes.get(vec_name)
+        if idx is None:
+            return None
+        fn = getattr(idx, "search_by_vector_batch_async", None)
+        if fn is None:
+            return None
+        queue = self._index_queues.get(vec_name)
+        pending = queue.snapshot() if queue is not None else []
+        handle = fn(queries, k)
+        if handle is None:
+            return None
+        queries = np.asarray(queries, np.float32)
+
+        def _finish(res, _pending=pending, _queries=queries, _k=k,
+                    _metric=idx.metric):
+            ids, dists = res
+            return self._finish_batch_results(ids, dists, _pending,
+                                              _queries, _metric, _k)
+
+        return handle.map(_finish)
+
+    def _finish_batch_results(self, ids, dists, pending, queries,
+                              metric: str, k: int):
+        """Host half shared by the sync and pipelined batch paths:
+        merge the queued (not-yet-indexed) tail, count live rows."""
+        b = len(queries)
         ids = np.asarray(ids, np.int64)
         dists = np.asarray(dists, np.float32)
         if pending:
             q_ids = np.asarray([d for d, _ in pending], np.int64)
             q_vecs = np.stack([v for _, v in pending]).astype(np.float32)
             qd = self._host_pairwise(np.asarray(queries, np.float32),
-                                     q_vecs, idx.metric)  # [B, nq]
+                                     q_vecs, metric)  # [B, nq]
             cat_ids = np.concatenate(
                 [ids, np.broadcast_to(q_ids, (b, len(q_ids)))], axis=1)
             cat_d = np.concatenate([dists, qd.astype(np.float32)], axis=1)

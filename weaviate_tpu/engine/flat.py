@@ -187,46 +187,96 @@ class FlatIndex:
                           filtered=allow_list is not None,
                           per_query_filters=per_query):
             with self._lock:
-                if per_query:
-                    if len(allow_list) != len(queries):
-                        raise ValueError(
-                            f"{len(allow_list)} allow lists != "
-                            f"{len(queries)} queries")
-                    masks = [self._allow_mask(a) for a in allow_list]
-                    if all(m is None for m in masks):
-                        allow_mask = None
-                    elif not self.supports_batched_filters:
-                        # store takes shared 1-D masks only (e.g. the
-                        # IVF probe) — serve per-query filters row by
-                        # row rather than crashing on a 2-D mask
-                        d = np.full((len(queries), k), np.float32(np.inf),
-                                    dtype=np.float32)
-                        slots = np.full((len(queries), k), -1,
-                                        dtype=np.int64)
-                        for r, m in enumerate(masks):
-                            dr, sr = self.store.search(
-                                queries[r:r + 1], k, m)
-                            kk = min(k, dr.shape[1])
-                            d[r, :kk] = dr[0, :kk]
-                            slots[r, :kk] = sr[0, :kk]
-                        ids = np.where(slots >= 0,
-                                       self._slot_to_id_safe(slots), -1)
-                        return ids, d
-                    else:
-                        # unfiltered rows get an all-ones mask (the store
-                        # still ANDs with its live-slot validity)
-                        allow_mask = np.ones(
-                            (len(masks), self.store.capacity), dtype=bool)
-                        for r, m in enumerate(masks):
-                            if m is not None:
-                                allow_mask[r, :] = False
-                                allow_mask[r, :len(m)] = m
-                else:
-                    allow_mask = self._allow_mask(allow_list)
+                kind, allow_mask = self._translate_batch_allow(
+                    queries, allow_list, per_query)
+                if kind == "rowwise":
+                    # store takes shared 1-D masks only (e.g. the
+                    # IVF probe) — serve per-query filters row by
+                    # row rather than crashing on a 2-D mask
+                    d = np.full((len(queries), k), np.float32(np.inf),
+                                dtype=np.float32)
+                    slots = np.full((len(queries), k), -1,
+                                    dtype=np.int64)
+                    for r, m in enumerate(allow_mask):
+                        dr, sr = self.store.search(
+                            queries[r:r + 1], k, m)
+                        kk = min(k, dr.shape[1])
+                        d[r, :kk] = dr[0, :kk]
+                        slots[r, :kk] = sr[0, :kk]
+                    ids = np.where(slots >= 0,
+                                   self._slot_to_id_safe(slots), -1)
+                    return ids, d
                 d, slots = self.store.search(queries, k, allow_mask)
                 ids = np.where(slots >= 0, self._slot_to_id_safe(slots),
                                -1)
                 return ids, d
+
+    def _translate_batch_allow(self, queries, allow_list, per_query: bool):
+        """Allow-list intake shared by the sync and async batch paths.
+        Caller holds ``_lock``. Returns ("mask", mask-or-None) for the
+        single-dispatch forms, or ("rowwise", per-row masks) when the
+        store cannot take a 2-D mask."""
+        if not per_query:
+            return "mask", self._allow_mask(allow_list)
+        if len(allow_list) != len(queries):
+            raise ValueError(
+                f"{len(allow_list)} allow lists != "
+                f"{len(queries)} queries")
+        masks = [self._allow_mask(a) for a in allow_list]
+        if all(m is None for m in masks):
+            return "mask", None
+        if not self.supports_batched_filters:
+            return "rowwise", masks
+        # unfiltered rows get an all-ones mask (the store still ANDs
+        # with its live-slot validity)
+        allow_mask = np.ones((len(masks), self.store.capacity),
+                             dtype=bool)
+        for r, m in enumerate(masks):
+            if m is not None:
+                allow_mask[r, :] = False
+                allow_mask[r, :len(m)] = m
+        return "mask", allow_mask
+
+    def search_by_vector_batch_async(self, queries: np.ndarray, k: int,
+                                     allow_list=None):
+        """Async twin of ``search_by_vector_batch`` (ISSUE 7): dispatch
+        under the index lock, results device-resident in the returned
+        ``DeviceResultHandle`` (resolving to the same (doc_ids [B,k],
+        dists [B,k]) contract). Returns ``None`` when this index cannot
+        serve the request async — injected stores without
+        ``search_async`` (IVF), or per-query filters on stores without
+        batched-filter support — and the caller falls back to the sync
+        path.
+
+        The slot -> doc-id resolution in the finish step runs against
+        the ``_slot_to_id`` table captured AT DISPATCH: ``compact()``
+        replaces the array wholesale, so an in-flight handle keeps the
+        mapping its scan was dispatched against; a concurrent
+        ``delete()`` writes -1 in place, which drops the row at the
+        shard layer exactly like the sync path's post-search delete
+        race."""
+        if not hasattr(self.store, "search_async"):
+            return None
+        queries = np.atleast_2d(np.asarray(queries))
+        per_query = _per_query_allow(allow_list)
+        with tracing.span("flat.search_batch", k=k, queries=len(queries),
+                          filtered=allow_list is not None,
+                          per_query_filters=per_query, dispatch="async"):
+            with self._lock:
+                kind, allow_mask = self._translate_batch_allow(
+                    queries, allow_list, per_query)
+                if kind == "rowwise":
+                    return None
+                handle = self.store.search_async(queries, k, allow_mask)
+                table = self._slot_to_id  # replaced (not resized) by compact
+
+        def _resolve(res, _table=table):
+            d, slots = res
+            clipped = np.clip(slots, 0, len(_table) - 1)
+            ids = np.where(slots >= 0, _table[clipped], -1)
+            return ids, d
+
+        return handle.map(_resolve)
 
     def search_by_vector_distance(self, query: np.ndarray, max_distance: float,
                                   allow_list: np.ndarray | None = None):

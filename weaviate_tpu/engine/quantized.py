@@ -43,6 +43,7 @@ from weaviate_tpu.ops import pq as pq_ops
 from weaviate_tpu.ops.distances import normalize_np
 from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
 from weaviate_tpu.runtime import hbm_ledger, tracing
+from weaviate_tpu.runtime.transfer import DeviceResultHandle
 
 _DEFAULT_CHUNK = 8192
 
@@ -313,13 +314,21 @@ class QuantizedVectorStore:
 
     def _vectors_for(self, slots: np.ndarray) -> np.ndarray:
         """Full-precision rows for given slots from whichever tier has them."""
-        if self._host_vectors is not None:
-            return self._host_vectors[slots]
-        if self.rescore_rows is not None:
+        return self._tier_vectors(self._host_vectors, self.rescore_rows,
+                                  self.fetch_fn, slots)
+
+    @staticmethod
+    def _tier_vectors(host_vectors, rescore_rows, fetch_fn,
+                      slots: np.ndarray) -> np.ndarray:
+        """Tier pick shared by the live path (``_vectors_for``) and the
+        async finish step's dispatch-time snapshot."""
+        if host_vectors is not None:
+            return host_vectors[slots]
+        if rescore_rows is not None:
             return np.asarray(
-                self.rescore_rows[jnp.asarray(slots)], dtype=np.float32)
-        if self.fetch_fn is not None:
-            return np.asarray(self.fetch_fn(slots), dtype=np.float32)
+                rescore_rows[jnp.asarray(slots)], dtype=np.float32)
+        if fetch_fn is not None:
+            return np.asarray(fetch_fn(slots), dtype=np.float32)
         raise RuntimeError(
             "no full-precision tier (rescore='none', no fetch_fn) — "
             "train() needs explicit vectors")
@@ -559,7 +568,21 @@ class QuantizedVectorStore:
         per-query [B, capacity] masks packed into a bitmask consumed
         inside the compressed scan kernels (disallowed rows never even
         become rescore candidates).
+
+        Like the plain store, this is ``search_async(...).result()`` —
+        the D2H transfer (and host rescore, which needs host
+        candidates) rides the handle's finish step.
         """
+        return self.search_async(queries, k, allow_mask).result()
+
+    def search_async(self, queries: np.ndarray, k: int,
+                     allow_mask: np.ndarray | None = None
+                     ) -> DeviceResultHandle:
+        """Dispatch-only twin of ``search``: the compressed scan
+        launches under ``_lock``; the oversampled candidates stay
+        device-resident in the returned handle, whose finish step runs
+        the exact host rescore (when this store's rescore mode needs
+        one) after the boundary transfer."""
         from weaviate_tpu.engine.store import normalize_allow_mask
 
         queries = np.asarray(queries, dtype=np.float32)
@@ -612,26 +635,54 @@ class QuantizedVectorStore:
                 d, i = self._scan(jnp.asarray(queries), k_cand, valid,
                                   k_out, allow_bits=allow_bits,
                                   allow_rows=allow_rows_dev)
-            tracing.device_sync(sp, d, i)  # outside the dispatch lock
-            d_np, i_np = np.asarray(d), np.asarray(i, dtype=np.int64)
-            if post_rescore:
+                # dispatch-time snapshot for the finish step's rescore:
+                # the scan's candidate slot-ids are only meaningful
+                # against THIS capacity/row layout — compact()/_grow()
+                # replace the full-precision tiers wholesale, and with
+                # the pipelined drain the dispatch->finish window is a
+                # whole overlapped batch, not microseconds
+                rescore_tiers = (self._host_vectors, self.rescore_rows,
+                                 self.fetch_fn)
+        # materialization + host rescore live in the handle's finish
+        # step: the candidates cross D2H at the API boundary (or on the
+        # serving pipeline's transfer thread), never under the lock
+
+        def _finish(d_np, i_np, _queries=queries, _k=k, _squeeze=squeeze,
+                    _post=post_rescore, _cap=capacity,
+                    _tiers=rescore_tiers):
+            i_np = i_np.astype(np.int64, copy=False)
+            if _post:
                 with tracing.span("store.host_rescore",
                                   candidates=int(i_np.shape[1])):
-                    d_np, i_np = self._host_rescore(queries, i_np, k)
-        out_d = d_np[:, :k].astype(np.float32)
-        out_i = i_np[:, :k]
-        if squeeze:
-            return out_d[0], out_i[0]
-        return out_d, out_i
+                    d_np, i_np = self._host_rescore(
+                        _queries, i_np, _k, capacity=_cap,
+                        vectors_for=lambda s: self._tier_vectors(
+                            *_tiers, s))
+            out_d = d_np[:, :_k].astype(np.float32)
+            out_i = i_np[:, :_k]
+            if _squeeze:
+                return out_d[0], out_i[0]
+            return out_d, out_i
 
-    def _host_rescore(self, queries: np.ndarray, cand_ids: np.ndarray, k: int):
+        return DeviceResultHandle(
+            (d, i), finish=_finish,
+            attrs={"rows": capacity, "queries": len(queries), "k": k,
+                   "quantization": self.quantization})
+
+    def _host_rescore(self, queries: np.ndarray, cand_ids: np.ndarray,
+                      k: int, capacity: int | None = None,
+                      vectors_for=None):
         """Vectorized exact rescore: one gather + one batched distance over
-        [B, k_cand, d] (no per-query Python loop)."""
+        [B, k_cand, d] (no per-query Python loop). ``capacity`` /
+        ``vectors_for`` pin the row layout the candidate ids were scanned
+        against (the async finish step passes its dispatch-time
+        snapshot); defaults read the live store."""
         b, kc = cand_ids.shape
-        safe = np.clip(cand_ids, 0, self.capacity - 1)
-        # _vectors_for picks whichever full-precision tier exists
-        # (host rows -> device bf16 rows -> fetch_fn)
-        cand = self._vectors_for(safe.reshape(-1)).reshape(b, kc, self.dim)
+        cap = self.capacity if capacity is None else capacity
+        safe = np.clip(cand_ids, 0, cap - 1)
+        # the tier pick (host rows -> device bf16 rows -> fetch_fn)
+        cand = ((vectors_for or self._vectors_for)(
+            safe.reshape(-1))).reshape(b, kc, self.dim)
         metric = "cosine" if self.metric in ("cosine", "cosine-dot") else self.metric
         if metric == "dot":
             dd = -np.einsum("bd,bkd->bk", queries, cand)

@@ -61,6 +61,7 @@ def _load():
              [i32, i64, u64p, i32p, i64, i64p, f32p, i64p, ctypes.c_float,
               u64p], i64),
             ("dp_stats", [u64p, u64p], None),
+            ("dp_cache_stats", [i32, i64p, u64p, u64p], None),
             ("dp_bench",
              [i32, i32, i32, i32, i32, u8p, i64, ctypes.POINTER(
                  ctypes.c_double), f32p, f32p, f32p, i64p], i64),
@@ -209,6 +210,19 @@ class DataPlane:
         fb = ctypes.c_uint64(0)
         self._lib.dp_stats(ctypes.byref(fast), ctypes.byref(fb))
         return fast.value, fb.value
+
+    def cache_stats(self, coll_id: int = -1) -> dict:
+        """Reply-cache accounting: cached doc entries for ``coll_id``
+        (-1 = all collections) plus global per-doc hit/miss counts from
+        the C++ reply builder — ``misses == 0`` after a warm pass means
+        property fetch on the hot path never re-entered Python."""
+        entries = ctypes.c_int64(0)
+        hits = ctypes.c_uint64(0)
+        misses = ctypes.c_uint64(0)
+        self._lib.dp_cache_stats(coll_id, ctypes.byref(entries),
+                                 ctypes.byref(hits), ctypes.byref(misses))
+        return {"entries": entries.value, "hits": hits.value,
+                "misses": misses.value}
 
 
 def bench(port: int, conns: int, streams: int, duration_ms: int, dim: int,

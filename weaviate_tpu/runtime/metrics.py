@@ -342,6 +342,18 @@ batcher_compile_bucket = registry.counter(
     "weaviate_tpu_query_batcher_compile_bucket_total",
     "Coalesced dispatches by padded pow2 (batch, k) bucket — the bucket "
     "set bounds the number of compiled program variants", ("b", "k"))
+batcher_async_dispatched = registry.counter(
+    "weaviate_tpu_query_batcher_async_dispatched_total",
+    "Coalesced drains dispatched through the zero-sync pipeline: "
+    "results stay device-resident and drain D2H on the transfer thread")
+batcher_overlapped = registry.counter(
+    "weaviate_tpu_query_batcher_overlapped_total",
+    "Dispatches launched while a previous batch was still draining "
+    "D2H — the overlap the double-buffered pipeline exists for")
+batcher_transfer_duration = registry.histogram(
+    "weaviate_tpu_query_batcher_transfer_seconds",
+    "D2H drain time (transfer.d2h window) of the coalesced batch a "
+    "query rode in, overlapped with the next dispatch")
 
 # -- HBM ledger (runtime/hbm_ledger.py keeps these current on every
 #    register/update/release; memwatch sets the budget + pressure) ------------

@@ -30,6 +30,19 @@ the same way, so the number of compiled program variants is bounded by
 log2(max_batch) * log2(max k) instead of one executable per observed
 (batch, k) combination. Mixed k's batch together at the k bucket and
 slice.
+
+Zero-sync pipeline (ISSUE 7): with an ``async_batch_fn`` (an index
+``search_by_vector_batch_async`` returning a device-resident
+``DeviceResultHandle``), the worker becomes a pure DISPATCH loop — it
+launches batch N's program and hands the handle to a dedicated transfer
+thread (runtime/transfer.py, double-buffered), then immediately drains
+and dispatches batch N+1 while N's results cross D2H. The device never
+idles on a host sync, and the host-side result routing (row slicing,
+waiter wakeup) for batch N overlaps batch N+1's device time. The
+transfer window (depth 2) is backpressure: at most two batches are in
+flight past dispatch, so staged host memory stays bounded. Results are
+bit-identical to the sync path — same program, same padding, same
+slicing; only WHERE the transfer happens moves.
 """
 
 from __future__ import annotations
@@ -40,6 +53,7 @@ import time
 import numpy as np
 
 from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime.transfer import TransferPipeline
 
 
 def _next_pow2(n: int) -> int:
@@ -52,7 +66,8 @@ def _next_pow2(n: int) -> int:
 class _Pending:
     __slots__ = ("query", "k", "allow", "event", "ids", "dists", "error",
                  "ctx", "t_exec_start", "t_exec_end", "batch_size",
-                 "t_mask_start", "t_mask_end")
+                 "t_mask_start", "t_mask_end", "t_fetch_start",
+                 "t_fetch_end")
 
     def __init__(self, query, k, allow):
         self.query = query
@@ -70,6 +85,8 @@ class _Pending:
         self.t_exec_end: float | None = None
         self.t_mask_start: float | None = None
         self.t_mask_end: float | None = None
+        self.t_fetch_start: float | None = None
+        self.t_fetch_end: float | None = None
         self.batch_size = 1
 
 
@@ -92,10 +109,19 @@ class QueryBatcher:
     def __init__(self, batch_fn, max_batch: int = 256,
                  supports_filter_batching: bool = False,
                  capacity_fn=None, pad_pow2: bool = True,
-                 owner: dict | None = None):
+                 owner: dict | None = None, async_batch_fn=None,
+                 transfer_depth: int = 2):
         from weaviate_tpu.runtime import hbm_ledger
 
         self._batch_fn = batch_fn
+        # zero-sync pipeline: ``async_batch_fn(queries, k, allow) ->
+        # DeviceResultHandle | None`` (None = this dispatch can't run
+        # async, fall back to batch_fn). When set, coalesced drains
+        # dispatch-and-go: D2H runs on the transfer thread while the
+        # worker drains the next batch.
+        self._async_fn = async_batch_fn
+        self._transfer: TransferPipeline | None = None
+        self._transfer_depth = transfer_depth
         self.max_batch = max_batch
         self.filter_batching = supports_filter_batching
         self._capacity_fn = capacity_fn
@@ -109,10 +135,15 @@ class QueryBatcher:
         self._queue: list[_Pending] = []
         self._worker: threading.Thread | None = None
         self._stopped = False
-        # observability (tools/bench_e2e asserts coalescing happens)
+        # observability (tools/bench_e2e asserts coalescing happens;
+        # tests/test_query_batcher.py asserts the pipeline overlaps)
         self.dispatches = 0
         self.batched_queries = 0
         self.filtered_batched = 0
+        self.async_dispatches = 0
+        # dispatches launched while a previous batch was still in the
+        # transfer window — the overlap the double-buffering exists for
+        self.overlapped_dispatches = 0
 
     def _ensure_worker(self):
         """Caller holds ``_cv`` (search() enqueues under it)."""
@@ -125,6 +156,26 @@ class QueryBatcher:
         with self._cv:
             self._stopped = True
             self._cv.notify_all()
+            tp = self._transfer
+        if tp is not None:
+            # drains in-flight handles: every waiter gets its result (or
+            # the fetch error), never a hang on shutdown
+            tp.stop()
+
+    def _ensure_transfer(self) -> TransferPipeline:
+        with self._cv:
+            if self._stopped:
+                # stop() only stops the pipeline it can SEE — creating
+                # one after it looked would leak a never-stopped drain
+                # thread and let post-stop dispatches succeed. Raising
+                # here routes the in-flight drain to its waiters as an
+                # error (via _run's handler / the submit RuntimeError
+                # path below).
+                raise RuntimeError("query batcher stopped")
+            if self._transfer is None:
+                self._transfer = TransferPipeline(
+                    depth=self._transfer_depth, name="qb-transfer")
+            return self._transfer
 
     def search(self, query: np.ndarray, k: int,
                allow: np.ndarray | None = None):
@@ -147,6 +198,13 @@ class QueryBatcher:
             tracing.record_span("batcher.execute", item.t_exec_start,
                                 item.t_exec_end or time.perf_counter(),
                                 batch=item.batch_size)
+            if item.t_fetch_start is not None:
+                # the pipelined D2H drain for this request's batch (the
+                # transfer thread's handle.result() window)
+                tracing.record_span("batcher.transfer",
+                                    item.t_fetch_start,
+                                    item.t_fetch_end
+                                    or item.t_fetch_start)
             from weaviate_tpu.runtime.metrics import (
                 batcher_execute_duration, batcher_wait_duration)
 
@@ -154,6 +212,13 @@ class QueryBatcher:
             if item.t_exec_end is not None:
                 batcher_execute_duration.observe(
                     item.t_exec_end - item.t_exec_start)
+            if item.t_fetch_start is not None \
+                    and item.t_fetch_end is not None:
+                from weaviate_tpu.runtime.metrics import (
+                    batcher_transfer_duration)
+
+                batcher_transfer_duration.observe(
+                    item.t_fetch_end - item.t_fetch_start)
         if item.error is not None:
             raise item.error
         return item.ids, item.dists
@@ -162,6 +227,13 @@ class QueryBatcher:
 
     def _run(self):
         while True:
+            # pipeline pacing: with the transfer window full (one batch
+            # computing, one draining), DON'T drain yet — arriving
+            # requests keep coalescing into the next batch, so the
+            # pipeline keeps the sync path's batch sizes AND the overlap
+            tp = self._transfer
+            if tp is not None:
+                tp.wait_slot()
             with self._cv:
                 while not self._queue and not self._stopped:
                     self._cv.wait(timeout=1.0)
@@ -273,25 +345,80 @@ class QueryBatcher:
             if filtered:
                 it.t_mask_start, it.t_mask_end = t_mask0, t_mask1
         # the pow2-padded query block becomes a device upload inside
-        # batch_fn — ledger-registered for the dispatch's duration so
+        # batch_fn — ledger-registered until the results leave the
+        # device (sync: end of this call; async: transfer completion) so
         # peak watermarks see concurrent drains
         from weaviate_tpu.runtime.hbm_ledger import ledger as _hbm
 
         pad_key = _hbm.register("dispatch_pad", queries.nbytes,
                                 dtype="float32", **self._hbm_owner)
-        try:
-            ids, dists = tracing.run_in(ctx, self._batch_fn, queries,
-                                        k_bucket, allows)
-        except Exception as e:  # noqa: BLE001
+
+        def _fail(err: BaseException) -> None:
+            """Single exit path for every failure mode: release the pad
+            exactly once and set EVERY not-yet-delivered waiter's event
+            — an unset event hangs its client forever (the transfer
+            thread swallows callback exceptions by design)."""
+            _hbm.release(pad_key)
             t1 = time.perf_counter()
             for it in coal:
-                it.t_exec_end = t1
-                it.error = e
-                it.event.set()
+                if not it.event.is_set():
+                    it.t_exec_end = t1
+                    it.error = err
+                    it.event.set()
+
+        handle = None
+        try:
+            if self._async_fn is not None:
+                # dispatch-and-go: launch the program, hand the
+                # device-resident handle to the transfer thread, return
+                # to drain the NEXT batch while this one crosses D2H
+                handle = tracing.run_in(ctx, self._async_fn, queries,
+                                        k_bucket, allows)
+            if handle is None:
+                ids, dists = tracing.run_in(ctx, self._batch_fn, queries,
+                                            k_bucket, allows)
+        except Exception as e:  # noqa: BLE001
+            _fail(e)
             return
-        finally:
+        if handle is None:
             _hbm.release(pad_key)
-        t1 = time.perf_counter()
+            self._deliver(coal, ids, dists, time.perf_counter())
+            return
+        self.async_dispatches += 1
+        from weaviate_tpu.runtime.metrics import (batcher_async_dispatched,
+                                                  batcher_overlapped)
+
+        batcher_async_dispatched.inc()
+
+        def _complete(res, err, t_fetch0, t_fetch1):
+            for it in coal:
+                it.t_fetch_start, it.t_fetch_end = t_fetch0, t_fetch1
+            if err is not None:
+                _fail(err)
+                return
+            try:
+                t1 = time.perf_counter()
+                self._deliver(coal, res[0], res[1], t1)
+                _hbm.release(pad_key)
+            except Exception as e:  # noqa: BLE001 — an out-of-contract
+                # result shape must surface to the waiters (the sync
+                # path raises it through _run's handler)
+                _fail(e)
+
+        try:
+            tp = self._ensure_transfer()
+            if tp.inflight > 0:
+                self.overlapped_dispatches += 1
+                batcher_overlapped.inc()
+            tp.submit(handle, _complete, ctx=ctx)
+        except Exception as e:  # noqa: BLE001 — stopped mid-shutdown
+            _fail(e)
+
+    @staticmethod
+    def _deliver(coal: list[_Pending], ids, dists, t1: float):
+        """Route one batch's host results to their waiters (identical
+        slicing for the sync and pipelined paths — parity by
+        construction)."""
         for row, it in enumerate(coal):
             it.t_exec_end = t1
             kk = min(it.k, ids.shape[1])

@@ -415,6 +415,44 @@ def device_sync(sp, *values) -> None:
         pass
 
 
+# -- device->host boundary ----------------------------------------------------
+
+def d2h(*values):
+    """THE sanctioned device->host transfer at the API boundary.
+
+    Fetches ``values`` (jax arrays; ``None`` entries pass through) to
+    numpy under a ``transfer.d2h`` span. Like ``device_sync``, the
+    device wait is ATTRIBUTED (``device_ms``) only on sampled traces —
+    there the device completion is timed separately (block_until_ready)
+    from the host-side copy, so the span splits chip time from memcpy
+    time. Unsampled/untraced callers still pay the transfer (that is the
+    point of calling this), just without the extra sync for attribution.
+
+    Hot-path modules (engine/, ops/, parallel/, the query batcher) must
+    not fetch device values themselves (graftlint G1); they return
+    device-resident handles (runtime/transfer.py) whose ``result()``
+    funnels through here — one audited boundary instead of scattered
+    ``np.asarray`` syncs.
+    """
+    import numpy as _np
+
+    n_arrays = sum(1 for v in values if v is not None)
+    with span("transfer.d2h", arrays=n_arrays) as sp:
+        cur = _current.get()
+        if cur is not None and cur[0].sampled and n_arrays:
+            try:
+                import jax
+
+                t0 = time.perf_counter()
+                jax.block_until_ready([v for v in values if v is not None])
+                sp.set(device_ms=round(
+                    (time.perf_counter() - t0) * 1000.0, 3))
+            except Exception:  # a poisoned buffer raises at asarray below
+                pass
+        out = tuple(None if v is None else _np.asarray(v) for v in values)
+    return out
+
+
 # -- cross-thread propagation -------------------------------------------------
 
 def capture():

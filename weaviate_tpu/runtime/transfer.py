@@ -1,0 +1,207 @@
+"""Device-resident result handles and the double-buffered D2H drain.
+
+The serving gap (ROADMAP item 1, BENCH_r04): the device sustains ~450k
+QPS on flat bf16 b=1024 while the served path peaks at ~12k — the
+difference lives in the Python stack, and the single worst offender is
+the synchronous ``np.asarray`` at the end of every search: the dispatch
+thread blocks on the device, the device then idles while Python slices
+and routes results, and neither side ever overlaps the other.
+
+This module is the fix's substrate (ISSUE 7 tentpole):
+
+- ``DeviceResultHandle`` — a future for one dispatched device program.
+  The engine's ``search_async`` entry points return it instead of numpy;
+  the raw device arrays stay resident until ``.result()`` performs THE
+  sanctioned device->host transfer (``tracing.d2h`` — recorded as a
+  ``transfer.d2h`` span with device-time attribution on sampled traces)
+  and runs the host-side ``finish`` post-step (slot -> doc-id
+  resolution, gathered-path remapping, exact rescore). Handles compose
+  with ``map`` so each layer adds its host post-processing without
+  forcing the transfer early.
+
+- ``TransferPipeline`` — a dedicated drain thread with a bounded
+  in-flight window (double buffering). The query batcher and the native
+  data plane submit (handle, callback) pairs: while batch N's results
+  cross D2H here, the dispatch thread is already launching batch N+1's
+  program, so the device never idles on a host sync. The window bound
+  (default 2) is backpressure: batch N+2's dispatch waits until N has
+  fully drained, keeping staged host memory and device in-flight work
+  bounded.
+
+This file is deliberately OUTSIDE graftlint G1's hot-path scope: it IS
+the API boundary the checker tells hot paths to move their transfers to
+(the same standing tracing.py has for its sampled ``device_sync``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from weaviate_tpu.runtime import tracing
+
+_UNSET = object()
+
+
+class DeviceResultHandle:
+    """Future-like handle for one dispatched device program's results.
+
+    ``arrays`` are the raw device (jax) arrays the program returns; they
+    stay device-resident until ``result()`` runs. ``finish(*host)`` is
+    the host-side post-step applied to the fetched numpy arrays; its
+    return value is the handle's result. ``result()`` is idempotent and
+    thread-safe (an error is cached and re-raised to every caller).
+    """
+
+    __slots__ = ("_arrays", "_finish", "_parent", "_value", "_error",
+                 "_lock", "attrs")
+
+    def __init__(self, arrays=(), finish=None, parent=None, attrs=None):
+        self._arrays = tuple(arrays)
+        self._finish = finish
+        self._parent = parent
+        self._value = _UNSET
+        self._error: BaseException | None = None
+        self._lock = threading.Lock()
+        self.attrs = dict(attrs or {})
+
+    @classmethod
+    def ready(cls, value) -> "DeviceResultHandle":
+        """A handle over an already-host-resident result (sync fallbacks
+        keep the async call signature without a fake transfer)."""
+        h = cls()
+        h._value = value
+        return h
+
+    def map(self, fn) -> "DeviceResultHandle":
+        """Chain a host post-step: the new handle resolves to
+        ``fn(self.result())``. The transfer still happens exactly once,
+        at the outermost ``result()``."""
+        return DeviceResultHandle(parent=self, finish=fn,
+                                  attrs=dict(self.attrs))
+
+    @property
+    def done(self) -> bool:
+        return self._value is not _UNSET or self._error is not None
+
+    def result(self):
+        """Fetch to host (``transfer.d2h``) and run the finish chain."""
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            if self._value is not _UNSET:
+                return self._value
+            try:
+                if self._parent is not None:
+                    host = self._parent.result()
+                    self._value = (self._finish(host)
+                                   if self._finish is not None else host)
+                else:
+                    host = tracing.d2h(*self._arrays)
+                    self._value = (self._finish(*host)
+                                   if self._finish is not None else host)
+            except BaseException as e:  # cache: every waiter sees it
+                self._error = e
+                raise
+            finally:
+                self._arrays = ()  # release the device references
+                self._parent = None
+            return self._value
+
+
+class TransferPipeline:
+    """Dedicated D2H drain thread with a bounded in-flight window.
+
+    ``submit(handle, callback, ctx)`` enqueues one transfer; it BLOCKS
+    while ``depth`` transfers are already queued or running — that bound
+    is the double-buffering contract (depth=2: batch N draining, batch
+    N+1 dispatched, batch N+2's dispatcher waits). ``callback(value,
+    error, t_fetch_start, t_fetch_end)`` runs on the drain thread;
+    ``ctx`` (a ``tracing.capture()`` handle) scopes the fetch so the
+    ``transfer.d2h`` span lands in a real request trace.
+
+    ``stop()`` drains everything already submitted — in-flight waiters
+    get their results (or the fetch error), never a hang — then joins
+    the thread. Submitting after stop raises.
+    """
+
+    def __init__(self, depth: int = 2, name: str = "d2h-transfer"):
+        self.depth = max(1, int(depth))
+        self.name = name
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._inflight = 0
+        self._thread: threading.Thread | None = None
+        self._stopped = False
+        # observability (bench/tests assert overlap through these)
+        self.transferred = 0
+        self.errors = 0
+
+    @property
+    def inflight(self) -> int:
+        """Transfers queued or currently draining."""
+        with self._cv:
+            return len(self._q) + self._inflight
+
+    def wait_slot(self) -> None:
+        """Block until the window has a free slot (or the pipeline is
+        stopped). Dispatchers call this BEFORE draining their queue so
+        requests keep coalescing while the window is full — racing ahead
+        with tiny batches would trade the batching win for the overlap
+        win instead of keeping both."""
+        with self._cv:
+            while (not self._stopped
+                   and len(self._q) + self._inflight >= self.depth):
+                self._cv.wait(timeout=1.0)
+
+    def submit(self, handle: DeviceResultHandle, callback, ctx=None):
+        with self._cv:
+            while (not self._stopped
+                   and len(self._q) + self._inflight >= self.depth):
+                self._cv.wait(timeout=1.0)
+            if self._stopped:
+                raise RuntimeError(f"transfer pipeline {self.name} stopped")
+            self._q.append((handle, callback, ctx))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=self.name, daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+            t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait(timeout=1.0)
+                if not self._q:  # stopped and drained
+                    self._cv.notify_all()
+                    return
+                handle, callback, ctx = self._q.popleft()
+                self._inflight += 1
+            err = None
+            value = None
+            t0 = time.perf_counter()
+            try:
+                value = tracing.run_in(ctx, handle.result)
+            except BaseException as e:  # noqa: BLE001 — deliver to waiters
+                err = e
+            t1 = time.perf_counter()
+            try:
+                callback(value, err, t0, t1)
+            except Exception:  # noqa: BLE001 — a bad callback must not
+                pass           # kill the drain thread for later batches
+            with self._cv:
+                self._inflight -= 1
+                self.transferred += 1
+                if err is not None:
+                    self.errors += 1
+                self._cv.notify_all()
