@@ -35,3 +35,18 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _faultline_isolation():
+    """Keep failure-policy state from leaking across tests: a schedule
+    someone forgot to disarm, a component health flag, or — subtler —
+    an OPEN circuit breaker keyed on an OS-assigned port that the next
+    test's fresh in-process node happens to reuse."""
+    yield
+    from weaviate_tpu.cluster.transport import reset_breakers
+    from weaviate_tpu.runtime import degrade, faultline
+
+    faultline.disarm()
+    degrade.reset()
+    reset_breakers()

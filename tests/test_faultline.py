@@ -1,0 +1,620 @@
+"""Faultline tentpole (ISSUE 8): fault injection, retry/deadline policy,
+circuit breakers, and the typed-error surface.
+
+Covers the contract points the chaos suite builds on:
+
+1. the registry — deterministic schedules (nth/every/times/seeded p),
+   disarmed zero-cost, per-injection accounting (counter + schedule);
+2. RetryPolicy — retriable-vs-terminal classification, full-jitter
+   backoff, and deadline exhaustion mid-retry raising the TYPED
+   DeadlineExceeded (chained to the real failure), never a generic 500;
+3. circuit breakers — closed -> open -> half-open -> closed transitions,
+   one-probe half-open, fail-fast while open (a dead peer stops eating
+   deadline budget), state gauge accounting;
+4. transport exception coverage — http.client.HTTPException /
+   IncompleteRead map to RpcError instead of escaping raw;
+5. the REST edge — 504 DEADLINE_EXCEEDED, 503 OVERLOADED with
+   Retry-After, degraded markers attached to responses, component
+   health in /v1/nodes;
+6. the query batcher — deadline-capped waits (no hang past budget) and
+   bounded-queue load shedding with the typed OverloadedError.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.cluster import transport
+from weaviate_tpu.cluster.transport import (CircuitBreaker, CircuitOpenError,
+                                            InternalServer, RpcError, rpc)
+from weaviate_tpu.runtime import degrade, faultline, retry
+from weaviate_tpu.runtime.retry import (DeadlineExceeded, OverloadedError,
+                                        RetryPolicy)
+
+
+# -- 1. fault registry --------------------------------------------------------
+
+
+def test_disarmed_fire_is_noop():
+    assert faultline.fire("kv.get_many") is None
+    assert not faultline.armed()
+
+
+def test_unknown_point_rejected():
+    with pytest.raises(KeyError):
+        faultline.arm("no.such.point")
+
+
+def test_nth_schedule_is_deterministic():
+    with faultline.injected("kv.get_many", nth=(1, 3)) as sched:
+        hits = []
+        for i in range(5):
+            try:
+                faultline.fire("kv.get_many")
+            except faultline.FaultInjected:
+                hits.append(i)
+        assert hits == [1, 3]
+        assert sched.calls == 5 and sched.injected == 2
+    assert not faultline.armed()
+
+
+def test_every_and_times_schedules():
+    with faultline.injected("batcher.dispatch", every=2, times=2) as sched:
+        hits = [i for i in range(8)
+                if _fires("batcher.dispatch")]
+        # every 2nd call, capped at 2 injections
+        assert hits == [1, 3]
+        assert sched.injected == 2
+
+
+def _fires(point) -> bool:
+    try:
+        faultline.fire(point)
+        return False
+    except faultline.FaultInjected:
+        return True
+
+
+def test_seeded_probability_replays_exactly():
+    def draw(seed):
+        with faultline.injected("transfer.d2h", p=0.5, seed=seed):
+            return [_fires("transfer.d2h") for _ in range(20)]
+
+    assert draw(7) == draw(7)
+    assert draw(7) != draw(8)  # astronomically unlikely to collide
+
+
+def test_latency_action_sleeps_then_proceeds():
+    with faultline.injected("kv.get_many", action="latency",
+                            latency_s=0.05, times=1):
+        t0 = time.perf_counter()
+        assert faultline.fire("kv.get_many") is None
+        assert time.perf_counter() - t0 >= 0.045
+        t0 = time.perf_counter()
+        faultline.fire("kv.get_many")  # times exhausted: no sleep
+        assert time.perf_counter() - t0 < 0.04
+
+
+def test_match_predicate_filters_by_attrs():
+    with faultline.injected(
+            "transport.rpc.send",
+            match=lambda a: str(a.get("path", "")).startswith("/replicas/"),
+    ) as sched:
+        assert faultline.fire("transport.rpc.send", path="/raft/vote") is None
+        with pytest.raises(faultline.FaultInjected):
+            faultline.fire("transport.rpc.send", path="/replicas/C/s0/commit")
+        assert sched.injected == 1
+
+
+def test_injection_counter_accounts_every_fault():
+    from weaviate_tpu.runtime.metrics import fault_injected_total
+
+    child = fault_injected_total.labels("kv.get_many", "error")
+    before = child.value
+    with faultline.injected("kv.get_many", times=3):
+        for _ in range(5):
+            _fires("kv.get_many")
+    assert fault_injected_total.labels("kv.get_many",
+                                       "error").value == before + 3
+
+
+def test_custom_error_and_drop_directive():
+    with faultline.injected("kv.get_many", error=lambda: OSError("disk")):
+        with pytest.raises(OSError):
+            faultline.fire("kv.get_many")
+    with faultline.injected("kv.get_many", action="corrupt", times=1):
+        assert faultline.fire("kv.get_many") == "corrupt"
+        assert faultline.fire("kv.get_many") is None
+
+
+# -- 2. deadline + retry policy -----------------------------------------------
+
+
+def test_deadline_nesting_only_shrinks():
+    with retry.deadline(10.0):
+        outer = retry.remaining()
+        with retry.deadline(100.0):  # inner may not EXTEND the budget
+            assert retry.remaining() <= outer
+        with retry.deadline(0.01):
+            assert retry.remaining() <= 0.01
+    assert retry.remaining() is None
+
+
+def test_budget_timeout_caps_and_raises_when_spent():
+    with retry.deadline(0.5):
+        assert retry.budget_timeout(30.0) <= 0.5
+        assert retry.budget_timeout(0.1) <= 0.1
+    with retry.deadline(0.01):
+        time.sleep(0.02)
+        with pytest.raises(DeadlineExceeded):
+            retry.budget_timeout(30.0)
+
+
+def test_retriable_error_recovers():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RpcError("transient", status=0)
+        return "ok"
+
+    assert RetryPolicy(base_s=0.001, cap_s=0.002).call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_terminal_error_not_retried():
+    calls = []
+
+    def handler_error():
+        calls.append(1)
+        raise RpcError("no such shard", status=404)
+
+    with pytest.raises(RpcError):
+        RetryPolicy(base_s=0.001).call(handler_error)
+    assert len(calls) == 1
+
+
+def test_timed_out_rpc_is_terminal_for_retry():
+    """A per-attempt timeout already burned its full time ceiling:
+    retrying a black-holed replica would turn one 30s ceiling into
+    three before failover gets a chance. Fast transport failures
+    (status=0, refused/reset) stay retriable."""
+    timed_out = RpcError("rpc to x:1/op failed: timed out", status=0)
+    timed_out.timed_out = True
+    calls = []
+
+    def blackholed():
+        calls.append(1)
+        raise timed_out
+
+    with pytest.raises(RpcError):
+        RetryPolicy(base_s=0.001).call(blackholed)
+    assert len(calls) == 1
+
+
+def test_circuit_open_is_terminal_for_retry():
+    calls = []
+
+    def refused():
+        calls.append(1)
+        raise CircuitOpenError("open")
+
+    with pytest.raises(CircuitOpenError):
+        RetryPolicy(base_s=0.001).call(refused)
+    assert len(calls) == 1  # burning backoff on a known-dead peer is the leak
+
+
+def test_deadline_exhaustion_mid_retry_is_typed_not_generic():
+    """ISSUE 8 satellite: budget runs out BETWEEN attempts -> the caller
+    gets DeadlineExceeded (chained to the real failure), not the raw
+    transient error and never a blind sleep past the deadline."""
+    def always_transient():
+        raise RpcError("transient", status=503)
+
+    policy = RetryPolicy(max_attempts=10, base_s=0.2, cap_s=0.2,
+                         multiplier=1.0)
+    t0 = time.perf_counter()
+    with retry.deadline(0.05):
+        with pytest.raises(DeadlineExceeded) as ei:
+            policy.call(always_transient)
+    assert time.perf_counter() - t0 < 1.0  # did not sleep through retries
+    assert isinstance(ei.value.__cause__, RpcError)
+
+
+def test_overloaded_retry_after_floors_backoff():
+    calls, t0 = [], time.perf_counter()
+
+    def overloaded_once():
+        calls.append(1)
+        if len(calls) == 1:
+            raise OverloadedError("full", retry_after_s=0.05)
+        return "ok"
+
+    assert RetryPolicy(base_s=0.0001, cap_s=0.0001).call(
+        overloaded_once) == "ok"
+    assert time.perf_counter() - t0 >= 0.045
+
+
+# -- 3. circuit breakers ------------------------------------------------------
+
+
+def test_breaker_full_transition_cycle():
+    br = CircuitBreaker("peer:1", threshold=3, cooldown_s=0.05)
+    assert br.state == "closed"
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    assert br.retry_after_s() > 0
+    time.sleep(0.06)
+    assert br.allow()           # the half-open probe
+    assert br.state == "half-open"
+    assert not br.allow()       # only ONE probe at a time
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_halfopen_failure_reopens():
+    br = CircuitBreaker("peer:2", threshold=1, cooldown_s=0.05)
+    br.record_failure()
+    assert br.state == "open"
+    time.sleep(0.06)
+    assert br.allow()
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+
+
+def test_http_error_status_resets_failure_streak():
+    """A 4xx/5xx response proves the peer is ALIVE: transport records
+    success at the wire level even though the caller sees RpcError."""
+    srv = InternalServer(port=0)
+
+    def boom(payload):
+        raise ValueError("handler failed")
+
+    srv.route("/boom", boom)
+    srv.start()
+    try:
+        br = transport.breaker_for(srv.address)
+        for _ in range(transport.CB_THRESHOLD + 2):
+            with pytest.raises(RpcError):
+                rpc(srv.address, "/boom", {}, timeout=5.0)
+        assert br.state == "closed"
+    finally:
+        srv.stop()
+
+
+def test_dead_peer_trips_breaker_then_fails_fast():
+    addr = "127.0.0.1:1"  # nothing listens: connection refused
+    transport.reset_breakers()
+    for _ in range(transport.CB_THRESHOLD):
+        with pytest.raises(RpcError):
+            rpc(addr, "/x", {}, timeout=0.5)
+    t0 = time.perf_counter()
+    with pytest.raises(CircuitOpenError):
+        rpc(addr, "/x", {}, timeout=5.0)
+    assert time.perf_counter() - t0 < 0.1  # no connection attempt at all
+    from weaviate_tpu.runtime.metrics import circuit_state
+
+    assert circuit_state.labels(addr).value == 2.0  # open
+
+
+def test_unexpected_escape_releases_halfopen_probe_slot():
+    """An exception rpc() does not map to RpcError (a custom faultline
+    error= outside the transport tuple) must hand back the half-open
+    probe slot — a leaked slot would wedge the peer in fail-fast
+    forever with no cooldown to expire."""
+    addr = "127.0.0.1:1"
+    transport.reset_breakers()
+    br = transport.breaker_for(addr)
+    br.cooldown_s = 0.05
+    for _ in range(br.threshold):
+        br.record_failure()
+    assert br.state == "open"
+    time.sleep(0.06)
+    with faultline.injected("transport.rpc.send",
+                            error=lambda: ZeroDivisionError("boom")):
+        with pytest.raises(ZeroDivisionError):
+            rpc(addr, "/x", {}, timeout=0.5)  # wins the probe, escapes
+    # the slot came back: the NEXT caller may probe (still half-open)
+    assert br.state == "half-open"
+    assert br.allow()
+
+
+def test_finder_total_fetch_failure_raises_not_nonexistence(monkeypatch):
+    """Digests proved the object exists; every replica then failing the
+    FETCH is unavailability, not a 404 — returning None would let a
+    read-then-recreate client clobber the surviving copies."""
+    from weaviate_tpu.replication.finder import Finder
+    from weaviate_tpu.replication.replicator import ConsistencyError
+
+    class _Sharding:
+        @staticmethod
+        def nodes_for(shard):
+            return ["n1", "n2", "n3"]
+
+    class _Config:
+        name = "C"
+
+    class _Col:
+        local_node = "n0"  # not a replica: every leg is remote
+        sharding = _Sharding()
+        config = _Config()
+
+    finder = Finder(_Col())
+    digest = {"uuid": "u1", "mtime": 5, "deleted": False, "hash": "h"}
+    monkeypatch.setattr(finder, "_digest",
+                        lambda node, shard, uuid: dict(digest))
+    monkeypatch.setattr(
+        finder, "_fetch",
+        lambda node, shard, uuid: (_ for _ in ()).throw(
+            RpcError("peer died", status=0)))
+    with pytest.raises(ConsistencyError):
+        finder.get_object("u1", "s0", level="QUORUM")
+
+
+def test_rpc_deadline_budget_caps_attempt_and_raises_when_spent():
+    with retry.deadline(0.01):
+        time.sleep(0.02)
+        with pytest.raises(DeadlineExceeded):
+            rpc("127.0.0.1:1", "/x", {}, timeout=30.0)
+
+
+# -- 4. transport exception coverage ------------------------------------------
+
+
+def test_http_exceptions_map_to_rpc_error(monkeypatch):
+    """ISSUE 8 satellite: IncompleteRead/BadStatusLine used to escape as
+    raw exceptions; they must be RpcError like any transport failure."""
+    class HalfDeadConn:
+        def __init__(self, *a, **kw):
+            pass
+
+        def request(self, *a, **kw):
+            pass
+
+        def getresponse(self):
+            raise http.client.IncompleteRead(b"partial")
+
+        def close(self):
+            pass
+
+    monkeypatch.setattr(transport.http.client, "HTTPConnection",
+                        HalfDeadConn)
+    transport.reset_breakers()
+    with pytest.raises(RpcError) as ei:
+        rpc("127.0.0.1:9", "/x", {}, timeout=1.0)
+    assert not isinstance(ei.value, CircuitOpenError)
+    assert transport.breaker_for("127.0.0.1:9")._failures == 1
+
+
+def test_injected_drop_completes_server_side_then_errors(monkeypatch):
+    """The drop directive's 2PC semantics: the handler RAN (the prepare
+    landed) but the caller sees a transport failure."""
+    served = []
+    srv = InternalServer(port=0)
+    srv.route("/op", lambda payload: served.append(payload) or {"ok": True})
+    srv.start()
+    try:
+        with faultline.injected("transport.rpc.send", action="drop",
+                                times=1) as sched:
+            with pytest.raises(RpcError):
+                rpc(srv.address, "/op", {"n": 1}, timeout=5.0)
+        assert served == [{"n": 1}]  # the peer really handled it
+        assert sched.injected == 1
+        # next call (disarmed) is fine
+        assert rpc(srv.address, "/op", {"n": 2}, timeout=5.0) == {"ok": True}
+    finally:
+        srv.stop()
+
+
+def test_injected_corrupt_payload_maps_to_rpc_error():
+    srv = InternalServer(port=0)
+    srv.route("/op", lambda payload: {"ok": True})
+    srv.start()
+    try:
+        with faultline.injected("transport.rpc.send", action="corrupt",
+                                times=1):
+            with pytest.raises(RpcError) as ei:
+                rpc(srv.address, "/op", {}, timeout=5.0)
+        assert "corrupt" in str(ei.value)
+    finally:
+        srv.stop()
+
+
+# -- 5. the REST edge ---------------------------------------------------------
+
+
+@pytest.fixture
+def rest_server(tmp_path):
+    from weaviate_tpu.api.rest import RestServer
+    from weaviate_tpu.db.database import Database
+
+    db = Database(str(tmp_path / "d"))
+    srv = RestServer(db, port=0, graphql_executor=None, modules=None)
+    srv.start()
+    yield srv
+    srv.stop()
+    db.close()
+
+
+def _get(srv, path, headers=None):
+    conn = http.client.HTTPConnection(srv.host, srv.port, timeout=10)
+    try:
+        conn.request("GET", path, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), \
+            json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def test_rest_maps_deadline_to_504(rest_server, monkeypatch):
+    monkeypatch.setattr(
+        rest_server, "dispatch",
+        lambda *a, **kw: (_ for _ in ()).throw(DeadlineExceeded("query")))
+    status, _headers, payload = _get(rest_server, "/v1/nodes")
+    assert status == 504
+    assert payload["error"][0]["code"] == "DEADLINE_EXCEEDED"
+    assert payload["error"][0]["layer"] == "query"
+
+
+def test_rest_maps_overload_to_503_with_retry_after(rest_server,
+                                                    monkeypatch):
+    monkeypatch.setattr(
+        rest_server, "dispatch",
+        lambda *a, **kw: (_ for _ in ()).throw(
+            OverloadedError("queue full", retry_after_s=0.25)))
+    status, headers, payload = _get(rest_server, "/v1/nodes")
+    assert status == 503
+    assert payload["error"][0]["code"] == "OVERLOADED"
+    # RFC 9110 delta-seconds: integer, ceil'd, floor of 1
+    assert headers["Retry-After"] == "1"
+
+
+def test_rest_maps_circuit_open_to_503(rest_server, monkeypatch):
+    monkeypatch.setattr(
+        rest_server, "dispatch",
+        lambda *a, **kw: (_ for _ in ()).throw(
+            CircuitOpenError("peer down", retry_after_s=1.5)))
+    status, headers, payload = _get(rest_server, "/v1/nodes")
+    assert status == 503
+    assert payload["error"][0]["code"] == "CIRCUIT_OPEN"
+    # 1.5s cooldown hint rounds UP to whole delta-seconds
+    assert headers["Retry-After"] == "2"
+
+
+def test_rest_request_timeout_header_sets_budget(rest_server, monkeypatch):
+    seen = {}
+
+    def capture(method, path, params, body):
+        seen["remaining"] = retry.remaining()
+        return 200, {"ok": True}
+
+    monkeypatch.setattr(rest_server, "dispatch", capture)
+    status, _h, _p = _get(rest_server, "/v1/nodes",
+                          headers={"X-Request-Timeout": "7"})
+    assert status == 200
+    assert seen["remaining"] is not None and 0 < seen["remaining"] <= 7.0
+
+
+def test_rest_attaches_degraded_markers(rest_server, monkeypatch):
+    def degraded_dispatch(method, path, params, body):
+        degrade.report("missing_shard", collection="C", shard="s1",
+                       detail="replica down")
+        return 200, {"data": []}
+
+    monkeypatch.setattr(rest_server, "dispatch", degraded_dispatch)
+    status, _h, payload = _get(rest_server, "/v1/nodes")
+    assert status == 200
+    assert payload["degraded"] == [{
+        "kind": "missing_shard", "collection": "C", "shard": "s1",
+        "detail": "replica down"}]
+
+
+def test_nodes_surface_component_health(rest_server):
+    degrade.mark_unhealthy("query_batcher", "dispatch failed twice")
+    try:
+        status, _h, payload = _get(rest_server, "/v1/nodes")
+        node = payload["nodes"][0]
+        assert node["status"] == "UNHEALTHY"
+        assert "query_batcher" in node["health"]["unhealthy"]
+        degrade.mark_healthy("query_batcher")
+        _s, _h, payload = _get(rest_server, "/v1/nodes")
+        assert payload["nodes"][0]["status"] == "HEALTHY"
+        assert payload["nodes"][0]["health"]["healthy"]
+    finally:
+        degrade.mark_healthy("query_batcher")
+
+
+# -- 6. the query batcher under the policy ------------------------------------
+
+
+def test_batcher_wait_capped_by_deadline_no_hang():
+    from weaviate_tpu.runtime.query_batcher import QueryBatcher
+
+    release = threading.Event()
+
+    def stuck(queries, k, allow):
+        release.wait(10.0)
+        b = len(queries)
+        return (np.zeros((b, k), np.int64), np.zeros((b, k), np.float32))
+
+    qb = QueryBatcher(stuck)
+    try:
+        t0 = time.perf_counter()
+        with retry.deadline(0.1):
+            with pytest.raises(DeadlineExceeded):
+                qb.search(np.zeros(4, np.float32), 3)
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        release.set()
+        qb.stop()
+
+
+def test_batcher_spent_budget_fails_before_enqueue():
+    from weaviate_tpu.runtime.query_batcher import QueryBatcher
+
+    qb = QueryBatcher(lambda q, k, a: (np.zeros((len(q), k), np.int64),
+                                       np.zeros((len(q), k), np.float32)))
+    try:
+        with retry.deadline(0.01):
+            time.sleep(0.02)
+            with pytest.raises(DeadlineExceeded):
+                qb.search(np.zeros(4, np.float32), 3)
+        assert qb.dispatches == 0  # never reached the device
+    finally:
+        qb.stop()
+
+
+def test_batcher_sheds_load_with_typed_overload():
+    from weaviate_tpu.runtime.query_batcher import QueryBatcher
+
+    release = threading.Event()
+
+    def slow(queries, k, allow):
+        release.wait(10.0)
+        b = len(queries)
+        return (np.zeros((b, k), np.int64), np.zeros((b, k), np.float32))
+
+    qb = QueryBatcher(slow, max_queue=2)
+    results = []
+
+    def client():
+        try:
+            results.append(qb.search(np.zeros(4, np.float32), 3))
+        except Exception as e:  # noqa: BLE001
+            results.append(e)
+
+    threads = []
+    try:
+        # first request occupies the worker; the queue then fills
+        t = threading.Thread(target=client)
+        t.start()
+        threads.append(t)
+        deadline = time.time() + 5.0
+        while qb.dispatches < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        for _ in range(2):  # fill max_queue
+            t = threading.Thread(target=client)
+            t.start()
+            threads.append(t)
+        deadline = time.time() + 5.0
+        while len(qb._queue) < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        with pytest.raises(OverloadedError) as ei:
+            qb.search(np.zeros(4, np.float32), 3)
+        assert ei.value.retry_after_s > 0
+    finally:
+        release.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        qb.stop()

@@ -958,6 +958,98 @@ def test_cache_reuses_and_invalidates_on_change(tmp_path):
     assert res3.violations == []
 
 
+# -- G6 timeout-discipline -----------------------------------------------------
+
+
+G6_POSITIVE = """
+    import http.client
+    import urllib.request
+    from weaviate_tpu.cluster.transport import rpc
+
+    def call_peer(addr):
+        return rpc(addr, "/op", {"x": 1})                 # P1: no timeout
+
+    def raw_conn(host, port):
+        c = http.client.HTTPConnection(host, port)        # P2: no timeout
+        return c
+
+    def fetch(url):
+        with urllib.request.urlopen(url) as r:            # P3: no timeout
+            return r.read()
+"""
+
+G6_ALIASED_POSITIVE = """
+    import weaviate_tpu.cluster.transport as t
+
+    def call_peer(addr):
+        return t.rpc(addr, "/op", {})                     # aliased module
+"""
+
+G6_NEGATIVE = """
+    import http.client
+    import urllib.request
+    from weaviate_tpu.cluster.transport import rpc
+
+    def call_peer(addr, budget):
+        a = rpc(addr, "/op", {}, timeout=2.0)             # explicit
+        b = rpc(addr, "/op", {}, timeout=None)            # deliberate opt-in
+        return a, b
+
+    def raw_conn(host, port):
+        return http.client.HTTPConnection(host, port, timeout=5.0)
+
+    def fetch(url):
+        with urllib.request.urlopen(url, None, 10.0) as r:  # positional
+            return r.read()
+
+    def not_transport(client):
+        return client.rpc("/op")                          # unrelated .rpc
+"""
+
+
+def test_g6_flags_unbounded_boundaries(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/cluster/fx.py": G6_POSITIVE})
+    g6 = [v for v in res.violations if v.check == "G6"]
+    msgs = " | ".join(v.message for v in g6)
+    assert len(g6) == 3, msgs
+    assert "transport.rpc call without an explicit timeout" in msgs
+    assert "HTTPConnection constructed without timeout" in msgs
+    assert "urlopen without a timeout" in msgs
+
+
+def test_g6_resolves_module_alias(tmp_path):
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/cluster/fx.py": G6_ALIASED_POSITIVE})
+    assert [v.check for v in res.violations] == ["G6"]
+
+
+def test_g6_accepts_explicit_and_deliberate_none(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/cluster/fx.py": G6_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G6"] == []
+
+
+def test_g6_scope_is_production_tree_only(tmp_path):
+    """Serving-path discipline: tests/tools stay out of G6 scope (they
+    stub transports and probe dead ports on purpose)."""
+    res = lint_tree(tmp_path, {
+        "weaviate_tpu/cluster/fx.py": G6_POSITIVE,
+        "tests/test_fx.py": G6_POSITIVE,
+        "tools/fx.py": G6_POSITIVE,
+    })
+    assert {v.path for v in res.violations if v.check == "G6"} == \
+        {"weaviate_tpu/cluster/fx.py"}
+
+
+def test_g6_repo_baseline_names_only_reasoned_bootstrap_site():
+    """The ONE grandfathered G6 site is the gossip bootstrap join —
+    every serving-path transport call carries an explicit timeout."""
+    entries = [e for e in core.load_baseline(
+        core.default_baseline_path(REPO_ROOT)) if e["check"] == "G6"]
+    assert [e["path"] for e in entries] == \
+        ["weaviate_tpu/cluster/membership.py"]
+    assert "bootstrap" in entries[0]["reason"]
+
+
 # -- CLI ----------------------------------------------------------------------
 
 

@@ -1,0 +1,138 @@
+"""G6 timeout-discipline: no unbounded waits on cross-node boundaries.
+
+The faultline tentpole (ISSUE 8) made per-attempt timeouts derive from
+the request's remaining deadline budget INSIDE ``transport.rpc`` — but
+that only caps the explicit ceiling a call site passes. A call site
+that passes NO timeout silently rides the process-wide default, and the
+next person to raise that default for one slow path (a backup, a bulk
+sync) quietly raises it for every serving-path RPC too. This checker
+keeps the ceiling explicit at every boundary:
+
+- every call to ``transport.rpc`` (however imported/aliased) must carry
+  an explicit ``timeout=`` keyword — ``timeout=None`` is accepted (it
+  says "deadline budget + default" ON PURPOSE), absence is not;
+- raw ``http.client.HTTPConnection``/``HTTPSConnection`` constructions
+  must pass ``timeout=`` (a connection with no timeout blocks a thread
+  forever on a half-dead peer);
+- ``urllib.request.urlopen`` must pass ``timeout`` (keyword or third
+  positional) — module/vectorizer egress hangs are still thread leaks.
+
+Deliberately-unbounded call sites (bootstrap joins that predate any
+request deadline) are grandfathered in the baseline WITH a reason, per
+graftlint convention.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Checker, FileContext, Violation
+
+_TRANSPORT_MOD = "weaviate_tpu.cluster.transport"
+_CONN_NAMES = ("HTTPConnection", "HTTPSConnection")
+
+
+class TimeoutDisciplineChecker(Checker):
+    id = "G6"
+    name = "timeout-discipline"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py") and path.startswith("weaviate_tpu/")
+
+    def check(self, ctx: FileContext) -> list[Violation]:
+        rpc_names, mod_aliases = self._rpc_bindings(ctx.tree)
+        out: list[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_rpc_call(node, rpc_names, mod_aliases):
+                if not self._has_timeout_kw(node):
+                    out.append(self._violation(
+                        ctx, node,
+                        "transport.rpc call without an explicit "
+                        "timeout= — the per-attempt ceiling must be a "
+                        "visible decision at the call site (pass "
+                        "timeout=None to opt into deadline-budget + "
+                        "default deliberately)"))
+            elif self._is_conn_ctor(node):
+                if not self._has_timeout_kw(node):
+                    out.append(self._violation(
+                        ctx, node,
+                        "HTTPConnection constructed without timeout= — "
+                        "a half-dead peer parks this thread forever"))
+            elif self._is_urlopen(node):
+                # urlopen(url, data=None, timeout=...) — third
+                # positional is the timeout
+                if not self._has_timeout_kw(node) and len(node.args) < 3:
+                    out.append(self._violation(
+                        ctx, node,
+                        "urlopen without a timeout — external egress "
+                        "must not be able to hang a serving thread"))
+        return out
+
+    # -- name resolution ----------------------------------------------------
+
+    def _rpc_bindings(self, tree) -> tuple[set[str], set[str]]:
+        """Names bound to transport's ``rpc`` + aliases of the transport
+        module itself (``t.rpc(...)`` style)."""
+        rpc_names: set[str] = set()
+        mod_aliases: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == _TRANSPORT_MOD:
+                    for alias in node.names:
+                        if alias.name == "rpc":
+                            rpc_names.add(alias.asname or "rpc")
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == _TRANSPORT_MOD:
+                        mod_aliases.add(alias.asname
+                                        or _TRANSPORT_MOD.split(".")[0])
+        return rpc_names, mod_aliases
+
+    @staticmethod
+    def _is_rpc_call(call: ast.Call, rpc_names: set[str],
+                     mod_aliases: set[str]) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return f.id in rpc_names
+        if isinstance(f, ast.Attribute) and f.attr == "rpc":
+            # <alias>.rpc(...) or weaviate_tpu.cluster.transport.rpc(...)
+            parts = []
+            cur = f.value
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                parts.append(cur.id)
+                dotted = ".".join(reversed(parts))
+                return dotted in mod_aliases or dotted == _TRANSPORT_MOD \
+                    or (len(parts) == 1 and parts[0] in mod_aliases)
+        return False
+
+    @staticmethod
+    def _is_conn_ctor(call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            return f.attr in _CONN_NAMES
+        return isinstance(f, ast.Name) and f.id in _CONN_NAMES
+
+    @staticmethod
+    def _is_urlopen(call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            return f.attr == "urlopen"
+        return isinstance(f, ast.Name) and f.id == "urlopen"
+
+    @staticmethod
+    def _has_timeout_kw(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return True
+            if kw.arg is None:
+                return True  # **kwargs — can't see inside; don't guess
+        return False
+
+    def _violation(self, ctx, node, msg) -> Violation:
+        return Violation(self.id, ctx.path, node.lineno, node.col_offset,
+                         f"[timeout-discipline] {msg}")

@@ -37,6 +37,7 @@ import numpy as np
 
 from weaviate_tpu.api.grpc import v1_pb2 as pb
 from weaviate_tpu.native import dataplane as dpn
+from weaviate_tpu.runtime import degrade
 from weaviate_tpu.runtime.transfer import TransferPipeline
 
 logger = logging.getLogger(__name__)
@@ -259,29 +260,60 @@ class NativeDataPlane:
                                time.perf_counter() - t0)
             return
 
-        def _done(res, err, _t_fetch0, _t_fetch1, _batch=batch, _col=col,
-                  _shard=shard, _t0=t0):
-            if err is not None:
-                logger.error("pipelined batch failed", exc_info=err)
-                for tok in _batch.tokens.tolist():
-                    try:
-                        self.dp.post_raw(int(tok), b"", 13,
-                                         "internal error")
-                    except Exception:  # noqa: BLE001
-                        pass
-                return
+        def _fail_batch(_batch):
+            for tok in _batch.tokens.tolist():
+                try:
+                    self.dp.post_raw(int(tok), b"", 13, "internal error")
+                except Exception:  # noqa: BLE001
+                    pass
+
+        def _serve(res, _batch, _col, _shard, _t0):
             ids, dists, counts = res
             try:
                 self._finish_batch(_batch, _col, _shard, ids, dists,
                                    counts, time.perf_counter() - _t0)
+                if degrade.is_unhealthy("native_plane"):
+                    degrade.mark_healthy("native_plane")
             except Exception:  # noqa: BLE001 — clients must not hang
                 logger.exception("pipelined reply build failed")
-                for tok in _batch.tokens.tolist():
-                    try:
-                        self.dp.post_raw(int(tok), b"", 13,
-                                         "internal error")
-                    except Exception:  # noqa: BLE001
-                        pass
+                _fail_batch(_batch)
+
+        def _done(res, err, _t_fetch0, _t_fetch1, _batch=batch, _col=col,
+                  _shard=shard, _t0=t0):
+            if err is None:
+                _serve(res, _batch, _col, _shard, _t0)
+                return
+            # faulted device batch: retry ONCE through the sync path
+            # (queries are still host-resident), then error only THIS
+            # batch's waiters and flip the plane's unhealthy flag —
+            # visible in /v1/nodes until a batch serves again. The
+            # retry is a full device dispatch, so it leaves the
+            # transfer thread: blocking here would stall every other
+            # in-flight batch's D2H behind one faulted batch.
+            logger.warning("pipelined batch faulted; retrying once "
+                           "synchronously: %s", err)
+            from weaviate_tpu.runtime.metrics import (
+                native_dispatch_retries)
+
+            native_dispatch_retries.inc()
+
+            def _retry_path():
+                try:
+                    res2 = _shard.vector_search_batch(
+                        _batch.queries, int(_batch.ks.max()))
+                except Exception as e2:  # noqa: BLE001
+                    logger.error("pipelined batch failed after retry",
+                                 exc_info=e2)
+                    degrade.mark_unhealthy(
+                        "native_plane",
+                        f"batch dispatch failed twice: {err}; "
+                        f"retry: {e2}")
+                    _fail_batch(_batch)
+                    return
+                _serve(res2, _batch, _col, _shard, _t0)
+
+            threading.Thread(target=_retry_path, daemon=True,
+                             name="native-plane-fault-retry").start()
 
         self._transfer.submit(handle, _done)
 

@@ -359,18 +359,66 @@ class GrpcServer:
             except Exception:  # noqa: BLE001 — tests stub the context
                 md = {}
             force = md.get("x-trace") == "true"
+            # adopt the client's gRPC deadline as this request's budget:
+            # the contextvar propagates it down through the batcher,
+            # shard fan-out and every transport call
+            from weaviate_tpu.cluster.transport import CircuitOpenError
+            from weaviate_tpu.runtime import retry
+
+            budget = None
+            expired = False
+            try:
+                rem = context.time_remaining()
+                # no-deadline clients surface as None OR as a huge
+                # sentinel (grpc reports ~infinity); adopting that
+                # would overflow downstream waits — treat it as "no
+                # budget". A deadline that ALREADY elapsed in transit
+                # must abort now, not run the full search for a client
+                # gRPC has cancelled.
+                if rem is not None:
+                    if rem <= 0:
+                        expired = True
+                    elif rem < 86400.0 * 365:
+                        budget = rem
+            except Exception:  # noqa: BLE001 — tests stub the context
+                pass
+            if expired:
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              "deadline expired before handling began")
             try:
                 # auth precedes the trace: rejected clients must not be
                 # able to fill the debug-trace ring
                 self._check_auth(context, verb)
-                with tracing.trace(f"grpc.{rpc_name}", force=force):
-                    return fn(request, context)
+                from weaviate_tpu.runtime import degrade
+
+                with tracing.trace(f"grpc.{rpc_name}", force=force), \
+                        retry.deadline(budget), degrade.collecting():
+                    reply = fn(request, context)
+                    # a degraded (partial) answer must be visible on
+                    # the gRPC surface too: marker list rides trailing
+                    # metadata (protos carry no spare field for it)
+                    markers = degrade.snapshot()
+                    if markers:
+                        import json as _json
+
+                        try:
+                            context.set_trailing_metadata((
+                                ("x-degraded", _json.dumps(markers)),))
+                        except Exception:  # noqa: BLE001 — stubbed ctx
+                            pass
+                    return reply
             except ApiError as e:
                 context.abort(e.code, e.message)
             except KeyError as e:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             except ValueError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except retry.DeadlineExceeded as e:
+                # typed: the budget ran out mid-flight — not INTERNAL
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+            except (retry.OverloadedError, CircuitOpenError) as e:
+                # retriable overload / open breaker: clients back off
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except Exception as e:  # noqa: BLE001 — surface as INTERNAL
                 logger.exception("grpc handler failed")
                 context.abort(grpc.StatusCode.INTERNAL, str(e))

@@ -13,6 +13,7 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import os
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -23,9 +24,10 @@ from weaviate_tpu import __version__ as VERSION
 
 # Weaviate API level implemented (reference openapi-specs/schema.json)
 API_VERSION = "1.25.2"
+from weaviate_tpu.cluster.transport import CircuitOpenError
 from weaviate_tpu.db.shard import ShardReadOnlyError
 from weaviate_tpu.filters.filters import Filter
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import degrade, retry, tracing
 from weaviate_tpu.runtime.memwatch import InsufficientMemoryError
 from weaviate_tpu.schema.config import CollectionConfig, Property
 
@@ -357,11 +359,18 @@ class RestServer:
     def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
                  schema_target=None, node=None,
                  graphql_executor=_DEFAULT_GRAPHQL,
-                 modules=None, auth=None):
+                 modules=None, auth=None,
+                 query_deadline_s: float | None = None):
         self.db = db
         self.schema_target = schema_target or db
         self.node = node
         self.auth = auth  # AuthStack | None (None = open access)
+        # default request time budget (0 = none unless the client sends
+        # X-Request-Timeout / ?timeout=); propagated via retry.deadline
+        if query_deadline_s is None:
+            query_deadline_s = float(
+                os.environ.get("QUERY_DEADLINE_S", "0") or 0)
+        self.query_deadline_s = query_deadline_s
         if graphql_executor is RestServer._DEFAULT_GRAPHQL:
             from weaviate_tpu.api.graphql import GraphQLExecutor
 
@@ -405,6 +414,21 @@ class RestServer:
                 else:
                     trace_cm = tracing.trace(f"rest.{method} /{route}",
                                              force=force)
+                # request time budget: explicit header/param wins, else
+                # the server default; 0/absent = no deadline. The budget
+                # propagates down through the batcher, shard fan-out and
+                # every transport call (retry.remaining caps per-attempt
+                # timeouts), so a retry can never outlive the request.
+                budget = outer.query_deadline_s
+                try:
+                    raw_budget = self.headers.get("X-Request-Timeout") \
+                        or params.get("timeout")
+                    if raw_budget:
+                        budget = float(raw_budget)
+                except ValueError:
+                    budget = outer.query_deadline_s
+                extra_headers: dict[str, str] = {}
+                markers: list = []
                 try:
                     if outer.auth is not None and \
                             not parsed.path.startswith("/.well-known"):
@@ -425,10 +449,17 @@ class RestServer:
                             raise ApiError(401, str(e))
                         except ForbiddenError as e:
                             raise ApiError(403, str(e))
-                    with trace_cm:
+                    with trace_cm, retry.deadline(budget), \
+                            degrade.collecting():
                         body = json.loads(raw) if raw else None
                         status, payload = outer.dispatch(
                             method, parsed.path, params, body)
+                        # explicit partial-result marker: a degraded
+                        # scatter-gather or downgraded-consistency read
+                        # must be visible to the client, not silent
+                        markers = degrade.snapshot()
+                        if markers and isinstance(payload, dict):
+                            payload["degraded"] = markers
                 except ApiError as e:
                     status, payload = e.status, {"error": [{"message": e.message}]}
                 except (KeyError, FileNotFoundError) as e:
@@ -449,6 +480,35 @@ class RestServer:
                         "budgetBytes": e.budget,
                         "usageSource": e.source,
                     }]}
+                except retry.DeadlineExceeded as e:
+                    # typed 504: the request's time budget ran out — not
+                    # a generic 500, so clients/gateways can distinguish
+                    # "took too long" from "broke"
+                    status, payload = 504, {"error": [{
+                        "message": str(e),
+                        "code": "DEADLINE_EXCEEDED",
+                        "layer": e.layer,
+                    }]}
+                except retry.OverloadedError as e:
+                    # RFC 9110: integer delta-seconds (fractions would
+                    # be ignored by conforming clients), floor of 1
+                    extra_headers["Retry-After"] = \
+                        str(max(1, -(-int(e.retry_after_s * 1000) // 1000)))
+                    status, payload = 503, {"error": [{
+                        "message": str(e),
+                        "code": "OVERLOADED",
+                    }]}
+                except CircuitOpenError as e:
+                    # the whole request depended on a peer whose breaker
+                    # is open (e.g. an unreplicated remote shard write):
+                    # retriable 503 with the breaker's cooldown hint
+                    # (integer delta-seconds per RFC 9110, floor of 1)
+                    extra_headers["Retry-After"] = \
+                        str(max(1, -(-int(e.retry_after_s * 1000) // 1000)))
+                    status, payload = 503, {"error": [{
+                        "message": str(e),
+                        "code": "CIRCUIT_OPEN",
+                    }]}
                 except Exception as e:
                     logger.exception("REST %s %s failed", method, self.path)
                     status, payload = 500, {"error": [{"message": str(e)}]}
@@ -465,6 +525,8 @@ class RestServer:
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for hk, hv in extra_headers.items():
+                    self.send_header(hk, hv)
                 self.end_headers()
                 if method != "HEAD":
                     self.wfile.write(data)
@@ -955,11 +1017,17 @@ class RestServer:
 
             from weaviate_tpu.runtime.hbm_ledger import ledger
 
+            local_health = degrade.health()
             for n in nodes:
                 if n["name"] == self.db.local_node:
                     n["stats"] = {**(n.get("stats") or {}),
                                   "deviceMemory": device_memory_stats(),
                                   "hbmLedgerBytes": ledger.total_bytes()}
+                    # component health (degrade registry): a faulted
+                    # batcher/native-plane dispatch path flips this
+                    n["health"] = local_health
+                    if not local_health["healthy"]:
+                        n["status"] = "UNHEALTHY"
                     if verbose:
                         # shard details are known for THIS node (remote
                         # breakdowns would need an RPC fan-out, as in the
@@ -973,8 +1041,12 @@ class RestServer:
         from weaviate_tpu.runtime.hbm_ledger import ledger
         from weaviate_tpu.runtime.memwatch import device_memory_stats
 
-        node = {"name": self.db.local_node, "status": "HEALTHY",
+        local_health = degrade.health()
+        node = {"name": self.db.local_node,
+                "status": "HEALTHY" if local_health["healthy"]
+                else "UNHEALTHY",
                 "version": VERSION,
+                "health": local_health,
                 "stats": {"shardCount": shard_count,
                           "objectCount": object_count,
                           "deviceMemory": device_memory_stats(),

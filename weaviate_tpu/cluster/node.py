@@ -29,16 +29,20 @@ class ClusterNode:
                  host: str = "127.0.0.1", port: int = 0, mesh=None,
                  gossip_interval: float = 0.3,
                  election_timeout: tuple[float, float] = (0.3, 0.6),
-                 advertise: str | None = None):
+                 advertise: str | None = None,
+                 remote_timeout: float | None = None):
         """``raft_peers``: the static bootstrap member set (node names,
         incl. this one) — reference: RAFT_JOIN env (cluster/bootstrap).
         ``advertise``: host:port other nodes reach this one at (container
-        deployments bind 0.0.0.0 and advertise their service name)."""
+        deployments bind 0.0.0.0 and advertise their service name).
+        ``remote_timeout``: per-attempt ceiling for remote shard ops
+        (None = REMOTE_RPC_TIMEOUT_S / 30s; always deadline-capped)."""
         self.name = name
         self.server = InternalServer(host, port, advertise=advertise)
         self.membership = Membership(name, self.server,
                                      interval=gossip_interval)
-        self.remote = RemoteShardClient(self.membership.resolve)
+        self.remote = RemoteShardClient(self.membership.resolve,
+                                        timeout=remote_timeout)
         self.db = Database(data_dir, mesh=mesh, local_node=name,
                            remote=self.remote,
                            nodes_provider=self.membership.alive_nodes)
@@ -90,7 +94,8 @@ class ClusterNode:
         return did
 
     def serve_rest(self, host: str = "127.0.0.1", port: int = 0,
-                   modules=None, auth=None):
+                   modules=None, auth=None,
+                   query_deadline_s: float | None = None):
         """Start the public /v1 REST API for this node (schema writes
         take the Raft path; reads/writes hit the local Database which
         scatter-gathers as needed). ``modules``/``auth`` pass through to
@@ -106,7 +111,8 @@ class ClusterNode:
             register_backup_handlers(self.server, self.db, lambda: modules)
         self.rest = RestServer(self.db, host=host, port=port,
                                schema_target=self, node=self,
-                               modules=modules, auth=auth)
+                               modules=modules, auth=auth,
+                               query_deadline_s=query_deadline_s)
         self.rest.start()
         return self.rest
 

@@ -15,31 +15,69 @@ ops: search | objects (batch put) | object:get | object:delete |
 from __future__ import annotations
 
 import logging
+import os
 
 import numpy as np
 
-from weaviate_tpu.cluster.transport import rpc
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.cluster.transport import RpcError, rpc
+from weaviate_tpu.runtime import faultline, tracing
+from weaviate_tpu.runtime.retry import RetryPolicy
 from weaviate_tpu.storage.objects import StorageObject
 
 logger = logging.getLogger(__name__)
+
+def default_timeout_s() -> float:
+    """Fallback per-attempt timeout for remote shard ops — used to be a
+    hard-coded 30.0 in the constructor. Server-managed clients receive
+    ``ServerConfig.remote_rpc_timeout_s`` explicitly (the CONFIG_FILE
+    overlay applies there); this env read is the fallback for directly
+    constructed clients, evaluated lazily so it is not frozen at import
+    time. Like every transport call, the ceiling is additionally capped
+    by the request's remaining deadline budget inside ``rpc``."""
+    return float(os.environ.get("REMOTE_RPC_TIMEOUT_S", "30"))
+
+#: ops safe to retry: reads and existence probes. Writes stay
+#: single-shot — the replication layer owns write-failure semantics
+#: (2PC abort + anti-entropy), and a blind transport retry of a put
+#: could double-apply side effects the coordinator already accounted
+_IDEMPOTENT_OPS = frozenset({
+    "search", "object:get", "objects:get", "objects:list",
+    "object:exists", "aggregate", "overview",
+})
 
 
 class RemoteShardClient:
     """Client side: every method targets one shard on one node
     (reference: sharding.RemoteIndexClient)."""
 
-    def __init__(self, resolver, timeout: float = 30.0):
+    def __init__(self, resolver, timeout: float | None = None):
         self.resolver = resolver  # node name -> "host:port"
-        self.timeout = timeout
+        self.timeout = default_timeout_s() if timeout is None else timeout
+        self.retry = RetryPolicy(op="remote.shard_op")
 
     def _call(self, node: str, collection: str, shard: str, op: str,
               payload: dict) -> dict:
         with tracing.span("remote.shard_op", op=op, node=node,
                           shard=shard):
-            return rpc(self.resolver(node),
-                       f"/indices/{collection}/{shard}/{op}", payload,
-                       timeout=self.timeout)
+            def attempt():
+                # fault point INSIDE the attempt and mapped to RpcError:
+                # an injected fault takes the exact path a real one
+                # would — through the retry policy, replica failover,
+                # and degraded-read handling (retries count as separate
+                # schedule calls, like every transport-level point)
+                try:
+                    faultline.fire("remote.shard_op", op=op, node=node,
+                                   shard=shard)
+                except faultline.FaultInjected as e:
+                    raise RpcError(
+                        f"remote {op} on {node}/{shard} failed: {e}") from e
+                return rpc(self.resolver(node),
+                           f"/indices/{collection}/{shard}/{op}", payload,
+                           timeout=self.timeout)
+
+            if op in _IDEMPOTENT_OPS:
+                return self.retry.call(attempt)
+            return attempt()
 
     def search_shard(self, node: str, collection: str, shard: str, *,
                      vector=None, k: int = 10, vec_name: str = "",

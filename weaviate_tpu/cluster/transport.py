@@ -18,13 +18,15 @@ import http.client
 import io
 import json
 import logging
+import os
 import socket
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import faultline, retry, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -195,42 +197,272 @@ class InternalServer:
 
 
 class RpcError(RuntimeError):
+    #: True when the failure was a per-attempt timeout: the call already
+    #: burned its full time ceiling, so the retry policy treats it as
+    #: terminal (failover handles it) instead of burning another ceiling
+    timed_out = False
+
     def __init__(self, message: str, status: int = 0):
         super().__init__(message)
         self.status = status
 
 
-def rpc(addr: str, path: str, payload=None, timeout: float = 10.0):
+class CircuitOpenError(RpcError):
+    """Fail-fast refusal: the peer's breaker is open. Subclasses
+    RpcError so every existing per-replica failure handler treats it
+    like the dead peer it represents — without paying the dead peer's
+    timeout. Carries the breaker's retry hint."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message, status=503)
+        self.retry_after_s = retry_after_s
+
+
+# -- per-peer circuit breakers -------------------------------------------------
+
+#: consecutive transport-level failures before a peer's circuit opens
+CB_THRESHOLD = int(os.environ.get("WEAVIATE_TPU_CB_THRESHOLD", "5"))
+#: seconds an open circuit refuses calls before allowing ONE half-open
+#: probe through
+CB_COOLDOWN_S = float(os.environ.get("WEAVIATE_TPU_CB_COOLDOWN_S", "2.0"))
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half-open", "open"
+_STATE_VALUE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+class CircuitBreaker:
+    """closed -> (N consecutive transport failures) -> open ->
+    (cooldown) -> half-open: one probe call goes through; success closes
+    the circuit, failure re-opens it for another cooldown. Only
+    TRANSPORT-level failures count — an HTTP error status proves the
+    peer is alive and must reset the streak."""
+
+    def __init__(self, peer: str, threshold: int | None = None,
+                 cooldown_s: float | None = None):
+        self.peer = peer
+        self.threshold = CB_THRESHOLD if threshold is None else threshold
+        self.cooldown_s = CB_COOLDOWN_S if cooldown_s is None else cooldown_s
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed right now? In OPEN past the cooldown,
+        exactly one caller wins the half-open probe; everyone else keeps
+        failing fast until the probe reports."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN \
+                    and time.monotonic() - self._opened_at >= self.cooldown_s:
+                self._transition(HALF_OPEN)
+            if self._state == HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def retry_after_s(self) -> float:
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(0.0,
+                       self.cooldown_s - (time.monotonic() - self._opened_at))
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == HALF_OPEN:
+                self._probing = False
+                self._transition(OPEN)
+            elif self._state == CLOSED and self._failures >= self.threshold:
+                self._transition(OPEN)
+
+    def release_probe(self) -> None:
+        """Give back a half-open probe slot WITHOUT recording an
+        outcome — for exceptions that escape ``rpc`` between ``allow``
+        and the record calls (not transport evidence either way). A
+        leaked slot would otherwise wedge the peer in fail-fast
+        forever."""
+        with self._lock:
+            self._probing = False
+
+    def _transition(self, to: str) -> None:
+        """Caller holds ``_lock``."""
+        self._state = to
+        if to == OPEN:
+            self._opened_at = time.monotonic()
+        try:
+            from weaviate_tpu.runtime.metrics import (circuit_state,
+                                                      circuit_transitions_total)
+
+            circuit_state.labels(self.peer).set(_STATE_VALUE[to])
+            circuit_transitions_total.labels(self.peer, to).inc()
+        except Exception:  # pragma: no cover
+            pass
+
+
+_breaker_lock = threading.Lock()
+_breakers: dict[str, CircuitBreaker] = {}
+
+
+def breaker_for(addr: str) -> CircuitBreaker:
+    # lock-free fast path (benign race, same pattern as
+    # degrade.is_unhealthy): every data-plane rpc() calls this, and a
+    # process-wide mutex just to read an existing dict entry would be
+    # avoidable fan-out contention. The lock only guards first-insert.
+    br = _breakers.get(addr)
+    if br is not None:
+        return br
+    with _breaker_lock:
+        br = _breakers.get(addr)
+        if br is None:
+            br = _breakers[addr] = CircuitBreaker(addr)
+        return br
+
+
+def reset_breakers() -> None:
+    """Test hook: forget every peer's breaker state (OS-assigned ports
+    get reused across in-process test clusters; a previous cluster's
+    open circuit must not poison the next one's fresh node)."""
+    with _breaker_lock:
+        for addr in list(_breakers):
+            try:
+                from weaviate_tpu.runtime.metrics import circuit_state
+
+                circuit_state.remove(addr)
+            except Exception:  # pragma: no cover
+                pass
+            del _breakers[addr]
+
+
+#: control-plane prefixes exempt from the circuit breaker: raft and
+#: gossip ARE the cluster's failure detectors — their probes must keep
+#: flowing to notice recovery (a raft heartbeat doubles as the
+#: half-open probe), and the connection storm against a peer that has
+#: not bound its port yet during cluster boot must not open the
+#: breaker that then fail-fasts DATA-plane calls to the same address
+BREAKER_EXEMPT_PREFIXES = ("/raft/", "/cluster/")
+
+#: default per-attempt timeout when a call site passes none explicitly
+#: (graftlint G6 keeps serving-path call sites explicit)
+RPC_DEFAULT_TIMEOUT_S = float(os.environ.get("RPC_DEFAULT_TIMEOUT_S", "10"))
+
+
+def rpc(addr: str, path: str, payload=None, timeout: float | None = None):
     """POST ``payload`` to http://addr/path; raises RpcError on transport
     or handler failure. Inside a trace the call carries a ``traceparent``
-    header and absorbs the remote node's exported spans on return."""
+    header and absorbs the remote node's exported spans on return.
+
+    Failure policy (the faultline tentpole): the per-attempt ``timeout``
+    is capped by the request's remaining deadline budget (an RPC never
+    gets more time than its request has left; an exhausted budget raises
+    the TYPED ``retry.DeadlineExceeded``); every transport-level failure
+    — connection, socket timeout, malformed/incomplete HTTP, corrupt
+    payload — maps to ``RpcError`` and feeds ``addr``'s circuit breaker;
+    an open breaker fails fast with ``CircuitOpenError`` so a dead peer
+    stops eating the deadline budget of every request that fans out to
+    it."""
+    if timeout is None:
+        timeout = RPC_DEFAULT_TIMEOUT_S
+    timeout = retry.budget_timeout(timeout, layer="transport.rpc")
     host, _, port = addr.partition(":")
+    # serialize BEFORE the breaker check: a caller-side encoding bug
+    # must not consume (and then leak) a half-open probe slot
     body = dumps(payload or {})
     headers = {"Content-Type": "application/json"}
-    with tracing.span("rpc.client", addr=addr, path=path) as sp:
-        tp = tracing.current_traceparent()
-        if tp is not None:
-            headers["traceparent"] = tp
-        try:
-            conn = http.client.HTTPConnection(host, int(port),
-                                              timeout=timeout)
+    breaker = None if path.startswith(BREAKER_EXEMPT_PREFIXES) \
+        else breaker_for(addr)
+    if breaker is not None and not breaker.allow():
+        raise CircuitOpenError(
+            f"rpc to {addr}{path} refused: circuit open "
+            f"({breaker._failures} consecutive failures)",
+            retry_after_s=breaker.retry_after_s())
+    recorded = False
+    try:
+        with tracing.span("rpc.client", addr=addr, path=path) as sp:
+            tp = tracing.current_traceparent()
+            if tp is not None:
+                headers["traceparent"] = tp
             try:
-                conn.request("POST", path, body=body, headers=headers)
-                resp = conn.getresponse()
-                raw = resp.read()
-                remote_spans = _decode_spans(
-                    resp.getheader(TRACE_SPANS_HEADER))
-            finally:
-                conn.close()
-        except (ConnectionError, socket.timeout, OSError) as e:
-            raise RpcError(f"rpc to {addr}{path} failed: {e}") from e
-        if remote_spans:
-            tracing.absorb(remote_spans,
-                           base_ms=getattr(sp, "start_ms", 0.0))
-        result = loads(raw)
-        if resp.status != 200:
-            raise RpcError(
-                result.get("error", f"status {resp.status}")
-                if isinstance(result, dict) else f"status {resp.status}",
-                status=resp.status)
-        return result
+                directive = faultline.fire("transport.rpc.send", addr=addr,
+                                           path=path)
+                conn = http.client.HTTPConnection(host, int(port),
+                                                  timeout=timeout)
+                try:
+                    conn.request("POST", path, body=body, headers=headers)
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                    remote_spans = _decode_spans(
+                        resp.getheader(TRACE_SPANS_HEADER))
+                finally:
+                    conn.close()
+                if directive == "drop":
+                    # the request REACHED the peer (its handler ran); the
+                    # reply is lost on the way back — the 2PC "prepare
+                    # landed, ack lost" scenario a refused connection
+                    # can't produce
+                    raise FaultDropped(
+                        f"rpc reply from {addr}{path} dropped")
+                if directive == "corrupt":
+                    raw = b"\x00corrupt\xff" + raw[:8]
+            except (ConnectionError, socket.timeout, OSError,
+                    http.client.HTTPException, FaultDropped,
+                    faultline.FaultInjected) as e:
+                # http.client.HTTPException covers the half-dead-peer
+                # modes (IncompleteRead, BadStatusLine, ...) that used
+                # to escape as raw exceptions instead of RpcError
+                if breaker is not None:
+                    breaker.record_failure()
+                    recorded = True
+                err = RpcError(f"rpc to {addr}{path} failed: {e}")
+                err.timed_out = isinstance(e, (socket.timeout,
+                                               TimeoutError))
+                raise err from e
+            try:
+                result = loads(raw)
+            except (ValueError, UnicodeDecodeError) as e:
+                # a garbled/truncated body is a wire-level failure too:
+                # it feeds the breaker like the half-dead-peer modes
+                if breaker is not None:
+                    breaker.record_failure()
+                    recorded = True
+                raise RpcError(f"rpc to {addr}{path} returned a corrupt "
+                               f"payload: {e}") from e
+            if breaker is not None:
+                breaker.record_success()
+                recorded = True
+            if remote_spans:
+                tracing.absorb(remote_spans,
+                               base_ms=getattr(sp, "start_ms", 0.0))
+            if resp.status != 200:
+                raise RpcError(
+                    result.get("error", f"status {resp.status}")
+                    if isinstance(result, dict) else f"status {resp.status}",
+                    status=resp.status)
+            return result
+    finally:
+        # an exception that escaped between allow() and the record calls
+        # (a custom faultline error=, a tracing bug) is not transport
+        # evidence either way — but the probe slot it may hold must be
+        # returned or the peer wedges in fail-fast forever
+        if breaker is not None and not recorded:
+            breaker.release_probe()
+
+
+class FaultDropped(Exception):
+    """Internal marker for faultline's ``drop`` directive (never escapes
+    ``rpc`` — mapped to RpcError like the timeout it simulates)."""

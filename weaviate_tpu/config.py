@@ -33,6 +33,14 @@ def _int(env, name: str, default: int) -> int:
         raise ValueError(f"{name} must be an integer, got {raw!r}")
 
 
+def _float(env, name: str, default: float) -> float:
+    raw = env.get(name)
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        raise ValueError(f"{name} must be a number, got {raw!r}")
+
+
 def _fraction(env, name: str, default: float) -> float:
     raw = env.get(name)
     if not raw:
@@ -85,6 +93,14 @@ class ServerConfig:
     hbm_device_limit_bytes: int = 0  # 0 = allocator-reported / unlimited
     hbm_high_watermark: float = 0.9
     hbm_low_watermark: float = 0.8
+    # failure policy (runtime/retry.py + cluster/transport.py):
+    # remote_rpc_timeout_s replaces cluster/remote.py's hard-coded 30s
+    # per-attempt ceiling; query_deadline_s is the default request time
+    # budget opened at the REST edge (0 = none unless the client sends
+    # X-Request-Timeout), propagated down through the batcher, shard
+    # fan-out and every transport call
+    remote_rpc_timeout_s: float = 30.0
+    query_deadline_s: float = 0.0
     # backups
     backup_filesystem_path: str = ""
 
@@ -119,6 +135,8 @@ class ServerConfig:
             hbm_device_limit_bytes=_int(env, "HBM_DEVICE_LIMIT_BYTES", 0),
             hbm_high_watermark=_fraction(env, "HBM_HIGH_WATERMARK", 0.9),
             hbm_low_watermark=_fraction(env, "HBM_LOW_WATERMARK", 0.8),
+            remote_rpc_timeout_s=_float(env, "REMOTE_RPC_TIMEOUT_S", 30.0),
+            query_deadline_s=_float(env, "QUERY_DEADLINE_S", 0.0),
             backup_filesystem_path=env.get("BACKUP_FILESYSTEM_PATH", ""),
         )
         path = env.get("CONFIG_FILE", "")

@@ -19,6 +19,7 @@ import numpy as np
 
 from weaviate_tpu.db.shard import Shard
 from weaviate_tpu.db.sharding import ShardingState
+from weaviate_tpu.runtime import degrade
 from weaviate_tpu.runtime import metrics as monitoring
 from weaviate_tpu.runtime import tracing
 from weaviate_tpu.schema.config import CollectionConfig
@@ -288,6 +289,45 @@ class Collection:
         if self._is_local(shard_name):
             return self.local_node
         return self.sharding.nodes_for(shard_name)[0]
+
+    def _remote_search_degraded(self, shard_name: str, **kwargs):
+        """Remote-shard scatter leg with replica failover and graceful
+        degradation: try each placed replica in read-preference order
+        (the per-peer circuit breaker makes a known-dead node cost ~0
+        deadline budget); when every replica is unreachable, return
+        ``None`` — the shard contributes NOTHING, the query still
+        answers, and an explicit ``missing_shard`` marker rides the
+        response (surfaced by the REST edge + the degraded counter)
+        instead of the whole-query failure a single dead replica used
+        to cause."""
+        from weaviate_tpu.cluster.transport import RpcError
+
+        remote = self._require_remote(shard_name)
+        nodes = [n for n in self.sharding.nodes_for(shard_name)
+                 if n != self.local_node]
+        last: Exception | None = None
+        for i, node in enumerate(nodes):
+            try:
+                items = remote.search_shard(node, self.config.name,
+                                            shard_name, **kwargs)
+            except RpcError as e:
+                last = e
+                # NOT a degraded marker: if a later replica serves, the
+                # answer is complete — failover is an implementation
+                # detail, and marking it partial would make clients
+                # distrust full results
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "replica %s failed for %s/%s, failing over: %s",
+                    node, self.config.name, shard_name, e)
+                continue
+            return items
+        degrade.report("missing_shard", collection=self.config.name,
+                       shard=shard_name,
+                       detail=str(last) if last is not None
+                       else "no reachable replica")
+        return None
 
     def _target_shard_names(self, tenant: str | None,
                             kind: str = "read") -> list[str]:
@@ -758,9 +798,20 @@ class Collection:
                     for r in rs:
                         r.object = shard.get_object(r.uuid)
                 else:
-                    raws = self._require_remote(name).get_objects(
-                        self._read_node(name), self.config.name, name,
-                        [r.uuid for r in rs])
+                    from weaviate_tpu.cluster.transport import RpcError
+
+                    try:
+                        raws = self._require_remote(name).get_objects(
+                            self._read_node(name), self.config.name, name,
+                            [r.uuid for r in rs])
+                    except RpcError as e:
+                        # the replica died between search and property
+                        # fetch: serve the ids/distances we have with a
+                        # degraded marker rather than failing the query
+                        degrade.report("objects_unavailable",
+                                       collection=self.config.name,
+                                       shard=name, detail=str(e))
+                        continue
                     for r, raw in zip(rs, raws):
                         r.object = StorageObject.from_bytes(raw) \
                             if raw else None
@@ -846,12 +897,14 @@ class Collection:
                                                 shard=name))
                 return out
             # remote shard: the owning node evaluates filters and resolves
-            # objects (reference: remote.SearchShard, index.go:1607)
-            items = self._require_remote(name).search_shard(
-                self._read_node(name), self.config.name, name,
-                vector=query, k=k, vec_name=vec_name,
+            # objects (reference: remote.SearchShard, index.go:1607);
+            # replica failover + degraded (partial) results on total loss
+            items = self._remote_search_degraded(
+                name, vector=query, k=k, vec_name=vec_name,
                 where=where.to_dict() if where is not None else None,
                 include_objects=include_objects)
+            if items is None:
+                return []
             return [_remote_result(i, name) for i in items]
 
         gathered = [one(names[0])] if len(names) == 1 else \
@@ -894,11 +947,12 @@ class Collection:
                         out.append(SearchResult(uuid=uuid, score=score,
                                                 shard=name))
                 return out
-            items = self._require_remote(name).search_shard(
-                self._read_node(name), self.config.name, name,
-                query=query, k=k, properties=properties,
+            items = self._remote_search_degraded(
+                name, query=query, k=k, properties=properties,
                 where=where.to_dict() if where is not None else None,
                 include_objects=include_objects)
+            if items is None:
+                return []
             return [_remote_result(i, name) for i in items]
 
         gathered = [one(names[0])] if len(names) == 1 else \

@@ -12,7 +12,7 @@ import logging
 
 from weaviate_tpu.cluster.transport import RpcError, rpc
 from weaviate_tpu.replication.replicator import ConsistencyError, required_acks
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import degrade, tracing
 from weaviate_tpu.storage.objects import StorageObject
 
 logger = logging.getLogger(__name__)
@@ -81,9 +81,22 @@ class Finder:
             except (RpcError, KeyError) as e:
                 errors.append(f"{node}: {e}")
         if len(digests) < need:
-            raise ConsistencyError(
-                f"{len(digests)}/{len(nodes)} replicas answered, need "
-                f"{need} for {level}: {'; '.join(errors)}")
+            # degraded read (ONE/QUORUM): the level is unreachable but
+            # SOME replica answered — serve its best-known value with an
+            # explicit downgraded-consistency marker rather than failing
+            # the whole read. ALL stays strict: the caller demanded
+            # every replica by name and gets the typed error.
+            if digests and level != "ALL":
+                degrade.report(
+                    "consistency_downgraded",
+                    collection=self.col.config.name, shard=shard_name,
+                    detail=f"{len(digests)}/{len(nodes)} replicas "
+                           f"answered, need {need} for {level}: "
+                           f"{'; '.join(errors)}")
+            else:
+                raise ConsistencyError(
+                    f"{len(digests)}/{len(nodes)} replicas answered, need "
+                    f"{need} for {level}: {'; '.join(errors)}")
 
         # winner by digest_rank: newest mtime, tombstone beats object at
         # a tie, content hash as the deterministic tie-break
@@ -103,7 +116,34 @@ class Finder:
                 self._repair(node, shard_name, None,
                              {"uuid": uuid, "mtime": winner["mtime"]})
             return None
-        raw = self._fetch(winner_node, shard_name, uuid)
+        raw = None
+        # the winner can die between digest and fetch: fail over to the
+        # remaining answering replicas (rank order) with a staleness
+        # marker instead of failing the read
+        candidates = sorted(seen, key=lambda n: digest_rank(seen[n]),
+                            reverse=True)
+        for i, node in enumerate(candidates):
+            try:
+                raw = self._fetch(node, shard_name, uuid)
+            except RpcError as e:
+                if i == len(candidates) - 1:
+                    # EVERY answering replica failed the fetch: this is
+                    # unavailability, not nonexistence — the digests just
+                    # proved the object exists. A degraded read may
+                    # downgrade consistency; it must never invent a 404
+                    # (a caller doing read-then-recreate would clobber
+                    # the surviving copies).
+                    raise ConsistencyError(
+                        f"object fetch failed on every answering replica "
+                        f"({', '.join(candidates)}) for {uuid}: {e}") from e
+                degrade.report("missing_replica",
+                               collection=self.col.config.name,
+                               shard=shard_name, node=node,
+                               detail=f"fetch failed: {e}")
+                continue
+            if node != winner_node:
+                stale = [n for n in stale if n != node]
+            break
         if raw is None:
             return None
         if stale:

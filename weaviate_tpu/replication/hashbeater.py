@@ -22,6 +22,9 @@ class HashBeater:
         self.depth = depth
 
     def _peer_rpc(self, node: str, shard_name: str, op: str, payload: dict):
+        # per-attempt ceiling = the shared remote-client config
+        # (REMOTE_RPC_TIMEOUT_S, no longer a hard-coded 30s); rpc()
+        # additionally caps it by any ambient deadline budget
         remote = self.col._require_remote(shard_name)
         return rpc(remote.resolver(node),
                    f"/replicas/{self.col.config.name}/{shard_name}/{op}",

@@ -15,7 +15,7 @@ import threading
 import uuid as uuid_mod
 
 from weaviate_tpu.cluster.transport import RpcError, rpc
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import faultline, tracing
 from weaviate_tpu.storage.objects import StorageObject
 
 logger = logging.getLogger(__name__)
@@ -50,6 +50,9 @@ class Replicator:
         return self.col._load_shard(shard_name)
 
     def _prepare(self, node: str, shard_name: str, rid: str, task: tuple) -> None:
+        # faultline: fires for LOCAL replicas too — a coordinator whose
+        # own replica faults mid-prepare must abort like any other
+        faultline.fire("replication.prepare", node=node, shard=shard_name)
         if node == self.col.local_node:
             self._shard_local(shard_name).stage(rid, task)
             return
@@ -62,6 +65,7 @@ class Replicator:
         self._rpc(node, shard_name, "prepare", payload)
 
     def _commit(self, node: str, shard_name: str, rid: str):
+        faultline.fire("replication.commit", node=node, shard=shard_name)
         if node == self.col.local_node:
             return self._shard_local(shard_name).commit_staged(rid)
         # unwrap so local and remote commits return the same shape

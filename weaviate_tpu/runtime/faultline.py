@@ -1,0 +1,234 @@
+"""faultline: deterministic fault injection at named boundaries.
+
+Every cross-node and cross-device boundary in this codebase has a
+failure story only if it can be MADE to fail on demand. This registry
+names those boundaries as fault points; production code calls
+``fire("point", ...)`` at each one. Disarmed (the default, and the only
+state outside tests/the chaos harness) that call is a read of one module
+global and an immediate return — nothing allocates, nothing locks, no
+schedule lookup happens, so the serving hot path pays a single
+predictable branch (the ``served_pipeline`` bench band is the proof).
+
+Armed, a fault point executes a DETERMINISTIC schedule — "fail the 3rd
+call", "every 4th call", "calls 2 and 5", or a seeded Bernoulli draw —
+so a chaos test that fails replays bit-for-bit from its seed. Supported
+actions:
+
+- ``error``    raise (default ``FaultInjected``; sites map it to their
+               domain error exactly like a real failure)
+- ``latency``  sleep ``latency_s`` then continue
+- ``drop``     returned as a directive: the site completes the send but
+               discards the reply (the 2PC "prepare landed, ack lost"
+               scenario a timeout alone cannot produce)
+- ``corrupt``  returned as a directive: the site damages the payload
+               (transport garbles the response body; kv flips bytes)
+
+Every injection bumps ``weaviate_tpu_fault_injected_total{point,action}``
+and annotates the active trace span, so a chaos run can assert that the
+metrics/span plumbing accounts for every fault it scheduled.
+
+Known fault points (grep for ``faultline.fire`` to verify):
+
+==========================  ==================================================
+point                       boundary
+==========================  ==================================================
+``transport.rpc.send``      every intra-cluster HTTP RPC (cluster/transport)
+``remote.shard_op``         RemoteShardClient data-plane ops (cluster/remote)
+``replication.prepare``     2PC prepare, per replica (incl. local short-circuit)
+``replication.commit``      2PC commit, per replica (incl. local short-circuit)
+``kv.get_many``             batched LSM point lookups (storage/kv)
+``transfer.d2h``            the sanctioned device->host fetch (runtime/transfer)
+``batcher.dispatch``        one coalesced device dispatch (runtime/query_batcher)
+==========================  ==================================================
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from contextlib import contextmanager
+
+#: the disarmed fast path: ``fire`` checks this plain module global
+#: before touching anything else. Only arm/disarm mutate it (under
+#: ``_lock``); readers tolerate the benign race — a site racing a
+#: concurrent arm() simply misses the very first scheduled call.
+_ARMED = False
+
+_lock = threading.Lock()
+_schedules: dict[str, list["Schedule"]] = {}
+
+KNOWN_POINTS = frozenset({
+    "transport.rpc.send",
+    "remote.shard_op",
+    "replication.prepare",
+    "replication.commit",
+    "kv.get_many",
+    "transfer.d2h",
+    "batcher.dispatch",
+})
+
+_ACTIONS = ("error", "latency", "drop", "corrupt")
+
+
+class FaultInjected(RuntimeError):
+    """The default injected failure. Sites catch it alongside their real
+    transport/IO errors so an injected fault takes the exact code path a
+    real one would."""
+
+    def __init__(self, point: str, message: str = ""):
+        super().__init__(message or f"faultline: injected fault at {point}")
+        self.point = point
+
+
+class Schedule:
+    """One armed fault: which calls at a point fire, and what happens.
+
+    Deterministic by construction — matching depends only on the call
+    index (``nth``/``every``/explicit sets) or on a ``random.Random(seed)``
+    stream, never on wall time or thread identity."""
+
+    __slots__ = ("point", "action", "nth", "every", "p", "latency_s",
+                 "times", "error", "match", "calls", "injected", "_rng")
+
+    def __init__(self, point: str, action: str = "error", *,
+                 nth: int | tuple | list | set | None = None,
+                 every: int | None = None, p: float | None = None,
+                 seed: int = 0, latency_s: float = 0.0,
+                 times: int | None = None, error=None, match=None):
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r}; "
+                             f"expected one of {_ACTIONS}")
+        self.point = point
+        self.action = action
+        self.nth = ({nth} if isinstance(nth, int) else
+                    None if nth is None else set(nth))
+        self.every = every
+        self.p = p
+        self.latency_s = latency_s
+        self.times = times
+        self.error = error
+        self.match = match
+        self.calls = 0     # calls SEEN (armed window only)
+        self.injected = 0  # calls actually faulted
+        self._rng = random.Random(seed)
+
+    def _selects(self, idx: int) -> bool:
+        """Does call ``idx`` (0-based since arming) fire? The Bernoulli
+        stream advances on EVERY call so selection is a pure function of
+        (seed, idx) regardless of hits."""
+        draw = self._rng.random() if self.p is not None else None
+        if self.times is not None and self.injected >= self.times:
+            return False
+        if self.nth is not None:
+            return idx in self.nth
+        if self.every is not None:
+            return (idx + 1) % self.every == 0
+        if self.p is not None:
+            return draw < self.p
+        return True  # no selector = every call (bounded by ``times``)
+
+
+def arm(point: str, action: str = "error", **kw) -> Schedule:
+    """Arm a schedule at a fault point; returns it (``.injected`` is the
+    test's ledger). Unknown points raise — a typo'd point would arm a
+    fault nothing ever fires."""
+    if point not in KNOWN_POINTS:
+        raise KeyError(f"unknown fault point {point!r}; known: "
+                       f"{sorted(KNOWN_POINTS)}")
+    sched = Schedule(point, action, **kw)
+    global _ARMED
+    with _lock:
+        _schedules.setdefault(point, []).append(sched)
+        _ARMED = True
+    return sched
+
+
+def disarm(point: str | None = None) -> None:
+    """Remove every schedule at ``point`` (all points when None)."""
+    global _ARMED
+    with _lock:
+        if point is None:
+            _schedules.clear()
+        else:
+            _schedules.pop(point, None)
+        _ARMED = bool(_schedules)
+
+
+def armed(point: str | None = None) -> bool:
+    if not _ARMED:
+        return False
+    with _lock:
+        return bool(_schedules) if point is None else point in _schedules
+
+
+@contextmanager
+def injected(point: str, action: str = "error", **kw):
+    """``with faultline.injected("kv.get_many", nth=0) as sched:`` —
+    arm for the block, disarm THIS schedule on exit (other concurrent
+    schedules at the same point survive)."""
+    sched = arm(point, action, **kw)
+    try:
+        yield sched
+    finally:
+        global _ARMED
+        with _lock:
+            lst = _schedules.get(point)
+            if lst is not None:
+                try:
+                    lst.remove(sched)
+                except ValueError:
+                    pass
+                if not lst:
+                    _schedules.pop(point, None)
+            _ARMED = bool(_schedules)
+
+
+def fire(point: str, **attrs) -> str | None:
+    """The production-side hook. Returns ``None`` (proceed normally) or
+    a directive string (``"drop"``/``"corrupt"``) the site interprets;
+    raises the scheduled error for ``action="error"``. Disarmed this is
+    one global read and a return."""
+    if not _ARMED:
+        return None
+    with _lock:
+        scheds = list(_schedules.get(point, ()))
+    directive = None
+    for sched in scheds:
+        if sched.match is not None and not sched.match(attrs):
+            continue
+        with _lock:
+            idx = sched.calls
+            sched.calls += 1
+            hit = sched._selects(idx)
+            if hit:
+                sched.injected += 1
+        if not hit:
+            continue
+        _record(point, sched.action, attrs)
+        if sched.action == "latency":
+            time.sleep(sched.latency_s)
+        elif sched.action == "error":
+            err = sched.error() if callable(sched.error) else sched.error
+            raise err if err is not None else FaultInjected(point)
+        else:
+            directive = sched.action
+    return directive
+
+
+def _record(point: str, action: str, attrs: dict) -> None:
+    """Metric + span annotation for one injection. Import cycles: metrics
+    and tracing both sit beside this module, so import lazily and never
+    let observability failure mask the injection itself."""
+    try:
+        from weaviate_tpu.runtime.metrics import fault_injected_total
+
+        fault_injected_total.labels(point, action).inc()
+    except Exception:  # pragma: no cover — registry unavailable
+        pass
+    try:
+        from weaviate_tpu.runtime import tracing
+
+        tracing.annotate(fault_point=point, fault_action=action)
+    except Exception:  # pragma: no cover
+        pass

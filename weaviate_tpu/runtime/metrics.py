@@ -375,6 +375,51 @@ memory_pressure_total = registry.counter(
     "Admission-control memory-pressure events",
     ("resource", "action"))
 
+# -- faultline / unified failure policy (runtime/faultline.py,
+#    runtime/retry.py, runtime/degrade.py, cluster/transport.py) --------------
+
+fault_injected_total = registry.counter(
+    "weaviate_tpu_fault_injected_total",
+    "Faults executed by an armed faultline schedule, by fault point "
+    "and action — a chaos run asserts this accounts for every "
+    "scheduled injection", ("point", "action"))
+retries_total = registry.counter(
+    "weaviate_tpu_retries_total",
+    "RetryPolicy attempt outcomes by operation: retried (backoff "
+    "taken), recovered (a retry succeeded), exhausted (attempts used "
+    "up), deadline (budget could not absorb another attempt)",
+    ("op", "outcome"))
+deadline_exceeded_total = registry.counter(
+    "weaviate_tpu_deadline_exceeded_total",
+    "Requests that ran out of their propagated time budget, by the "
+    "layer that noticed", ("layer",))
+circuit_state = registry.gauge(
+    "weaviate_tpu_circuit_state",
+    "Per-peer transport circuit breaker state: 0=closed, 1=half-open, "
+    "2=open", ("peer",))
+circuit_transitions_total = registry.counter(
+    "weaviate_tpu_circuit_transitions_total",
+    "Circuit breaker state transitions by peer and target state",
+    ("peer", "to"))
+degraded_results_total = registry.counter(
+    "weaviate_tpu_degraded_results_total",
+    "Requests answered with explicitly-marked PARTIAL results instead "
+    "of an error (dead replica skipped, consistency level downgraded)",
+    ("kind", "collection"))
+component_unhealthy = registry.gauge(
+    "weaviate_tpu_component_unhealthy",
+    "1 while a serving component (query batcher, native data plane) is "
+    "flagged unhealthy after a dispatch failure; cleared on recovery",
+    ("component",))
+batcher_dispatch_retries = registry.counter(
+    "weaviate_tpu_query_batcher_dispatch_retries_total",
+    "Coalesced device dispatches retried once after a failure before "
+    "erroring their own waiters")
+native_dispatch_retries = registry.counter(
+    "weaviate_tpu_native_plane_dispatch_retries_total",
+    "Native data-plane pipelined batches retried once through the sync "
+    "path after a device/transfer fault")
+
 # -- tracing (runtime/tracing.py feeds this on every finished span) -----------
 
 span_duration = registry.histogram(

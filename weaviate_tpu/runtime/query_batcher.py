@@ -47,13 +47,20 @@ slicing; only WHERE the transfer happens moves.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 import numpy as np
 
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import degrade, faultline, retry, tracing
 from weaviate_tpu.runtime.transfer import TransferPipeline
+
+#: bounded intake: past this queue depth the batcher sheds load with a
+#: typed retriable OverloadedError (REST surfaces it as 503 +
+#: Retry-After) instead of accepting latency it can never serve
+DEFAULT_MAX_QUEUE = int(os.environ.get("WEAVIATE_TPU_BATCHER_MAX_QUEUE",
+                                       "4096"))
 
 
 def _next_pow2(n: int) -> int:
@@ -110,7 +117,8 @@ class QueryBatcher:
                  supports_filter_batching: bool = False,
                  capacity_fn=None, pad_pow2: bool = True,
                  owner: dict | None = None, async_batch_fn=None,
-                 transfer_depth: int = 2):
+                 transfer_depth: int = 2,
+                 max_queue: int | None = None):
         from weaviate_tpu.runtime import hbm_ledger
 
         self._batch_fn = batch_fn
@@ -123,6 +131,8 @@ class QueryBatcher:
         self._transfer: TransferPipeline | None = None
         self._transfer_depth = transfer_depth
         self.max_batch = max_batch
+        self.max_queue = DEFAULT_MAX_QUEUE if max_queue is None \
+            else max_queue
         self.filter_batching = supports_filter_batching
         self._capacity_fn = capacity_fn
         self.pad_pow2 = pad_pow2
@@ -130,6 +140,14 @@ class QueryBatcher:
         # layer passes its collection/shard; standalone batchers fall
         # back to the ambient owner scope)
         self._hbm_owner = owner or hbm_ledger.current_owner()
+        # health key scoped to THIS batcher's owner: batchers are
+        # per-shard/per-vector, and a healthy shard's batch must not
+        # clear the unhealthy flag a persistently-broken shard set
+        scope = "/".join(str(v) for v in (
+            self._hbm_owner.get("collection"), self._hbm_owner.get("shard"))
+            if v and v not in ("-", "_unowned"))
+        self._component = f"query_batcher:{scope}" if scope \
+            else "query_batcher"
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: list[_Pending] = []
@@ -179,14 +197,37 @@ class QueryBatcher:
 
     def search(self, query: np.ndarray, k: int,
                allow: np.ndarray | None = None):
-        """Blocking per-request entry; coalesces under concurrency."""
+        """Blocking per-request entry; coalesces under concurrency.
+
+        Deadline-aware: a request that arrives with its budget spent
+        fails typed BEFORE enqueueing, and the wait below is capped at
+        the remaining budget — a client can never hang past its
+        deadline on a wedged dispatch. Overload-aware: a full queue
+        sheds with a retriable OverloadedError instead of queueing
+        latency the budget can't absorb."""
+        retry.check("batcher")
         item = _Pending(np.asarray(query, dtype=np.float32), k, allow)
         t_enqueue = time.perf_counter()
         with self._cv:
+            if len(self._queue) >= self.max_queue:
+                raise retry.OverloadedError(
+                    f"query batcher queue full "
+                    f"({len(self._queue)}/{self.max_queue})",
+                    retry_after_s=0.1)
             self._queue.append(item)
             self._ensure_worker()
             self._cv.notify()
-        item.event.wait()
+        rem = retry.remaining()
+        if rem is None:
+            item.event.wait()
+        elif not item.event.wait(timeout=min(rem, threading.TIMEOUT_MAX)):
+            # budget spent while queued/dispatched: the worker will
+            # still complete the batch (results discarded), but THIS
+            # client gets the typed timeout now
+            from weaviate_tpu.runtime.metrics import deadline_exceeded_total
+
+            deadline_exceeded_total.labels("batcher").inc()
+            raise retry.DeadlineExceeded("batcher")
         # wait-vs-execute split, recorded into THIS request's trace from
         # the worker's stamps (the worker thread has no request context)
         if item.t_exec_start is not None:
@@ -366,23 +407,65 @@ class QueryBatcher:
                     it.error = err
                     it.event.set()
 
+        def _sync_batch():
+            # faultline point: one coalesced device dispatch (the
+            # deterministic schedule sees retries as separate calls)
+            faultline.fire("batcher.dispatch", batch=b, k=k_bucket)
+            return tracing.run_in(ctx, self._batch_fn, queries,
+                                  k_bucket, allows)
+
+        def _retry_once(first_err: BaseException):
+            """Faulted device batch: ONE sync retry. A second failure
+            errors only THIS batch's waiters — with the ORIGINAL error,
+            the root cause — and flips the batcher's unhealthy flag
+            (visible in /v1/nodes); later batches keep serving and
+            clear it on success. Returns the (ids, dists) tuple or None
+            after failing the waiters."""
+            from weaviate_tpu.runtime.metrics import batcher_dispatch_retries
+
+            batcher_dispatch_retries.inc()
+            try:
+                res2 = _sync_batch()
+                # a sync fn that can't actually serve (null-device
+                # stubs return None) is a failed retry, not a result
+                if not (isinstance(res2, tuple) and len(res2) == 2):
+                    raise TypeError(
+                        f"batch_fn returned {type(res2).__name__}, "
+                        "expected (ids, dists)")
+                return res2
+            except Exception as e2:  # noqa: BLE001
+                degrade.mark_unhealthy(
+                    self._component,
+                    f"dispatch failed twice: {first_err}; retry: {e2}")
+                _fail(first_err)
+                return None
+
+        def _mark_served():
+            if degrade.is_unhealthy(self._component):
+                degrade.mark_healthy(self._component)
+
         handle = None
+        ids = dists = None
         try:
             if self._async_fn is not None:
                 # dispatch-and-go: launch the program, hand the
                 # device-resident handle to the transfer thread, return
                 # to drain the NEXT batch while this one crosses D2H
+                faultline.fire("batcher.dispatch", batch=b, k=k_bucket)
                 handle = tracing.run_in(ctx, self._async_fn, queries,
                                         k_bucket, allows)
             if handle is None:
-                ids, dists = tracing.run_in(ctx, self._batch_fn, queries,
-                                            k_bucket, allows)
+                ids, dists = _sync_batch()
         except Exception as e:  # noqa: BLE001
-            _fail(e)
-            return
+            result = _retry_once(e)
+            if result is None:
+                return
+            ids, dists = result
+            handle = None
         if handle is None:
             _hbm.release(pad_key)
             self._deliver(coal, ids, dists, time.perf_counter())
+            _mark_served()
             return
         self.async_dispatches += 1
         from weaviate_tpu.runtime.metrics import (batcher_async_dispatched,
@@ -390,20 +473,38 @@ class QueryBatcher:
 
         batcher_async_dispatched.inc()
 
-        def _complete(res, err, t_fetch0, t_fetch1):
-            for it in coal:
-                it.t_fetch_start, it.t_fetch_end = t_fetch0, t_fetch1
-            if err is not None:
-                _fail(err)
-                return
+        def _finish(res):
             try:
                 t1 = time.perf_counter()
                 self._deliver(coal, res[0], res[1], t1)
                 _hbm.release(pad_key)
+                _mark_served()
             except Exception as e:  # noqa: BLE001 — an out-of-contract
                 # result shape must surface to the waiters (the sync
                 # path raises it through _run's handler)
                 _fail(e)
+
+        def _complete(res, err, t_fetch0, t_fetch1):
+            for it in coal:
+                it.t_fetch_start, it.t_fetch_end = t_fetch0, t_fetch1
+            if err is None:
+                _finish(res)
+                return
+            # the device batch (or its D2H drain) faulted on the
+            # transfer thread: retry ONCE through the sync path — the
+            # queries are still host-resident, so a transient device
+            # fault costs one re-dispatch, not client errors. The retry
+            # is a FULL device dispatch, so it runs on its own
+            # short-lived thread: blocking here would stall every other
+            # in-flight batch's D2H behind one faulted batch.
+
+            def _retry_path():
+                res2 = _retry_once(err)
+                if res2 is not None:
+                    _finish(res2)
+
+            threading.Thread(target=_retry_path, daemon=True,
+                             name="batcher-fault-retry").start()
 
         try:
             tp = self._ensure_transfer()

@@ -471,15 +471,36 @@ def run_in(ctx, fn, *args, **kwargs):
         _current.reset(token)
 
 
+def annotate(**attrs) -> None:
+    """Attach attrs to the CURRENT span (no-op outside a trace) — how
+    cross-cutting layers (faultline injections, degraded-read markers)
+    tag whatever span happens to be active."""
+    cur = _current.get()
+    if cur is not None:
+        cur[1].set(**attrs)
+
+
 def propagate(fn):
-    """Wrap ``fn`` to carry the CURRENT context into worker threads
-    (pool.map / Thread targets don't inherit contextvars)."""
+    """Wrap ``fn`` to carry the CURRENT request context into worker
+    threads (pool.map / Thread targets don't inherit contextvars).
+    Carries the whole request triple: trace span, deadline budget, and
+    the degraded-marker sink — a shard fan-out thread must spend the
+    same budget and report into the same response as its request."""
+    from weaviate_tpu.runtime import degrade, retry
+
     ctx = _current.get()
-    if ctx is None:
+    dl = retry.current_deadline()
+    markers = degrade.current_markers()
+    if ctx is None and dl is None and markers is None:
         return fn
 
     def wrapper(*args, **kwargs):
-        return run_in(ctx, fn, *args, **kwargs)
+        tokens = (retry.set_deadline(dl), degrade.set_markers(markers))
+        try:
+            return run_in(ctx, fn, *args, **kwargs)
+        finally:
+            retry.reset_deadline(tokens[0])
+            degrade.reset_markers(tokens[1])
 
     return wrapper
 

@@ -39,7 +39,7 @@ import threading
 import time
 from collections import deque
 
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import faultline, tracing
 
 _UNSET = object()
 
@@ -98,6 +98,10 @@ class DeviceResultHandle:
                     self._value = (self._finish(host)
                                    if self._finish is not None else host)
                 else:
+                    # faultline point: the sanctioned D2H boundary — an
+                    # injected error is cached like a real fetch failure
+                    # and reaches every waiter of THIS handle only
+                    faultline.fire("transfer.d2h", arrays=len(self._arrays))
                     host = tracing.d2h(*self._arrays)
                     self._value = (self._finish(*host)
                                    if self._finish is not None else host)

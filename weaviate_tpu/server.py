@@ -85,7 +85,8 @@ class Server:
             self.node = ClusterNode(cfg.cluster_hostname, cfg.data_path,
                                     raft_peers=peers, host=cfg.host,
                                     port=cfg.cluster_data_port,
-                                    advertise=cfg.cluster_advertise or None)
+                                    advertise=cfg.cluster_advertise or None,
+                                    remote_timeout=cfg.remote_rpc_timeout_s)
             self.node.start(seed_addrs=cfg.cluster_join or None)
             self.db = self.node.db
         else:
@@ -108,13 +109,14 @@ class Server:
         from weaviate_tpu.api.rest import RestServer
 
         if self.node is not None:
-            self.rest = self.node.serve_rest(host=cfg.host,
-                                             port=cfg.rest_port,
-                                             modules=modules, auth=auth)
+            self.rest = self.node.serve_rest(
+                host=cfg.host, port=cfg.rest_port, modules=modules,
+                auth=auth, query_deadline_s=cfg.query_deadline_s)
         else:
             self.rest = RestServer(self.db, host=cfg.host,
                                    port=cfg.rest_port, modules=modules,
-                                   auth=auth)
+                                   auth=auth,
+                                   query_deadline_s=cfg.query_deadline_s)
             self.rest.start()
 
         from weaviate_tpu.api.grpc.server import GrpcServer

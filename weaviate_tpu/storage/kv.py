@@ -42,7 +42,7 @@ import msgpack
 import numpy as np
 
 from weaviate_tpu import native
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import faultline, tracing
 from weaviate_tpu.storage.wal import WriteAheadLog
 
 logger = logging.getLogger(__name__)
@@ -1135,6 +1135,11 @@ class Bucket:
         paths diverge). Segments are immutable once listed, so the disk
         lookups for memtable misses happen after the lock drops."""
         assert self.strategy == "replace"
+        # faultline point: the batched property-fetch feed (native
+        # plane reply building + warm pass read through here) — chaos
+        # runs inject errors/latency/corruption without touching disk
+        directive = faultline.fire("kv.get_many", bucket=self.name,
+                                   n=len(keys))
         misses: list[int] = []
         out: list = []
         with tracing.span("kv.get_many", bucket=self.name, n=len(keys)):
@@ -1153,6 +1158,12 @@ class Bucket:
                         misses.append(idx)
             for idx in misses:
                 out[idx] = _replace_segment_lookup(segments, keys[idx])
+            if directive == "corrupt":
+                # deterministic damage: flip the first byte of every
+                # value — consumers must contain the decode failure
+                # (error their own reply, never hang or crash the store)
+                out = [bytes([v[0] ^ 0xFF]) + v[1:]
+                       if isinstance(v, bytes) and v else v for v in out]
             return out
 
     def get_set(self, key: bytes) -> set:
