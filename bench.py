@@ -823,6 +823,66 @@ def sec_tracing_overhead(ctx):
     return out
 
 
+def sec_durability_tax(ctx):
+    """What PERSISTENCE_WAL_SYNC costs (ISSUE 9): batched put throughput
+    with the WAL fsync off vs on, group-commit (one frame + one fsync
+    per put_many batch) vs per-record puts (one fsync each). Host-side
+    by construction — the tax under test is fsync(2), not the device;
+    every timing is wall. The benchkeeper guard is the group-commit
+    GAIN ratio (batched-sync qps / per-record-sync qps): if batching
+    stops amortizing the fsync (a per-record fsync sneaking into the
+    batch path), durable imports collapse and this ratio goes to ~1."""
+    import shutil
+    import tempfile
+
+    from weaviate_tpu.storage.kv import KVStore
+
+    batch = 100
+    payload = {"v": "x" * 64}
+
+    def run_mode(sync: bool, batched: bool, n: int) -> float:
+        # per-mode op counts: the synced modes pay a real fsync(2) per
+        # frame (~2-40 ms depending on the FS), so they get fewer ops —
+        # qps normalizes across modes
+        d = tempfile.mkdtemp(prefix="benchdur-")
+        try:
+            store = KVStore(d, sync_wal=sync)
+            b = store.bucket("objects", memtable_limit=256 << 20)
+            t0 = time.perf_counter()
+            if batched:
+                for i in range(0, n, batch):
+                    b.put_many([(f"k{j}".encode(), payload)
+                                for j in range(i, i + batch)])
+            else:
+                for i in range(n):
+                    b.put(f"k{i}".encode(), payload)
+            took = time.perf_counter() - t0
+            store.close()
+            return n / took
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    out = {
+        "batch_size": batch,
+        "batched_sync_off_qps": round(run_mode(False, True, 5000), 1),
+        "batched_sync_on_qps": round(run_mode(True, True, 1000), 1),
+        "record_sync_off_qps": round(run_mode(False, False, 3000), 1),
+        "record_sync_on_qps": round(run_mode(True, False, 150), 1),
+    }
+    out["sync_tax_frac"] = round(
+        1.0 - out["batched_sync_on_qps"] /
+        max(out["batched_sync_off_qps"], 1e-9), 4)
+    out["group_commit_gain"] = round(
+        out["batched_sync_on_qps"] / max(out["record_sync_on_qps"], 1e-9),
+        2)
+    log(f"[durability] batched put {out['batched_sync_off_qps']:.0f} -> "
+        f"{out['batched_sync_on_qps']:.0f} qps with sync_wal "
+        f"(tax {out['sync_tax_frac']:.1%}); per-record sync "
+        f"{out['record_sync_on_qps']:.0f} qps "
+        f"(group-commit gain {out['group_commit_gain']:.1f}x)")
+    return out
+
+
 def sec_quantized(ctx):
     import numpy as np
 
@@ -1204,6 +1264,7 @@ SECTIONS = [
     ("filtered_scan", sec_filtered_scan, ("x", "rtt_s")),
     ("quantized", sec_quantized, ("x", "rtt_s")),
     ("tracing_overhead", sec_tracing_overhead, ()),
+    ("durability_tax", sec_durability_tax, ()),
     ("kernel_conformance", sec_conformance, ("rng",)),
     ("served_pipeline", sec_served_pipeline, ()),
     ("serving_fabric", sec_fabric, ()),

@@ -46,7 +46,9 @@ def _faultline_isolation():
     yield
     from weaviate_tpu.cluster.transport import reset_breakers
     from weaviate_tpu.runtime import degrade, faultline
+    from weaviate_tpu.storage import recovery
 
     faultline.disarm()
     degrade.reset()
     reset_breakers()
+    recovery.reset()

@@ -1028,6 +1028,117 @@ def test_g6_accepts_explicit_and_deliberate_none(tmp_path):
     assert [v for v in res.violations if v.check == "G6"] == []
 
 
+# -- G7 durability-discipline ---------------------------------------------------
+
+
+G7_POSITIVE = """
+    import os
+
+    def swap_state(tmp, final):
+        os.replace(tmp, final)                   # P1: bare rename
+
+    def rewrite(path, blob):
+        with open(path + ".tmp", "wb") as f:     # P2: wb, fn never fsyncs
+            f.write(blob)
+        os.replace(path + ".tmp", path)          # P3: bare rename again
+"""
+
+G7_NEGATIVE = """
+    import os
+
+    from weaviate_tpu.storage import fsutil
+
+    def swap_state(tmp, final):
+        fsutil.atomic_replace(tmp, final)        # the sanctioned path
+
+    def rewrite(path, blob):
+        with open(path + ".tmp", "wb") as f:     # wb + fsync: fine
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        fsutil.atomic_replace(path + ".tmp", path)
+
+    def reset_log(path):
+        f = open(path, "wb")                     # truncate-reset pattern
+        f.flush()
+        os.fsync(f.fileno())
+        return f
+
+    def quarantine(path):
+        os.replace(path, path + ".corrupt")      # evidence move: exempt
+"""
+
+
+def test_g7_flags_bare_replace_and_unsynced_wb(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/storage/fx.py": G7_POSITIVE})
+    g7 = [v for v in res.violations if v.check == "G7"]
+    msgs = " | ".join(v.message for v in g7)
+    assert len(g7) == 3, msgs
+    assert "bare os.replace" in msgs
+    assert 'open(..., "wb") in a function that never fsyncs' in msgs
+
+
+def test_g7_accepts_fsutil_fsync_and_quarantine(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/storage/fx.py": G7_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G7"] == []
+
+
+def test_g7_guarded_write_is_not_an_fsync(tmp_path):
+    """fsutil.guarded_write writes (and tears) but never fsyncs — a
+    'wb' writer that only guards must still be flagged."""
+    res = lint_tree(tmp_path, {"weaviate_tpu/storage/fx.py": """
+        from weaviate_tpu.storage import fsutil
+
+        def write_guarded_only(path, blob):
+            with open(path, "wb") as f:
+                fsutil.guarded_write(f, blob, "segment.write.mid")
+    """})
+    g7 = [v for v in res.violations if v.check == "G7"]
+    assert len(g7) == 1 and "never fsyncs" in g7[0].message
+
+
+def test_g7_scope_covers_state_owners_only(tmp_path):
+    """storage/cluster/engine + benchkeeper/crashtest own durable state;
+    api/runtime/tests do not (their writes are reports/sockets)."""
+    res = lint_tree(tmp_path, {
+        "weaviate_tpu/storage/fx.py": G7_POSITIVE,
+        "weaviate_tpu/cluster/fx.py": G7_POSITIVE,
+        "weaviate_tpu/engine/fx.py": G7_POSITIVE,
+        "tools/benchkeeper/fx.py": G7_POSITIVE,
+        "weaviate_tpu/api/fx.py": G7_POSITIVE,
+        "weaviate_tpu/runtime/fx.py": G7_POSITIVE,
+        "tests/test_fx.py": G7_POSITIVE,
+    })
+    flagged = {v.path for v in res.violations if v.check == "G7"}
+    assert flagged == {"weaviate_tpu/storage/fx.py",
+                       "weaviate_tpu/cluster/fx.py",
+                       "weaviate_tpu/engine/fx.py",
+                       "tools/benchkeeper/fx.py"}
+
+
+def test_g7_fsutil_itself_is_exempt(tmp_path):
+    """fsutil IS the audited implementation — its own os.replace is the
+    one the rest of the tree is routed through."""
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/storage/fsutil.py": G7_POSITIVE})
+    assert [v for v in res.violations if v.check == "G7"] == []
+
+
+def test_g7_baseline_stays_empty_for_storage_engine_cluster():
+    """ISSUE 9 acceptance: the durable tree itself carries ZERO G7
+    grandfathers — the fsync ordering was fixed by routing through
+    fsutil, not baselined. Only the advisory benchkeeper writers may be
+    baselined (with reasons)."""
+    entries = core.load_baseline(core.default_baseline_path(REPO_ROOT))
+    g7_state = [e for e in entries
+                if e.get("check") == "G7"
+                and str(e.get("path", "")).startswith("weaviate_tpu/")]
+    assert g7_state == [], (
+        "G7 baseline entries for weaviate_tpu/ are not allowed — route "
+        "the write through storage/fsutil instead:\n"
+        + "\n".join(str(e) for e in g7_state))
+
+
 def test_g6_scope_is_production_tree_only(tmp_path):
     """Serving-path discipline: tests/tools stay out of G6 scope (they
     stub transports and probe dead ports on purpose)."""
@@ -1074,7 +1185,8 @@ def test_repo_gate_zero_nonbaselined_violations():
     modulo the checked-in baseline, and the baseline must not be stale.
     bench.py and tools/benchkeeper ride the gate too — their JSON
     fields are the perf gate's wire format (G5 timing conventions)."""
-    res = run(["weaviate_tpu", "bench.py", "tools/benchkeeper"],
+    res = run(["weaviate_tpu", "bench.py", "tools/benchkeeper",
+               "tools/crashtest"],
               REPO_ROOT, use_cache=False,
               baseline_path=core.default_baseline_path(REPO_ROOT))
     assert res.errors == []

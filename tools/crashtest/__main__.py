@@ -1,0 +1,5 @@
+import sys
+
+from tools.crashtest.harness import main
+
+sys.exit(main())

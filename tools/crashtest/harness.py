@@ -1,0 +1,233 @@
+"""Crash matrix driver: spawn worker, kill at a crashpoint, verify.
+
+The worker runs in a SUBPROCESS because a crashpoint is a real
+``os._exit(137)`` — in-process simulation would keep Python state alive
+and prove nothing about what reached the kernel. Verification runs
+in-process by default (same machine, same page cache — what the dead
+process ``write()``d is visible; what a ``torn`` schedule withheld is
+genuinely absent, which is how the byte-boundary cases bite).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+from dataclasses import asdict, dataclass, field
+
+from weaviate_tpu.runtime import faultline
+
+#: per-point schedule plans: which call index to kill at, tuned so every
+#: point fires inside the default 400-op workload (the matrix FAILS a
+#: point whose schedule never fired — silent no-coverage is a result,
+#: not a skip). Entries are (suffix, schedule-kwargs) so one point can
+#: run several byte-boundary variants (clean kill + torn writes).
+POINT_PLANS: dict[str, list[tuple[str, dict]]] = {
+    "wal.append.pre_fsync": [
+        ("kill", {"action": "crash", "nth": 40}),
+        ("torn5", {"action": "torn", "nth": 40, "torn_bytes": 5}),
+        ("torn13", {"action": "torn", "nth": 40, "torn_bytes": 13}),
+    ],
+    "wal.append.post_fsync": [("kill", {"action": "crash", "nth": 40})],
+    "wal.create": [("kill", {"action": "crash", "nth": 6})],
+    "segment.write.mid": [
+        ("kill", {"action": "crash", "nth": 9}),
+        ("torn3", {"action": "torn", "nth": 9, "torn_bytes": 3}),
+    ],
+    "segment.write.pre_rename": [("kill", {"action": "crash", "nth": 1})],
+    "segment.post_rename": [("kill", {"action": "crash", "nth": 1})],
+    "raft.persist.meta": [("kill", {"action": "crash", "nth": 0})],
+    "raft.persist.log": [("kill", {"action": "crash", "nth": 6})],
+    "raft.persist.snapshot": [("kill", {"action": "crash", "nth": 0})],
+    "hnsw.snap.pre_replace": [("kill", {"action": "crash", "nth": 0})],
+    "hnsw.snap.post_replace": [("kill", {"action": "crash", "nth": 0})],
+}
+
+
+@dataclass
+class CrashResult:
+    point: str
+    variant: str
+    worker_rc: int
+    fired: bool             # worker died at the scheduled point
+    ok: bool                # invariants held after restart
+    journaled_ops: int = 0
+    lost: list[str] = field(default_factory=list)
+    phantom: list[str] = field(default_factory=list)
+    recovery_nonempty: bool = False
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def _spawn_worker(base: str, spec: list[dict], n_ops: int, seed: int,
+                  start: int = 0, timeout: float = 120.0) -> int:
+    env = dict(os.environ)
+    env["WEAVIATE_TPU_FAULTLINE"] = json.dumps(spec)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.crashtest.workload", "run", base,
+         "--ops", str(n_ops), "--seed", str(seed), "--start", str(start)],
+        env=env, timeout=timeout,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))))
+    return proc.returncode
+
+
+def _verify_inproc(base: str, n_ops: int, seed: int) -> dict:
+    from weaviate_tpu.storage import recovery
+
+    recovery.reset()  # scope the report to THIS restart
+    from tools.crashtest.workload import verify
+
+    return verify(base, n_ops, seed)
+
+
+def verify_dir(base: str, n_ops: int = 400, seed: int = 0) -> dict:
+    """Public in-process verification entry (tests use this)."""
+    return _verify_inproc(base, n_ops, seed)
+
+
+def run_one(point: str, variant: str, sched: dict, base: str,
+            n_ops: int = 400, seed: int = 0) -> CrashResult:
+    """One kill-restart-verify cycle at ``point`` in a fresh ``base``."""
+    spec = [dict(sched, point=point, times=1)]
+    exit_code = sched.get("exit_code", 137)
+    rc = _spawn_worker(base, spec, n_ops, seed)
+    fired = rc == exit_code
+    if not fired:
+        # the schedule never fired (rc 0) or the worker failed some
+        # other way — either is a matrix failure, not a pass
+        return CrashResult(point, variant, rc, fired=False, ok=False)
+    report = _verify_inproc(base, n_ops, seed)
+    totals = report["recovery"]["totals"]
+    return CrashResult(
+        point, variant, rc, fired=True, ok=report["ok"],
+        journaled_ops=report["journaled_ops"],
+        lost=report["lost_acked_writes"],
+        phantom=report["phantom_or_mismatched"],
+        recovery_nonempty=bool(totals["buckets"]) and (
+            totals["frames_replayed"] > 0 or totals["bytes_truncated"] > 0
+            or totals["wals_quarantined"] > 0
+            or totals["wal_files_replayed"] > 0))
+
+
+def run_matrix(base_dir: str | None = None, points=None, n_ops: int = 400,
+               seed: int = 0) -> list[CrashResult]:
+    """The deterministic sweep: every named crashpoint (plus torn-write
+    variants), each in its own directory."""
+    own = base_dir is None
+    base_dir = base_dir or tempfile.mkdtemp(prefix="crashtest-")
+    points = list(points or faultline.CRASHPOINTS)
+    results = []
+    for point in points:
+        for variant, sched in POINT_PLANS.get(
+                point, [("kill", {"action": "crash", "nth": 0})]):
+            base = os.path.join(base_dir, f"{point}.{variant}")
+            os.makedirs(base, exist_ok=True)
+            results.append(run_one(point, variant, sched, base,
+                                   n_ops=n_ops, seed=seed))
+    if own:
+        import shutil
+
+        shutil.rmtree(base_dir, ignore_errors=True)
+    return results
+
+
+def run_sweep(rounds: int = 8, n_ops: int = 400, seed: int = 0,
+              base: str | None = None) -> list[CrashResult]:
+    """Randomized kill-restart-verify: ONE store, the workload resuming
+    from its journal after every crash, the (point, action, nth) drawn
+    from a seeded stream — a failing round replays bit-for-bit."""
+    rng = random.Random(seed)
+    own = base is None
+    base = base or tempfile.mkdtemp(prefix="crashsweep-")
+    results = []
+    candidates = [(p, v, s) for p, plans in POINT_PLANS.items()
+                  for v, s in plans]
+    for rnd in range(rounds):
+        point, variant, sched = candidates[rng.randrange(len(candidates))]
+        sched = dict(sched, nth=rng.randrange(0, 30))
+        start = _journal_ops(base)
+        spec = [dict(sched, point=point, times=1)]
+        rc = _spawn_worker(base, spec, n_ops, seed, start=start)
+        crashed = rc == sched.get("exit_code", 137)
+        if not crashed and rc != 0:
+            results.append(CrashResult(point, f"sweep{rnd}.{variant}", rc,
+                                       fired=False, ok=False))
+            continue
+        report = _verify_inproc(base, n_ops, seed)
+        # a draw whose nth lands past the remaining workload completes
+        # cleanly (rc 0) — the verify still ran, so the round counts as
+        # ok (randomized sweeps legitimately include non-firing draws),
+        # but ``fired`` reports what actually happened: a sweep whose
+        # draws STOP firing must be visible, not report crash coverage
+        # it no longer has
+        results.append(CrashResult(
+            point, f"sweep{rnd}.{variant}", rc, fired=crashed,
+            ok=report["ok"], journaled_ops=report["journaled_ops"],
+            lost=report["lost_acked_writes"],
+            phantom=report["phantom_or_mismatched"],
+            recovery_nonempty=True))
+        if report["journaled_ops"] >= n_ops:
+            break  # workload complete — nothing left to crash
+    if own:
+        import shutil
+
+        shutil.rmtree(base, ignore_errors=True)
+    return results
+
+
+def _journal_ops(base: str) -> int:
+    from tools.crashtest.workload import _journal_count
+
+    return _journal_count(base)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="crashtest",
+        description="kill-restart-verify crash harness "
+                    "(deterministic matrix over faultline.CRASHPOINTS)")
+    ap.add_argument("--ops", type=int, default=400)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sweep", type=int, default=0,
+                    help="run N randomized kill rounds instead of the "
+                         "deterministic matrix")
+    ap.add_argument("--keep", default="",
+                    help="run in this directory and keep the state")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    if args.sweep:
+        results = run_sweep(rounds=args.sweep, n_ops=args.ops,
+                            seed=args.seed, base=args.keep or None)
+    else:
+        results = run_matrix(base_dir=args.keep or None, n_ops=args.ops,
+                             seed=args.seed)
+    # run_one already folds not-fired into ok=False for the matrix;
+    # sweep rounds that completed cleanly are ok with fired=False
+    ok = all(r.ok for r in results)
+    if args.json:
+        print(json.dumps({"ok": ok,
+                          "results": [r.to_dict() for r in results]},
+                         indent=2))
+    else:
+        for r in results:
+            status = "PASS" if r.ok else \
+                ("NOT-FIRED" if not r.fired else "FAIL")
+            print(f"{status:9s} {r.point:28s} {r.variant:10s} "
+                  f"rc={r.worker_rc} journaled={r.journaled_ops} "
+                  f"lost={len(r.lost)} phantom={len(r.phantom)}")
+            for msg in (r.lost + r.phantom)[:5]:
+                print(f"          {msg}")
+        print(("crash matrix: all invariants held"
+               if ok else "crash matrix: FAILURES above"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

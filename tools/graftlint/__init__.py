@@ -1,7 +1,7 @@
 """graftlint: repo-native static analysis for TPU hot-path and
 lock-discipline invariants.
 
-Five checkers over the repo's own idioms (the Python analog of the
+Checkers over the repo's own idioms (the Python analog of the
 reference relying on `go vet` + the race detector — bug classes that
 pytest structurally cannot see because they need production concurrency
 or a real TPU to fire):
@@ -19,6 +19,12 @@ or a real TPU to fire):
                       static acquisition graph
 - G5 metrics-conventions Prometheus naming / HELP rules at registration
                       call sites (the lint_metrics seed, folded in)
+- G6 timeout-discipline every transport.rpc call site / raw HTTP
+                      connection carries an explicit timeout=
+- G7 durability-discipline os.replace / open(..., "wb") on persistent
+                      state in storage|cluster|engine goes through
+                      fsutil.atomic_replace (fsync-file -> rename ->
+                      fsync-dir) or an fsyncing function
 
 Run: ``python -m tools.graftlint [--json] [--update-baseline] paths...``
 Suppress: ``# graftlint: disable=G1`` on the violating line (give a

@@ -33,7 +33,7 @@ import re
 import sys
 from dataclasses import asdict, dataclass, field
 
-CHECK_IDS = ("G1", "G2", "G3", "G4", "G5", "G6")
+CHECK_IDS = ("G1", "G2", "G3", "G4", "G5", "G6", "G7")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
@@ -143,10 +143,11 @@ def all_checkers() -> list[Checker]:
     from tools.graftlint.g4_locks import LockDisciplineChecker
     from tools.graftlint.g5_metrics import MetricsConventionChecker
     from tools.graftlint.g6_timeouts import TimeoutDisciplineChecker
+    from tools.graftlint.g7_durability import DurabilityChecker
 
     return [HostSyncChecker(), RetraceChecker(), PallasChecker(),
             LockDisciplineChecker(), MetricsConventionChecker(),
-            TimeoutDisciplineChecker()]
+            TimeoutDisciplineChecker(), DurabilityChecker()]
 
 
 # -- suppressions -------------------------------------------------------------

@@ -624,6 +624,8 @@ class RestServer:
                 "text/plain; version=0.0.4; charset=utf-8")
         if seg == ["debug", "memory"]:
             return 200, self._debug_memory()
+        if seg == ["debug", "storage"]:
+            return 200, self._debug_storage()
         if seg == ["debug", "perf"]:
             # last benchkeeper gate verdict + per-section trend deltas
             # (tools/benchkeeper persists the artifact; perfgate loads
@@ -972,6 +974,24 @@ class RestServer:
                 deltas[dev] = int(stats["bytesInUse"]) - snap["totalBytes"]
         if deltas:
             out["allocatorDelta"] = deltas
+        return out
+
+    def _debug_storage(self) -> dict:
+        """GET /v1/debug/storage: per-bucket crash-recovery reports
+        (frames replayed, torn-tail bytes truncated, WALs/segments
+        quarantined, segments recovered) filed at every bucket open,
+        plus the effective durability config. The crashtest harness
+        (tools/crashtest) asserts a non-empty report here after every
+        kill-restart cycle; the same registry feeds the
+        weaviate_tpu_recovery_* counters."""
+        from weaviate_tpu.storage import recovery
+
+        out = recovery.snapshot()
+        out["config"] = {
+            "syncWal": bool(getattr(self.db, "sync_wal", False)),
+            # the raft bucket ignores syncWal — pinned durable
+            "raftBucketPinnedSync": self.node is not None,
+        }
         return out
 
     def _local_shard_details(self) -> list[dict]:

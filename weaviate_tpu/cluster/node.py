@@ -30,13 +30,17 @@ class ClusterNode:
                  gossip_interval: float = 0.3,
                  election_timeout: tuple[float, float] = (0.3, 0.6),
                  advertise: str | None = None,
-                 remote_timeout: float | None = None):
+                 remote_timeout: float | None = None,
+                 sync_wal: bool | None = None):
         """``raft_peers``: the static bootstrap member set (node names,
         incl. this one) — reference: RAFT_JOIN env (cluster/bootstrap).
         ``advertise``: host:port other nodes reach this one at (container
         deployments bind 0.0.0.0 and advertise their service name).
         ``remote_timeout``: per-attempt ceiling for remote shard ops
-        (None = REMOTE_RPC_TIMEOUT_S / 30s; always deadline-capped)."""
+        (None = REMOTE_RPC_TIMEOUT_S / 30s; always deadline-capped).
+        ``sync_wal``: fsync acked data-plane writes (None =
+        PERSISTENCE_WAL_SYNC; the raft bucket is pinned sync below
+        either way)."""
         self.name = name
         self.server = InternalServer(host, port, advertise=advertise)
         self.membership = Membership(name, self.server,
@@ -45,13 +49,19 @@ class ClusterNode:
                                         timeout=remote_timeout)
         self.db = Database(data_dir, mesh=mesh, local_node=name,
                            remote=self.remote,
-                           nodes_provider=self.membership.alive_nodes)
+                           nodes_provider=self.membership.alive_nodes,
+                           sync_wal=sync_wal)
         register_incoming(self.server, self.db)
         from weaviate_tpu.replication import register_replication
 
         register_replication(self.server, self.db)
         self.fsm = SchemaFSM(self.db)
-        raft_bucket = self.db._schema_store.bucket("raft", "replace")
+        # pinned sync regardless of PERSISTENCE_WAL_SYNC: raft answers
+        # votes/appends only after (term, votedFor, log) are durable —
+        # an unsynced ack can double-vote or lose committed entries
+        # across a crash (see raft.py persistence notes)
+        raft_bucket = self.db._schema_store.bucket("raft", "replace",
+                                                   sync_wal=True)
         self.raft = RaftNode(name, raft_peers, self.membership.resolve,
                              self.server, self.fsm.apply,
                              store_bucket=raft_bucket,

@@ -36,6 +36,7 @@ import threading
 import time
 
 from weaviate_tpu.cluster.transport import RpcError, rpc
+from weaviate_tpu.runtime import faultline
 
 logger = logging.getLogger(__name__)
 
@@ -116,38 +117,73 @@ class RaftNode:
         return self._entry(i)["term"]
 
     # -- persistence ---------------------------------------------------------
+    #
+    # Raft's safety argument requires (term, votedFor, log) to hit DISK
+    # before the RPC that exposed them is answered — a node that votes,
+    # crashes, and forgets it voted can grant a second vote in the same
+    # term (two leaders); a follower that acks an append and loses the
+    # entries lets the leader count a majority that doesn't exist. The
+    # raft bucket is therefore pinned ``sync_wal=True`` at construction
+    # (cluster/node.py) regardless of PERSISTENCE_WAL_SYNC, and every
+    # persist below batches its records into ONE WAL frame — one fsync
+    # per RPC response, not one per record (the hashicorp/raft+boltdb
+    # reference gets the same through one bolt transaction per persist).
+
+    def _meta_pair(self) -> tuple[bytes, dict]:
+        return (b"meta", {"term": self.current_term,
+                          "voted_for": self.voted_for})
+
+    def _span_pair(self) -> tuple[bytes, dict]:
+        return (b"log_span", {"start": self.log_start,
+                              "len": len(self.log),
+                              "snap_last_term": self.snap_last_term})
 
     def _persist_meta(self) -> None:
         if self._bucket is not None:
-            self._bucket.put(b"meta", {"term": self.current_term,
-                                       "voted_for": self.voted_for})
+            faultline.fire("raft.persist.meta", term=self.current_term)
+            self._bucket.put(*self._meta_pair())
 
-    def _persist_log(self, start_abs: int | None = None) -> None:
+    def _persist_log(self, start_abs: int | None = None,
+                     extra_pairs=None) -> None:
+        """Persist entries >= start_abs, the span, AND the meta in one
+        synced frame — callers answer their RPC right after, so this is
+        the per-response fsync. ``extra_pairs`` ride the SAME frame: the
+        snapshot-taking paths pass the snapshot record here so a crash
+        can never land between the snapshot and the span that must
+        agree with it."""
         if self._bucket is None:
             return
         start_abs = self.log_start if start_abs is None else start_abs
-        for i in range(max(start_abs, self.log_start),
-                       self.log_start + len(self.log)):
-            self._bucket.put(f"log-{i:012d}".encode(), self._entry(i))
-        self._bucket.put(b"log_span", {"start": self.log_start,
-                                       "len": len(self.log),
-                                       "snap_last_term": self.snap_last_term})
+        faultline.fire("raft.persist.log", start=start_abs)
+        pairs: list[tuple[bytes, object]] = list(extra_pairs or [])
+        pairs.extend(
+            (f"log-{i:012d}".encode(), self._entry(i))
+            for i in range(max(start_abs, self.log_start),
+                           self.log_start + len(self.log)))
+        pairs.append(self._span_pair())
+        pairs.append(self._meta_pair())
+        self._bucket.put_many(pairs)
 
-    def _persist_snapshot(self, state: dict, last_index: int,
-                          last_term: int, peers: list[str]) -> None:
-        if self._bucket is not None:
-            self._bucket.put(b"snapshot", {"state": state,
-                                           "last_index": last_index,
-                                           "last_term": last_term,
-                                           "peers": peers})
+    def _snapshot_pair(self, state: dict, last_index: int,
+                       last_term: int, peers: list[str]
+                       ) -> tuple[bytes, dict]:
+        faultline.fire("raft.persist.snapshot", last_index=last_index)
+        return (b"snapshot", {"state": state,
+                              "last_index": last_index,
+                              "last_term": last_term,
+                              "peers": peers})
 
-    def _truncate_log_from(self, abs_i: int) -> None:
-        """Drop entries >= abs_i (conflict truncation)."""
+    def _truncate_log_from(self, abs_i: int, persist: bool = True) -> None:
+        """Drop entries >= abs_i (conflict truncation).
+
+        ``persist=False`` is for the append-conflict path whose very
+        next statement is a full ``_persist_log`` — the span in that
+        batched frame supersedes this one, so writing it here too
+        would pay a second fsync per conflicting AppendEntries."""
         del self.log[abs_i - self.log_start:]
-        if self._bucket is not None:
-            self._bucket.put(b"log_span", {"start": self.log_start,
-                                           "len": len(self.log),
-                                           "snap_last_term": self.snap_last_term})
+        if persist and self._bucket is not None:
+            faultline.fire("raft.persist.log", start=abs_i)
+            self._bucket.put(*self._span_pair())
         self._recompute_peers()
 
     def _restore(self) -> None:
@@ -173,14 +209,22 @@ class RaftNode:
                                      self.name)
         span = self._bucket.get(b"log_span")
         if span:
+            snap_start = self.log_start  # boundary the snapshot set
             start, n = span["start"], span["len"]
             # tolerate a snapshot taken after the last log persist
-            start = max(start, self.log_start)
+            start = max(start, snap_start)
             self.log = [self._bucket.get(f"log-{i:012d}".encode())
                         for i in range(start, span["start"] + n)]
             self.log_start = start
-            self.snap_last_term = span.get("snap_last_term",
-                                           self.snap_last_term)
+            if span["start"] >= snap_start:
+                self.snap_last_term = span.get("snap_last_term",
+                                               self.snap_last_term)
+            # else: the span predates the snapshot (a crash between the
+            # two persist frames of the pre-batching format) — its tail
+            # term describes an OLDER boundary; adopting it would make
+            # _last_log() under-report this node's last term and let it
+            # grant votes to candidates with older logs (Raft §5.4.1).
+            # The snapshot's own last_term stands.
         else:
             n = self._bucket.get(b"log_len") or 0  # round-1 format
             self.log = [self._bucket.get(f"log-{i:012d}".encode())
@@ -516,7 +560,8 @@ class RaftNode:
             state = self.snapshot_fn() if self.snapshot_fn else {}
             last = self.last_applied
             last_term = self._term_at(last)
-            self._persist_snapshot(state, last, last_term, list(self.peers))
+            snap_pair = self._snapshot_pair(state, last, last_term,
+                                            list(self.peers))
             # bootstrap_peers absorbs conf entries covered by the snapshot
             # so _recompute_peers stays correct over the shorter log
             self.bootstrap_peers = list(self.peers)
@@ -524,11 +569,16 @@ class RaftNode:
             del self.log[:drop]
             self.log_start = last + 1
             self.snap_last_term = last_term
-            self._persist_log()
+            # snapshot + span + meta land in ONE synced frame — a crash
+            # can never leave a snapshot whose span disagrees with it
+            self._persist_log(extra_pairs=[snap_pair])
             if self._bucket is not None:
-                # drop compacted entry records
-                for i in range(self.log_start - drop, self.log_start):
-                    self._bucket.delete(f"log-{i:012d}".encode())
+                # drop compacted entry records — one batched tombstone
+                # frame, after the snapshot + span are durable (a crash
+                # in between replays consistently: span bounds the read)
+                self._bucket.delete_many(
+                    f"log-{i:012d}".encode()
+                    for i in range(self.log_start - drop, self.log_start))
             logger.info("raft %s: snapshot through index %d (log now %d "
                         "entries)", self.name, last, len(self.log))
             return last
@@ -558,10 +608,9 @@ class RaftNode:
             self.last_applied = last
             self.bootstrap_peers = sorted(
                 set(payload.get("peers") or []) | {self.name})
-            self._persist_snapshot(payload["state"], last,
-                                   payload["last_term"],
-                                   list(payload.get("peers") or []))
-            self._persist_log()
+            self._persist_log(extra_pairs=[self._snapshot_pair(
+                payload["state"], last, payload["last_term"],
+                list(payload.get("peers") or []))])
             self._recompute_peers()
             self._applied_cv.notify_all()
             return {"term": self.current_term}
@@ -613,7 +662,7 @@ class RaftNode:
                     continue  # snapshot already covers it
                 if i <= self._abs_last():
                     if self._term_at(i) != e["term"]:
-                        self._truncate_log_from(i)
+                        self._truncate_log_from(i, persist=False)
                         self.log.extend(entries[k:])
                         self._persist_log(i)
                         appended = True

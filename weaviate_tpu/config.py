@@ -58,6 +58,12 @@ def _fraction(env, name: str, default: float) -> float:
 class ServerConfig:
     # persistence (PERSISTENCE_DATA_PATH, environment.go)
     data_path: str = "./data"
+    # PERSISTENCE_WAL_SYNC: fsync every WAL append before acking the
+    # write (durability over throughput — see bench.py durability_tax
+    # for the cost). Off = the OS page cache decides when acked writes
+    # hit disk, so a POWER failure (not a process crash) can lose the
+    # tail. The raft bucket is pinned sync regardless (cluster/node.py).
+    wal_sync: bool = False
     # API listeners
     host: str = "127.0.0.1"
     rest_port: int = 8080
@@ -109,6 +115,7 @@ class ServerConfig:
         env = os.environ if env is None else env
         cfg = cls(
             data_path=env.get("PERSISTENCE_DATA_PATH", "./data"),
+            wal_sync=_flag(env, "PERSISTENCE_WAL_SYNC"),
             host=env.get("BIND_ADDRESS", env.get("ORIGIN_HOST",
                                                  "127.0.0.1")),
             rest_port=_int(env, "PORT", 8080),

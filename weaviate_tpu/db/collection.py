@@ -88,7 +88,8 @@ class Collection:
                  sharding_state: ShardingState | None = None, mesh=None,
                  local_node: str = "node-0", on_sharding_change=None,
                  memwatch=None, remote=None, nodes_provider=None,
-                 async_indexing: bool | None = None):
+                 async_indexing: bool | None = None,
+                 sync_wal: bool | None = None):
         config.validate()
         self.config = config
         self.data_dir = data_dir
@@ -96,6 +97,7 @@ class Collection:
         self.local_node = local_node
         self.memwatch = memwatch
         self.async_indexing = async_indexing  # None = shard reads the env
+        self.sync_wal = sync_wal  # None = shard reads PERSISTENCE_WAL_SYNC
         # cross-node data plane (reference: Index holds a
         # sharding.RemoteIndexClient for non-local shards, index.go:1607)
         self.remote = remote
@@ -217,7 +219,8 @@ class Collection:
                 self.shards[name] = Shard(
                     self.data_dir, self.config, name, mesh=self.mesh,
                     memwatch=self.memwatch,
-                    async_indexing=self.async_indexing)
+                    async_indexing=self.async_indexing,
+                    sync_wal=self.sync_wal)
             return self.shards[name]
 
     def _require_active(self, tenant: str) -> None:

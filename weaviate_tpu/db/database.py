@@ -23,12 +23,23 @@ class Database:
                  local_node: str = "node-0", start_cycles: bool = False,
                  maintenance_interval: float = 5.0,
                  memory_monitor=None, remote=None, nodes_provider=None,
-                 async_indexing: bool | None = None):
+                 async_indexing: bool | None = None,
+                 sync_wal: bool | None = None):
         self.data_dir = data_dir
         self.mesh = mesh
         self.local_node = local_node
         self.remote = remote
         self.async_indexing = async_indexing  # None = env decides per shard
+        # PERSISTENCE_WAL_SYNC (ServerConfig.wal_sync): fsync acked
+        # writes. None = read the env through config._flag (the ONE
+        # parser, so embedded and server-launched use cannot disagree);
+        # the schema store follows the same setting (raft's bucket pins
+        # sync separately).
+        if sync_wal is None:
+            from weaviate_tpu.config import _flag
+
+            sync_wal = _flag(os.environ, "PERSISTENCE_WAL_SYNC")
+        self.sync_wal = sync_wal
         self.nodes_provider = nodes_provider or (lambda: [local_node])
         # cluster hook fn(collection, [tenant]): routes auto tenant
         # creation through Raft (set by ClusterNode); None = local apply
@@ -38,7 +49,8 @@ class Database:
         self.offload_backend = None
         os.makedirs(data_dir, exist_ok=True)
         self._lock = threading.RLock()
-        self._schema_store = KVStore(os.path.join(data_dir, "_schema"))
+        self._schema_store = KVStore(os.path.join(data_dir, "_schema"),
+                                     sync_wal=self.sync_wal)
         self._schema = self._schema_store.bucket("classes", "replace")
         self.collections: dict[str, Collection] = {}
         from weaviate_tpu.runtime import CycleManager, MemoryMonitor
@@ -72,6 +84,7 @@ class Database:
                 memwatch=self.memwatch, remote=self.remote,
                 nodes_provider=self.nodes_provider,
                 async_indexing=self.async_indexing,
+                sync_wal=self.sync_wal,
             )
             col._auto_tenant_hook = self.auto_tenant_hook
             col.offload_backend = self.offload_backend
@@ -95,7 +108,8 @@ class Database:
                              on_sharding_change=self._persist,
                              memwatch=self.memwatch, remote=self.remote,
                              nodes_provider=self.nodes_provider,
-                             async_indexing=self.async_indexing)
+                             async_indexing=self.async_indexing,
+                             sync_wal=self.sync_wal)
             col._auto_tenant_hook = self.auto_tenant_hook
             col.offload_backend = self.offload_backend
             self.collections[config.name] = col

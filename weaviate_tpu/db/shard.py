@@ -126,9 +126,18 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
 
 class Shard:
     def __init__(self, data_dir: str, collection: CollectionConfig, name: str,
-                 mesh=None, memwatch=None, async_indexing: bool | None = None):
+                 mesh=None, memwatch=None, async_indexing: bool | None = None,
+                 sync_wal: bool | None = None):
         self.name = name
         self.memwatch = memwatch
+        # PERSISTENCE_WAL_SYNC (reference: commit logger fsync
+        # discipline): fsync every acked write's WAL frame. Parsed by
+        # config._flag itself so the two can never disagree.
+        if sync_wal is None:
+            from weaviate_tpu.config import _flag
+
+            sync_wal = _flag(os.environ, "PERSISTENCE_WAL_SYNC")
+        self.sync_wal = sync_wal
         # ASYNC_INDEXING (reference env gate, repo.go/index_queue.go):
         # imports enqueue vectors; a background worker drains into the
         # vector index. Off by default — searches stay read-your-writes.
@@ -164,7 +173,7 @@ class Shard:
         self.dir = os.path.join(data_dir, collection.name, name)
         os.makedirs(self.dir, exist_ok=True)
         self._lock = threading.RLock()
-        self.store = KVStore(self.dir)
+        self.store = KVStore(self.dir, sync_wal=self.sync_wal)
         self.objects = self.store.bucket(BUCKET_OBJECTS, "replace")
         self.docid = self.store.bucket(BUCKET_DOCID, "replace")
         self.meta = self.store.bucket(BUCKET_META, "replace")

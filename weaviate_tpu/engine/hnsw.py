@@ -37,6 +37,7 @@ import threading
 
 import numpy as np
 
+from weaviate_tpu.runtime import faultline
 from weaviate_tpu.storage.wal import WriteAheadLog
 
 # filtered queries with fewer allowed candidates than this do a brute-force
@@ -879,15 +880,30 @@ class HNSWIndex:
     def condense(self):
         """Replace the op log with a snapshot (reference condensor.go:27 —
         theirs rewrites a minimal op stream; a snapshot is the same
-        fixed point)."""
+        fixed point).
+
+        Crash ordering: the snapshot must be DURABLY renamed into place
+        before the op log resets — fsync tmp, rename, fsync dir, only
+        then truncate. The old code reset the log right after an
+        un-fsynced ``os.replace``: a crash could leave a zero-length (or
+        garbage) hnsw.snap AND an empty log, losing the whole graph.
+        The ``hnsw.snap.pre/post_replace`` crashpoints kill in exactly
+        those two windows; restart must replay to the same graph."""
         if self._log_dir is None:
             return
+        from weaviate_tpu.storage import fsutil
+
         with self._lock:
             tmp = os.path.join(self._log_dir, "hnsw.snap.tmp")
             final = os.path.join(self._log_dir, "hnsw.snap")
             with open(tmp, "wb") as f:
-                pickle.dump(self.snapshot(), f, protocol=pickle.HIGHEST_PROTOCOL)
-            os.replace(tmp, final)
+                pickle.dump(self.snapshot(), f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+                f.flush()
+                os.fsync(f.fileno())
+            fsutil.atomic_replace(tmp, final, fsync_file_first=False,
+                                  crashpoint="hnsw.snap.pre_replace")
+            faultline.fire("hnsw.snap.post_replace", path=final)
             self._log.reset()
 
     def _replay(self, log_dir: str):
@@ -909,7 +925,14 @@ class HNSWIndex:
         if not os.path.exists(wal_path):
             return
         snap_count = self._count
-        for payload in WriteAheadLog.replay(wal_path):
+        from weaviate_tpu.storage import recovery
+        from weaviate_tpu.storage.wal import ReplayReport
+
+        rep = ReplayReport()
+        parts = os.path.normpath(log_dir).split(os.sep)[-2:]
+        rec = recovery.BucketRecovery(
+            "/".join([p for p in parts if p] + ["hnsw.wal"]))
+        for payload in WriteAheadLog.replay(wal_path, rep):
             op = pickle.loads(payload)
             tag = op[0]
             if tag == "N":
@@ -950,6 +973,13 @@ class HNSWIndex:
                 slot = self._id_to_slot.get(doc_id)
                 if slot is not None:
                     self._ep, self._max_level = slot, level
+        rec.wal_files_replayed = 1
+        rec.frames_replayed = rep.frames
+        rec.bytes_truncated = rep.bytes_truncated
+        if rep.quarantined:
+            rec.wals_quarantined = 1
+            rec.quarantined_files.append("hnsw.wal")
+        recovery.record(rec)
         if self._codes is not None and self._count > snap_count:
             # inserts logged after the compress snapshot carry no codes in
             # their WAL records — re-encode the replayed tail in one batch

@@ -22,10 +22,21 @@ actions:
                scenario a timeout alone cannot produce)
 - ``corrupt``  returned as a directive: the site damages the payload
                (transport garbles the response body; kv flips bytes)
+- ``crash``    ``os._exit(exit_code)`` (default 137, the SIGKILL status)
+               right at the fault point — the crashpoint primitive the
+               kill-restart-verify harness (tools/crashtest) schedules
+               at every persistence boundary
+- ``torn``     returned as the Schedule itself: the site (fsutil's
+               ``guarded_write`` file wrapper) writes the first
+               ``torn_bytes`` bytes of its payload, flushes, then
+               ``os._exit`` — a genuinely partial frame on disk,
+               simulating process death mid-``write(2)``
 
 Every injection bumps ``weaviate_tpu_fault_injected_total{point,action}``
 and annotates the active trace span, so a chaos run can assert that the
-metrics/span plumbing accounts for every fault it scheduled.
+metrics/span plumbing accounts for every fault it scheduled (``crash``/
+``torn`` injections die before any assert — their ledger is the on-disk
+state the harness verifies after restart).
 
 Known fault points (grep for ``faultline.fire`` to verify):
 
@@ -39,11 +50,23 @@ point                       boundary
 ``kv.get_many``             batched LSM point lookups (storage/kv)
 ``transfer.d2h``            the sanctioned device->host fetch (runtime/transfer)
 ``batcher.dispatch``        one coalesced device dispatch (runtime/query_batcher)
+``wal.append.pre_fsync``    WAL frame written (tear-able), before fsync
+``wal.append.post_fsync``   WAL frame durable, before the ack returns
+``wal.create``              new WAL file minted, before its dir entry is synced
+``segment.write.mid``       per record inside a segment write (tear-able)
+``segment.write.pre_rename``segment bytes fsynced, before os.replace
+``segment.post_rename``     segment renamed+dir-synced, before WAL delete
+``raft.persist.meta``       before (term, votedFor) hits the raft bucket
+``raft.persist.log``        before a log batch hits the raft bucket
+``raft.persist.snapshot``   before the FSM snapshot hits the raft bucket
+``hnsw.snap.pre_replace``   HNSW snapshot fsynced, before os.replace
+``hnsw.snap.post_replace``  snapshot durable, before the op-log reset
 ==========================  ==================================================
 """
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -58,6 +81,23 @@ _ARMED = False
 _lock = threading.Lock()
 _schedules: dict[str, list["Schedule"]] = {}
 
+#: the persistence crashpoints, in deterministic sweep order — the
+#: crashtest harness iterates exactly this tuple, and KNOWN_POINTS is
+#: derived from it below, so there is ONE list to maintain
+CRASHPOINTS = (
+    "wal.append.pre_fsync",
+    "wal.append.post_fsync",
+    "wal.create",
+    "segment.write.mid",
+    "segment.write.pre_rename",
+    "segment.post_rename",
+    "raft.persist.meta",
+    "raft.persist.log",
+    "raft.persist.snapshot",
+    "hnsw.snap.pre_replace",
+    "hnsw.snap.post_replace",
+)
+
 KNOWN_POINTS = frozenset({
     "transport.rpc.send",
     "remote.shard_op",
@@ -66,9 +106,9 @@ KNOWN_POINTS = frozenset({
     "kv.get_many",
     "transfer.d2h",
     "batcher.dispatch",
-})
+}) | frozenset(CRASHPOINTS)
 
-_ACTIONS = ("error", "latency", "drop", "corrupt")
+_ACTIONS = ("error", "latency", "drop", "corrupt", "crash", "torn")
 
 
 class FaultInjected(RuntimeError):
@@ -89,13 +129,15 @@ class Schedule:
     stream, never on wall time or thread identity."""
 
     __slots__ = ("point", "action", "nth", "every", "p", "latency_s",
-                 "times", "error", "match", "calls", "injected", "_rng")
+                 "times", "error", "match", "calls", "injected", "_rng",
+                 "exit_code", "torn_bytes")
 
     def __init__(self, point: str, action: str = "error", *,
                  nth: int | tuple | list | set | None = None,
                  every: int | None = None, p: float | None = None,
                  seed: int = 0, latency_s: float = 0.0,
-                 times: int | None = None, error=None, match=None):
+                 times: int | None = None, error=None, match=None,
+                 exit_code: int = 137, torn_bytes: int = 0):
         if action not in _ACTIONS:
             raise ValueError(f"unknown fault action {action!r}; "
                              f"expected one of {_ACTIONS}")
@@ -109,6 +151,8 @@ class Schedule:
         self.times = times
         self.error = error
         self.match = match
+        self.exit_code = exit_code   # crash/torn: os._exit status
+        self.torn_bytes = torn_bytes  # torn: payload bytes that land
         self.calls = 0     # calls SEEN (armed window only)
         self.injected = 0  # calls actually faulted
         self._rng = random.Random(seed)
@@ -184,16 +228,19 @@ def injected(point: str, action: str = "error", **kw):
             _ARMED = bool(_schedules)
 
 
-def fire(point: str, **attrs) -> str | None:
-    """The production-side hook. Returns ``None`` (proceed normally) or
-    a directive string (``"drop"``/``"corrupt"``) the site interprets;
-    raises the scheduled error for ``action="error"``. Disarmed this is
+def fire(point: str, **attrs) -> str | Schedule | None:
+    """The production-side hook. Returns ``None`` (proceed normally), a
+    directive string (``"drop"``/``"corrupt"``) the site interprets, or
+    the matched :class:`Schedule` for ``action="torn"`` (the site needs
+    its ``torn_bytes``/``exit_code``); raises the scheduled error for
+    ``action="error"``; ``action="crash"`` never returns — the process
+    exits right here, at the boundary the point names. Disarmed this is
     one global read and a return."""
     if not _ARMED:
         return None
     with _lock:
         scheds = list(_schedules.get(point, ()))
-    directive = None
+    directive: str | Schedule | None = None
     for sched in scheds:
         if sched.match is not None and not sched.match(attrs):
             continue
@@ -205,15 +252,46 @@ def fire(point: str, **attrs) -> str | None:
                 sched.injected += 1
         if not hit:
             continue
+        if sched.action == "crash":
+            # no metrics/span recording — the process is gone before any
+            # scrape; the on-disk state IS the ledger the harness reads
+            os._exit(sched.exit_code)
         _record(point, sched.action, attrs)
         if sched.action == "latency":
             time.sleep(sched.latency_s)
         elif sched.action == "error":
             err = sched.error() if callable(sched.error) else sched.error
             raise err if err is not None else FaultInjected(point)
+        elif sched.action == "torn":
+            directive = sched
         else:
             directive = sched.action
     return directive
+
+
+def arm_from_env(var: str = "WEAVIATE_TPU_FAULTLINE",
+                 env=None) -> list[Schedule]:
+    """Arm schedules described by a JSON env var — the bridge that lets
+    the crashtest harness schedule faults in a SUBPROCESS worker it is
+    about to kill. Value: a JSON list of Schedule kwargs, e.g.
+    ``[{"point": "wal.append.pre_fsync", "action": "crash", "nth": 3}]``.
+    Empty/absent arms nothing."""
+    import json
+
+    env = os.environ if env is None else env
+    raw = env.get(var, "")
+    if not raw:
+        return []
+    specs = json.loads(raw)
+    out = []
+    for spec in specs:
+        spec = dict(spec)
+        point = spec.pop("point")
+        action = spec.pop("action", "error")
+        if "nth" in spec and isinstance(spec["nth"], list):
+            spec["nth"] = set(spec["nth"])
+        out.append(arm(point, action, **spec))
+    return out
 
 
 def _record(point: str, action: str, attrs: dict) -> None:

@@ -297,6 +297,31 @@ lsm_compaction_duration = registry.histogram(
     "weaviate_tpu_lsm_compaction_duration_seconds",
     "Segment compaction latency", ("bucket",))
 
+# -- crash recovery (storage/recovery.py records these at every bucket
+#    open; /v1/debug/storage serves the same registry as JSON) ----------------
+
+recovery_frames_replayed = registry.counter(
+    "weaviate_tpu_recovery_frames_replayed_total",
+    "Intact WAL frames re-applied into the memtable at bucket open",
+    ("bucket",))
+recovery_bytes_truncated = registry.counter(
+    "weaviate_tpu_recovery_bytes_truncated_total",
+    "Torn-tail WAL bytes dropped at bucket open (crash mid-append)",
+    ("bucket",))
+recovery_wals_quarantined = registry.counter(
+    "weaviate_tpu_recovery_wals_quarantined_total",
+    "WAL files renamed .corrupt at open: a frame failed its CRC with "
+    "intact bytes after it (mid-file corruption, not a torn tail)",
+    ("bucket",))
+recovery_segments_quarantined = registry.counter(
+    "weaviate_tpu_recovery_segments_quarantined_total",
+    "Segment files renamed .corrupt at open (unparseable header/"
+    "footer/index)", ("bucket",))
+recovery_segments_recovered = registry.counter(
+    "weaviate_tpu_recovery_segments_recovered_total",
+    "Segments written from replayed WAL state at bucket open",
+    ("bucket",))
+
 # -- vector index internals (reference: hnsw/metrics.go) ----------------------
 
 vector_index_tombstones = registry.gauge(

@@ -86,7 +86,8 @@ class Server:
                                     raft_peers=peers, host=cfg.host,
                                     port=cfg.cluster_data_port,
                                     advertise=cfg.cluster_advertise or None,
-                                    remote_timeout=cfg.remote_rpc_timeout_s)
+                                    remote_timeout=cfg.remote_rpc_timeout_s,
+                                    sync_wal=cfg.wal_sync)
             self.node.start(seed_addrs=cfg.cluster_join or None)
             self.db = self.node.db
         else:
@@ -96,7 +97,8 @@ class Server:
                                local_node=cfg.cluster_hostname,
                                start_cycles=True,
                                memory_monitor=memwatch,
-                               async_indexing=cfg.async_indexing or None)
+                               async_indexing=cfg.async_indexing or None,
+                               sync_wal=cfg.wal_sync)
 
         modules = default_provider(self.db, enabled=cfg.enabled_modules)
 

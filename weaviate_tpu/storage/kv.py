@@ -43,7 +43,8 @@ import numpy as np
 
 from weaviate_tpu import native
 from weaviate_tpu.runtime import faultline, tracing
-from weaviate_tpu.storage.wal import WriteAheadLog
+from weaviate_tpu.storage import fsutil, recovery
+from weaviate_tpu.storage.wal import ReplayReport, WriteAheadLog
 
 logger = logging.getLogger(__name__)
 
@@ -367,7 +368,10 @@ class _Segment:
             for k, v in items:
                 idx_rows.append((0, len(k), f.tell(), len(v)))
                 keys.append(k)
-                f.write(v)
+                # crashpoint per record: a crash/torn schedule here
+                # leaves a partial segment at .tmp — never renamed, so
+                # recovery cannot even see it (the covering WAL replays)
+                fsutil.guarded_write(f, v, "segment.write.mid", path=tmp)
             keys_off = f.tell()
             off = keys_off
             for i, k in enumerate(keys):
@@ -399,7 +403,11 @@ class _Segment:
             f.write(struct.pack("<Q", foot_off))
             f.flush()
             os.fsync(f.fileno())
-        os.replace(tmp, path)
+        # fsync-file -> rename -> fsync-dir: the segment's NAME must be
+        # durable before the WAL that covers it may be deleted (fsutil
+        # ordering rules; handle already fsynced above)
+        fsutil.atomic_replace(tmp, path, fsync_file_first=False,
+                              crashpoint="segment.write.pre_rename")
         return cls(path)
 
 
@@ -637,6 +645,9 @@ class Bucket:
         self._memtable_metric = _m.lsm_memtable_bytes.labels(label)
         self._flush_metric = _m.lsm_flush_duration.labels(label)
         self._compaction_metric = _m.lsm_compaction_duration.labels(label)
+        # recovery report: everything this open repairs/quarantines is
+        # filed to storage/recovery (log + counters + /v1/debug/storage)
+        self._recovery = recovery.BucketRecovery(label)
         self._load_segments()
         self._wal_seq = 0
         self._write_gen = 0
@@ -645,12 +656,22 @@ class Bucket:
         self._recover_wals()
         if self._mem.wal is None:
             self._mem.wal = self._new_wal()
+        recovery.record(self._recovery)
 
     # -- startup -------------------------------------------------------------
 
     def _load_segments(self):
         """Open every on-disk segment. Caller holds ``_lock`` — in
         practice __init__, before the bucket is shared."""
+        # a crash mid-segment-write leaves a .tmp that was never
+        # renamed: invisible to recovery (the covering WAL replays),
+        # but clean it up so torn bytes don't accumulate forever
+        for f in os.listdir(self.dir):
+            if f.endswith(".db.tmp"):
+                try:
+                    os.remove(os.path.join(self.dir, f))
+                except OSError:
+                    pass
         segs = sorted(
             f for f in os.listdir(self.dir)
             if f.startswith("segment-") and f.endswith(".db")
@@ -672,6 +693,8 @@ class Bucket:
                 logger.error(
                     "bucket %s: segment %s is corrupt (%s) — quarantined "
                     "as .corrupt, its records are lost", self.name, s, e)
+                self._recovery.segments_quarantined += 1
+                self._recovery.quarantined_files.append(s)
                 try:
                     os.replace(path, path + ".corrupt")
                 except OSError:
@@ -704,7 +727,8 @@ class Bucket:
         replayed_paths = []
         for nm in names:
             path = os.path.join(self.dir, nm)
-            for payload in WriteAheadLog.replay(path):
+            rep = ReplayReport()
+            for payload in WriteAheadLog.replay(path, rep):
                 rec = msgpack.unpackb(payload, raw=False, strict_map_key=False)
                 if "B" in rec:  # raw-value batch frame (map import path)
                     for k, v in rec["B"]:
@@ -737,20 +761,28 @@ class Bucket:
                         _unpack_value(self.strategy, rec["v"])
                         if rec["v"] is not None else _TOMBSTONE)
             replayed_paths.append(path)
+            self._recovery.wal_files_replayed += 1
+            self._recovery.frames_replayed += rep.frames
+            self._recovery.bytes_truncated += rep.bytes_truncated
+            if rep.quarantined:
+                self._recovery.wals_quarantined += 1
+                self._recovery.quarantined_files.append(nm)
             if nm.startswith("wal-"):
                 seq = int(nm.split("-")[1].split(".")[0])
                 self._wal_seq = max(self._wal_seq, seq + 1)
         if self._mem.has_data:
-            # recovered state becomes one segment; stale WALs then delete
+            # recovered state becomes one (durably renamed) segment;
+            # only then may the stale WALs delete — reversing this
+            # order would lose the replayed frames to a second crash
             items = list(self._mem.packed_items(self.strategy))
             seg = self._write_segment(items)
             self._segments.append(seg)
             self._mem = self._new_mem(None)
+            self._recovery.segments_recovered += 1
         for path in replayed_paths:
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+            # a quarantined WAL was renamed .corrupt — the remove is a
+            # no-op there, the evidence file stays for forensics
+            fsutil.remove_durable(path)
 
     # -- write path ----------------------------------------------------------
 
@@ -1324,10 +1356,12 @@ class Bucket:
                     self._sealed.pop(0)
                 if mt.wal is not None:
                     mt.wal.close()
-                    try:
-                        os.remove(mt.wal.path)
-                    except OSError:
-                        pass
+                    # the covering WAL deletes only AFTER the segment's
+                    # rename is durable (atomic_replace inside
+                    # _Segment.write); a crash in this window replays
+                    # the WAL onto the new segment — idempotent
+                    fsutil.remove_durable(mt.wal.path,
+                                          crashpoint="segment.post_rename")
                 did = True
                 if max_tables is not None:
                     max_tables -= 1
@@ -1418,12 +1452,12 @@ class Bucket:
                 self._segments = ([merged_seg] if merged_seg else []) + tail
             # unlink only — concurrent readers may still hold the old list
             # snapshot; the inode stays alive until their references drop
-            # and GC closes the mmap (POSIX unlink-while-open semantics)
+            # and GC closes the mmap (POSIX unlink-while-open semantics).
+            # Durable unlink: a crash that rolls a delete back leaves
+            # old + merged coexisting, which replays consistently, but
+            # the fsync keeps the window one crash wide, not unbounded.
             for seg in snapshot:
-                try:
-                    os.remove(seg.path)
-                except OSError:
-                    pass
+                fsutil.remove_durable(seg.path)
 
     def close(self) -> None:
         self.flush()
@@ -1451,16 +1485,30 @@ class KVStore:
         self._lock = threading.Lock()
 
     def bucket(self, name: str, strategy: str = "replace", **kwargs) -> Bucket:
+        """``sync_wal`` in ``kwargs`` overrides the store default —
+        the raft bucket pins ``sync_wal=True`` regardless of config
+        (an unsynced vote/log ack breaks raft's safety argument). An
+        explicit override that CONTRADICTS an already-open bucket
+        raises: silently returning the unsynced instance would make the
+        pin a no-op and reopen the double-vote window with zero
+        diagnostic."""
+        explicit_sync = kwargs.get("sync_wal")
         with self._lock:
             if name not in self._buckets:
+                kwargs.setdefault("sync_wal", self.sync_wal)
                 self._buckets[name] = Bucket(
-                    self.dir, name, strategy, sync_wal=self.sync_wal, **kwargs
+                    self.dir, name, strategy, **kwargs
                 )
             b = self._buckets[name]
             if b.strategy != strategy:
                 raise ValueError(
                     f"bucket {name!r} exists with strategy {b.strategy!r}"
                 )
+            if explicit_sync is not None and b.sync_wal != explicit_sync:
+                raise ValueError(
+                    f"bucket {name!r} is already open with sync_wal="
+                    f"{b.sync_wal}; an explicit sync_wal={explicit_sync} "
+                    "request cannot be honored after the fact")
             return b
 
     def buckets(self) -> list[Bucket]:
