@@ -883,6 +883,97 @@ def sec_durability_tax(ctx):
     return out
 
 
+def sec_mixed_rw(ctx):
+    """Sustained mixed read/write on the epoch store (ISSUE 11): a
+    steady interleave of put/delete/query against an epoch-stacked
+    ``EpochStore``, then a delete-heavy tail and the background
+    compaction policy — asserting HBM ledger bytes actually FALL after
+    compaction (the reclamation single-buffer tombstones never gave
+    back). The benchkeeper guard is ``hbm_reclaimed_frac``, a
+    rig-independent ratio: if compaction stops folding tombstoned
+    capacity out of the ledger, mixed read/write traffic grows HBM
+    without bound again and this goes to ~0."""
+    import numpy as np
+
+    from weaviate_tpu.engine.epochs import EpochStore
+    from weaviate_tpu.runtime import hbm_ledger
+    from weaviate_tpu.runtime.hbm_ledger import ledger as _ledger
+
+    rng = ctx["rng"]
+    dim = 128
+    rows = int(os.environ.get("BENCH_MIXED_ROWS",
+                              str(min(ctx.get("n", 65536), 262144))))
+    epoch_rows = max(rows // 8, 2048)
+    k = 10
+    qbatch = 64
+    mbatch = 1024
+    with hbm_ledger.owner("bench_mixed", "s0"):
+        store = EpochStore(dim=dim, epoch_rows=epoch_rows,
+                           capacity=min(epoch_rows, 8192),
+                           chunk_size=min(epoch_rows, 8192))
+    # phase A: bulk fill (the staged-scatter fast path, per-epoch)
+    fill = rng.standard_normal((rows, dim)).astype(np.float32)
+    t0 = time.perf_counter()
+    for s in range(0, rows, 4096):
+        _retry_transient(lambda s=s: store.add(fill[s:s + 4096]))
+    _retry_transient(store.flush_staged)
+    fill_s = time.perf_counter() - t0
+    # phase B: steady mixed interleave — every iteration puts a batch,
+    # tombstones an older batch, and serves a query batch
+    iters = int(os.environ.get("BENCH_MIXED_ITERS", "16"))
+    oldest = 0
+    puts = dels = queries = 0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        _retry_transient(lambda: store.add(
+            rng.standard_normal((mbatch, dim)).astype(np.float32)))
+        puts += mbatch
+        store.delete(np.arange(oldest, oldest + mbatch, dtype=np.int64))
+        oldest += mbatch
+        dels += mbatch
+        q = rng.standard_normal((qbatch, dim)).astype(np.float32)
+        d, i = _retry_transient(lambda q=q: store.search(q, k))
+        assert (i[:, 0] >= 0).all()
+        queries += qbatch
+    mixed_s = max(time.perf_counter() - t0, 1e-9)
+    # phase C: delete-heavy tail, then the compaction policy reclaims
+    hbm_before = _ledger.shard_bytes("bench_mixed", "s0")
+    total = store.count
+    doomed = np.arange(oldest, total, dtype=np.int64)
+    store.delete(doomed[np.arange(len(doomed)) % 4 != 0])
+    store.seal_active()
+    compactions0 = store.compactions_total
+    for _ in range(8):
+        if not store.maintain():
+            break
+    hbm_after = _ledger.shard_bytes("bench_mixed", "s0")
+    reclaimed = 1.0 - hbm_after / max(hbm_before, 1)
+    if hbm_after >= hbm_before:
+        raise RuntimeError(
+            f"compaction reclaimed nothing: ledger {hbm_before} -> "
+            f"{hbm_after} bytes")
+    # survivors still serve after the folds
+    d, i = store.search(fill[: qbatch], k)
+    out = {
+        "rows": rows,
+        "epoch_rows": epoch_rows,
+        "epochs_final": store.epoch_count,
+        "fill_rows_per_s": round(rows / max(fill_s, 1e-9), 1),
+        "mixed_put_per_s": round(puts / mixed_s, 1),
+        "mixed_delete_per_s": round(dels / mixed_s, 1),
+        "mixed_query_qps": round(queries / mixed_s, 1),
+        "compactions": store.compactions_total - compactions0,
+        "hbm_before_bytes": int(hbm_before),
+        "hbm_after_bytes": int(hbm_after),
+        "hbm_reclaimed_frac": round(reclaimed, 4),
+    }
+    log(f"[mixed_rw] {out['mixed_query_qps']:.0f} qps under sustained "
+        f"put/delete ({out['mixed_put_per_s']:.0f}/s each); "
+        f"{out['compactions']} compactions reclaimed "
+        f"{reclaimed:.1%} of {hbm_before / 1e6:.1f} MB")
+    return out
+
+
 def sec_quantized(ctx):
     import numpy as np
 
@@ -1265,6 +1356,7 @@ SECTIONS = [
     ("quantized", sec_quantized, ("x", "rtt_s")),
     ("tracing_overhead", sec_tracing_overhead, ()),
     ("durability_tax", sec_durability_tax, ()),
+    ("mixed_rw", sec_mixed_rw, ("rng",)),
     ("kernel_conformance", sec_conformance, ("rng",)),
     ("served_pipeline", sec_served_pipeline, ()),
     ("serving_fabric", sec_fabric, ()),

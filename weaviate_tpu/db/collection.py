@@ -9,6 +9,7 @@ shard per tenant.
 from __future__ import annotations
 
 import functools
+import logging
 import os
 import threading
 import time
@@ -24,6 +25,8 @@ from weaviate_tpu.runtime import metrics as monitoring
 from weaviate_tpu.runtime import tracing
 from weaviate_tpu.schema.config import CollectionConfig
 from weaviate_tpu.storage.objects import StorageObject
+
+logger = logging.getLogger(__name__)
 
 
 class SearchResult:
@@ -108,6 +111,16 @@ class Collection:
         # FROZEN-tier offload target (a backup backend); set by Database
         self.offload_backend = None
         self._lock = threading.RLock()
+        # reentrancy guard for the epoch memory-pressure rescue (a
+        # migration's target-side ingest runs admission too)
+        self._rescue_tls = threading.local()
+        # at most ONE epoch migration in flight per collection: the
+        # mover holds the SOURCE shard's lock across ingest + cutover
+        # (so concurrent writes to the moving uuids can't be lost), and
+        # serializing migrations means only one thread ever nests two
+        # shard locks — no ABBA ordering can arise. RLock: a rescue
+        # fired from a migration's own target-side admission re-enters.
+        self._migrate_lock = threading.RLock()
         # Sharded per-uuid write locks for read-modify-write flows
         # (reference appends, PATCH) — the RMW must be atomic per object but
         # must not hold the collection-wide lock across a replicated put,
@@ -216,11 +229,17 @@ class Collection:
         # same on-disk shard
         with self._lock:
             if name not in self.shards:
-                self.shards[name] = Shard(
+                shard = Shard(
                     self.data_dir, self.config, name, mesh=self.mesh,
                     memwatch=self.memwatch,
                     async_indexing=self.async_indexing,
                     sync_wal=self.sync_wal)
+                # admission rescue: compact tombstone-heavy epochs,
+                # then migrate the coldest sealed epoch to a sibling
+                # with headroom, BEFORE a 507 latches (epoch policy)
+                shard.memory_rescue = (
+                    lambda s=shard: self._rescue_shard(s))
+                self.shards[name] = shard
             return self.shards[name]
 
     def _require_active(self, tenant: str) -> None:
@@ -482,7 +501,12 @@ class Collection:
             return
         node = nodes[0]
         if node == self.local_node:
-            self._load_shard(shard_name).put_object_batch(objs)
+            shard = self._load_shard(shard_name)
+            shard.put_object_batch(objs)
+            # clean any migrated sibling copy AFTER the fresh write
+            # landed: a 507/crash before the write must leave the old
+            # copy intact (double-present is deduped; lost is lost)
+            self._unmigrate(shard, objs)
         else:
             self._require_remote(shard_name).put_objects(
                 node, self.config.name, shard_name,
@@ -564,7 +588,15 @@ class Collection:
 
             return Finder(self).get_object(uuid, name, consistency)
         if self._is_local(name):
-            return self._load_shard(name).get_object(uuid)
+            shard = self._load_shard(name)
+            obj = shard.get_object(uuid)
+            if obj is None:
+                # epoch migration moved this object to a sibling: the
+                # durable marker keeps ring routing correct
+                dst = shard.migrated_to(uuid)
+                if dst and dst != name and self._is_local(dst):
+                    return self._load_shard(dst).get_object(uuid)
+            return obj
         raw = self._require_remote(name).get_object(
             self._read_node(name), self.config.name, name, uuid)
         return None if raw is None else StorageObject.from_bytes(raw)
@@ -579,7 +611,16 @@ class Collection:
 
             ok = Replicator(self).delete(name, uuid, consistency)
         elif nodes[0] == self.local_node:
-            ok = self._load_shard(name).delete_object(uuid)
+            shard = self._load_shard(name)
+            ok = shard.delete_object(uuid)
+            # a migrated copy (or the transient double-present crash
+            # window) lives at the marker's destination — delete it too
+            # so exactly zero copies remain, and drop the marker
+            dst = shard.migrated_to(uuid)
+            if dst and dst != name and self._is_local(dst):
+                ok = self._load_shard(dst).delete_object(uuid) or ok
+            if dst:
+                shard.clear_migrated(uuid)
         else:
             ok = self._require_remote(name).delete_object(
                 nodes[0], self.config.name, name, uuid)
@@ -819,6 +860,200 @@ class Collection:
                         r.object = StorageObject.from_bytes(raw) \
                             if raw else None
 
+    # -- epoch migration (ROADMAP item 3: ledger-driven epoch placement) ------
+
+    def _unmigrate(self, shard, objs) -> None:
+        """A re-put at an object's ring home supersedes its migrated
+        copy: delete the sibling's copy and drop the routing marker —
+        called AFTER the fresh write landed (a failed or crashed re-put
+        must never have destroyed the only copy first; the transient
+        double-present window is deduped by uuid in the merge, and GETs
+        prefer the ring copy). Zero-cost when the shard never migrated
+        anything."""
+        if shard._migrated_count <= 0:
+            return
+        for obj in objs:
+            dst = shard.migrated_to(obj.uuid)
+            if dst and dst != shard.name and self._is_local(dst):
+                self._load_shard(dst).delete_object(obj.uuid)
+            if dst:
+                shard.clear_migrated(obj.uuid)
+
+    def _sibling_with_headroom(self, src_name: str) -> str | None:
+        """The local sibling shard with the most HBM headroom (smallest
+        ledger footprint) — the migration target. None when this
+        collection has no other local shard."""
+        from weaviate_tpu.runtime.hbm_ledger import ledger
+
+        def over_quota(name: str) -> bool:
+            # quota check from ALREADY-LOADED shards only: a cold shard
+            # holds no device arrays (its ledger bytes are ~0), and
+            # constructing N-1 Shard objects mid-rescue — fresh device
+            # stores, bucket opens — is exactly wrong under pressure
+            sh = self.shards.get(name)
+            return sh is not None and sh.over_shard_limit()
+
+        best, best_bytes = None, None
+        for name in self.sharding.shard_names:
+            if name == src_name or not self._is_local(name):
+                continue
+            if over_quota(name):
+                continue  # no headroom there either
+            b = ledger.shard_bytes(self.config.name, name)
+            if best_bytes is None or b < best_bytes:
+                best, best_bytes = name, b
+        if best is None:
+            return None
+        if over_quota(src_name):
+            # quota pressure: any under-quota sibling IS headroom
+            return best
+        src_bytes = ledger.shard_bytes(self.config.name, src_name)
+        # "headroom exists" = the sibling is meaningfully lighter than
+        # the source; migrating between two equally-full shards would
+        # just bounce the epoch back on the next cycle
+        return best if best_bytes < src_bytes else None
+
+    def migrate_epoch(self, src_name: str, vec_name: str = "",
+                      dst_name: str | None = None) -> int:
+        """Migrate the coldest sealed epoch of ``src_name``'s
+        epoch-backed index to a sibling shard with headroom: serialize
+        the epoch's objects from the source LSM, durable ingest on the
+        target (``Shard.put_object_batch`` — vectors land in the
+        target's device epochs), then the atomic source-side cutover
+        (``Shard.migrate_out``: durable routing markers + slot→doc-id
+        table rows dropped under the index lock) and the epoch's HBM
+        released (``drop_epoch``). Crash ordering keeps every object
+        served EXACTLY once: before the cutover markers the ring copy
+        answers; after them the marker routes reads to the target; the
+        transient double-present window is deduped by uuid in the
+        scatter-gather merge. Returns objects moved (0 = nothing to
+        do). Single-replica, non-tenant collections only — a replicated
+        shard's epochs rebalance through the replication story, not
+        this local move."""
+        if (self.config.replication.factor > 1
+                or self.config.multi_tenancy.enabled
+                or not self._is_local(src_name)):
+            return 0
+        src = self._load_shard(src_name)
+        moved_total = 0
+        with self._migrate_lock:
+            for name, idx in list(src.vector_indexes.items()):
+                if vec_name and name != vec_name:
+                    continue
+                es = getattr(idx, "epoch_store", None)
+                if es is None:
+                    continue
+                eid = es.coldest_sealed()
+                if eid is None:
+                    continue
+                dst = dst_name or self._sibling_with_headroom(src_name)
+                if dst is None or dst == src_name \
+                        or not self._is_local(dst):
+                    return moved_total
+                moved_total += self._migrate_one(src, idx, es, eid, dst)
+        return moved_total
+
+    def _migrate_one(self, src, idx, es, eid: int, dst: str) -> int:
+        """Move one epoch. Caller holds ``_migrate_lock``. The SOURCE
+        shard's lock is held across serialize -> target ingest ->
+        cutover so a concurrent put/delete of a moving uuid cannot land
+        in the un-synchronized window (it would be erased by the
+        cutover, or resurrected from the target's pre-write copy);
+        writers to the source simply queue behind the move, bounded by
+        one epoch's ingest."""
+        from weaviate_tpu.runtime import faultline
+
+        src_name = src.name
+        with src._lock:
+            doc_ids = idx.epoch_doc_ids(eid)
+            if not len(doc_ids):
+                es.drop_epoch(eid)
+                return 0
+            objs = [o for o in src.objects_by_doc_ids(doc_ids)
+                    if o is not None]
+            if not objs:
+                return 0
+            # 1) durable routing markers FIRST: from here on, deletes
+            #    and re-puts of a moving uuid reach BOTH sides no
+            #    matter where a kill lands (a marker to a copy that
+            #    never ingests is harmless — GETs prefer the ring copy)
+            src.mark_migrating([o.uuid for o in objs], dst)
+            faultline.fire("epoch.migrate.pre_ingest", shard=src_name,
+                           epoch=eid, docs=len(doc_ids))
+            try:
+                # 2) durable ingest at the target (fresh doc ids there;
+                #    vectors land in the target's own device epochs)
+                self._load_shard(dst).put_object_batch(objs)
+            except MemoryError:
+                # the sibling hit ITS watermark mid-ingest: nothing was
+                # cut over, the source still serves — clean the markers
+                # back off (nothing landed at dst) and report no move
+                for o in objs:
+                    src.clear_migrated(o.uuid)
+                logger.warning(
+                    "epoch migration %s/%s e%d -> %s aborted: target "
+                    "at watermark", self.config.name, src_name, eid, dst)
+                return 0
+            faultline.fire("epoch.migrate.post_ingest", shard=src_name,
+                           epoch=eid)
+            # 3) source cutover: the batched removal from LSM +
+            #    slot→doc-id tables (markers already durable)
+            src.migrate_out([o.uuid for o in objs], dst)
+            faultline.fire("epoch.migrate.post_cutover", shard=src_name,
+                           epoch=eid)
+            # 4) the (now all-tombstone) epoch's HBM releases through
+            #    the ledger finalizers at cutover
+            es.drop_epoch(eid)
+            es.migrations_total += 1
+        monitoring.epoch_migrations.labels(self.config.name,
+                                           src_name).inc()
+        logger.info(
+            "epoch migration: %s/%s e%d -> %s (%d objects)",
+            self.config.name, src_name, eid, dst, len(objs))
+        return len(objs)
+
+    def epoch_maintenance(self) -> bool:
+        """One background policy cycle (registered with the database's
+        cyclemanager as ``epoch-maintenance`` — the ONLY driver of epoch
+        upkeep, so the work runs once per interval): per-shard seal /
+        drop / compact — deletes RECLAIM HBM here, which is what
+        relieves the device-GLOBAL admission watermark — then migrate
+        the coldest sealed epoch off any shard over its per-shard quota
+        watermark to a sibling with headroom instead of letting the
+        quota 507 writes. (A local move cannot reduce device-global
+        usage — two shards of one process share the chips — so only
+        quota pressure, the budget migration genuinely relieves,
+        triggers it.)"""
+        did = False
+        with self._lock:
+            shards = list(self.shards.values())
+        for shard in shards:
+            did = shard.epoch_maintenance() or did
+        for shard in shards:
+            if shard.over_shard_limit():
+                did = self.migrate_epoch(shard.name) > 0 or did
+        return did
+
+    def _rescue_shard(self, shard) -> bool:
+        """Synchronous memory-pressure rescue (wired as
+        ``shard.memory_rescue``): compact first — tombstone-heavy
+        epochs give bytes back without moving anything — then migrate
+        the coldest sealed epoch to a sibling with headroom. Runs on
+        the importing thread, once, before admission re-checks. The
+        thread-local reentrancy guard stops a migration's own
+        target-side ingest (which runs admission too) from cascading
+        rescues across the ring."""
+        if getattr(self._rescue_tls, "active", False):
+            return False
+        self._rescue_tls.active = True
+        try:
+            did = shard.epoch_maintenance()
+            if shard.over_shard_limit():
+                did = self.migrate_epoch(shard.name) > 0 or did
+            return did
+        finally:
+            self._rescue_tls.active = False
+
     @staticmethod
     def _and_masks(a, b) -> np.ndarray:
         """Intersect two allow lists (bool mask or doc-id array forms)."""
@@ -866,8 +1101,29 @@ class Collection:
                 d[li, pos] = r.distance
                 idx[li, pos] = len(flat)
                 flat.append(r)
-        _, out_i = native.merge_topk_host(d, idx, k=min(k, len(flat)))
-        return [flat[i] for i in out_i.tolist() if i >= 0]
+        # merge OVERSAMPLED (2k) so the uuid dedup below can drop a
+        # transient double-present copy without eating into the k
+        # contract — a duplicate pair in the top-k would otherwise
+        # shadow the next distinct candidate
+        _, out_i = native.merge_topk_host(d, idx, k=min(2 * k, len(flat)))
+        # dedup by uuid, best (first, ascending) distance wins: an
+        # epoch-migration crash window can briefly leave an object
+        # present on two shards — it must never be served twice.
+        # Results without a uuid (score-only merges) always pass.
+        out, seen = [], set()
+        for i in out_i.tolist():
+            if i < 0:
+                continue
+            r = flat[i]
+            u = getattr(r, "uuid", None)
+            if u is not None:
+                if u in seen:
+                    continue
+                seen.add(u)
+            out.append(r)
+            if len(out) == k:
+                break
+        return out
 
     @_timed("vector")
     def near_vector(self, query, k: int = 10, vec_name: str = "",

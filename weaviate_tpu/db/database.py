@@ -62,6 +62,13 @@ class Database:
         self.cycles = CycleManager()
         self.cycles.register("lsm-maintenance", self._maintenance_cycle,
                              maintenance_interval)
+        # epoch policy (ROADMAP item 3): seal/compact/drop device epochs
+        # (deletes reclaim HBM — what relieves the device-global
+        # watermark) and, at a shard's per-shard quota watermark,
+        # migrate its coldest sealed epoch to a sibling with headroom
+        # instead of letting the quota 507 writes
+        self.cycles.register("epoch-maintenance", self._epoch_cycle,
+                             maintenance_interval)
         if start_cycles:
             self.cycles.start()
         self._load_existing()
@@ -71,6 +78,12 @@ class Database:
         for col in list(self.collections.values()):
             for shard in list(col.shards.values()):
                 did = shard.maintenance() or did
+        return did
+
+    def _epoch_cycle(self) -> bool:
+        did = False
+        for col in list(self.collections.values()):
+            did = col.epoch_maintenance() or did
         return did
 
     def _load_existing(self):

@@ -54,12 +54,14 @@ def _make_vector_index(vc: VectorConfig, dim: int, mesh=None):
             rescore_limit=cfg.rescore_limit,
             prefix_bits=cfg.prefix_bits,
             mesh=mesh,
+            epoch_rows=cfg.epoch_rows,
             **common,
         )
     if cfg.index_type == "flat":
         return FlatIndex(
             mesh=mesh,
             dtype=jnp.bfloat16 if cfg.storage_dtype == "bfloat16" else jnp.float32,
+            epoch_rows=cfg.epoch_rows,
             **common,
         )
     if cfg.index_type == "ivf":
@@ -182,6 +184,25 @@ class Shard:
         self.tombstones = self.store.bucket("tombstones", "replace")
         # staged 2PC batches: request id -> ("put", [objs]) | ("delete", uuid)
         self._staged: dict[str, tuple] = {}
+        # epoch-migration routing overrides (uuid -> destination shard),
+        # durable in the meta bucket; the in-memory count makes the
+        # common case (no migrations) a zero-cost check on reads/puts
+        self._migrated_count = sum(
+            1 for k in self.meta.keys() if k.startswith(b"migrated:"))
+        # memory-pressure rescue hook (db/collection.py wires this to
+        # epoch compaction + migration): called once when admission
+        # would 507, then admission re-checks before actually rejecting
+        self.memory_rescue = None
+        # optional per-shard HBM quota (WEAVIATE_TPU_SHARD_HBM_LIMIT_
+        # BYTES): the placement-level watermark epoch migration exists
+        # for — moving the coldest sealed epoch to a sibling genuinely
+        # relieves THIS shard's ledger footprint, where the device-
+        # global budget only compaction can relieve locally
+        try:
+            self.shard_hbm_limit = int(os.environ.get(
+                "WEAVIATE_TPU_SHARD_HBM_LIMIT_BYTES", "0") or 0)
+        except ValueError:
+            self.shard_hbm_limit = 0
         self._counter = self.meta.get(b"doc_counter") or 0
         self.read_only = bool(self.meta.get(b"read_only") or False)
         self.mesh = mesh
@@ -353,19 +374,39 @@ class Shard:
             last = {o.uuid: i for i, o in enumerate(objs)}
             objs = [objs[i] for i in sorted(last.values())]
         doc_ids: list[int] = []
+        gate = self.memwatch is not None or self.shard_hbm_limit
+        if gate:
+            # optimistic rescue pass, OUTSIDE the shard lock so the
+            # hook (epoch compaction, then migrating the coldest sealed
+            # epoch to a sibling — db/collection.py) can touch sibling
+            # shards without a lock cycle. The AUTHORITATIVE admission
+            # check re-runs under the lock below, serialized with the
+            # adds, so N concurrent importers can't all pass against
+            # the same stale usage. Read-only shards skip the rescue —
+            # they refuse with ShardReadOnlyError, not 507.
+            nbytes = sum(int(np.asarray(v).nbytes)
+                         for o in objs for v in o.vectors.values())
+            if not self.read_only:
+                try:
+                    self._admit_device_bytes(nbytes)
+                except MemoryError:
+                    if self.memory_rescue is None:
+                        raise
+                    try:
+                        self.memory_rescue()
+                    except Exception:  # noqa: BLE001 — best-effort; the
+                        logger.exception(  # typed 507 below is the answer
+                            "shard %s/%s: memory-pressure rescue failed",
+                            self.collection_name, self.name)
         with self._lock:
             if self.read_only:
                 raise ShardReadOnlyError(
                     f"shard {self.name!r} is read-only (status READONLY)")
             self._validate_vectors(objs)
-            if self.memwatch is not None:
+            if gate:
                 # refuse BEFORE mutating anything (reference memwatch
-                # CheckAlloc gates imports): vectors land in device HBM
-                nbytes = sum(int(np.asarray(v).nbytes)
-                             for o in objs for v in o.vectors.values())
-                self.memwatch.check_device_alloc(
-                    nbytes,
-                    what=f"import {self.collection_name}/{self.name}")
+                # CheckAlloc semantics): vectors land in device HBM
+                self._admit_device_bytes(nbytes)
             vec_batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
             # doc ids for the whole batch come from one counter bump (one
             # meta write instead of len(objs))
@@ -475,6 +516,16 @@ class Shard:
             # idx.store after the batcher exists.
             def _gathered_capacity(i=idx) -> int:
                 s = getattr(i, "store", None)
+                es = getattr(i, "epoch_store", None)
+                if es is not None:
+                    # single-epoch passthrough keeps the solo gathered
+                    # cutover (the epoch IS a DeviceVectorStore); a
+                    # multi-epoch stack has no host-remap solo path, so
+                    # selective filters ride the batched bitmask there
+                    if (es.mesh is None and not es.quantization
+                            and es.epoch_count == 1):
+                        return es.capacity
+                    return 0
                 if (s is None or getattr(s, "mesh", None) is not None
                         or not hasattr(s, "_dispatch_gathered")):
                     return 0
@@ -838,6 +889,113 @@ class Shard:
             self.read_only = bool(value)
             self.meta.put(b"read_only", bool(value))
 
+    # -- epoch migration (db/collection.py orchestrates; see
+    #    ARCHITECTURE.md "Epoch store") ---------------------------------------
+
+    def _admit_device_bytes(self, nbytes: int) -> None:
+        """Both admission gates, typed 507 on either: the device-global
+        watermark (memwatch; compaction relieves it) and the per-shard
+        quota (ledger bytes vs ``shard_hbm_limit``; epoch MIGRATION
+        relieves it — the bytes move to a sibling's ledger scope)."""
+        what = f"import {self.collection_name}/{self.name}"
+        if self.memwatch is not None:
+            self.memwatch.check_device_alloc(nbytes, what=what)
+        if self.shard_hbm_limit and self.over_shard_limit(nbytes):
+            from weaviate_tpu.runtime.hbm_ledger import ledger
+            from weaviate_tpu.runtime.memwatch import \
+                InsufficientMemoryError
+
+            used = ledger.shard_bytes(self.collection_name, self.name)
+            high = (self.memwatch.high_watermark
+                    if self.memwatch is not None else 0.9)
+            raise InsufficientMemoryError(
+                f"device allocation of {nbytes} bytes ({what}) would "
+                f"exceed {high:.0%} of shard HBM quota "
+                f"{self.shard_hbm_limit} (ledger usage {used})",
+                projected=used + int(nbytes),
+                budget=self.shard_hbm_limit, source="ledger")
+
+    def over_shard_limit(self, extra: int = 0) -> bool:
+        """Is this shard's ledger footprint (+``extra``) past its quota
+        watermark? The epoch policy migrates when this trips."""
+        if not self.shard_hbm_limit:
+            return False
+        from weaviate_tpu.runtime.hbm_ledger import ledger
+
+        high = (self.memwatch.high_watermark
+                if self.memwatch is not None else 0.9)
+        used = ledger.shard_bytes(self.collection_name, self.name)
+        return used + int(extra) > self.shard_hbm_limit * high
+
+    def migrated_to(self, uuid: str) -> str | None:
+        """Destination shard of a migrated object, or None. The durable
+        marker keeps uuid ring routing correct after an epoch moved its
+        objects to a sibling; the in-memory count keeps this a no-op
+        when no migration ever happened."""
+        if self._migrated_count <= 0:
+            return None
+        v = self.meta.get(b"migrated:" + uuid.encode())
+        if v is None:
+            return None
+        return v.decode() if isinstance(v, (bytes, bytearray)) else str(v)
+
+    def clear_migrated(self, uuid: str) -> None:
+        """Drop a routing override (the object was re-put or deleted at
+        its ring home)."""
+        with self._lock:
+            if self.meta.get(b"migrated:" + uuid.encode()) is not None:
+                self.meta.delete(b"migrated:" + uuid.encode())
+                self._migrated_count = max(0, self._migrated_count - 1)
+
+    def mark_migrating(self, uuids: list[str], dst_name: str) -> None:
+        """Durably record the routing markers (one WAL frame) BEFORE
+        the destination ingest: a kill anywhere after this point leaves
+        every copy findable — GETs prefer the ring copy and follow the
+        marker only on a miss, deletes/re-puts clean BOTH sides through
+        the marker, search dedups by uuid. A marker pointing at a copy
+        that never landed (kill before ingest) is harmless for the same
+        reasons."""
+        with self._lock:
+            keys = [b"migrated:" + u.encode() for u in uuids]
+            fresh = sum(1 for k in keys if self.meta.get(k) is None)
+            self.meta.put_many([(k, dst_name) for k in keys])
+            self._migrated_count += fresh  # re-marking an interrupted
+            # move must not inflate the fast-path counter
+
+    def migrate_out(self, uuids: list[str], dst_name: str) -> int:
+        """Source-side cutover AFTER the destination acked the ingest
+        (markers were written by ``mark_migrating`` before it): remove
+        the objects — batched index tombstones, inverted unindex,
+        docid/objects deletes. Crash ordering: a kill before this point
+        leaves a double-present object (never a lost one, and the
+        pre-ingest markers mean deletes reach both copies); after it,
+        reads route through the markers to the destination."""
+        with self._lock:
+            keys = [u.encode() for u in uuids]
+            pairs = []
+            for u, k in zip(uuids, keys):
+                raw = self.docid.get(k)
+                if raw is not None:
+                    pairs.append((int(raw), u))
+            if pairs:
+                self._delete_docs_batch(pairs)
+            self.docid.delete_many(keys)
+            self.objects.delete_many(keys)
+            return len(pairs)
+
+    def epoch_maintenance(self) -> bool:
+        """Run the epoch policy for every epoch-backed index on this
+        shard: seal overfull actives, drop empty sealed epochs, fold
+        tombstone-heavy ones (reclaims HBM through the ledger
+        finalizers). Returns True when work was done (cyclemanager
+        backoff signal)."""
+        did = False
+        for idx in self.vector_indexes.values():
+            es = getattr(idx, "epoch_store", None)
+            if es is not None:
+                did = es.maintain() or did
+        return did
+
     # -- replication support -------------------------------------------------
 
     STAGED_TTL_S = 120.0
@@ -1010,12 +1168,16 @@ class Shard:
             vector_index_compressed.labels(*labels).set(
                 1 if getattr(idx, "compressed", False) else 0)
             hbm = 0
-            for arr_name in ("vectors", "valid", "sq_norms", "codes",
-                             "rescore_rows", "list_vecs", "list_codes",
-                             "list_valid", "list_slots", "list_norms"):
-                arr = getattr(store, arr_name, None)
-                if arr is not None and hasattr(arr, "nbytes"):
-                    hbm += int(arr.nbytes)
+            stores = ([ep.store for ep in store.epochs]
+                      if getattr(idx, "epoch_store", None) is not None
+                      else [store])
+            for st in stores:
+                for arr_name in ("vectors", "valid", "sq_norms", "codes",
+                                 "rescore_rows", "list_vecs", "list_codes",
+                                 "list_valid", "list_slots", "list_norms"):
+                    arr = getattr(st, arr_name, None)
+                    if arr is not None and hasattr(arr, "nbytes"):
+                        hbm += int(arr.nbytes)
             vector_index_hbm_bytes.labels(*labels).set(hbm)
         return did
 

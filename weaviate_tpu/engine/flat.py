@@ -59,7 +59,8 @@ class FlatIndex:
     def __init__(self, dim: int, metric: str = "l2-squared", mesh=None,
                  dtype=None, capacity: int = 8192, chunk_size: int = 8192,
                  quantization: str | None = None, store=None,
-                 selection: str = "approx", **quant_kwargs):
+                 selection: str = "approx", epoch_rows: int = 0,
+                 **quant_kwargs):
         import jax.numpy as jnp
 
         self.dim = dim
@@ -68,6 +69,19 @@ class FlatIndex:
             # injected store (IVFIndex subclass passes an IVFStore; the
             # id<->slot bookkeeping below is store-agnostic)
             self.store = store
+        elif epoch_rows:
+            # epoch-stacked device corpus (engine/epochs.py): writes land
+            # in a small active epoch, sealed epochs are immutable,
+            # tombstone-heavy ones compact in the background and the
+            # coldest can migrate to a sibling shard under HBM pressure
+            from weaviate_tpu.engine.epochs import EpochStore
+
+            self.store = EpochStore(
+                dim=dim, metric=metric, epoch_rows=epoch_rows,
+                capacity=capacity, dtype=dtype, mesh=mesh,
+                chunk_size=chunk_size, selection=selection,
+                quantization=quantization,
+                quant_kwargs=quant_kwargs or None)
         elif quantization:
             from weaviate_tpu.engine.quantized import QuantizedVectorStore
 
@@ -320,11 +334,17 @@ class FlatIndex:
         the store (reference: hnsw/compress.go:38, enabled via a config
         update once enough data exists). Slot layout is preserved, so the
         id<->slot mapping carries over untouched."""
+        from weaviate_tpu.engine.epochs import EpochStore
         from weaviate_tpu.engine.quantized import QuantizedVectorStore
         from weaviate_tpu.runtime import hbm_ledger
 
         with self._lock:
             old = self.store
+            if isinstance(old, EpochStore):
+                if old.quantization:
+                    raise RuntimeError("index is already compressed")
+                return self._compress_epochs(old, quantization,
+                                             **quant_kwargs)
             if isinstance(old, QuantizedVectorStore):
                 raise RuntimeError("index is already compressed")
             snap = old.snapshot()
@@ -356,12 +376,66 @@ class FlatIndex:
             new._count = snap["count"]
             self.store = new
 
+    def _compress_epochs(self, old, quantization: str,
+                         **quant_kwargs) -> None:
+        """Epoch-preserving compression: the quantized twin keeps the
+        SAME global slot layout (epochs re-split by epoch_rows), so the
+        id<->slot tables carry over untouched. Caller holds ``_lock``."""
+        from weaviate_tpu.engine.epochs import EpochStore
+        from weaviate_tpu.runtime import hbm_ledger
+
+        snap = old.snapshot()
+        own = getattr(old, "_owner", None) or hbm_ledger.current_owner()
+        with hbm_ledger.owner(**own):
+            new = EpochStore(
+                dim=self.dim, metric=self.metric,
+                epoch_rows=old.epoch_rows, chunk_size=old.chunk_size,
+                mesh=old.mesh, selection=old.selection,
+                quantization=quantization, quant_kwargs=quant_kwargs)
+        live = np.nonzero(snap["valid"])[0]
+        live_vecs = snap["vectors"][live]
+        if quantization == "pq":
+            centroids = new._quant_kwargs.get("pq_centroids", 16)
+            if len(live) < centroids:
+                raise RuntimeError(
+                    f"need >= {centroids} live vectors to train PQ, "
+                    f"have {len(live)}")
+        new._restore_rows(live, snap["vectors"], int(snap["count"]))
+        if quantization == "pq":
+            new.train(live_vecs)
+        self.store = new
+
     @property
     def compressed(self) -> bool:
         """Reference Compressed() (vector_index.go:37)."""
+        from weaviate_tpu.engine.epochs import EpochStore
         from weaviate_tpu.engine.quantized import QuantizedVectorStore
 
+        if isinstance(self.store, EpochStore):
+            return bool(self.store.quantization)
         return isinstance(self.store, QuantizedVectorStore)
+
+    # -- epoch hooks (engine/epochs.py; db/collection.py migration) -----------
+
+    @property
+    def epoch_store(self):
+        """The backing ``EpochStore`` when this index is epoch-backed,
+        else None (the maintenance policy keys on this)."""
+        from weaviate_tpu.engine.epochs import EpochStore
+
+        return self.store if isinstance(self.store, EpochStore) else None
+
+    def epoch_doc_ids(self, eid: int) -> np.ndarray:
+        """Doc ids of one epoch's live rows — the unit the migration
+        policy serializes to a sibling shard."""
+        es = self.epoch_store
+        if es is None:
+            return np.empty(0, np.int64)
+        with self._lock:
+            gslots = es.live_globals_of(eid)
+            gslots = gslots[gslots < len(self._slot_to_id)]
+            ids = self._slot_to_id[gslots]
+            return ids[ids >= 0]
 
     # -- maintenance / persistence -------------------------------------------
 
@@ -388,7 +462,11 @@ class FlatIndex:
         idx = cls.__new__(cls)
         idx.dim = snap["dim"]
         idx.metric = snap["metric"]
-        if snap.get("quantization"):
+        if snap.get("epoch_rows"):
+            from weaviate_tpu.engine.epochs import EpochStore
+
+            idx.store = EpochStore.restore(snap, mesh=mesh, **kwargs)
+        elif snap.get("quantization"):
             from weaviate_tpu.engine.quantized import QuantizedVectorStore
 
             idx.store = QuantizedVectorStore.restore(snap, mesh=mesh, **kwargs)
@@ -396,7 +474,13 @@ class FlatIndex:
             idx.store = DeviceVectorStore.restore(snap, mesh=mesh, **kwargs)
         idx._lock = threading.RLock()
         slot_to_id = snap["slot_to_id"]
-        idx._slot_to_id = np.full(idx.store.capacity, -1, dtype=np.int64)
+        # the snapshot's table can be WIDER than the restored store's
+        # capacity (an epoch store sealed early keeps an active epoch's
+        # unused range; restore re-splits by epoch_rows) — size to the
+        # max so no entry is dropped; slots past the restored count are
+        # -1 (nothing live ever pointed there)
+        size = max(idx.store.capacity, len(slot_to_id))
+        idx._slot_to_id = np.full(size, -1, dtype=np.int64)
         idx._slot_to_id[: len(slot_to_id)] = slot_to_id
         idx._id_to_slot = {
             int(doc): int(slot)

@@ -126,6 +126,10 @@ class QuantizedVectorStore:
         # (approx_max_k, default) or "fused" (exact in-kernel running-
         # carry top-k — pallas_kernels.fused_topk_pairs)
         selection: str = "approx",
+        # HBM-ledger component suffix ("@e3" for epoch stores): codes/
+        # prefix/rescore_rows register as "codes@e3" etc. so per-epoch
+        # device bytes are individually visible and individually released
+        component_suffix: str = "",
     ):
         if quantization not in ("pq", "bq"):
             raise ValueError(f"unknown quantization {quantization!r}")
@@ -165,6 +169,7 @@ class QuantizedVectorStore:
         )
         self.mesh = mesh
         self.n_shards = 1 if mesh is None else mesh.shape[SHARD_AXIS]
+        self.hbm_component_suffix = component_suffix
         self.prefix_words = 0
         if prefix_bits and mesh is None:
             wp = max(4, prefix_bits // 32 // 4 * 4)
@@ -261,7 +266,8 @@ class QuantizedVectorStore:
 
         def _set(component, nbytes, dtype=None):
             hbm_ledger.ledger.set_keyed(
-                self._hbm_keys, component, nbytes, owner=self._hbm_owner,
+                self._hbm_keys, component + self.hbm_component_suffix,
+                nbytes, owner=self._hbm_owner,
                 dtype=dtype, sharding=sharding)
 
         _set("codes", int(self.codes.nbytes) + int(self.valid.nbytes),
@@ -552,6 +558,59 @@ class QuantizedVectorStore:
             allow_bits=allow_bits,
         )
 
+    def rescore_mode(self) -> str:
+        """Where the exact rescore happens for this store's config:
+        ``"inline"`` (inside the SPMD program, distances already exact),
+        ``"post"`` (oversampled candidates come back for a host rescore),
+        or ``"none"`` (code-distance order is the contract)."""
+        if self.rescore == "device" and self.mesh is not None:
+            return "inline"
+        if (self._host_vectors is not None
+                or (self.rescore == "device" and self.mesh is None)
+                or (self.rescore == "none" and self.fetch_fn is not None)):
+            return "post"
+        return "none"
+
+    def epoch_scan(self, queries: np.ndarray, k_cand: int, k_out: int,
+                   allow_mask: np.ndarray | None = None,
+                   pre_normalized: bool = False):
+        """Dispatch-only compressed scan for the epoch store: candidates
+        stay device-resident with STORE-LOCAL ids for the cross-epoch
+        merge; the (single, global) host rescore runs in the epoch
+        store's finish step against the returned dispatch-time tier
+        snapshot. ``pre_normalized`` skips query normalization when the
+        epoch store already normalized once for every epoch (normalizing
+        per epoch would not be bit-identical to the single-store path).
+        Returns ``(d_dev, i_dev, tiers)``."""
+        from weaviate_tpu.engine.store import (batched_mask_operands,
+                                               normalize_allow_mask)
+
+        queries = np.asarray(queries, dtype=np.float32)
+        if not pre_normalized:
+            queries = self._maybe_norm(queries)
+        allow_mask = normalize_allow_mask(allow_mask, len(queries))
+        with self._lock:
+            if not self.trained:
+                raise RuntimeError("PQ store not trained; call train() first")
+            capacity = self.capacity
+            valid = self.valid
+            allow_bits = allow_rows_dev = None
+            if allow_mask is not None and allow_mask.ndim == 2:
+                allow_bits, allow_rows_dev = batched_mask_operands(
+                    allow_mask, len(queries), capacity, self.mesh,
+                    owner=self._hbm_owner)
+            elif allow_mask is not None:
+                full = np.zeros(capacity, dtype=bool)
+                w = min(len(allow_mask), capacity)
+                full[:w] = allow_mask[:w]
+                valid = jnp.logical_and(valid, self._placed(full))
+            d, i = self._scan(jnp.asarray(queries), min(k_cand, capacity),
+                              valid, min(k_out, capacity),
+                              allow_bits=allow_bits,
+                              allow_rows=allow_rows_dev)
+            tiers = (self._host_vectors, self.rescore_rows, self.fetch_fn)
+        return d, i, tiers
+
     def search(self, queries: np.ndarray, k: int, allow_mask: np.ndarray | None = None):
         """Two-stage: compressed scan (oversampled) -> exact rescore.
 
@@ -593,13 +652,12 @@ class QuantizedVectorStore:
         allow_mask = normalize_allow_mask(allow_mask, len(queries))
         # inline = exact rescore happens inside the SPMD program; post =
         # oversampled candidates come back for a host-side exact pass
-        # (sourced from host rows, single-device HBM rows, or fetch_fn)
-        inline_rescore = self.rescore == "device" and self.mesh is not None
-        post_rescore = not inline_rescore and (
-            self._host_vectors is not None
-            or (self.rescore == "device" and self.mesh is None)
-            or (self.rescore == "none" and self.fetch_fn is not None)
-        )
+        # (sourced from host rows, single-device HBM rows, or fetch_fn).
+        # ONE classifier (rescore_mode) serves this and the epoch-store
+        # dispatch so the two paths can never drift.
+        mode = self.rescore_mode()
+        inline_rescore = mode == "inline"
+        post_rescore = mode == "post"
         with tracing.span("store.quantized_scan", rows=self.capacity,
                           queries=len(queries), k=k,
                           quantization=self.quantization,
@@ -715,7 +773,8 @@ class QuantizedVectorStore:
     # -- maintenance / persistence -------------------------------------------
 
     def compact(self) -> np.ndarray:
-        with self._lock:
+        with tracing.span("store.compact", rows=self.capacity,
+                          quantization=self.quantization), self._lock:
             live = np.nonzero(self._valid_np)[0]
             mapping = np.full(self.capacity, -1, dtype=np.int64)
             mapping[live] = np.arange(len(live))

@@ -35,6 +35,7 @@ import numpy as np
 from weaviate_tpu.ops.distances import normalize
 from weaviate_tpu.ops.topk import chunked_topk_distances
 from weaviate_tpu.runtime import hbm_ledger, tracing
+from weaviate_tpu.runtime import transfer
 from weaviate_tpu.runtime.transfer import DeviceResultHandle
 from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
 from weaviate_tpu.parallel.sharded_search import (
@@ -158,8 +159,14 @@ class DeviceVectorStore:
         chunk_size: int = _DEFAULT_CHUNK,
         normalize_on_add: bool | None = None,
         selection: str = "approx",
+        component: str = "corpus",
     ):
         self.dim = dim
+        # HBM-ledger component label: the epoch store passes a per-epoch
+        # label ("corpus@e3") so /v1/debug/memory and the hbm_bytes gauge
+        # attribute device bytes to individual epochs — and releasing an
+        # epoch visibly drops exactly its own series
+        self.hbm_component = component
         self.metric = metric
         self.dtype = dtype
         self.mesh = mesh
@@ -246,7 +253,8 @@ class DeviceVectorStore:
         nbytes = sum(int(a.nbytes)
                      for a in (self.vectors, self.valid, self.sq_norms))
         hbm_ledger.ledger.set_keyed(
-            self._hbm_keys, "corpus", nbytes, owner=self._hbm_owner,
+            self._hbm_keys, self.hbm_component, nbytes,
+            owner=self._hbm_owner,
             dtype=jnp.dtype(self.dtype).name,
             sharding="sharded" if self.mesh is not None else "single")
 
@@ -394,22 +402,53 @@ class DeviceVectorStore:
 
     def delete(self, slots) -> None:
         """Tombstone slots (reference: delete = tombstone + later cleanup,
-        hnsw/delete.go). Slots stay allocated until compaction."""
+        hnsw/delete.go). Slots stay allocated until compaction.
+
+        Rows still HOST-STAGED (added but not yet flushed) are
+        tombstoned in the staging buffer itself — scrubbed so they never
+        reach HBM — instead of paying a full device flush just to clear
+        a mask bit the scatter was about to set. The device-side clear
+        still runs for every requested slot (clearing a never-set slot
+        is a no-op), so interleaved add/delete/flush sequences agree
+        with the host mirror no matter which side of the flush the
+        delete lands on."""
         slots = np.atleast_1d(np.asarray(slots, dtype=np.int32))
         m = len(slots)
         if m == 0:
             return
         with self._lock:
-            self._flush_staged_locked()
             in_range = np.unique(slots[(slots >= 0)
                                        & (slots < self.capacity)])
             self._live_count -= int(np.count_nonzero(
                 self._valid_np[in_range]))
             self._valid_np[in_range] = False
+            if self._staged_rows:
+                self._scrub_staged_locked(in_range)
             bucket = _next_pow2(max(m, 8))
             buf = np.full(bucket, self.capacity + 1, dtype=np.int32)  # OOB no-op
             buf[:m] = slots
             self.valid = _clear_slots(self.valid, self._placed_replicated(buf))
+
+    def _scrub_staged_locked(self, dead: np.ndarray) -> None:
+        """Drop staged rows whose slots are in ``dead`` so a deleted-
+        before-flush row never lands on device at all. Caller holds
+        ``_lock``."""
+        kept_slots: list[np.ndarray] = []
+        kept_vecs: list[np.ndarray] = []
+        rows = 0
+        for sl, vec in zip(self._staged_slots, self._staged_vecs):
+            keep = ~np.isin(sl, dead)
+            if keep.all():
+                kept_slots.append(sl)
+                kept_vecs.append(vec)
+                rows += len(sl)
+            elif keep.any():
+                kept_slots.append(sl[keep])
+                kept_vecs.append(vec[keep])
+                rows += int(keep.sum())
+        self._staged_slots = kept_slots
+        self._staged_vecs = kept_vecs
+        self._staged_rows = rows
 
     def _placed_replicated(self, arr):
         if self.mesh is None:
@@ -577,6 +616,49 @@ class DeviceVectorStore:
             (d, i), finish=_finish,
             attrs={"rows": capacity, "queries": len(queries), "k": k})
 
+    def epoch_scan(self, queries: np.ndarray, k: int,
+                   allow_mask: np.ndarray | None = None):
+        """Dispatch-only scan for the epoch store (engine/epochs.py):
+        top-k of THIS store alone, ids STORE-LOCAL, results left
+        device-resident for the cross-epoch merge. ``allow_mask``
+        carries this epoch's column slice of the global filter ([cap]
+        shared or [B, cap] per-query). The gathered low-selectivity
+        cutover is deliberately not taken here: its bucket-local remap
+        is a host finish step, and the epoch merge needs raw device
+        candidates (single-epoch stores keep the cutover through the
+        ``search`` passthrough)."""
+        queries = np.asarray(queries, dtype=np.float32)
+        allow_mask = normalize_allow_mask(allow_mask, len(queries))
+        with self._lock:
+            self._flush_staged_locked()
+            vectors, valid, norms = self.vectors, self.valid, self.sq_norms
+            capacity = self.capacity
+            allow_bits = allow_rows_dev = None
+            if allow_mask is not None and allow_mask.ndim == 2:
+                allow_bits, allow_rows_dev = batched_mask_operands(
+                    allow_mask, len(queries), capacity, self.mesh,
+                    owner=self._hbm_owner)
+            elif allow_mask is not None:
+                full = np.zeros(capacity, dtype=bool)
+                w = min(len(allow_mask), capacity)
+                full[:w] = allow_mask[:w]
+                valid = jnp.logical_and(valid, self._placed(full))
+            k_eff = min(k, capacity)
+            metric = ("cosine" if self.metric in ("cosine", "cosine-dot")
+                      else self.metric)
+            cs = min(self.chunk_size, capacity // self.n_shards)
+            if self.mesh is None:
+                return chunked_topk_distances(
+                    jnp.asarray(queries), vectors, k=k_eff, chunk_size=cs,
+                    metric=metric, valid=valid, x_sq_norms=norms,
+                    use_pallas=self.use_pallas, selection=self.selection,
+                    allow_bits=allow_bits)
+            return sharded_topk(
+                jnp.asarray(queries), vectors, valid, norms, k=k_eff,
+                chunk_size=cs, metric=metric, mesh=self.mesh,
+                use_pallas=self.use_pallas, selection=self.selection,
+                allow_rows=allow_rows_dev)
+
     def _dispatch_gathered(self, queries: np.ndarray, k: int,
                            allowed: np.ndarray):
         """Filtered search at low selectivity: gather the allowed rows
@@ -657,13 +739,19 @@ class DeviceVectorStore:
         Returns old_slot -> new_slot mapping (-1 for dropped). The HBM analog
         of the reference's tombstone-cleanup cycle (hnsw tombstone cleanup /
         lsmkv compaction)."""
-        with self._lock:
+        with tracing.span("store.compact", rows=self.capacity) as sp, \
+                self._lock:
             self._flush_staged_locked()
             valid_np = self._valid_np  # host mirror — no device sync
             live = np.nonzero(valid_np)[0]
             mapping = np.full(self.capacity, -1, dtype=np.int64)
             mapping[live] = np.arange(len(live))
-            vec_np = np.asarray(self.vectors)[live]
+            sp.set(live=len(live))
+            # the rebuild's one D2H rides the sanctioned boundary
+            # (transfer.d2h span, device_ms split from memcpy on sampled
+            # traces) instead of a bare np.asarray sync in engine/
+            (vec_host,) = transfer.d2h(self.vectors)
+            vec_np = vec_host[live]
             self._count = len(live)
             new_cap = self._align(max(len(live), 2))
             self.capacity = new_cap

@@ -86,6 +86,48 @@ def select_survivors(vals, ids, k: int, selection: str = "approx",
     return fd, fi
 
 
+@functools.partial(jax.jit, static_argnames=("k", "selection"))
+def merge_epoch_topk(parts, slot_maps, k: int, selection: str = "approx"):
+    """Cross-epoch candidate merge (engine/epochs.py): the single-device
+    twin of the ICI merge — per-epoch survivor sets become one global
+    top-k without the distances ever leaving HBM.
+
+    ``parts`` is a tuple of per-epoch ``(d [B, k_e], i [B, k_e])`` pairs
+    with EPOCH-LOCAL row ids (-1 dead); ``slot_maps`` a matching tuple of
+    ``[cap_e] int32`` local->global slot tables (compaction repacks an
+    epoch's rows but keeps global slots stable through its map). Each
+    epoch's ids gather through its map, the candidate sets concatenate in
+    epoch order (so distance ties resolve to the lower global slot, same
+    as a single-buffer scan), and the merge itself is EXACT:
+    ``fused_topk_pairs`` (the in-kernel running-carry fold) under
+    ``selection="fused"``, ``lax.top_k`` otherwise — per-epoch selection
+    error never compounds across epochs, mirroring the chunk-carry
+    contract of ``chunked_topk_distances``. Returns ``(d [B, k],
+    i [B, k])`` global ids, (MASKED_DISTANCE, -1) padded."""
+    mapped_d, mapped_i = [], []
+    for (d, i), smap in zip(parts, slot_maps):
+        cap = smap.shape[0]
+        g = smap[jnp.clip(i, 0, cap - 1)]
+        mapped_d.append(d)
+        mapped_i.append(jnp.where(i >= 0, g, -1))
+    cat_d = jnp.concatenate(mapped_d, axis=1)
+    cat_i = jnp.concatenate(mapped_i, axis=1)
+    ncand = cat_d.shape[1]
+    kk = min(k, ncand)
+    if selection == "fused" and kk <= 256:
+        from weaviate_tpu.ops.pallas_kernels import fused_topk_pairs
+
+        fd, fi = fused_topk_pairs(cat_d, cat_i, k=kk)
+    else:
+        fd, fi = topk_smallest(cat_d, cat_i, kk)
+    if kk < k:
+        fd = jnp.pad(fd, ((0, 0), (0, k - kk)),
+                     constant_values=MASKED_DISTANCE)
+        fi = jnp.pad(fi, ((0, 0), (0, k - kk)), constant_values=-1)
+    fi = jnp.where(fd >= MASKED_DISTANCE * 0.5, -1, fi)
+    return fd, fi
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def merge_topk(dists: jnp.ndarray, ids: jnp.ndarray, k: int):
     """Merge candidate sets: dists [B, M], ids [B, M] -> top-k of the union.

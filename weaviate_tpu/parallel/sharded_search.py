@@ -10,6 +10,16 @@ compiled SPMD program:
 The all_gather moves only [n_shards, B, k] candidate (distance, id) pairs
 over ICI — never raw vectors — so the collective payload is tiny compared
 with the HBM traffic of the scan itself.
+
+Allow-mask row alignment contract: ``allow_rows`` is always [B, N_local]
+bool, column-sharded P(None, shard) ROW-ALIGNED with whatever corpus
+array the same call scans. Epoch stores (engine/epochs.py) honor this by
+column-slicing the global mask to each epoch's LOCAL row space
+(compaction-aware through the epoch's slot maps) before dispatching that
+epoch's scan — one sliced mask per epoch program, while the per-epoch
+candidate sets and their replicated local->global slot maps merge in a
+separate tiny program (ops/topk.merge_epoch_topk, this module's ICI
+merge pattern turned inward).
 """
 
 from __future__ import annotations

@@ -119,6 +119,21 @@ class CycleManager:
                 cb.next_due = 0.0
         self._wake.set()
 
+    def run_now(self, name: str) -> bool:
+        """Run a callback synchronously on the CALLING thread
+        (deterministic tests and operational drives — e.g. forcing an
+        ``epoch-maintenance`` pass without waiting a tick): takes the
+        pause lock so it never overlaps the scheduler running the same
+        callback, and feeds the same backoff bookkeeping. Returns False
+        for unknown names."""
+        with self._lock:
+            cb = self._callbacks.get(name)
+        if cb is None:
+            return False
+        with self._pause_lock:
+            cb.run()
+        return True
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             now = time.monotonic()

@@ -106,6 +106,13 @@ KNOWN_POINTS = frozenset({
     "kv.get_many",
     "transfer.d2h",
     "batcher.dispatch",
+    # epoch migration (db/collection.py migrate_epoch): the three crash
+    # windows the no-loss/no-double-serve invariant is tested across —
+    # after target ingest, after the durable cutover markers, and after
+    # the source delete
+    "epoch.migrate.pre_ingest",
+    "epoch.migrate.post_ingest",
+    "epoch.migrate.post_cutover",
 }) | frozenset(CRASHPOINTS)
 
 _ACTIONS = ("error", "latency", "drop", "corrupt", "crash", "torn")

@@ -249,6 +249,16 @@ class HBMLedger:
         with self._lock:
             return self._by_shard.get((str(collection), str(shard)), 0)
 
+    def shard_component_bytes(self, collection: str, shard: str) -> dict:
+        """Component -> device bytes for one shard. Epoch stores label
+        per epoch (``corpus@e3``, ``codes@e3``), so this is how the
+        epoch policy (and its tests) see exactly which epoch owns which
+        bytes — and that compaction/migration actually released them."""
+        collection, shard = str(collection), str(shard)
+        with self._lock:
+            return {comp: b for (c, s, comp), b in self._by_gauge.items()
+                    if c == collection and s == shard}
+
     def breakdown(self) -> dict:
         """Per-collection rollup: bytes by collection, with nested shard
         and component splits. Device placement only (host-tier entries —

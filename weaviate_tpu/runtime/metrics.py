@@ -380,6 +380,34 @@ batcher_transfer_duration = registry.histogram(
     "D2H drain time (transfer.d2h window) of the coalesced batch a "
     "query rode in, overlapped with the next dispatch")
 
+# -- epoch store (engine/epochs.py publishes on seal/compact/drop;
+#    db/collection.py bumps the migration counter) ----------------------------
+
+epoch_count = registry.gauge(
+    "weaviate_tpu_epoch_count",
+    "Device epochs in the stack (sealed + active) per epoch-backed "
+    "vector store", ("collection", "shard"))
+epoch_live_rows = registry.gauge(
+    "weaviate_tpu_epoch_live_rows",
+    "Live (non-tombstoned) rows per device epoch; series are removed "
+    "when their epoch compacts away or migrates",
+    ("collection", "shard", "epoch"))
+epoch_tombstone_rows = registry.gauge(
+    "weaviate_tpu_epoch_tombstone_rows",
+    "Tombstoned rows per device epoch — what the background compaction "
+    "policy folds out to reclaim HBM",
+    ("collection", "shard", "epoch"))
+epoch_compactions = registry.counter(
+    "weaviate_tpu_epoch_compactions_total",
+    "Sealed epochs folded on device (live rows repacked, tombstoned "
+    "HBM released through the ledger finalizers)",
+    ("collection", "shard"))
+epoch_migrations = registry.counter(
+    "weaviate_tpu_epoch_migrations_total",
+    "Sealed epochs migrated to a sibling shard with headroom instead "
+    "of latching 507 rejections at the HBM watermark",
+    ("collection", "shard"))
+
 # -- HBM ledger (runtime/hbm_ledger.py keeps these current on every
 #    register/update/release; memwatch sets the budget + pressure) ------------
 

@@ -74,7 +74,7 @@ class _Pending:
     __slots__ = ("query", "k", "allow", "event", "ids", "dists", "error",
                  "ctx", "t_exec_start", "t_exec_end", "batch_size",
                  "t_mask_start", "t_mask_end", "t_fetch_start",
-                 "t_fetch_end")
+                 "t_fetch_end", "epochs")
 
     def __init__(self, query, k, allow):
         self.query = query
@@ -95,6 +95,10 @@ class _Pending:
         self.t_fetch_start: float | None = None
         self.t_fetch_end: float | None = None
         self.batch_size = 1
+        # epoch fanout of the dispatch this request rode in (the epoch
+        # store's handle reports how many per-epoch scans fused into
+        # the one merged program) — 0 for single-buffer stores
+        self.epochs = 0
 
 
 class QueryBatcher:
@@ -238,7 +242,9 @@ class QueryBatcher:
                                     item.t_mask_end or item.t_mask_start)
             tracing.record_span("batcher.execute", item.t_exec_start,
                                 item.t_exec_end or time.perf_counter(),
-                                batch=item.batch_size)
+                                batch=item.batch_size,
+                                **({"epochs": item.epochs}
+                                   if item.epochs else {}))
             if item.t_fetch_start is not None:
                 # the pipelined D2H drain for this request's batch (the
                 # transfer thread's handle.result() window)
@@ -454,6 +460,11 @@ class QueryBatcher:
                 faultline.fire("batcher.dispatch", batch=b, k=k_bucket)
                 handle = tracing.run_in(ctx, self._async_fn, queries,
                                         k_bucket, allows)
+                if handle is not None:
+                    n_ep = int(handle.attrs.get("epochs", 0) or 0)
+                    if n_ep:
+                        for it in coal:
+                            it.epochs = n_ep
             if handle is None:
                 ids, dists = _sync_batch()
         except Exception as e:  # noqa: BLE001

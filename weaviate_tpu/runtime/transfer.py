@@ -44,6 +44,16 @@ from weaviate_tpu.runtime import faultline, tracing
 _UNSET = object()
 
 
+def d2h(*values):
+    """THE sanctioned device->host fetch for maintenance paths (epoch
+    compaction, store rebuilds, migration serialization): delegates to
+    ``tracing.d2h`` so the copy lands in a ``transfer.d2h`` span with
+    device-time attribution on sampled traces. Serving paths should ride
+    ``DeviceResultHandle`` instead — this direct form is for host-side
+    rebuild work where a future adds nothing."""
+    return tracing.d2h(*values)
+
+
 class DeviceResultHandle:
     """Future-like handle for one dispatched device program's results.
 

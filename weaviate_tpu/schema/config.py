@@ -90,6 +90,12 @@ class VectorIndexConfig:
     # ivf
     ivf_nlist: int = 0  # 0 = auto
     ivf_nprobe: int = 0  # 0 = auto
+    # epoch-stacked device corpus (engine/epochs.py): seal the active
+    # epoch every N rows; sealed epochs are immutable, compact in the
+    # background (deletes reclaim HBM) and can migrate under memory
+    # pressure. 0 = legacy single donated buffer. Flat indexes only —
+    # graph/ivf layouts have their own reorganize stories.
+    epoch_rows: int = 0
 
     def validate(self):
         from weaviate_tpu.ops.distances import DISTANCE_METRICS
@@ -109,6 +115,15 @@ class VectorIndexConfig:
             if self.quantization is None:
                 raise ValueError(
                     "prefix_bits requires quantization pq or bq")
+        if self.epoch_rows:
+            if not isinstance(self.epoch_rows, int) or self.epoch_rows < 0:
+                raise ValueError(
+                    f"epoch_rows must be a non-negative int, got "
+                    f"{self.epoch_rows!r}")
+            if self.index_type != "flat":
+                raise ValueError(
+                    "epoch_rows requires index_type 'flat' (graph/ivf "
+                    "layouts have their own reorganize stories)")
 
 
 @dataclass
