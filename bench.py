@@ -1345,6 +1345,146 @@ def sec_fabric(ctx):
 
 
 # (name, fn, ctx keys produced upstream that the section requires)
+def sec_hierarchical_merge(ctx):
+    """ISSUE 13: flat 1-D merge vs the two-level ICI+DCN merge.
+
+    Three parts, in decreasing rig-independence:
+
+    1. ``dcn_bytes_ratio`` — the GATED metric: per-host cross-DCN
+       candidate bytes, two-level / flat, computed from pure topology
+       math for the reference 2-host x 4-device pod (the virtual mesh
+       every parity test runs on). Rig-independent by construction —
+       benchkeeper gates it with a tight band on any platform.
+    2. A LIVE flat-vs-two-level BQ scan on the local devices arranged
+       as a 2x(n/2) hierarchical mesh (skipped fields when the rig has
+       fewer than 2 devices or an odd count): parity check + wall
+       timings + QPS.
+    3. The 1B-vector BQ DRY RUN: the full placement plan — shard-
+       aligned capacity, per-component bytes, per-host HBM load — for
+       1e9 x 768 BQ on the hierarchical mesh, no allocation (the codes
+       alone are 96 GB; planning is what the ledger admission gates
+       against).
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from weaviate_tpu.ops import bq as bq_ops
+    from weaviate_tpu.parallel import partition
+    from weaviate_tpu.parallel.mesh import (make_hierarchical_mesh,
+                                            make_mesh)
+    from weaviate_tpu.parallel.sharded_search import (
+        replicate_array, shard_array, sharded_quantized_topk,
+        topology_dcn_candidate_bytes)
+
+    k = 32  # ICI-divisible on the 2x4 reference pod: zero slice padding
+    ref_hosts, ref_local = 2, 4
+    flat_bytes = topology_dcn_candidate_bytes(ref_hosts, ref_local, k,
+                                              level="flat")
+    two_bytes = topology_dcn_candidate_bytes(ref_hosts, ref_local, k,
+                                             level="two_level")
+    compact_bytes = topology_dcn_candidate_bytes(
+        ref_hosts, ref_local, k, level="two_level", compact=True)
+    out = {
+        "ref_topology": f"{ref_hosts}x{ref_local}",
+        "k": k,
+        "dcn_bytes_flat_per_host": flat_bytes,
+        "dcn_bytes_two_level_per_host": two_bytes,
+        "dcn_bytes_two_level_compact_per_host": compact_bytes,
+        "dcn_bytes_ratio": round(two_bytes / flat_bytes, 4),
+        "dcn_bytes_ratio_compact": round(compact_bytes / flat_bytes, 4),
+    }
+    log(f"DCN candidate bytes/query/host on {ref_hosts}x{ref_local}: "
+        f"flat {flat_bytes} (O(devices*k)) -> two-level {two_bytes} "
+        f"(O(hosts*k), ratio {out['dcn_bytes_ratio']})")
+
+    # live flat-vs-hierarchical run on whatever devices exist
+    n_dev = len(jax.devices())
+    if n_dev >= 2 and n_dev % 2 == 0:
+        n = int(os.environ.get("BENCH_HIER_N", "131072"))
+        dim, b = 128, 64
+        rng = np.random.default_rng(3)
+        # chunk-aligned rows per device
+        n = max(n // n_dev, 1024) * n_dev
+        xb = rng.standard_normal((n, dim)).astype(np.float32)
+        qv = rng.standard_normal((b, dim)).astype(np.float32)
+        codes = np.asarray(bq_ops.bq_encode(jnp.asarray(xb)))
+        qw = np.asarray(bq_ops.bq_encode(jnp.asarray(qv)))
+        valid = np.ones(n, dtype=bool)
+        meshes = {"flat_1d": make_mesh(),
+                  "two_level": make_hierarchical_mesh(n_hosts=2)}
+        reps = max(_bench_repeats(), 3)
+        results = {}
+        parity = {}
+        for name, mesh in meshes.items():
+            args = (replicate_array(jnp.asarray(qv), mesh),
+                    replicate_array(jnp.asarray(qw), mesh),
+                    shard_array(jnp.asarray(codes), mesh),
+                    shard_array(jnp.asarray(valid), mesh),
+                    None, None)
+            kw = dict(k=k, k_out=k, chunk_size=min(4096, n // n_dev),
+                      quantization="bq", metric="l2-squared", mesh=mesh)
+
+            def run_once(args=args, kw=kw):
+                d, i = sharded_quantized_topk(*args, **kw)
+                jax.block_until_ready((d, i))
+                return d, i
+
+            d, i = _retry_transient(run_once, what=f"hier/{name} warm")
+            parity[name] = (np.asarray(d), np.asarray(i))
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                run_once()
+                best = min(best, time.perf_counter() - t0)
+            results[name] = {
+                "batch_ms": round(best * 1e3, 3),
+                "qps": round(b / best, 1),
+            }
+        parity_ok = bool(
+            np.array_equal(parity["flat_1d"][0], parity["two_level"][0])
+            and np.array_equal(parity["flat_1d"][1],
+                               parity["two_level"][1]))
+        # a parity break is a MERGE bug, not a perf datum — fail the
+        # section loudly (the gated dcn_bytes_ratio is topology math
+        # and cannot see wire-format regressions; this assert can)
+        assert parity_ok, "two-level merge diverged from flat 1-D merge"
+        out["live"] = {
+            "n": n, "dim": dim, "batch": b,
+            "mesh": f"2x{n_dev // 2}",
+            **{name: r for name, r in results.items()},
+            "parity_bit_identical": parity_ok,
+        }
+        log(f"live 2x{n_dev // 2} BQ {n} rows: "
+            f"flat {results['flat_1d']['batch_ms']} ms vs two-level "
+            f"{results['two_level']['batch_ms']} ms, parity="
+            f"{out['live']['parity_bit_identical']}")
+        mesh_1b = meshes["two_level"]
+    else:
+        out["live"] = {"skipped": f"{n_dev} device(s)"}
+        mesh_1b = None
+
+    # 1B-vector BQ dry run: plan only, zero allocation
+    plan = partition.plan_corpus_placement(
+        1_000_000_000, 768, mesh_1b, quantization="bq", chunk_size=4096)
+    assert plan["capacity"] % plan["shards"] == 0
+    assert sum(plan["perHostBytes"].values()) == plan["totalBytes"]
+    out["dry_run_1b"] = {
+        "rows": plan["rows"], "hosts": plan["hosts"],
+        "rowsPerDevice": plan["rowsPerDevice"],
+        "totalGB": round(plan["totalBytes"] / 1e9, 2),
+        "perHostGB": {h: round(v / 1e9, 2)
+                      for h, v in plan["perHostBytes"].items()},
+        "dcnBytesPerQueryPerHost": topology_dcn_candidate_bytes(
+            plan["hosts"], max(plan["shards"] // plan["hosts"], 1), k,
+            level="two_level") if plan["hosts"] > 1 else 0,
+    }
+    log(f"1B x 768 BQ dry run: {out['dry_run_1b']['totalGB']} GB over "
+        f"{plan['hosts']} host(s), {plan['rowsPerDevice']} rows/device")
+    return out
+
+
 SECTIONS = [
     ("setup", sec_setup, ()),
     ("cpu_baseline", sec_cpu_baseline, ("corpus", "queries")),
@@ -1358,6 +1498,7 @@ SECTIONS = [
     ("durability_tax", sec_durability_tax, ()),
     ("mixed_rw", sec_mixed_rw, ("rng",)),
     ("kernel_conformance", sec_conformance, ("rng",)),
+    ("hierarchical_merge", sec_hierarchical_merge, ()),
     ("served_pipeline", sec_served_pipeline, ()),
     ("serving_fabric", sec_fabric, ()),
 ]

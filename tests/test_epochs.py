@@ -562,3 +562,135 @@ def test_epoch_compress_keeps_results(rng):
     assert got[0] == 12 and d[0] < 1e-3
     got, _ = idx.search_by_vector(vecs[7], k=50)
     assert 7 not in got.tolist()
+
+
+# -- ISSUE 13: cross-node epoch migration -------------------------------------
+
+
+class _FakeRemote:
+    """Remote shard client double: captures cross-node ingests and
+    serves GET/DELETE from the captured store."""
+
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.calls = []
+        self.objects = {}  # (node, shard) -> {uuid: raw}
+
+    def put_objects(self, node, collection, shard, raw_objects):
+        from weaviate_tpu.cluster.transport import RpcError
+        from weaviate_tpu.storage.objects import StorageObject
+
+        if self.fail:
+            raise RpcError(507, "target at watermark")
+        self.calls.append(("put", node, collection, shard,
+                           len(raw_objects)))
+        bucket = self.objects.setdefault((node, shard), {})
+        for raw in raw_objects:
+            bucket[StorageObject.from_bytes(raw).uuid] = raw
+
+    def get_object(self, node, collection, shard, uuid):
+        return self.objects.get((node, shard), {}).get(uuid)
+
+    def delete_object(self, node, collection, shard, uuid):
+        return self.objects.get((node, shard), {}).pop(uuid, None) \
+            is not None
+
+
+def _cross_node_collection(tmpdir, remote, local_hbm=None):
+    from weaviate_tpu.db.collection import Collection
+    from weaviate_tpu.db.sharding import ShardingState
+    from weaviate_tpu.schema.config import (CollectionConfig,
+                                            ShardingConfig, VectorConfig,
+                                            VectorIndexConfig)
+
+    state = ShardingState(
+        shard_names=["shard-0", "shard-1"],
+        placement={"shard-0": ["node-a"], "shard-1": ["node-b"]})
+    cfg = CollectionConfig(
+        name="XNode",
+        vectors=[VectorConfig(name="", dim=16,
+                              index=VectorIndexConfig(
+                                  index_type="flat", epoch_rows=16))],
+        sharding=ShardingConfig(desired_count=2))
+    col = Collection(
+        tmpdir, cfg, sharding_state=state, local_node="node-a",
+        remote=remote,
+        nodes_provider=lambda: ["node-a", "node-b"],
+        node_hbm_provider=lambda: {"node-b": 0})
+    return col
+
+
+def test_cross_node_epoch_migration_durable_cutover(rng):
+    """No LOCAL sibling has headroom (the only sibling lives on
+    node-b): migrate_epoch ships the coldest sealed epoch over the
+    shard RPC behind the same durable-marker cutover — reads follow the
+    marker to the remote copy, deletes clean both sides, and the
+    epoch's HBM releases locally."""
+    with tempfile.TemporaryDirectory() as d:
+        remote = _FakeRemote()
+        col = _cross_node_collection(d, remote)
+        try:
+            uuids = _uuids_for_shard(col.sharding, "shard-0", 24)
+            for j, u in enumerate(uuids):
+                col.put_object({"j": j}, uuid=u,
+                               vector=rng.standard_normal(16)
+                               .astype(np.float32))
+            shard = col.shards["shard-0"]
+            for idx in shard.vector_indexes.values():
+                idx.epoch_store.seal_active()
+            before = ledger.shard_bytes("XNode", "shard-0")
+            moved = col.migrate_epoch("shard-0")
+            assert moved > 0
+            assert remote.calls and remote.calls[0][:4] == (
+                "put", "node-b", "XNode", "shard-1")
+            assert ledger.shard_bytes("XNode", "shard-0") < before
+            # marker-routed read reaches the remote copy
+            migrated = [u for u in uuids
+                        if shard.migrated_to(u) == "shard-1"]
+            assert len(migrated) == moved
+            for u in migrated[:5]:
+                obj = col.get_object(u)
+                assert obj is not None and obj.uuid == u
+            # delete cleans BOTH sides and drops the marker
+            victim = migrated[0]
+            assert col.delete_object(victim)
+            assert shard.migrated_to(victim) is None
+            assert remote.get_object("node-b", "XNode", "shard-1",
+                                     victim) is None
+        finally:
+            col.close()
+
+
+def test_cross_node_migration_rpc_failure_aborts_markers_kept(rng):
+    """An ingest RPC failure (target watermark / lost reply / network
+    fault) is AMBIGUOUS — the put may have landed durably before the
+    reply was lost — so the abort keeps the routing markers (a marker
+    to an absent copy is harmless; a dropped marker to a present copy
+    is an undeletable zombie), cuts nothing over, and the source still
+    serves every object. A later retry re-marks and completes."""
+    with tempfile.TemporaryDirectory() as d:
+        remote = _FakeRemote(fail=True)
+        col = _cross_node_collection(d, remote)
+        try:
+            uuids = _uuids_for_shard(col.sharding, "shard-0", 12)
+            for j, u in enumerate(uuids):
+                col.put_object({"j": j}, uuid=u,
+                               vector=rng.standard_normal(16)
+                               .astype(np.float32))
+            shard = col.shards["shard-0"]
+            for idx in shard.vector_indexes.values():
+                idx.epoch_store.seal_active()
+            assert col.migrate_epoch("shard-0") == 0
+            marked = [u for u in uuids
+                      if shard.migrated_to(u) == "shard-1"]
+            assert marked  # kept, not rolled back
+            for u in uuids:  # ring copy still authoritative
+                assert col.get_object(u) is not None
+            # the network heals: the retry re-marks and completes
+            remote.fail = False
+            moved = col.migrate_epoch("shard-0")
+            assert moved > 0
+            for u in uuids:
+                assert col.get_object(u) is not None
+        finally:
+            col.close()

@@ -1161,6 +1161,79 @@ def test_g6_repo_baseline_names_only_reasoned_bootstrap_site():
     assert "bootstrap" in entries[0]["reason"]
 
 
+# -- G8 partition-discipline --------------------------------------------------
+
+
+G8_POSITIVE = """
+    import jax.sharding
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P   # P1: import outside home
+
+    def place(mesh, arr):
+        spec = P(None, "shard")                   # P2: literal spec
+        other = jax.sharding.PartitionSpec("shard")  # P3: dotted literal
+        return NamedSharding(mesh, spec), other
+"""
+
+G8_NEGATIVE = """
+    from jax.sharding import Mesh, NamedSharding
+
+    from weaviate_tpu.parallel import partition
+
+    def place(mesh, arr, allow):
+        specs = partition.match_partition_rules(
+            partition.SEARCH_RULES, {"x": arr, "allow_rows": allow}, mesh)
+        return NamedSharding(mesh, specs["x"]), partition.row_sharding(
+            mesh, dim=1)
+"""
+
+
+def test_g8_flags_spec_import_and_literals(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/parallel/fx.py": G8_POSITIVE})
+    g8 = [v for v in res.violations if v.check == "G8"]
+    msgs = " | ".join(v.message for v in g8)
+    assert len(g8) == 3, msgs
+    assert "imported outside" in msgs
+    assert "hand-written P(...)" in msgs or "literal" in msgs
+
+
+def test_g8_accepts_rule_table_resolution(tmp_path):
+    res = lint_tree(tmp_path, {"weaviate_tpu/parallel/fx.py": G8_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G8"] == []
+
+
+def test_g8_partition_home_is_exempt(tmp_path):
+    """partition.py IS the rule table — the one audited home for
+    PartitionSpec construction."""
+    res = lint_tree(
+        tmp_path, {"weaviate_tpu/parallel/partition.py": G8_POSITIVE})
+    assert [v for v in res.violations if v.check == "G8"] == []
+
+
+def test_g8_scope_is_production_tree_only(tmp_path):
+    """Tests and benches build specs for fixtures; product code must
+    not."""
+    res = lint_tree(tmp_path, {
+        "weaviate_tpu/engine/fx.py": G8_POSITIVE,
+        "tests/test_fx.py": G8_POSITIVE,
+        "tools/fx.py": G8_POSITIVE,
+    })
+    assert {v.path for v in res.violations if v.check == "G8"} == \
+        {"weaviate_tpu/engine/fx.py"}
+
+
+def test_g8_baseline_stays_empty_for_weaviate_tpu():
+    """ISSUE 13 acceptance: zero hand-wired PartitionSpec literals
+    remain outside parallel/partition.py — placement was CENTRALIZED
+    into the rule tables, not grandfathered."""
+    entries = [e for e in core.load_baseline(
+        core.default_baseline_path(REPO_ROOT)) if e.get("check") == "G8"]
+    assert entries == [], (
+        "G8 baseline entries are not allowed — resolve the spec "
+        "through partition.match_partition_rules instead:\n"
+        + "\n".join(str(e) for e in entries))
+
+
 # -- CLI ----------------------------------------------------------------------
 
 

@@ -1,9 +1,22 @@
-"""Multi-host (DCN) readiness (VERDICT r2 item 5).
+"""Multi-host (DCN) readiness (VERDICT r2 item 5 + ISSUE 13).
 
 Two OS processes x 4 virtual CPU devices each join one JAX runtime via
 ``maybe_initialize_distributed`` and run the SAME SPMD sharded-search
 step over the GLOBAL 8-device mesh — the simulated two-host pod. The
 collectives cross the process boundary the way they would cross DCN.
+
+ISSUE 13 extends the worker with the hierarchical mesh: the SAME two
+processes build the 2x4 ``('host', 'ici')`` mesh (process_count drives
+n_hosts — no virtual-host override needed here) and the two-level merge
+must be bit-identical to the flat 1-D merge across flat / BQ /
+per-query-bitmask paths, with the DCN leg of the merge now carrying one
+per-host winner block instead of every device's candidates.
+
+Some jaxlib CPU builds ship without multiprocess collective support
+("Multiprocess computations aren't implemented on the CPU backend") —
+those environments SKIP rather than fail: the in-process virtual-host
+parity suite (tests/test_hierarchical.py) carries the merge coverage
+there, and this test runs for real on runtimes with gloo collectives.
 """
 
 import os
@@ -13,6 +26,8 @@ import sys
 import textwrap
 
 import pytest
+
+_BACKEND_UNSUPPORTED = "Multiprocess computations aren't implemented"
 
 _WORKER = textwrap.dedent("""
     import os, sys
@@ -28,34 +43,80 @@ _WORKER = textwrap.dedent("""
     import jax
     jax.config.update("jax_platforms", "cpu")
 
-    from weaviate_tpu.parallel.mesh import (make_mesh,
+    from weaviate_tpu.parallel.mesh import (make_hierarchical_mesh,
+                                            make_mesh,
                                             maybe_initialize_distributed)
-    from weaviate_tpu.parallel.sharded_search import (replicate_array,
-                                                      shard_array,
-                                                      sharded_topk)
+    from weaviate_tpu.parallel.sharded_search import (
+        replicate_array, shard_array, sharded_quantized_topk,
+        sharded_topk)
     import jax.numpy as jnp
 
     assert maybe_initialize_distributed()
     assert jax.process_count() == 2, jax.process_count()
     assert len(jax.devices()) == 8, len(jax.devices())
 
-    mesh = make_mesh()  # global mesh over all 8 devices
+    flat = make_mesh()                    # global 1-D mesh, all 8 devices
+    hier = make_hierarchical_mesh()       # 2 hosts x 4 local devices
+    assert dict(hier.shape) == {"host": 2, "ici": 4}, dict(hier.shape)
+    # device rows of the hierarchical mesh are the two PROCESSES: the
+    # ici axis must never cross a process boundary
+    rows = np.asarray(hier.devices)
+    for r in range(2):
+        assert len({d.process_index for d in rows[r]}) == 1, rows
+
     n, d, b, k = 512, 16, 4, 5
     rng = np.random.default_rng(0)  # same seed on both processes
     x = rng.standard_normal((n, d)).astype(np.float32)
     q = x[[7, 99, 255, 444]] + 0.01
     valid = np.ones(n, dtype=bool)
+    allow = rng.random((b, n)) > 0.4
 
-    xs = shard_array(jnp.asarray(x), mesh)
-    vs = shard_array(jnp.asarray(valid), mesh)
-    qs = replicate_array(jnp.asarray(q), mesh)
-    d_out, i_out = sharded_topk(qs, xs, vs, None, k=k, chunk_size=64,
-                                metric="l2-squared", mesh=mesh)
-    # fully-replicated output: every process can read it
+    def flat_search(mesh, allow_rows=None):
+        kw = {}
+        if allow_rows is not None:
+            kw["allow_rows"] = shard_array(jnp.asarray(allow_rows),
+                                           mesh, dim=1)
+        return sharded_topk(
+            replicate_array(jnp.asarray(q), mesh),
+            shard_array(jnp.asarray(x), mesh),
+            shard_array(jnp.asarray(valid), mesh), None,
+            k=k, chunk_size=64, metric="l2-squared", mesh=mesh, **kw)
+
+    # 1) legacy 1-D step still answers correctly over DCN
+    d_out, i_out = flat_search(flat)
     ids = np.asarray(i_out)
     assert list(ids[:, 0]) == [7, 99, 255, 444], ids[:, 0]
-    print(f"proc {jax.process_index()}: OK {ids[:, 0].tolist()}",
-          flush=True)
+
+    # 2) two-level merge parity: flat + per-query bitmask variants
+    for mask in (None, allow):
+        d1, i1 = flat_search(flat, mask)
+        d2, i2 = flat_search(hier, mask)
+        assert np.array_equal(np.asarray(d1), np.asarray(d2)), "dists"
+        assert np.array_equal(np.asarray(i1), np.asarray(i2)), "ids"
+
+    # 3) BQ parity across the same two meshes
+    from weaviate_tpu.ops import bq as bq_ops
+
+    dim = 64
+    xb = rng.standard_normal((n, dim)).astype(np.float32)
+    qb = rng.standard_normal((b, dim)).astype(np.float32)
+    codes = np.asarray(bq_ops.bq_encode(jnp.asarray(xb)))
+    qw = np.asarray(bq_ops.bq_encode(jnp.asarray(qb)))
+    outs = []
+    for mesh in (flat, hier):
+        dd, ii = sharded_quantized_topk(
+            replicate_array(jnp.asarray(qb), mesh),
+            replicate_array(jnp.asarray(qw), mesh),
+            shard_array(jnp.asarray(codes), mesh),
+            shard_array(jnp.asarray(valid), mesh),
+            None, None, k=8, k_out=8, chunk_size=64, quantization="bq",
+            metric="l2-squared", mesh=mesh)
+        outs.append((np.asarray(dd), np.asarray(ii)))
+    assert np.array_equal(outs[0][0], outs[1][0]), "bq dists"
+    assert np.array_equal(outs[0][1], outs[1][1]), "bq ids"
+
+    print(f"proc {jax.process_index()}: OK {ids[:, 0].tolist()} "
+          "hier-parity flat+mask+bq", flush=True)
 """)
 
 
@@ -92,6 +153,10 @@ def test_two_process_spmd_step(tmp_path):
                 q.kill()
             pytest.fail("multi-process SPMD step timed out")
         outs.append(out)
+    if any(_BACKEND_UNSUPPORTED in out for out in outs):
+        pytest.skip("jaxlib CPU build lacks multiprocess collectives — "
+                    "hierarchical parity coverage rides "
+                    "tests/test_hierarchical.py on this platform")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert "OK" in out, out

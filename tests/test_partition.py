@@ -1,0 +1,258 @@
+"""Partition rules + hierarchical mesh topology + ledger placement
+(ISSUE 13 satellites: rule-resolution unit suite, make_mesh ordering,
+ledger-driven node ranking)."""
+
+import numpy as np
+import jax
+import pytest
+
+from weaviate_tpu.parallel import partition
+from weaviate_tpu.parallel.mesh import (
+    HOST_AXIS,
+    ICI_AXIS,
+    SHARD_AXIS,
+    host_count,
+    host_labels,
+    is_hierarchical,
+    make_hierarchical_mesh,
+    make_mesh,
+    n_row_shards,
+    row_axes,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+class _Arr:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+# -- mesh topology ------------------------------------------------------------
+
+
+def test_hierarchical_mesh_shape_and_device_order():
+    mesh = make_hierarchical_mesh(n_hosts=2)
+    assert is_hierarchical(mesh)
+    assert dict(mesh.shape) == {HOST_AXIS: 2, ICI_AXIS: 4}
+    assert n_row_shards(mesh) == 8
+    assert host_count(mesh) == 2
+    assert host_labels(mesh) == ["host-0", "host-1"]
+    # rows of the mesh array are hosts: consecutive corpus row blocks
+    # land intra-host (the two-level merge's traffic math relies on it)
+    devs = np.asarray(mesh.devices)
+    flat = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    assert [d.id for d in devs[0]] == [d.id for d in flat[:4]]
+    assert [d.id for d in devs[1]] == [d.id for d in flat[4:]]
+
+
+def test_hierarchical_mesh_degenerates_single_host():
+    mesh = make_hierarchical_mesh(n_hosts=1)
+    assert not is_hierarchical(mesh)
+    assert mesh.axis_names == (SHARD_AXIS,)
+    assert n_row_shards(mesh) == 8
+    assert row_axes(mesh) == SHARD_AXIS
+
+
+def test_hierarchical_mesh_rejects_uneven_split():
+    with pytest.raises(ValueError, match="split evenly"):
+        make_hierarchical_mesh(n_hosts=3)
+
+
+def test_virtual_hosts_env_drives_default(monkeypatch):
+    from weaviate_tpu.parallel.mesh import default_mesh
+
+    monkeypatch.setenv("WEAVIATE_TPU_VIRTUAL_HOSTS", "2")
+    mesh = default_mesh()
+    assert is_hierarchical(mesh)
+    assert dict(mesh.shape) == {HOST_AXIS: 2, ICI_AXIS: 4}
+    monkeypatch.delenv("WEAVIATE_TPU_VIRTUAL_HOSTS")
+    assert not is_hierarchical(default_mesh())
+
+
+def test_make_mesh_groups_devices_by_process():
+    """Satellite: the legacy 1-D axis must ALSO order devices
+    process-major so row-contiguous shards stay intra-host (single
+    process: the sort is the identity, pinned here as the contract)."""
+    mesh = make_mesh()
+    devs = list(np.asarray(mesh.devices).ravel())
+    keys = [(d.process_index, d.id) for d in devs]
+    assert keys == sorted(keys)
+
+
+def test_row_axes_resolution():
+    assert row_axes(None) == SHARD_AXIS
+    assert row_axes(make_mesh(8)) == SHARD_AXIS
+    assert row_axes(make_hierarchical_mesh(n_hosts=2)) == \
+        (HOST_AXIS, ICI_AXIS)
+
+
+# -- rule resolution ----------------------------------------------------------
+
+
+def test_match_rules_flat_mesh():
+    mesh = make_mesh(8)
+    specs = partition.match_partition_rules(
+        partition.SEARCH_RULES,
+        {"q": _Arr((4, 32)), "x": _Arr((1024, 32)),
+         "valid": _Arr((1024,)), "allow_rows": _Arr((4, 1024))},
+        mesh)
+    assert tuple(specs["q"]) == ()
+    assert tuple(specs["x"]) == (SHARD_AXIS,)
+    assert tuple(specs["valid"]) == (SHARD_AXIS,)
+    assert tuple(specs["allow_rows"]) == (None, SHARD_AXIS)
+
+
+def test_match_rules_hierarchical_mesh():
+    """The SAME table resolves to the composite (host, ici) axes on the
+    2-D mesh — no call-site changes."""
+    mesh = make_hierarchical_mesh(n_hosts=2)
+    specs = partition.match_partition_rules(
+        partition.SEARCH_RULES,
+        {"x": _Arr((1024, 32)), "allow_rows": _Arr((4, 1024))},
+        mesh)
+    assert tuple(specs["x"]) == ((HOST_AXIS, ICI_AXIS),)
+    assert tuple(specs["allow_rows"]) == (None, (HOST_AXIS, ICI_AXIS))
+
+
+def test_match_rules_precedence_first_wins():
+    rules = ((r"^x", partition.REPLICATED),
+             (r"x$", partition.ROW_SHARDED))
+    specs = partition.match_partition_rules(
+        rules, {"x": _Arr((64, 8))}, make_mesh(8))
+    assert tuple(specs["x"]) == ()
+
+
+def test_match_rules_scalar_and_none_passthrough():
+    specs = partition.match_partition_rules(
+        partition.SEARCH_RULES,
+        {"unnamed_scalar": _Arr(()), "unnamed_one": _Arr((1, 1)),
+         "x_sq_norms": None},
+        make_mesh(8))
+    assert all(tuple(s) == () for s in specs.values())
+
+
+def test_match_rules_no_rule_found_raises():
+    with pytest.raises(ValueError, match="no partition rule matches"):
+        partition.match_partition_rules(
+            partition.SEARCH_RULES, {"mystery": _Arr((64, 8))},
+            make_mesh(8))
+
+
+def test_quantized_and_ivf_tables_disagree_on_centroids():
+    """'centroids' is a replicated PQ codebook in the quantized scan but
+    the LIST-sharded coarse quantizer in the IVF probe — per-entry-point
+    tables keep both placements declarative."""
+    mesh = make_mesh(8)
+    qspec = partition.match_partition_rules(
+        partition.QUANTIZED_RULES, {"centroids": _Arr((16, 16, 8))},
+        mesh)["centroids"]
+    ispec = partition.match_partition_rules(
+        partition.IVF_RULES, {"centroids": _Arr((64, 32))},
+        mesh)["centroids"]
+    assert tuple(qspec) == ()
+    assert tuple(ispec) == (SHARD_AXIS,)
+
+
+def test_row_spec_dim_placement():
+    mesh = make_hierarchical_mesh(n_hosts=2)
+    assert tuple(partition.row_spec(mesh, dim=0)) == \
+        ((HOST_AXIS, ICI_AXIS),)
+    assert tuple(partition.row_spec(mesh, dim=1)) == \
+        (None, (HOST_AXIS, ICI_AXIS))
+
+
+# -- ledger host rollup -------------------------------------------------------
+
+
+def test_ledger_host_rollup_sums_to_total():
+    from weaviate_tpu.runtime.hbm_ledger import HBMLedger
+
+    led = HBMLedger()
+    led.register("corpus", 1000, collection="c", shard="s",
+                 sharding="sharded")
+    led.register("codebook", 101, collection="c", shard="s",
+                 sharding="replicated")
+    led.register("staging", 7, collection="c", shard="s",
+                 sharding="single")
+    roll = led.host_rollup(2)
+    assert set(roll) == {"host-0", "host-1"}
+    assert sum(roll.values()) == led.total_bytes() == 1108
+    # sharded+replicated split evenly (remainder to host-0); single
+    # lands where device 0 lives
+    assert roll["host-1"] == 500 + 50
+    assert roll["host-0"] == 500 + 51 + 7
+    # degenerate single host: everything on host-0
+    assert led.host_rollup(1) == {"host-0": 1108}
+
+
+# -- ledger-driven placement --------------------------------------------------
+
+
+def test_placement_ranks_nodes_by_headroom(tmp_path):
+    from weaviate_tpu.db.collection import Collection
+    from weaviate_tpu.schema.config import CollectionConfig
+
+    hbm = {"node-a": 500, "node-b": 10, "node-c": 200}
+    col = Collection(
+        str(tmp_path), CollectionConfig(name="Plc"),
+        local_node="node-a",
+        nodes_provider=lambda: ["node-a", "node-b", "node-c"],
+        node_hbm_provider=lambda: hbm)
+    try:
+        # local node reads its own ledger (may be nonzero from other
+        # tests), peers read the provider: b (10) < c (200) always
+        ranked = col._placement_nodes()
+        assert ranked.index("node-b") < ranked.index("node-c")
+        # desired_count=1 collection: the single shard lands on the
+        # lightest node
+        first = col.sharding.nodes_for(col.sharding.shard_names[0])[0]
+        assert first == ranked[0]
+    finally:
+        col.close()
+
+
+def test_placement_provider_failure_is_nonfatal(tmp_path):
+    from weaviate_tpu.db.collection import Collection
+    from weaviate_tpu.schema.config import CollectionConfig
+
+    def boom():
+        raise RuntimeError("stale gossip")
+
+    col = Collection(
+        str(tmp_path), CollectionConfig(name="PlcBoom"),
+        local_node="node-a",
+        nodes_provider=lambda: ["node-a", "node-b"],
+        node_hbm_provider=boom)
+    try:
+        assert set(col.sharding.placement) == set(col.sharding.shard_names)
+    finally:
+        col.close()
+
+
+# -- 1B dry-run placement plan ------------------------------------------------
+
+
+def test_plan_corpus_placement_1b_bq():
+    """ISSUE 13 acceptance: the 1B-vector BQ dry run — shard-aligned
+    capacity, per-host bytes summing exactly, zero allocation."""
+    mesh = make_hierarchical_mesh(n_hosts=2)
+    plan = partition.plan_corpus_placement(
+        1_000_000_000, 768, mesh, quantization="bq", chunk_size=4096)
+    assert plan["hosts"] == 2 and plan["shards"] == 8
+    assert plan["capacity"] >= plan["rows"]
+    assert plan["capacity"] % (plan["shards"] * 4096) == 0
+    assert sum(plan["perHostBytes"].values()) == plan["totalBytes"]
+    # BQ codes dominate: 1e9 rows x 96 B/row ~ 96 GB + 1 GB valid mask
+    assert 9.6e10 < plan["totalBytes"] < 1.0e11
+    assert plan["components"]["codes"] == plan["capacity"] * 96
+
+
+def test_plan_corpus_placement_single_device():
+    plan = partition.plan_corpus_placement(
+        10_000, 128, None, quantization="none", chunk_size=1024)
+    assert plan["hosts"] == 1 and plan["shards"] == 1
+    assert plan["perHostBytes"] == {"host-0": plan["totalBytes"]}
+    assert plan["components"]["vectors"] == plan["capacity"] * 256

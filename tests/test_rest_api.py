@@ -35,6 +35,23 @@ def test_meta_and_wellknown(client):
     assert nodes[0]["status"] == "HEALTHY"
 
 
+def test_nodes_per_host_hbm_rollup(client):
+    """ISSUE 13 acceptance: /v1/nodes reports per-host hbmBytes that
+    SUM to the ledger total (the hierarchical-sharding attribution)."""
+    from weaviate_tpu.runtime.hbm_ledger import ledger
+
+    client.create_class({"class": "HostBytes"})
+    client.create_object("HostBytes", {}, vector=[1.0, 2.0, 3.0, 4.0])
+    nodes = client.request("GET", "/v1/nodes?output=verbose")["nodes"]
+    stats = nodes[0]["stats"]
+    hosts = stats["hbmHostBytes"]
+    assert hosts and all(h.startswith("host-") for h in hosts)
+    assert sum(hosts.values()) == stats["hbmLedgerBytes"] \
+        == ledger.total_bytes()
+    # per-shard breakdown still rides verbose output alongside
+    assert any(s["class"] == "HostBytes" for s in nodes[0]["shards"])
+
+
 def test_schema_crud(client):
     client.create_class({"name": "Article", "properties": [
         {"name": "title", "data_type": "text"},

@@ -25,6 +25,10 @@ or a real TPU to fire):
                       state in storage|cluster|engine goes through
                       fsutil.atomic_replace (fsync-file -> rename ->
                       fsync-dir) or an fsyncing function
+- G8 partition-discipline hand-written PartitionSpec/P(...) literals
+                      outside parallel/partition.py — placement
+                      resolves through the match_partition_rules
+                      tables, never per-call-site axis literals
 
 Run: ``python -m tools.graftlint [--json] [--update-baseline] paths...``
 Suppress: ``# graftlint: disable=G1`` on the violating line (give a

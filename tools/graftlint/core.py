@@ -33,7 +33,7 @@ import re
 import sys
 from dataclasses import asdict, dataclass, field
 
-CHECK_IDS = ("G1", "G2", "G3", "G4", "G5", "G6", "G7")
+CHECK_IDS = ("G1", "G2", "G3", "G4", "G5", "G6", "G7", "G8")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
@@ -144,10 +144,12 @@ def all_checkers() -> list[Checker]:
     from tools.graftlint.g5_metrics import MetricsConventionChecker
     from tools.graftlint.g6_timeouts import TimeoutDisciplineChecker
     from tools.graftlint.g7_durability import DurabilityChecker
+    from tools.graftlint.g8_partition import PartitionDisciplineChecker
 
     return [HostSyncChecker(), RetraceChecker(), PallasChecker(),
             LockDisciplineChecker(), MetricsConventionChecker(),
-            TimeoutDisciplineChecker(), DurabilityChecker()]
+            TimeoutDisciplineChecker(), DurabilityChecker(),
+            PartitionDisciplineChecker()]
 
 
 # -- suppressions -------------------------------------------------------------

@@ -1,0 +1,103 @@
+"""G8 partition-discipline: PartitionSpec literals live in partition.py.
+
+ISSUE 13 moved every placement decision into the regex rule tables of
+``weaviate_tpu/parallel/partition.py`` (``match_partition_rules``, the
+SNIPPETS [1] pattern): the SPMD entry points, the device stores, and
+the placement helpers all NAME their operands and let the table decide
+``P(None, 'shard')`` vs ``P(('host', 'ici'), None)``. A hand-written
+``PartitionSpec`` anywhere else silently re-scatters placement across
+call sites — and, worse, hard-wires a mesh SHAPE: a literal
+``P('shard')`` compiles fine on the 1-D mesh and then misplaces (or
+refuses to compile) on the hierarchical ``('host', 'ici')`` mesh,
+exactly the class of bug the rule tables exist to prevent.
+
+This checker gates ``weaviate_tpu/`` (product code; tests and benches
+may build specs for fixtures):
+
+- ``from jax.sharding import PartitionSpec [as P]`` (and
+  ``from jax.experimental.pjit``-era spellings) outside partition.py is
+  a violation at the import;
+- every CALL of a name bound to PartitionSpec by such an import — or of
+  ``jax.sharding.PartitionSpec`` via attribute access — is a violation
+  at the call site.
+
+Keepers need a reasoned baseline entry, per graftlint convention.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Checker, FileContext, Violation
+
+_HOME = "weaviate_tpu/parallel/partition.py"
+_SCOPE = "weaviate_tpu/"
+#: modules that export PartitionSpec under any historical spelling
+_SPEC_MODULES = ("jax.sharding", "jax.experimental.pjit",
+                 "jax.interpreters.sharded_jit", "jax.interpreters.pxla")
+
+
+class PartitionDisciplineChecker(Checker):
+    id = "G8"
+    name = "partition-discipline"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py") and path != _HOME and \
+            path.startswith(_SCOPE)
+
+    def check(self, ctx: FileContext) -> list[Violation]:
+        out: list[Violation] = []
+        spec_aliases: set[str] = set()   # names bound to PartitionSpec
+        module_aliases: set[str] = set()  # names bound to jax.sharding
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module in _SPEC_MODULES:
+                for alias in node.names:
+                    if alias.name == "PartitionSpec":
+                        spec_aliases.add(alias.asname or alias.name)
+                        out.append(self._violation(
+                            ctx, node,
+                            "PartitionSpec imported outside "
+                            "parallel/partition.py — name the operand "
+                            "and resolve its spec through "
+                            "partition.match_partition_rules / the "
+                            "row_sharding helpers instead"))
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name in _SPEC_MODULES:
+                        module_aliases.add(
+                            alias.asname or alias.name.split(".")[0])
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in spec_aliases:
+                out.append(self._violation(
+                    ctx, node,
+                    f"hand-written {f.id}(...) literal — placement "
+                    "belongs in the partition.py rule table (a literal "
+                    "axis name silently misplaces on the other mesh "
+                    "shape)"))
+            elif isinstance(f, ast.Attribute) and \
+                    f.attr == "PartitionSpec" and \
+                    self._names_spec_module(f.value, module_aliases):
+                out.append(self._violation(
+                    ctx, node,
+                    "hand-written jax.sharding.PartitionSpec(...) "
+                    "literal — placement belongs in the partition.py "
+                    "rule table"))
+        return out
+
+    @staticmethod
+    def _names_spec_module(value: ast.expr, module_aliases: set) -> bool:
+        """``value`` is ``jax.sharding`` (dotted) or an alias of it."""
+        if isinstance(value, ast.Name):
+            return value.id in module_aliases
+        return (isinstance(value, ast.Attribute)
+                and value.attr == "sharding"
+                and isinstance(value.value, ast.Name)
+                and value.value.id == "jax")
+
+    def _violation(self, ctx, node, msg) -> Violation:
+        return Violation(self.id, ctx.path, node.lineno, node.col_offset,
+                         f"[partition-discipline] {msg}")

@@ -619,6 +619,14 @@ class RestServer:
                 perfgate.refresh()
             except Exception:
                 pass
+            # scrape-time per-host HBM refresh: the host split depends
+            # on live totals, so recompute here (never fail the scrape)
+            try:
+                from weaviate_tpu.runtime.hbm_ledger import ledger
+
+                ledger.refresh_host_gauge()
+            except Exception:
+                pass
             return 200, RawResponse(
                 registry.expose().encode(),
                 "text/plain; version=0.0.4; charset=utf-8")
@@ -957,7 +965,13 @@ class RestServer:
         from weaviate_tpu.runtime.hbm_ledger import ledger
         from weaviate_tpu.runtime.memwatch import device_memory_stats
 
+        from weaviate_tpu.parallel.mesh import host_count
+
         snap = ledger.snapshot()
+        # per-MESH-HOST device bytes (hierarchical sharding attribution)
+        # — distinct from each collection's host-RAM-tier "hostBytes"
+        snap["hbmHostBytes"] = ledger.host_rollup(
+            host_count(getattr(self.db, "mesh", None)))
         mw = getattr(self.db, "memwatch", None)
         budget = mw.device_budget() if mw is not None else None
         out = {
@@ -1037,12 +1051,18 @@ class RestServer:
 
             from weaviate_tpu.runtime.hbm_ledger import ledger
 
+            from weaviate_tpu.parallel.mesh import host_count
+
             local_health = degrade.health()
             for n in nodes:
                 if n["name"] == self.db.local_node:
                     n["stats"] = {**(n.get("stats") or {}),
                                   "deviceMemory": device_memory_stats(),
-                                  "hbmLedgerBytes": ledger.total_bytes()}
+                                  "hbmLedgerBytes": ledger.total_bytes(),
+                                  # per-mesh-host rollup (sums to
+                                  # hbmLedgerBytes — ROADMAP item 2)
+                                  "hbmHostBytes": ledger.host_rollup(
+                                      host_count(self.db.mesh))}
                     # component health (degrade registry): a faulted
                     # batcher/native-plane dispatch path flips this
                     n["health"] = local_health
@@ -1058,6 +1078,7 @@ class RestServer:
         object_count = sum(
             s.object_count() for c in self.db.collections.values()
             for s in c.shards.values())
+        from weaviate_tpu.parallel.mesh import host_count
         from weaviate_tpu.runtime.hbm_ledger import ledger
         from weaviate_tpu.runtime.memwatch import device_memory_stats
 
@@ -1070,7 +1091,9 @@ class RestServer:
                 "stats": {"shardCount": shard_count,
                           "objectCount": object_count,
                           "deviceMemory": device_memory_stats(),
-                          "hbmLedgerBytes": ledger.total_bytes()}}
+                          "hbmLedgerBytes": ledger.total_bytes(),
+                          "hbmHostBytes": ledger.host_rollup(
+                              host_count(self.db.mesh))}}
         if verbose:
             node["shards"] = self._local_shard_details()
         return [node]

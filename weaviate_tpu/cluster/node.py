@@ -70,6 +70,10 @@ class ClusterNode:
                              restore_fn=self.fsm.restore)
         # auto tenant creation must take the Raft path in a cluster
         self.db.set_auto_tenant_hook(self.add_tenants)
+        # ledger-driven placement (ROADMAP item 2): every node gossips
+        # its HBM ledger total; placement + cross-node epoch migration
+        # read the peers' readings from membership meta
+        self.db.node_hbm_provider = self._gossiped_hbm
         self.server.start()
         self.rest = None
 
@@ -92,7 +96,33 @@ class ClusterNode:
         # (reference: shard_hashbeater launched per shard at shard load)
         self.db.cycles.register("hashbeat", self._hashbeat_cycle,
                                 interval=5.0, max_interval=60.0)
+        # broadcast this node's HBM ledger total (reference:
+        # delegate.go piggybacks disk space on gossip the same way)
+        self._publish_hbm()
+        self.db.cycles.register("hbm-gossip", self._publish_hbm,
+                                interval=2.0, max_interval=30.0)
         self.db.cycles.start()
+
+    def _publish_hbm(self) -> bool:
+        """Refresh the gossiped ``hbmBytes`` meta from the local HBM
+        ledger. Returns True ("did work") every time: a False return
+        is the cyclemanager's IDLE/backoff signal and would decay this
+        heartbeat from its 2s cadence toward max_interval — placement
+        would then rank nodes on up-to-30s-stale readings."""
+        from weaviate_tpu.runtime.hbm_ledger import ledger
+
+        self.membership.set_meta(hbmBytes=ledger.total_bytes())
+        return True
+
+    def _gossiped_hbm(self) -> dict:
+        """node -> last gossiped HBM ledger bytes (nodes that never
+        reported are absent — placement treats them as unknown)."""
+        out = {}
+        for name, info in self.membership.nodes().items():
+            v = (info.meta or {}).get("hbmBytes")
+            if isinstance(v, (int, float)):
+                out[name] = int(v)
+        return out
 
     def _hashbeat_cycle(self) -> bool:
         from weaviate_tpu.replication import HashBeater
@@ -142,15 +172,30 @@ class ClusterNode:
         replays the descriptor's original placement so restored files
         match their shards)."""
         config.validate()
-        # placement computed ONCE here, applied identically everywhere
+        # placement computed ONCE here, applied identically everywhere.
+        # Ledger-driven: candidates rank by gossiped HBM headroom
+        # (lightest first, stable for un-reported nodes), so new
+        # collections land on the nodes with room (ROADMAP item 2).
         if sharding_state is not None:
             state = sharding_state
         elif config.multi_tenancy.enabled:
             state = ShardingState.create_partitioned()
         else:
+            from weaviate_tpu.runtime.hbm_ledger import ledger
+
+            hbm = self._gossiped_hbm()
+            nodes = self.membership.alive_nodes()
+            # rank only when at least one PEER has reported: right
+            # after cluster formation the peers' hbmBytes meta has not
+            # gossiped yet, and comparing the local live ledger against
+            # unreported-as-zero peers would spuriously demote the
+            # local node (same guard as Collection._placement_nodes)
+            if any(n != self.name for n in hbm):
+                hbm[self.name] = ledger.total_bytes()
+                nodes = sorted(nodes, key=lambda n: hbm.get(n, 0))
             state = ShardingState.create(
                 config.sharding.desired_count,
-                nodes=self.membership.alive_nodes(),
+                nodes=nodes,
                 replication_factor=config.replication.factor)
         self.raft.propose({"type": "add_class", "config": config.to_dict(),
                            "sharding": state.to_dict()})

@@ -92,7 +92,8 @@ class Collection:
                  local_node: str = "node-0", on_sharding_change=None,
                  memwatch=None, remote=None, nodes_provider=None,
                  async_indexing: bool | None = None,
-                 sync_wal: bool | None = None):
+                 sync_wal: bool | None = None,
+                 node_hbm_provider=None):
         config.validate()
         self.config = config
         self.data_dir = data_dir
@@ -105,6 +106,10 @@ class Collection:
         # sharding.RemoteIndexClient for non-local shards, index.go:1607)
         self.remote = remote
         self._nodes_provider = nodes_provider or (lambda: [local_node])
+        # node -> HBM ledger bytes (gossiped meta in a cluster); feeds
+        # ledger-driven placement + the cross-node epoch migration
+        # target choice. None = only the local ledger is known.
+        self._node_hbm_provider = node_hbm_provider
         # cluster hook fn(collection_name, [tenant]) routing auto tenant
         # creation through Raft; None = apply locally (single node)
         self._auto_tenant_hook = None
@@ -131,9 +136,12 @@ class Collection:
             if config.multi_tenancy.enabled:
                 sharding_state = ShardingState.create_partitioned()
             else:
+                # ledger-driven placement (ROADMAP item 2): round-robin
+                # starts at the node with the most HBM headroom, so a
+                # new collection's shards land on light nodes first
                 sharding_state = ShardingState.create(
                     config.sharding.desired_count,
-                    nodes=self._nodes_provider(),
+                    nodes=self._placement_nodes(),
                     replication_factor=config.replication.factor,
                 )
         self.sharding = sharding_state
@@ -292,6 +300,53 @@ class Collection:
             raise RuntimeError(f"auto tenant creation for {tenant!r} did "
                                "not converge")
         self._record_tenant(tenant, "write")
+
+    def _reported_hbm(self) -> dict:
+        """The hbm provider's reading (gossiped ``hbmBytes`` meta in a
+        cluster), {} when no provider is wired or it fails — stale
+        gossip must never fail collection creation or migration."""
+        if self._node_hbm_provider is None:
+            return {}
+        try:
+            return {str(k): int(v) for k, v in
+                    dict(self._node_hbm_provider()).items()}
+        except Exception:  # noqa: BLE001
+            return {}
+
+    def _node_hbm_bytes(self, reported: dict | None = None) -> dict:
+        """node -> known HBM ledger bytes. The local node always reads
+        its own ledger (authoritative); other nodes come from the
+        provider reading (pass ``reported`` to reuse one already
+        fetched), defaulting to 0 — an unknown node is assumed empty,
+        which keeps single-node behavior identical to the
+        pre-placement code."""
+        from weaviate_tpu.runtime.hbm_ledger import ledger
+
+        out = dict(reported) if reported is not None \
+            else self._reported_hbm()
+        out[self.local_node] = ledger.total_bytes()
+        return out
+
+    def _placement_nodes(self) -> list[str]:
+        """Candidate nodes ordered by HBM headroom (lightest ledger
+        first; sort is stable so equally-loaded nodes keep the
+        provider's order). ShardingState.create round-robins shards
+        from index 0, so the lightest node receives the first shard(s)
+        of every new collection.
+
+        Ranking engages only when at least one PEER (a node other than
+        this one) has actually reported through the hbm provider
+        (gossip in a cluster): with no peer information, the provider's
+        order stands — the gossip view always contains this node's own
+        reading, and comparing the local live ledger against
+        unreported-as-zero peers would spuriously demote the local node
+        on every non-empty process."""
+        nodes = list(self._nodes_provider())
+        reported = self._reported_hbm()
+        if not any(n != self.local_node for n in reported):
+            return nodes
+        hbm = self._node_hbm_bytes(reported)
+        return sorted(nodes, key=lambda n: hbm.get(n, 0))
 
     def _require_remote(self, shard_name: str):
         if self.remote is None:
@@ -592,10 +647,19 @@ class Collection:
             obj = shard.get_object(uuid)
             if obj is None:
                 # epoch migration moved this object to a sibling: the
-                # durable marker keeps ring routing correct
+                # durable marker keeps ring routing correct (the
+                # sibling may live on another NODE after a cross-node
+                # epoch move)
                 dst = shard.migrated_to(uuid)
-                if dst and dst != name and self._is_local(dst):
-                    return self._load_shard(dst).get_object(uuid)
+                if dst and dst != name:
+                    if self._is_local(dst):
+                        return self._load_shard(dst).get_object(uuid)
+                    if self.remote is not None:
+                        raw = self._require_remote(dst).get_object(
+                            self._read_node(dst), self.config.name,
+                            dst, uuid)
+                        if raw is not None:
+                            return StorageObject.from_bytes(raw)
             return obj
         raw = self._require_remote(name).get_object(
             self._read_node(name), self.config.name, name, uuid)
@@ -616,9 +680,15 @@ class Collection:
             # a migrated copy (or the transient double-present crash
             # window) lives at the marker's destination — delete it too
             # so exactly zero copies remain, and drop the marker
+            # (cross-node moves route the delete over the shard RPC)
             dst = shard.migrated_to(uuid)
-            if dst and dst != name and self._is_local(dst):
-                ok = self._load_shard(dst).delete_object(uuid) or ok
+            if dst and dst != name:
+                if self._is_local(dst):
+                    ok = self._load_shard(dst).delete_object(uuid) or ok
+                elif self.remote is not None:
+                    ok = self._require_remote(dst).delete_object(
+                        self._read_node(dst), self.config.name, dst,
+                        uuid) or ok
             if dst:
                 shard.clear_migrated(uuid)
         else:
@@ -874,8 +944,13 @@ class Collection:
             return
         for obj in objs:
             dst = shard.migrated_to(obj.uuid)
-            if dst and dst != shard.name and self._is_local(dst):
-                self._load_shard(dst).delete_object(obj.uuid)
+            if dst and dst != shard.name:
+                if self._is_local(dst):
+                    self._load_shard(dst).delete_object(obj.uuid)
+                elif self.remote is not None:
+                    self._require_remote(dst).delete_object(
+                        self._read_node(dst), self.config.name, dst,
+                        obj.uuid)
             if dst:
                 shard.clear_migrated(obj.uuid)
 
@@ -913,6 +988,32 @@ class Collection:
         # just bounce the epoch back on the next cycle
         return best if best_bytes < src_bytes else None
 
+    def _remote_sibling_with_headroom(self, src_name: str) -> str | None:
+        """The cross-NODE half of epoch migration (ROADMAP item 3's
+        leftover, riding item 2's placement machinery): a sibling shard
+        placed on another node, chosen by that node's gossiped HBM
+        ledger bytes. Only nodes whose reported footprint is BELOW this
+        node's qualify — a local move cannot relieve device-global
+        pressure (two shards of one process share the chips), but
+        shipping the epoch to a genuinely lighter node does. Nodes with
+        no gossiped ledger reading are skipped: never ship an epoch
+        blind."""
+        if self.remote is None:
+            return None
+        hbm = self._node_hbm_bytes()
+        local_bytes = hbm.get(self.local_node, 0)
+        best, best_bytes = None, None
+        for name in self.sharding.shard_names:
+            if name == src_name or self._is_local(name):
+                continue
+            node = self.sharding.nodes_for(name)[0]
+            b = hbm.get(node)
+            if b is None or b >= local_bytes:
+                continue
+            if best_bytes is None or b < best_bytes:
+                best, best_bytes = name, b
+        return best
+
     def migrate_epoch(self, src_name: str, vec_name: str = "",
                       dst_name: str | None = None) -> int:
         """Migrate the coldest sealed epoch of ``src_name``'s
@@ -947,10 +1048,19 @@ class Collection:
                 if eid is None:
                     continue
                 dst = dst_name or self._sibling_with_headroom(src_name)
-                if dst is None or dst == src_name \
-                        or not self._is_local(dst):
+                if dst is None and dst_name is None:
+                    # no LOCAL headroom: the cross-node half — ship the
+                    # epoch to a sibling shard on a lighter node,
+                    # behind the same durable-marker cutover
+                    dst = self._remote_sibling_with_headroom(src_name)
+                if dst is None or dst == src_name:
                     return moved_total
-                moved_total += self._migrate_one(src, idx, es, eid, dst)
+                if self._is_local(dst):
+                    moved_total += self._migrate_one(src, idx, es, eid,
+                                                     dst)
+                else:
+                    moved_total += self._migrate_one_remote(
+                        src, idx, es, eid, dst)
         return moved_total
 
     def _migrate_one(self, src, idx, es, eid: int, dst: str) -> int:
@@ -1010,6 +1120,75 @@ class Collection:
         logger.info(
             "epoch migration: %s/%s e%d -> %s (%d objects)",
             self.config.name, src_name, eid, dst, len(objs))
+        return len(objs)
+
+    def _migrate_one_remote(self, src, idx, es, eid: int,
+                            dst: str) -> int:
+        """Cross-node twin of ``_migrate_one``: same durable-marker
+        cutover ordering, with the target-side durable ingest riding
+        the remote shard client (``put_objects`` → the destination
+        node's ``Shard.put_object_batch``, so vectors land in ITS
+        device epochs under ITS admission control). Markers go first (a
+        marker to a copy that never ingests is harmless — GETs prefer
+        the ring copy); an ingest RPC failure aborts with NOTHING cut
+        over and the markers LEFT IN PLACE: a timeout or lost reply is
+        ambiguous — the put may have landed durably on the target — and
+        dropping the markers would orphan that copy as an undeletable
+        zombie (searches would keep surfacing it after the ring copy is
+        deleted). Kept markers keep every copy reachable: deletes and
+        re-puts clean BOTH sides through them, search dedups by uuid,
+        and a later retry simply re-marks and re-ingests (idempotent by
+        uuid). The source shard lock is held across the RPC — the same
+        writes-queue-behind-the-move contract as the local twin;
+        exposure is bounded by the remote client's per-attempt deadline
+        (REMOTE_RPC_TIMEOUT_S) + the per-peer circuit breaker failing
+        known-dead nodes fast, and migrations are serialized per
+        collection. The same ``epoch.migrate.*`` fault points fire, so
+        the crashtest harness covers this path too."""
+        from weaviate_tpu.cluster.transport import RpcError
+        from weaviate_tpu.runtime import faultline
+
+        src_name = src.name
+        dst_node = self.sharding.nodes_for(dst)[0]
+        with src._lock:
+            doc_ids = idx.epoch_doc_ids(eid)
+            if not len(doc_ids):
+                es.drop_epoch(eid)
+                return 0
+            objs = [o for o in src.objects_by_doc_ids(doc_ids)
+                    if o is not None]
+            if not objs:
+                return 0
+            src.mark_migrating([o.uuid for o in objs], dst)
+            faultline.fire("epoch.migrate.pre_ingest", shard=src_name,
+                           epoch=eid, docs=len(doc_ids))
+            try:
+                self._require_remote(dst).put_objects(
+                    dst_node, self.config.name, dst,
+                    [o.to_bytes() for o in objs])
+            except RpcError as e:
+                # ambiguous outcome (the put may have landed before a
+                # timeout/lost reply): keep the markers so a possibly-
+                # present target copy stays reachable for deletes and
+                # dedup — clearing them here would orphan it
+                logger.warning(
+                    "cross-node epoch migration %s/%s e%d -> %s@%s "
+                    "aborted (markers kept, nothing cut over): %s",
+                    self.config.name, src_name, eid, dst, dst_node, e)
+                return 0
+            faultline.fire("epoch.migrate.post_ingest", shard=src_name,
+                           epoch=eid)
+            src.migrate_out([o.uuid for o in objs], dst)
+            faultline.fire("epoch.migrate.post_cutover", shard=src_name,
+                           epoch=eid)
+            es.drop_epoch(eid)
+            es.migrations_total += 1
+        monitoring.epoch_migrations.labels(self.config.name,
+                                           src_name).inc()
+        logger.info(
+            "cross-node epoch migration: %s/%s e%d -> %s@%s "
+            "(%d objects)", self.config.name, src_name, eid, dst,
+            dst_node, len(objs))
         return len(objs)
 
     def epoch_maintenance(self) -> bool:

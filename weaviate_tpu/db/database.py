@@ -27,6 +27,11 @@ class Database:
                  sync_wal: bool | None = None):
         self.data_dir = data_dir
         self.mesh = mesh
+        # host-count hint for scrape-time hbm_host_bytes refreshes
+        from weaviate_tpu.parallel.mesh import host_count
+        from weaviate_tpu.runtime.hbm_ledger import ledger as _hbm_ledger
+
+        _hbm_ledger.set_host_count(host_count(mesh))
         self.local_node = local_node
         self.remote = remote
         self.async_indexing = async_indexing  # None = env decides per shard
@@ -41,6 +46,11 @@ class Database:
             sync_wal = _flag(os.environ, "PERSISTENCE_WAL_SYNC")
         self.sync_wal = sync_wal
         self.nodes_provider = nodes_provider or (lambda: [local_node])
+        # node -> gossiped HBM ledger bytes; set by ClusterNode (reads
+        # membership meta). Collections bind _node_hbm lazily so a hook
+        # installed after startup still reaches already-loaded
+        # collections' placement + cross-node migration decisions.
+        self.node_hbm_provider = None
         # cluster hook fn(collection, [tenant]): routes auto tenant
         # creation through Raft (set by ClusterNode); None = local apply
         self.auto_tenant_hook = None
@@ -86,6 +96,13 @@ class Database:
             did = col.epoch_maintenance() or did
         return did
 
+    def _node_hbm(self) -> dict:
+        """Late-binding wrapper: collections constructed before the
+        cluster layer installs ``node_hbm_provider`` still see it."""
+        if self.node_hbm_provider is None:
+            return {}
+        return self.node_hbm_provider()
+
     def _load_existing(self):
         for key in self._schema.keys():
             d = self._schema.get(key)
@@ -98,6 +115,7 @@ class Database:
                 nodes_provider=self.nodes_provider,
                 async_indexing=self.async_indexing,
                 sync_wal=self.sync_wal,
+                node_hbm_provider=self._node_hbm,
             )
             col._auto_tenant_hook = self.auto_tenant_hook
             col.offload_backend = self.offload_backend
@@ -122,7 +140,8 @@ class Database:
                              memwatch=self.memwatch, remote=self.remote,
                              nodes_provider=self.nodes_provider,
                              async_indexing=self.async_indexing,
-                             sync_wal=self.sync_wal)
+                             sync_wal=self.sync_wal,
+                             node_hbm_provider=self._node_hbm)
             col._auto_tenant_hook = self.auto_tenant_hook
             col.offload_backend = self.offload_backend
             self.collections[config.name] = col

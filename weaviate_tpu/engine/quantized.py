@@ -41,7 +41,7 @@ import numpy as np
 from weaviate_tpu.ops import bq as bq_ops
 from weaviate_tpu.ops import pq as pq_ops
 from weaviate_tpu.ops.distances import normalize_np
-from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
+from weaviate_tpu.parallel.mesh import n_row_shards, shardable_capacity
 from weaviate_tpu.runtime import hbm_ledger, tracing
 from weaviate_tpu.runtime.transfer import DeviceResultHandle
 
@@ -168,7 +168,7 @@ class QuantizedVectorStore:
             else normalize_on_add
         )
         self.mesh = mesh
-        self.n_shards = 1 if mesh is None else mesh.shape[SHARD_AXIS]
+        self.n_shards = n_row_shards(mesh)
         self.hbm_component_suffix = component_suffix
         self.prefix_words = 0
         if prefix_bits and mesh is None:

@@ -37,7 +37,7 @@ from weaviate_tpu.ops.topk import chunked_topk_distances
 from weaviate_tpu.runtime import hbm_ledger, tracing
 from weaviate_tpu.runtime import transfer
 from weaviate_tpu.runtime.transfer import DeviceResultHandle
-from weaviate_tpu.parallel.mesh import SHARD_AXIS, shardable_capacity
+from weaviate_tpu.parallel.mesh import n_row_shards, shardable_capacity
 from weaviate_tpu.parallel.sharded_search import (
     replicate_array,
     shard_array,
@@ -180,7 +180,7 @@ class DeviceVectorStore:
         # non-TPU backends it runs through the Pallas interpreter, so keep
         # it for tests/TPU serving, not CPU serving.
         self.selection = selection
-        self.n_shards = 1 if mesh is None else mesh.shape[SHARD_AXIS]
+        self.n_shards = n_row_shards(mesh)
         # cosine provider normalizes at insert (reference stores normalized
         # vectors and uses the dot kernel: cosine_dist.go "cosine-dot")
         self.normalize_on_add = (

@@ -10,15 +10,25 @@ an all_gather over ICI — no host round-trips inside a query.
 from weaviate_tpu.parallel.mesh import (
     default_mesh,
     device_count,
+    host_count,
+    is_hierarchical,
+    make_hierarchical_mesh,
     make_mesh,
+    n_row_shards,
     shardable_capacity,
 )
+from weaviate_tpu.parallel.partition import match_partition_rules
 from weaviate_tpu.parallel.sharded_search import sharded_topk
 
 __all__ = [
     "default_mesh",
     "device_count",
+    "host_count",
+    "is_hierarchical",
+    "make_hierarchical_mesh",
     "make_mesh",
+    "match_partition_rules",
+    "n_row_shards",
     "shardable_capacity",
     "sharded_topk",
 ]

@@ -1,9 +1,23 @@
 """Mesh construction helpers.
 
-The framework uses a 1-D ``shard`` axis for corpus row-sharding (the analog
-of the reference's physical shards, usecases/sharding/state.go:28). On a
-multi-host pod the same axis spans DCN automatically via jax.devices()
-once ``maybe_initialize_distributed`` has joined the global runtime.
+The framework uses two mesh shapes for corpus row-sharding (the analog
+of the reference's physical shards, usecases/sharding/state.go:28):
+
+- the legacy 1-D ``('shard',)`` mesh: every device is one shard of the
+  row axis, collectives span the whole pod in one hop;
+- the hierarchical 2-D ``('host', 'ici')`` mesh (ISSUE 13): devices are
+  grouped by the OS process that owns them, so the ``ici`` axis stays
+  inside a host (fast interconnect) and only the ``host`` axis crosses
+  DCN. The two-level candidate merge in sharded_search exploits this:
+  candidates reduce over ``ici`` first and only per-host winners cross
+  ``host`` — O(hosts*k) DCN traffic instead of O(devices*k).
+
+Single-host, ``make_hierarchical_mesh`` degenerates to the 1-D
+``shard`` mesh so every existing call site keeps working unchanged.
+Device order is always process-grouped (``_process_grouped_devices``)
+so row-contiguous shards are intra-host on BOTH mesh shapes — a flat
+``jax.devices()`` interleaving would silently turn every "ICI" hop
+into a DCN hop.
 """
 
 from __future__ import annotations
@@ -16,6 +30,13 @@ import numpy as np
 from jax.sharding import Mesh
 
 SHARD_AXIS = "shard"
+#: hierarchical mesh axes: ``host`` crosses DCN, ``ici`` stays on-host
+HOST_AXIS = "host"
+ICI_AXIS = "ici"
+
+#: env knob: fake N hosts on a single process (the 8-device virtual CPU
+#: mesh becomes a 2x4 "two-host pod" with WEAVIATE_TPU_VIRTUAL_HOSTS=2)
+VIRTUAL_HOSTS_ENV = "WEAVIATE_TPU_VIRTUAL_HOSTS"
 
 _dist_lock = threading.Lock()
 _dist_initialized = False
@@ -68,19 +89,117 @@ def is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+def _process_grouped_devices() -> list:
+    """All devices, grouped by owning process then device id. jax's
+    global device order is USUALLY process-major already, but that is
+    not contractual — and a flat interleaved order would assign
+    consecutive corpus row blocks to devices on DIFFERENT hosts,
+    silently turning every intra-"shard-neighborhood" collective hop
+    into a DCN hop (ISSUE 13 satellite). Sorting pins the contract."""
+    return sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+
+
 def make_mesh(n_devices: int | None = None, axis_name: str = SHARD_AXIS) -> Mesh:
-    """1-D mesh over the first ``n_devices`` devices."""
-    devs = jax.devices()
+    """1-D mesh over the first ``n_devices`` devices, process-grouped so
+    row-contiguous shards stay intra-host even on the legacy flat axis."""
+    devs = _process_grouped_devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis_name,))
 
 
+def virtual_hosts(env=None) -> int | None:
+    """WEAVIATE_TPU_VIRTUAL_HOSTS as an int, or None when unset/invalid."""
+    env = env if env is not None else os.environ
+    raw = env.get(VIRTUAL_HOSTS_ENV)
+    if not raw:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n >= 1 else None
+
+
+def make_hierarchical_mesh(n_hosts: int | None = None,
+                           n_devices: int | None = None) -> Mesh:
+    """2-D ``('host', 'ici')`` mesh: one row of local devices per host.
+
+    ``n_hosts`` defaults to ``jax.process_count()`` (overridable by
+    WEAVIATE_TPU_VIRTUAL_HOSTS for the single-process virtual pod used
+    in tests and the 1B dry run). With one host this DEGENERATES to the
+    existing 1-D ``shard`` mesh, so every current call site — store
+    placement, sharded_search, grow_rows — keeps working unchanged.
+
+    Device order is process-grouped and rows of the mesh array are
+    hosts, so a row-sharded array placed with the composite
+    ``(host, ici)`` axes lands consecutive corpus row blocks intra-host
+    — the property the two-level merge's traffic math relies on.
+    """
+    devs = _process_grouped_devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if n_hosts is None:
+        n_hosts = virtual_hosts() or jax.process_count()
+    if n_hosts <= 1 or len(devs) <= 1:
+        return Mesh(np.asarray(devs), (SHARD_AXIS,))
+    if len(devs) % n_hosts:
+        raise ValueError(
+            f"{len(devs)} devices do not split evenly over {n_hosts} "
+            "hosts — hierarchical row-sharding needs equal local device "
+            "counts per host")
+    arr = np.asarray(devs).reshape(n_hosts, len(devs) // n_hosts)
+    return Mesh(arr, (HOST_AXIS, ICI_AXIS))
+
+
+def is_hierarchical(mesh: Mesh | None) -> bool:
+    return mesh is not None and HOST_AXIS in mesh.axis_names
+
+
+def row_axes(mesh: Mesh | None):
+    """The mesh axis (or composite axis tuple) corpus rows shard over."""
+    if is_hierarchical(mesh):
+        return (HOST_AXIS, ICI_AXIS)
+    if mesh is not None and len(mesh.axis_names) == 1:
+        return mesh.axis_names[0]  # honor a custom 1-D axis name
+    return SHARD_AXIS
+
+
+def n_row_shards(mesh: Mesh | None) -> int:
+    """Row shards = devices participating in the row axis (both mesh
+    shapes shard rows over every device; hierarchical just names the
+    host/ici split). Honors a custom 1-D axis name, like row_axes."""
+    if mesh is None:
+        return 1
+    if is_hierarchical(mesh):
+        return int(mesh.shape[HOST_AXIS]) * int(mesh.shape[ICI_AXIS])
+    return int(mesh.shape[mesh.axis_names[0]])
+
+
+def host_count(mesh: Mesh | None = None) -> int:
+    """Hosts backing ``mesh`` (1-D meshes report the process count; a
+    virtual-host override counts as real hosts for attribution)."""
+    if is_hierarchical(mesh):
+        return int(mesh.shape[HOST_AXIS])
+    if mesh is None:
+        return max(1, virtual_hosts() or 1)
+    return max(1, virtual_hosts() or jax.process_count())
+
+
+def host_labels(mesh: Mesh | None = None) -> list[str]:
+    return [f"host-{i}" for i in range(host_count(mesh))]
+
+
 def default_mesh() -> Mesh | None:
     """Mesh over all devices, or None when there is a single device
-    (single-chip path skips shard_map entirely)."""
+    (single-chip path skips shard_map entirely). Multi-process runtimes
+    — and single-process ones faking hosts via
+    WEAVIATE_TPU_VIRTUAL_HOSTS — get the hierarchical mesh so the
+    two-level merge engages; everything else keeps the 1-D shard axis."""
     if device_count() <= 1:
         return None
+    if is_multiprocess() or (virtual_hosts() or 1) > 1:
+        return make_hierarchical_mesh()
     return make_mesh()
 
 

@@ -1,0 +1,185 @@
+"""Declarative partition rules: regex -> PartitionSpec (ISSUE 13).
+
+This module is the ONLY place in ``weaviate_tpu/`` allowed to construct
+``jax.sharding.PartitionSpec`` (enforced by graftlint G8 "partition
+discipline"). The SPMD search entry points name their operands and let
+a regex rule table decide placement — the SNIPPETS [1]
+``match_partition_rules`` pattern: per-collection placement (corpus
+rows, codes, masks, norms, slot maps) is one table per entry point
+instead of hand-wired ``P(None, 'shard')`` literals scattered across
+call sites — and the device stores' placement helpers resolve through
+the ``row_sharding``/``replicated_sharding`` functions below.
+
+Rule values are mesh-independent TEMPLATES: tuples whose entries are
+``None`` (replicated dim) or the ``ROWS`` token, which resolves to the
+mesh's row axes — ``'shard'`` on the legacy 1-D mesh, the composite
+``('host', 'ici')`` pair on the hierarchical mesh. The same table
+therefore drives both mesh shapes; the two-level merge needs no
+spec changes at call sites. The device stores' placement helpers
+(``shard_array``/``grow_rows``/``sharded_zeros``/``replicate_array``/
+``tracked_shard_array``) resolve through ``row_sharding``/
+``replicated_sharding`` below — dim-parametrized, same ``ROWS``
+resolution, no per-operand table needed for a plain leading-dim
+row shard.
+
+Templates may be SHORTER than the array rank (PartitionSpec semantics:
+unnamed trailing dims are replicated), so ``(ROWS,)`` row-shards any
+leading-dim corpus array regardless of rank.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from weaviate_tpu.parallel.mesh import row_axes
+
+#: template token: resolves to the mesh's row-sharding axis/axes
+ROWS = "@rows"
+
+#: common templates
+REPLICATED: tuple = ()
+ROW_SHARDED = (ROWS,)          # leading dim = corpus rows / IVF lists
+QUERY_MASK = (None, ROWS)      # [B, N] per-query masks: column-sharded,
+#                                row-aligned with the corpus
+
+#: operand placement for the flat SPMD scan (sharded_search._sharded_topk_jit)
+SEARCH_RULES = (
+    (r"^(q|queries)$", REPLICATED),
+    (r"^(x|corpus|vectors)$", ROW_SHARDED),
+    (r"^(valid|x_sq_norms|sq_norms|norms)$", ROW_SHARDED),
+    (r"^allow(_rows|_mask)?$", QUERY_MASK),
+)
+
+#: operand placement for the compressed SPMD scan (BQ / PQ / PQ4): the
+#: codebook and packed query bits are replicated, codes + per-row state
+#: row-shard, the optional bf16 rescore rows stay with their owning
+#: device, per-query filter masks column-shard row-aligned
+QUANTIZED_RULES = (
+    (r"^(q|q_words)$", REPLICATED),
+    (r"^(cent|centroids|codebook|pq_centroids)$", REPLICATED),
+    (r"^(codes|rescore_rows)$", ROW_SHARDED),
+    (r"^(valid|slots)$", ROW_SHARDED),
+    (r"^allow(_rows|_mask)?$", QUERY_MASK),
+)
+
+#: operand placement for the IVF-PQ probe: EVERY list-dim array shards
+#: over the list axis; only the query and the PQ codebook replicate
+IVF_RULES = (
+    (r"^q$", REPLICATED),
+    (r"^pq_centroids$", REPLICATED),
+    (r"^(centroids|list_codes|list_valid|list_slots)$", ROW_SHARDED),
+)
+
+def _is_scalar(arr) -> bool:
+    shape = getattr(arr, "shape", None)
+    if shape is None:
+        return True
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def resolve_template(template, mesh: Mesh | None) -> PartitionSpec:
+    """Template tuple -> concrete PartitionSpec for ``mesh`` (``ROWS``
+    entries become the mesh's row axes)."""
+    axes = row_axes(mesh)
+    return PartitionSpec(
+        *(axes if entry == ROWS else entry for entry in template))
+
+
+def match_partition_rules(rules, named_arrays: dict, mesh: Mesh | None):
+    """``{name: array}`` -> ``{name: PartitionSpec}`` by first-matching
+    regex (SNIPPETS [1] pattern). Scalars (0-d or single-element) and
+    absent operands (``None``) pass through replicated — partitioning a
+    scalar is meaningless and optional operands simply have no bytes to
+    place. A non-scalar operand no rule names is an error: silent
+    replication of a corpus-sized array is exactly the bug this table
+    exists to prevent."""
+    out = {}
+    for name, arr in named_arrays.items():
+        if arr is None or _is_scalar(arr):
+            out[name] = resolve_template(REPLICATED, mesh)
+            continue
+        for pattern, template in rules:
+            if re.search(pattern, name) is not None:
+                out[name] = resolve_template(template, mesh)
+                break
+        else:
+            raise ValueError(
+                f"no partition rule matches operand {name!r} "
+                f"(shape {getattr(arr, 'shape', None)}) — add it to the "
+                "rule table in parallel/partition.py")
+    return out
+
+
+def replicated_spec() -> PartitionSpec:
+    return PartitionSpec()
+
+
+def row_spec(mesh: Mesh | None, dim: int = 0) -> PartitionSpec:
+    """Rows sharded on ``dim``, every other dim replicated — the
+    template behind ``shard_array(..., dim=...)``."""
+    return resolve_template((None,) * dim + (ROWS,), mesh)
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, replicated_spec())
+
+
+def row_sharding(mesh: Mesh, dim: int = 0) -> NamedSharding:
+    return NamedSharding(mesh, row_spec(mesh, dim))
+
+
+def plan_corpus_placement(n_rows: int, dim: int, mesh: Mesh | None, *,
+                          quantization: str = "bq",
+                          chunk_size: int = 1024,
+                          rescore_bytes_per_dim: int = 0) -> dict:
+    """1B-vector DRY RUN (ISSUE 13 acceptance): the placement plan for
+    an ``n_rows x dim`` corpus on ``mesh`` — shard-aligned capacity,
+    bytes per component from the rule-table placements, and the exact
+    per-host HBM load — WITHOUT allocating anything (the 1B BQ layout
+    is 96+ GB of codes; the plan is what admission and the HBM ledger
+    gate against before a single transfer).
+
+    ``quantization``: "bq" (packed sign bits, dim/32 u32 words/row),
+    "pq4"/"pq" (one byte per segment, dim/4 segments assumed), or
+    "none" (bf16 rows). ``rescore_bytes_per_dim`` adds owning-device
+    bf16 rescore rows (2) when the serving path rescores on device."""
+    from weaviate_tpu.parallel.mesh import (host_count, n_row_shards,
+                                            shardable_capacity)
+
+    n_shards = max(1, n_row_shards(mesh))
+    n_hosts = max(1, host_count(mesh))
+    cap = shardable_capacity(int(n_rows), n_shards,
+                             min(chunk_size, -(-int(n_rows) // n_shards)))
+    if quantization == "bq":
+        row_bytes = (dim // 32) * 4
+    elif quantization in ("pq", "pq4"):
+        row_bytes = dim // 4
+    else:
+        row_bytes = dim * 2  # bf16 rows
+    components = {
+        "codes" if quantization != "none" else "vectors": cap * row_bytes,
+        "valid": cap * 1,
+        "sq_norms": cap * 4 if quantization == "none" else 0,
+        "rescore_rows": cap * dim * rescore_bytes_per_dim,
+    }
+    components = {k: v for k, v in components.items() if v}
+    total = sum(components.values())
+    per_host = total // n_hosts
+    rows_per_host = cap // n_hosts
+    return {
+        "rows": int(n_rows),
+        "capacity": cap,
+        "shards": n_shards,
+        "hosts": n_hosts,
+        "rowsPerHost": rows_per_host,
+        "rowsPerDevice": cap // n_shards,
+        "components": components,
+        "totalBytes": total,
+        "perHostBytes": {f"host-{i}": per_host + (total - per_host
+                                                  * n_hosts if i == 0
+                                                  else 0)
+                         for i in range(n_hosts)},
+    }

@@ -4,15 +4,29 @@ The cross-shard query path of the reference — parallel per-shard search plus
 a host-side merge (adapters/repos/db/index.go:1576-1648) — becomes one
 compiled SPMD program:
 
-    per-device chunked scan  →  local top-k  →  all_gather(k per device)
+    per-device chunked scan  →  local top-k  →  candidate merge
     →  merge top-k (replicated)
 
-The all_gather moves only [n_shards, B, k] candidate (distance, id) pairs
-over ICI — never raw vectors — so the collective payload is tiny compared
-with the HBM traffic of the scan itself.
+On the legacy 1-D ``shard`` mesh the candidate merge is a single
+all_gather of [n_shards, B, k] (distance, id) pairs. On the hierarchical
+``('host', 'ici')`` mesh (ISSUE 13) it is TWO-LEVEL: an all_gather +
+exact reduce over ``ici`` INSIDE each host first, then only the per-host
+winner block — sliced over the ICI ranks so exactly one logical copy per
+host crosses the wire — all_gathers over ``host``. Cross-host candidate
+traffic drops from O(devices*k) to O(hosts*k) pairs per query, which is
+the difference between a 1B-vector corpus being DCN-bound or
+compute-bound (cross-host DCN bandwidth is orders of magnitude scarcer
+than ICI). Results are bit-identical to the 1-D merge: exact top-k is
+mergeable, and the host-major candidate order both merges share makes
+even distance TIES resolve identically (tests/test_hierarchical.py).
+
+Partition specs are not hand-wired here: every operand resolves through
+the regex rule tables in ``parallel/partition.py``
+(``match_partition_rules``, the SNIPPETS [1] pattern) — graftlint G8
+keeps PartitionSpec literals out of this module.
 
 Allow-mask row alignment contract: ``allow_rows`` is always [B, N_local]
-bool, column-sharded P(None, shard) ROW-ALIGNED with whatever corpus
+bool, column-sharded over the row axes ROW-ALIGNED with whatever corpus
 array the same call scans. Epoch stores (engine/epochs.py) honor this by
 column-slicing the global mask to each epoch's LOCAL row space
 (compaction-aware through the epoch's slot maps) before dispatching that
@@ -25,12 +39,13 @@ merge pattern turned inward).
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 try:  # jax >= 0.6: top-level export, replication check renamed check_vma
     from jax import shard_map as _shard_map_impl
@@ -42,7 +57,14 @@ except ImportError:  # jax 0.4.x: experimental home, check_rep
     _SHARD_MAP_CHECK_KW = "check_rep"
 
 from weaviate_tpu.ops.topk import chunked_topk_distances, topk_smallest
-from weaviate_tpu.parallel.mesh import SHARD_AXIS
+from weaviate_tpu.parallel import partition
+from weaviate_tpu.parallel.mesh import (
+    HOST_AXIS,
+    ICI_AXIS,
+    SHARD_AXIS,
+    is_hierarchical,
+    n_row_shards,
+)
 from weaviate_tpu.runtime import tracing
 
 
@@ -54,11 +76,30 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
         **{_SHARD_MAP_CHECK_KW: check_vma})
 
 
+def dcn_compact_default() -> bool:
+    """WEAVIATE_TPU_DCN_COMPACT=1 packs the cross-host candidate block
+    as (bf16 distance, uint32 slot) — 6 bytes/candidate instead of 8.
+    OFF by default: bf16 rounding can reorder near-tied candidates, so
+    the bit-identical-to-1-D parity contract only holds when distances
+    are bf16-exact (e.g. BQ hamming counts at dim <= 256)."""
+    return os.environ.get("WEAVIATE_TPU_DCN_COMPACT", "0").lower() in (
+        "1", "true", "on")
+
+
+def _shard_index(mesh: Mesh, axis: str):
+    """This device's linear row-shard index (host-major on the
+    hierarchical mesh, matching the row-contiguous device order)."""
+    if is_hierarchical(mesh):
+        return (jax.lax.axis_index(HOST_AXIS) * mesh.shape[ICI_AXIS]
+                + jax.lax.axis_index(ICI_AXIS))
+    return jax.lax.axis_index(axis)
+
+
 def _ici_merge_topk(d, ids, axis: str, k_out: int):
-    """The cross-shard candidate merge every SPMD entry point shares:
-    all_gather [n_shards, B, kk] (distance, id) pairs over ICI, flatten
-    per query, exact top-k (the device analog of the reference's
-    host-side merge, index.go:1644)."""
+    """The 1-D cross-shard candidate merge: all_gather [n_shards, B, kk]
+    (distance, id) pairs over the single mesh axis, flatten per query,
+    exact top-k (the device analog of the reference's host-side merge,
+    index.go:1644)."""
     all_d = jax.lax.all_gather(d, axis)
     all_i = jax.lax.all_gather(ids, axis)
     n_sh, b, kk = all_d.shape
@@ -67,10 +108,139 @@ def _ici_merge_topk(d, ids, axis: str, k_out: int):
     return topk_smallest(cat_d, cat_i, min(k_out, n_sh * kk))
 
 
+def _two_level_merge_topk(d, ids, mesh: Mesh, k_out: int,
+                          compact: bool = False):
+    """Hierarchical candidate merge: ICI reduce inside the host, then a
+    k-way merge of one compact per-host winner block across DCN.
+
+    Level 1 — ICI: all_gather every local device's kk candidates and
+    reduce to the host's top-k1 (k1 = min(k_out, n_ici*kk)). This
+    collective never leaves the host.
+
+    Level 2 — DCN: the per-host winner block is replicated across the
+    host's ICI ranks after level 1, so a naive all_gather over ``host``
+    would ship n_ici REDUNDANT copies and erase the win. Instead each
+    ICI rank slices its 1/n_ici of the block, the slices all_gather
+    over ``host`` (exactly ONE logical copy per host crosses DCN —
+    O(hosts*k) candidate pairs), and a cheap second ICI all_gather
+    reassembles the full [n_hosts, k1] block on every device for the
+    final exact top-k.
+
+    Bit-identity with the 1-D merge: exact top-k is mergeable (a
+    candidate dropped by its host's level-1 reduce is outranked by k1
+    same-host candidates that precede it in the flat concat order, so
+    the flat merge drops it too), and the final concat is host-major
+    with level-1-sorted candidates inside each host — the same derived
+    tie order the flat merge's shard-major concat produces. Padding
+    (the slice split needs k1 % n_ici == 0) uses +inf distances, which
+    sort strictly after every real AND every masked candidate, so pads
+    can never displace one.
+
+    ``compact`` casts the DCN block to (bf16 distance, uint32 slot) —
+    see ``dcn_compact_default`` for the exactness tradeoff. Ids cross
+    the wire bitcast to uint32 either way (free, and -1 survives the
+    round trip exactly).
+    """
+    n_hosts = int(mesh.shape[HOST_AXIS])
+    n_ici = int(mesh.shape[ICI_AXIS])
+    # level 1: ICI all_gather + on-device exact reduce (the
+    # merge_epoch_topk survivor-merge pattern from ops/topk.py: concat
+    # in source order, one exact top-k over the union)
+    all_d = jax.lax.all_gather(d, ICI_AXIS)
+    all_i = jax.lax.all_gather(ids, ICI_AXIS)
+    _, b, kk = all_d.shape
+    cat_d = jnp.transpose(all_d, (1, 0, 2)).reshape(b, n_ici * kk)
+    cat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(b, n_ici * kk)
+    k1 = min(k_out, n_ici * kk)
+    host_d, host_i = topk_smallest(cat_d, cat_i, k1)
+    k_final = min(k_out, n_hosts * n_ici * kk)
+    if n_hosts == 1:
+        return host_d, host_i  # degenerate: k1 == k_final
+    # level 2: slice over ICI ranks so ONE logical copy per host
+    # crosses DCN
+    per_rank = -(-k1 // n_ici)
+    pad = per_rank * n_ici - k1
+    if pad:
+        host_d = jnp.pad(host_d, ((0, 0), (0, pad)),
+                         constant_values=jnp.inf)
+        host_i = jnp.pad(host_i, ((0, 0), (0, pad)), constant_values=-1)
+    if compact:
+        host_d = host_d.astype(jnp.bfloat16)
+    host_iu = jax.lax.bitcast_convert_type(host_i, jnp.uint32)
+    rank = jax.lax.axis_index(ICI_AXIS)
+    sl_d = jax.lax.dynamic_slice_in_dim(host_d, rank * per_rank,
+                                        per_rank, axis=1)
+    sl_i = jax.lax.dynamic_slice_in_dim(host_iu, rank * per_rank,
+                                        per_rank, axis=1)
+    g_d = jax.lax.all_gather(sl_d, HOST_AXIS)   # the DCN hop
+    g_i = jax.lax.all_gather(sl_i, HOST_AXIS)
+    a_d = jax.lax.all_gather(g_d, ICI_AXIS)     # cheap on-host regather
+    a_i = jax.lax.all_gather(g_i, ICI_AXIS)
+    # (ici_rank, host, B, per_rank) -> [B, host-major contiguous blocks]
+    cat2_d = jnp.transpose(a_d, (2, 1, 0, 3)).reshape(
+        b, n_hosts * n_ici * per_rank)
+    cat2_i = jnp.transpose(a_i, (2, 1, 0, 3)).reshape(
+        b, n_hosts * n_ici * per_rank)
+    cat2_i = jax.lax.bitcast_convert_type(cat2_i, jnp.int32)
+    if compact:
+        cat2_d = cat2_d.astype(jnp.float32)
+    return topk_smallest(cat2_d, cat2_i, k_final)
+
+
+def _merge_topk_mesh(d, ids, mesh: Mesh, axis: str, k_out: int,
+                     compact: bool = False):
+    """Mesh-shape dispatch: 1-D flat merge vs hierarchical two-level."""
+    if is_hierarchical(mesh):
+        return _two_level_merge_topk(d, ids, mesh, k_out, compact=compact)
+    return _ici_merge_topk(d, ids, axis, k_out)
+
+
+def topology_dcn_candidate_bytes(n_hosts: int, n_local: int, k: int,
+                                 kk: int | None = None, *,
+                                 level: str = "two_level",
+                                 compact: bool = False) -> int:
+    """Pure topology math: per-query candidate bytes ONE host sends
+    across DCN during the merge, for an ``n_hosts x n_local`` pod.
+    Rig-independent — the benchkeeper ``dcn_bytes_ratio`` gate computes
+    this for the reference 2x4 topology no matter what hardware the
+    bench runs on. ``kk`` is the per-device candidate count (defaults
+    to k); ``compact`` counts the bf16+uint32 wire format (6 B/pair vs
+    8)."""
+    kk = k if kk is None else kk
+    if n_hosts <= 1:
+        return 0
+    if level == "flat":
+        # all_gather over the whole axis: each of the host's n_local
+        # devices ships kk pairs (f32+int32) to the other hosts
+        return n_local * kk * 8 * (n_hosts - 1)
+    pair = 6 if compact else 8
+    k1 = min(k, n_local * kk)
+    per_rank = -(-k1 // n_local)  # ICI-rank slice width (inf-padded)
+    return per_rank * n_local * pair * (n_hosts - 1)
+
+
+def merge_dcn_candidate_bytes(mesh: Mesh, k: int, kk: int | None = None,
+                              *, level: str = "auto",
+                              compact: bool = False) -> int:
+    """``topology_dcn_candidate_bytes`` for a concrete mesh (0 when the
+    mesh is single-host)."""
+    from weaviate_tpu.parallel.mesh import host_count
+
+    n_hosts = host_count(mesh)
+    if n_hosts <= 1:
+        return 0
+    if level == "auto":
+        level = "two_level" if is_hierarchical(mesh) else "flat"
+    return topology_dcn_candidate_bytes(
+        n_hosts, n_row_shards(mesh) // n_hosts, k, kk, level=level,
+        compact=compact)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "k", "chunk_size", "metric", "mesh", "axis", "use_pallas", "selection",
+        "k", "chunk_size", "metric", "mesh", "axis", "use_pallas",
+        "selection", "dcn_compact",
     ),
 )
 def _sharded_topk_jit(
@@ -86,24 +256,25 @@ def _sharded_topk_jit(
     use_pallas: bool = False,
     selection: str = "exact",
     allow_rows: jnp.ndarray | None = None,
+    dcn_compact: bool = False,
 ):
     """Top-k of q [B,d] against row-sharded corpus x [N,d].
 
-    ``x``/``valid``/``x_sq_norms`` must be sharded over ``axis`` on their
-    leading dim; ``q`` is replicated. ``allow_rows`` ([B, N] bool —
-    per-query filter masks) is sharded over ``axis`` on its COLUMN dim,
+    ``x``/``valid``/``x_sq_norms`` must be row-sharded over the mesh's
+    row axes on their leading dim; ``q`` is replicated. ``allow_rows``
+    ([B, N] bool — per-query filter masks) is sharded on its COLUMN dim,
     row-aligned with the corpus: each device applies (and, for the fused
-    kernel, packs) only its own slice; the ICI merge is unchanged because
-    masked rows simply never become candidates. Returns replicated
-    (dists [B,k], global_ids [B,k]) where ids index the unsharded [N]
-    row space.
+    kernel, packs) only its own slice; the candidate merge is unchanged
+    because masked rows simply never become candidates. Returns
+    replicated (dists [B,k], global_ids [B,k]) where ids index the
+    unsharded [N] row space.
     """
     n = x.shape[0]
-    n_shards = mesh.shape[axis]
+    n_shards = n_row_shards(mesh)
     local_rows = n // n_shards
 
     def local_search(q_, x_, valid_, norms_, allow_):
-        shard_idx = jax.lax.axis_index(axis)
+        shard_idx = _shard_index(mesh, axis)
         d, i = chunked_topk_distances(
             q_,
             x_,
@@ -117,16 +288,16 @@ def _sharded_topk_jit(
             selection=selection,
             allow_rows=allow_,
         )
-        return _ici_merge_topk(d, i, axis, k)
+        return _merge_topk_mesh(d, i, mesh, axis, k, compact=dcn_compact)
 
-    in_specs = (
-        P(),            # q replicated
-        P(axis, None),  # x row-sharded
-        P(axis),        # valid row-sharded
-        P() if x_sq_norms is None else P(axis),
-        P() if allow_rows is None else P(None, axis),  # mask column-sharded
-    )
-    out_specs = (P(), P())
+    specs = partition.match_partition_rules(
+        partition.SEARCH_RULES,
+        {"q": q, "x": x, "valid": valid, "x_sq_norms": x_sq_norms,
+         "allow_rows": allow_rows},
+        mesh)
+    in_specs = (specs["q"], specs["x"], specs["valid"],
+                specs["x_sq_norms"], specs["allow_rows"])
+    out_specs = (partition.replicated_spec(), partition.replicated_spec())
     fn = shard_map(
         local_search,
         mesh=mesh,
@@ -139,24 +310,28 @@ def _sharded_topk_jit(
 
 def sharded_topk(q, x, valid, x_sq_norms, *, k, chunk_size, metric, mesh,
                  axis=SHARD_AXIS, use_pallas=False, selection="exact",
-                 allow_rows=None):
-    """Span-wrapped dispatch of the SPMD scan + ICI top-k merge program
+                 allow_rows=None, dcn_compact=None):
+    """Span-wrapped dispatch of the SPMD scan + top-k merge program
     (spans can't live inside jit; the wrapper times the host-side
     dispatch and device_sync at the store level attributes execution)."""
-    with tracing.span("spmd.sharded_topk", shards=mesh.shape[axis], k=k,
-                      rows=int(x.shape[0]),
+    if dcn_compact is None:
+        dcn_compact = dcn_compact_default()
+    with tracing.span("spmd.sharded_topk", shards=n_row_shards(mesh),
+                      k=k, rows=int(x.shape[0]),
+                      hierarchical=is_hierarchical(mesh),
                       filtered=allow_rows is not None):
         return _sharded_topk_jit(
             q, x, valid, x_sq_norms, k=k, chunk_size=chunk_size,
             metric=metric, mesh=mesh, axis=axis, use_pallas=use_pallas,
-            selection=selection, allow_rows=allow_rows)
+            selection=selection, allow_rows=allow_rows,
+            dcn_compact=dcn_compact)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "k", "k_out", "chunk_size", "quantization", "metric", "mesh", "axis",
-        "use_pallas", "selection",
+        "use_pallas", "selection", "dcn_compact",
     ),
 )
 def _sharded_quantized_topk_jit(
@@ -176,22 +351,25 @@ def _sharded_quantized_topk_jit(
     use_pallas: bool = False,
     selection: str = "approx",
     allow_rows: jnp.ndarray | None = None,
+    dcn_compact: bool = False,
 ):
     """Compressed scan over a row-sharded code array, one SPMD program.
 
     The reference composes compression with sharding for free because PQ/BQ
     is per-shard state inside each physical shard (hnsw/compress.go:38 under
     usecases/sharding/state.go:28). The TPU analog: codes [N, m|w] live
-    row-sharded over ``axis``; each device scans its rows (MXU hamming /
-    LUT-ADC), approx-selects ``k`` local candidates, optionally rescores
-    them EXACTLY against its own row-sharded ``rescore_rows`` (bf16 —
-    owning-device rescore, no cross-device vector traffic), and the final
-    merge all_gathers only [n_shards, B, k] (distance, id) pairs over ICI.
+    row-sharded over the mesh's row axes; each device scans its rows (MXU
+    hamming / LUT-ADC), approx-selects ``k`` local candidates, optionally
+    rescores them EXACTLY against its own row-sharded ``rescore_rows``
+    (bf16 — owning-device rescore, no cross-device vector traffic), and
+    the final merge moves only candidate (distance, id) pairs — one
+    all_gather on the 1-D mesh, the two-level ICI+DCN reduce on the
+    hierarchical one.
 
     ``q`` is replicated f32 (pre-normalized for cosine); ``q_words`` packed
     query bits for bq. ``selection`` picks the per-shard survivor selector
     for the bq/pq4 scan-reduce paths ("approx" = approx_max_k, "fused" =
-    exact in-kernel running-carry top-k); the ICI merge contract is
+    exact in-kernel running-carry top-k); the merge contract is
     unchanged either way. ``allow_rows`` [B, N] bool per-query filter
     masks are COLUMN-sharded row-aligned with the codes; each device
     packs its slice to the kernel bitmask locally. Returns replicated
@@ -202,12 +380,12 @@ def _sharded_quantized_topk_jit(
     from weaviate_tpu.ops.distances import MASKED_DISTANCE
 
     n = codes.shape[0]
-    n_shards = mesh.shape[axis]
+    n_shards = n_row_shards(mesh)
     local_rows = n // n_shards
     b = q.shape[0]
 
     def local_scan(q_, qw_, cent_, codes_, valid_, resc_, allow_=None):
-        shard_idx = jax.lax.axis_index(axis)
+        shard_idx = _shard_index(mesh, axis)
         ab_ = None
         if allow_ is not None:
             from weaviate_tpu.ops.pallas_kernels import (
@@ -248,7 +426,8 @@ def _sharded_quantized_topk_jit(
             dd = jnp.where(i_c >= 0, dd, MASKED_DISTANCE)
             d_c, i_c = topk_smallest(dd, i_c, min(k_out, i_c.shape[1]))
         gid = jnp.where(i_c >= 0, i_c + shard_idx * local_rows, -1)
-        return _ici_merge_topk(d_c, gid, axis, k_out)
+        return _merge_topk_mesh(d_c, gid, mesh, axis, k_out,
+                                compact=dcn_compact)
 
     # assemble args/specs in Python (quantization and rescore/allow
     # presence are static): shard_map can't close over traced arrays and
@@ -258,22 +437,33 @@ def _sharded_quantized_topk_jit(
             else jnp.zeros((1, 1, 1), jnp.float32))
     has_resc = rescore_rows is not None
     has_allow = allow_rows is not None
+    rule_specs = partition.match_partition_rules(
+        partition.QUANTIZED_RULES,
+        {"q": q, "q_words": qw, "centroids": cent, "codes": codes,
+         "valid": valid, "rescore_rows": rescore_rows,
+         "allow_rows": allow_rows},
+        mesh)
     args = [q, qw, cent, codes, valid]
-    specs = [P(), P(), P(), P(axis, None), P(axis)]
+    specs = [rule_specs["q"], rule_specs["q_words"],
+             rule_specs["centroids"], rule_specs["codes"],
+             rule_specs["valid"]]
     if has_resc:
         args.append(rescore_rows)
-        specs.append(P(axis, None))
+        specs.append(rule_specs["rescore_rows"])
     if has_allow:
         args.append(allow_rows)
-        specs.append(P(None, axis))  # mask column-sharded, row-aligned
+        specs.append(rule_specs["allow_rows"])
 
     def fn(q_, qw_, cent_, codes_, valid_, *rest):
         resc_ = rest[0] if has_resc else None
         allow_ = rest[-1] if has_allow else None
         return local_scan(q_, qw_, cent_, codes_, valid_, resc_, allow_)
 
-    sharded = shard_map(fn, mesh=mesh, in_specs=tuple(specs),
-                        out_specs=(P(), P()), check_vma=False)
+    sharded = shard_map(
+        fn, mesh=mesh, in_specs=tuple(specs),
+        out_specs=(partition.replicated_spec(),
+                   partition.replicated_spec()),
+        check_vma=False)
     return sharded(*args)
 
 
@@ -281,28 +471,33 @@ def sharded_quantized_topk(q, q_words, codes, valid, rescore_rows,
                            centroids, *, k, k_out, chunk_size,
                            quantization, metric, mesh, axis=SHARD_AXIS,
                            use_pallas=False, selection="approx",
-                           allow_rows=None):
-    """Span-wrapped dispatch of the compressed SPMD scan + ICI merge."""
-    with tracing.span("spmd.quantized_topk", shards=mesh.shape[axis],
+                           allow_rows=None, dcn_compact=None):
+    """Span-wrapped dispatch of the compressed SPMD scan + merge."""
+    if dcn_compact is None:
+        dcn_compact = dcn_compact_default()
+    with tracing.span("spmd.quantized_topk", shards=n_row_shards(mesh),
                       k=k_out, rows=int(codes.shape[0]),
                       quantization=quantization,
+                      hierarchical=is_hierarchical(mesh),
                       filtered=allow_rows is not None):
         return _sharded_quantized_topk_jit(
             q, q_words, codes, valid, rescore_rows, centroids, k=k,
             k_out=k_out, chunk_size=chunk_size, quantization=quantization,
             metric=metric, mesh=mesh, axis=axis, use_pallas=use_pallas,
-            selection=selection, allow_rows=allow_rows)
+            selection=selection, allow_rows=allow_rows,
+            dcn_compact=dcn_compact)
 
 
-def shard_array(arr, mesh: Mesh, axis: str = SHARD_AXIS, dim: int = 0):
-    """Place ``arr`` on ``mesh`` sharded along ``dim``.
+def shard_array(arr, mesh: Mesh, dim: int = 0):
+    """Place ``arr`` on ``mesh`` row-sharded along ``dim`` (the mesh's
+    row axes resolve through partition.row_sharding — 'shard' on the
+    1-D mesh, ('host','ici') on the hierarchical one; a custom 1-D
+    axis name is honored via row_axes).
 
     On a multi-process (DCN) mesh, device_put can only target addressable
     devices — each process materializes its own shards from the (process-
     locally identical) host array via make_array_from_callback."""
-    spec = [None] * arr.ndim
-    spec[dim] = axis
-    sharding = NamedSharding(mesh, P(*spec))
+    sharding = partition.row_sharding(mesh, dim=dim)
     if jax.process_count() > 1:
         arr_np = np.asarray(arr)
         return jax.make_array_from_callback(
@@ -312,18 +507,17 @@ def shard_array(arr, mesh: Mesh, axis: str = SHARD_AXIS, dim: int = 0):
 
 def replicate_array_multihost(arr, mesh: Mesh):
     arr_np = np.asarray(arr)
-    sharding = NamedSharding(mesh, P())
+    sharding = partition.replicated_sharding(mesh)
     return jax.make_array_from_callback(
         arr_np.shape, sharding, lambda idx: arr_np[idx])
 
 
-def grow_rows(arr, pad_rows: int, mesh: Mesh | None, axis: str = SHARD_AXIS):
+def grow_rows(arr, pad_rows: int, mesh: Mesh | None):
     """Append ``pad_rows`` zero rows to ``arr`` (leading dim), donated and —
     on a mesh — shard-local: both capacities are shard-aligned so each
     device just extends its own shard. An eager concatenate + re-place
     would funnel the full array through one device (minutes + 2x memory at
     100M-row capacities)."""
-    shape = (arr.shape[0] + pad_rows,) + arr.shape[1:]
 
     def pad(a):
         return jnp.concatenate(
@@ -331,21 +525,16 @@ def grow_rows(arr, pad_rows: int, mesh: Mesh | None, axis: str = SHARD_AXIS):
 
     if mesh is None:
         return jax.jit(pad, donate_argnums=0)(arr)
-    spec = [None] * len(shape)
-    spec[0] = axis
-    out_sh = NamedSharding(mesh, P(*spec))
+    out_sh = partition.row_sharding(mesh, dim=0)
     return jax.jit(pad, donate_argnums=0, out_shardings=out_sh)(arr)
 
 
-def sharded_zeros(shape, dtype, mesh: Mesh, axis: str = SHARD_AXIS,
-                  dim: int = 0):
+def sharded_zeros(shape, dtype, mesh: Mesh, dim: int = 0):
     """Allocate a zero array directly in its sharded layout — each device
     materializes only its own shard (a host jnp.zeros + device_put round
     trip copies the full array through one device and takes minutes at
     100M-row capacities)."""
-    spec = [None] * len(shape)
-    spec[dim] = axis
-    out_sh = NamedSharding(mesh, P(*spec))
+    out_sh = partition.row_sharding(mesh, dim=dim)
     return jax.jit(
         functools.partial(jnp.zeros, shape, dtype), out_shardings=out_sh
     )()
@@ -354,7 +543,7 @@ def sharded_zeros(shape, dtype, mesh: Mesh, axis: str = SHARD_AXIS,
 def replicate_array(arr, mesh: Mesh):
     if jax.process_count() > 1:
         return replicate_array_multihost(arr, mesh)
-    return jax.device_put(arr, NamedSharding(mesh, P()))
+    return jax.device_put(arr, partition.replicated_sharding(mesh))
 
 
 def tracked_shard_array(arr, mesh: Mesh, dim: int = 0,
@@ -373,7 +562,8 @@ def tracked_shard_array(arr, mesh: Mesh, dim: int = 0,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "nprobe", "metric", "mesh", "axis"),
+    static_argnames=("k", "nprobe", "metric", "mesh", "axis",
+                     "dcn_compact"),
 )
 def sharded_ivf_pq_topk(
     q: jnp.ndarray,
@@ -387,20 +577,22 @@ def sharded_ivf_pq_topk(
     metric: str,
     mesh: Mesh,
     axis: str = SHARD_AXIS,
+    dcn_compact: bool = False,
 ):
     """SPMD IVF-PQ probe over LIST-sharded posting lists.
 
     The 100M-per-chip capacity layout (SURVEY §7): ``centroids``
     [nlist, d], ``list_codes`` [nlist, cap, m], ``list_valid``
-    [nlist, cap], ``list_slots`` [nlist, cap] are all sharded over
-    ``axis`` on the LIST dim; ``q`` and the PQ codebook are replicated.
-    Each device ranks ITS local centroids, probes its local top-nprobe
-    lists (so the union covers >= the global top-nprobe; recall can only
-    exceed the single-device equivalent), scores codes via the chunked
-    one-hot int8 matmul (engine/ivf._ivf_probe_topk_pq), and contributes
-    k local candidates to an all_gather merge over ICI — slots, not
-    vectors, cross the interconnect (the SPMD analog of the reference's
-    scatter-gather, index.go:1541).
+    [nlist, cap], ``list_slots`` [nlist, cap] are all sharded over the
+    mesh's row axes on the LIST dim; ``q`` and the PQ codebook are
+    replicated. Each device ranks ITS local centroids, probes its local
+    top-nprobe lists (so the union covers >= the global top-nprobe;
+    recall can only exceed the single-device equivalent), scores codes
+    via the chunked one-hot int8 matmul (engine/ivf._ivf_probe_topk_pq),
+    and contributes k local candidates to the candidate merge — slots,
+    not vectors, cross the interconnect (the SPMD analog of the
+    reference's scatter-gather, index.go:1541), and on the hierarchical
+    mesh only per-host winners cross DCN.
 
     NOTE: returned distances are int8-quantized ADC approximations (the
     per-query LUT quantization in engine/ivf adds ~0.4% distance error)
@@ -412,7 +604,6 @@ def sharded_ivf_pq_topk(
     """
     from weaviate_tpu.engine.ivf import _ivf_probe_topk_pq
 
-    n_shards = mesh.shape[axis]
     dummy_allow = jnp.ones((1,), dtype=bool)
 
     def local_probe(q_, cent_, codes_, valid_, slots_, pqc_):
@@ -422,14 +613,22 @@ def sharded_ivf_pq_topk(
             q_, cent_, cn, codes_, valid_, slots_, pqc_,
             dummy_allow, min(k, local_nlist * codes_.shape[1]),
             min(nprobe, local_nlist), metric, False)
-        return _ici_merge_topk(d, s, axis, k)
+        return _merge_topk_mesh(d, s, mesh, axis, k, compact=dcn_compact)
 
+    specs = partition.match_partition_rules(
+        partition.IVF_RULES,
+        {"q": q, "centroids": centroids, "list_codes": list_codes,
+         "list_valid": list_valid, "list_slots": list_slots,
+         "pq_centroids": pq_centroids},
+        mesh)
     fn = shard_map(
         local_probe,
         mesh=mesh,
-        in_specs=(P(), P(axis, None), P(axis, None, None),
-                  P(axis, None), P(axis, None), P()),
-        out_specs=(P(), P()),
+        in_specs=(specs["q"], specs["centroids"], specs["list_codes"],
+                  specs["list_valid"], specs["list_slots"],
+                  specs["pq_centroids"]),
+        out_specs=(partition.replicated_spec(),
+                   partition.replicated_spec()),
         check_vma=False,
     )
     return fn(q, centroids, list_codes, list_valid, list_slots,

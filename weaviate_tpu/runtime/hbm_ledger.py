@@ -95,6 +95,9 @@ class HBMLedger:
         self._by_collection: dict[str, int] = {}
         self._by_shard: dict[tuple[str, str], int] = {}
         self._by_gauge: dict[tuple[str, str, str], int] = {}
+        # mesh host count hint (set once at startup when the mesh is
+        # known) so scrape-time host-gauge refreshes need no mesh access
+        self._host_count_hint = 1
 
     # -- registration ---------------------------------------------------------
 
@@ -258,6 +261,59 @@ class HBMLedger:
         with self._lock:
             return {comp: b for (c, s, comp), b in self._by_gauge.items()
                     if c == collection and s == shard}
+
+    def set_host_count(self, n_hosts: int) -> None:
+        """Record the mesh's host count (server startup / Database
+        init) so ``refresh_host_gauge`` can run from scrape handlers
+        without reaching back to the mesh."""
+        with self._lock:
+            self._host_count_hint = max(1, int(n_hosts))
+
+    def refresh_host_gauge(self) -> dict:
+        """Scrape-time refresh of ``weaviate_tpu_hbm_host_bytes`` (the
+        perfgate.refresh pattern): the split depends on LIVE totals, so
+        recomputing at exposition keeps the gauge summing exactly to
+        the live device total instead of whatever the last REST read
+        left behind."""
+        return self.host_rollup(self._host_count_hint)
+
+    def host_rollup(self, n_hosts: int) -> dict:
+        """Per-HOST device bytes for the hierarchical mesh (ISSUE 13):
+        ``{"host-0": bytes, ...}`` that SUMS EXACTLY to
+        ``total_bytes()`` — the attribution /v1/nodes and the
+        ``weaviate_tpu_hbm_host_bytes`` gauge report, and what the
+        placement hook ranks hosts by.
+
+        Attribution follows each entry's LOGICAL-bytes contract:
+        row-"sharded" and "replicated" entries split evenly across
+        hosts (row-sharding is equal by construction —
+        ``shardable_capacity`` — and a replicated array's logical bytes
+        are counted once, so an even split keeps the sum invariant;
+        the per-device replication overhead already shows up only in
+        the allocator-vs-ledger delta); "single"-device entries and
+        compile estimates land on host-0, where device 0 lives.
+        Integer remainders go to host-0 so the sum is exact."""
+        n_hosts = max(1, int(n_hosts))
+        out = {f"host-{i}": 0 for i in range(n_hosts)}
+        with self._lock:
+            entries = [(e.sharding, e.nbytes) for e in
+                       self._entries.values() if e.placement == "device"]
+        for sharding, nbytes in entries:
+            if n_hosts > 1 and sharding in ("sharded", "replicated"):
+                share = nbytes // n_hosts
+                for i in range(n_hosts):
+                    out[f"host-{i}"] += share
+                out["host-0"] += nbytes - share * n_hosts
+            else:
+                out["host-0"] += nbytes
+        try:
+            from weaviate_tpu.runtime.metrics import hbm_host_bytes
+
+            for host, b in out.items():
+                hbm_host_bytes.labels(host).set(float(b))
+        except Exception:  # noqa: BLE001 — accounting must never fail reads
+            pass
+        return out
 
     def breakdown(self) -> dict:
         """Per-collection rollup: bytes by collection, with nested shard

@@ -419,6 +419,12 @@ hbm_peak_bytes = registry.gauge(
     "weaviate_tpu_hbm_peak_bytes",
     "High-water mark of ledger-registered device bytes since process "
     "start")
+hbm_host_bytes = registry.gauge(
+    "weaviate_tpu_hbm_host_bytes",
+    "Ledger device bytes attributed per mesh host (hierarchical "
+    "ICI+DCN sharding); host values sum exactly to the ledger's live "
+    "device total",
+    ("host",))
 hbm_budget_bytes = registry.gauge(
     "weaviate_tpu_hbm_budget_bytes",
     "Per-device HBM budget admission control gates against (0 = no "
@@ -538,6 +544,14 @@ def serve_metrics(host: str = "127.0.0.1", port: int = 2112):
                 from weaviate_tpu.runtime import perfgate
 
                 perfgate.refresh()
+            except Exception:
+                pass
+            # per-host HBM attribution depends on live totals — refresh
+            # at scrape so the gauge sums to the live ledger total
+            try:
+                from weaviate_tpu.runtime.hbm_ledger import ledger
+
+                ledger.refresh_host_gauge()
             except Exception:
                 pass
             body = registry.expose().encode()

@@ -77,6 +77,23 @@ class Server:
             high_watermark=cfg.hbm_high_watermark,
             low_watermark=cfg.hbm_low_watermark)
 
+        # device mesh for the serving stack: on a multi-host runtime
+        # (or a WEAVIATE_TPU_VIRTUAL_HOSTS pod) collections row-shard
+        # over the hierarchical ('host','ici') mesh so the two-level
+        # ICI+DCN merge serves queries; single-process single-host
+        # keeps the existing single-device placement (mesh=None)
+        from weaviate_tpu.parallel.mesh import (default_mesh,
+                                                is_multiprocess,
+                                                virtual_hosts)
+
+        mesh = (default_mesh()
+                if is_multiprocess() or (virtual_hosts() or 1) > 1
+                else None)
+        if mesh is not None:
+            logger.info("serving over %s mesh: %s",
+                        "hierarchical" if "host" in mesh.axis_names
+                        else "1-D", dict(mesh.shape))
+
         cluster_mode = len(cfg.raft_join) > 1 or bool(cfg.cluster_join)
         if cluster_mode:
             from weaviate_tpu.cluster.node import ClusterNode
@@ -87,7 +104,7 @@ class Server:
                                     port=cfg.cluster_data_port,
                                     advertise=cfg.cluster_advertise or None,
                                     remote_timeout=cfg.remote_rpc_timeout_s,
-                                    sync_wal=cfg.wal_sync)
+                                    sync_wal=cfg.wal_sync, mesh=mesh)
             self.node.start(seed_addrs=cfg.cluster_join or None)
             self.db = self.node.db
         else:
@@ -98,7 +115,7 @@ class Server:
                                start_cycles=True,
                                memory_monitor=memwatch,
                                async_indexing=cfg.async_indexing or None,
-                               sync_wal=cfg.wal_sync)
+                               sync_wal=cfg.wal_sync, mesh=mesh)
 
         modules = default_provider(self.db, enabled=cfg.enabled_modules)
 
