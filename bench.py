@@ -974,6 +974,75 @@ def sec_mixed_rw(ctx):
     return out
 
 
+def sec_antientropy_convergence(ctx):
+    """Anti-entropy heal rate (ISSUE 14): how many hashbeat rounds (and
+    how many reconciled entries) it takes to converge N divergent
+    entries across 3 replicas after a partition heals. The divergence
+    is manufactured with the faultline topology layer: one node is
+    isolated and written at consistency ONE, so the entries exist on
+    exactly one replica; the heal then has to push every one of them to
+    both peers. The benchkeeper guard is ``rounds_to_converge`` — a
+    pure protocol metric, independent of the rig: ONE Merkle walk +
+    push/pull per peer must repair a fresh divergence, and a second
+    round appearing means the diff/propagate path stopped repairing
+    everything it saw."""
+    import shutil
+    import tempfile
+
+    from weaviate_tpu.cluster import transport
+    from weaviate_tpu.runtime import faultline
+
+    from tools.clusterchaos import checker
+    from tools.clusterchaos.workload import ChaosCluster
+
+    n_entries = int(os.environ.get("BENCH_ANTIENTROPY_ENTRIES", "96"))
+    base = tempfile.mkdtemp(prefix="bench-antientropy-")
+    cluster = None
+    try:
+        cluster = ChaosCluster(base)
+        cluster.wait_members()
+        cluster.create_collection()
+        shard = cluster.shard_name()
+        faultline.isolate("n0", name="bench-diverge")
+        col = cluster.col("n0")
+        t0 = time.perf_counter()
+        with faultline.node_scope("n0"):
+            for i in range(n_entries):
+                col.put_object({"client": 0, "seq": i, "rev": i},
+                               vector=[float(i % 7), 1.0],
+                               uuid=f"be000000-0000-0000-0000-{i:012d}",
+                               consistency="ONE")
+        write_ms = (time.perf_counter() - t0) * 1000
+        faultline.heal("bench-diverge")
+        checker.wait_replicas_serving(cluster, shard)
+        t0 = time.perf_counter()
+        conv = checker.drive_convergence(cluster, shard, max_rounds=8)
+        heal_ms = (time.perf_counter() - t0) * 1000
+        if not conv["converged"]:
+            raise RuntimeError(f"replicas never converged: {conv}")
+        out = {
+            "divergent_entries": n_entries,
+            "replicas": 3,
+            "rounds_to_converge": conv["rounds"],
+            "entries_reconciled": conv["reconciled"],
+            "divergent_write_wall_ms": round(write_ms, 1),
+            "heal_wall_ms": round(heal_ms, 1),
+            "reconcile_per_s": round(
+                conv["reconciled"] / max(heal_ms / 1000, 1e-9), 1),
+        }
+        log(f"[antientropy] {n_entries} divergent entries x 3 replicas "
+            f"converged in {out['rounds_to_converge']} round(s), "
+            f"{out['entries_reconciled']} reconciled "
+            f"({out['reconcile_per_s']:.0f}/s)")
+        return out
+    finally:
+        faultline.heal()
+        transport.reset_breakers()
+        if cluster is not None:
+            cluster.close()
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def sec_quantized(ctx):
     import numpy as np
 
@@ -1496,6 +1565,7 @@ SECTIONS = [
     ("quantized", sec_quantized, ("x", "rtt_s")),
     ("tracing_overhead", sec_tracing_overhead, ()),
     ("durability_tax", sec_durability_tax, ()),
+    ("antientropy_convergence", sec_antientropy_convergence, ()),
     ("mixed_rw", sec_mixed_rw, ("rng",)),
     ("kernel_conformance", sec_conformance, ("rng",)),
     ("hierarchical_merge", sec_hierarchical_merge, ()),

@@ -45,10 +45,13 @@ def _faultline_isolation():
     test's fresh in-process node happens to reuse."""
     yield
     from weaviate_tpu.cluster.transport import reset_breakers
+    from weaviate_tpu.replication.hashbeater import replication_status
     from weaviate_tpu.runtime import degrade, faultline
     from weaviate_tpu.storage import recovery
 
     faultline.disarm()
+    faultline.heal()  # partition topology rules, like the disarm above
     degrade.reset()
     reset_breakers()
     recovery.reset()
+    replication_status.reset()

@@ -563,6 +563,106 @@ def test_transfer_fault_under_concurrent_load_no_hangs():
         qb.stop()
 
 
+def _current_leader(nodes, timeout=15.0):
+    end = time.time() + timeout
+    while time.time() < end:
+        for n in nodes:
+            if n.raft.is_leader:
+                return n
+        time.sleep(0.02)
+    raise AssertionError("no leader")
+
+
+def _propose_retry(node, cname, timeout=20.0):
+    t0 = time.time()
+    while True:
+        try:
+            node.create_collection(CollectionConfig(
+                name=cname,
+                properties=[Property(name="p", data_type="text")]))
+            return
+        except Exception:  # noqa: BLE001 — leadership churn mid-flap
+            if time.time() - t0 > timeout:
+                raise
+            time.sleep(0.1)
+
+
+def test_asymmetric_raft_leader_cannot_receive(chaos_cluster):
+    """ISSUE 14 satellite: a leader that can SEND but not RECEIVE (its
+    inbound links cut — heartbeats arrive at followers, every ack
+    vanishes). Without the quorum-contact lease this wedges forever:
+    followers never time out, the leader never commits. The leader
+    must step down, the reachable majority must elect + commit, and
+    the heal must converge with no committed entry lost."""
+    from weaviate_tpu.schema.config import CollectionConfig, Property  # noqa: F401
+
+    nodes = chaos_cluster
+    leader = _current_leader(nodes)
+    others = [n for n in nodes if n is not leader]
+    _propose_retry(leader, "PreCut")
+    # inbound cut: x -> leader lost for every x; leader -> x intact
+    faultline.partition("*", leader.name, name="inbound")
+    try:
+        # the lease expires: the unhearing leader abdicates
+        end = time.time() + 10.0
+        while time.time() < end and leader.raft.is_leader:
+            time.sleep(0.05)
+        assert not leader.raft.is_leader, \
+            "leader kept leading with every ack cut (no step-down)"
+        # the majority elects among themselves and keeps committing
+        new_leader = _current_leader(others)
+        assert new_leader is not leader
+        _propose_retry(new_leader, "DarkCommit")
+        # no split-brain: the old leader cannot commit anything
+        from weaviate_tpu.cluster.raft import NotLeaderError
+
+        with pytest.raises((NotLeaderError, TimeoutError)):
+            leader.raft.propose_local({"type": "noop"}, timeout=0.5)
+    finally:
+        faultline.heal("inbound")
+    # heal: everyone converges on every committed entry
+    deadline = time.time() + 20.0
+    want = {"PreCut", "DarkCommit"}
+    while time.time() < deadline:
+        if all(want <= set(n.db.collections) for n in nodes):
+            break
+        time.sleep(0.1)
+    for n in nodes:
+        assert want <= set(n.db.collections), (n.name, n.db.collections)
+
+
+def test_asymmetric_raft_leader_cannot_send(chaos_cluster):
+    """The reverse asymmetry: the leader's OUTBOUND links die (it can
+    receive but not send). Followers stop hearing heartbeats, elect a
+    new leader, and the new leader's appends — which still REACH the
+    old one — depose it. No split-brain, nothing lost."""
+    nodes = chaos_cluster
+    leader = _current_leader(nodes)
+    others = [n for n in nodes if n is not leader]
+    _propose_retry(leader, "PreOut")
+    faultline.partition(leader.name, "*", name="outbound")
+    try:
+        new_leader = _current_leader(others)
+        assert new_leader is not leader
+        _propose_retry(new_leader, "OutDark")
+        # the new leader's appends reach the old leader: it must have
+        # stepped down to follower (higher term arrived inbound)
+        end = time.time() + 10.0
+        while time.time() < end and leader.raft.is_leader:
+            time.sleep(0.05)
+        assert not leader.raft.is_leader
+    finally:
+        faultline.heal("outbound")
+    deadline = time.time() + 20.0
+    want = {"PreOut", "OutDark"}
+    while time.time() < deadline:
+        if all(want <= set(n.db.collections) for n in nodes):
+            break
+        time.sleep(0.1)
+    for n in nodes:
+        assert want <= set(n.db.collections), (n.name, n.db.collections)
+
+
 def test_kv_faults_during_property_fetch_are_contained(tmp_path):
     """kv.get_many faults (error, corruption, latency) during property
     fetch: the error surfaces typed to its caller, corruption raises

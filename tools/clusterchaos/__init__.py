@@ -1,0 +1,29 @@
+"""clusterchaos: cluster-scale composition of faultline + crashpoint
+with a consistency verdict.
+
+Runs a seeded mixed put/delete/read workload at mixed consistency
+levels against a REAL 3-node replicated cluster while partitions,
+link flaps and node kills fire, then checks — post-heal — that the
+consistency-level promises actually held: QUORUM/ALL-acked writes
+survive and read back at ALL, the converged value per uuid is an
+allowed (acked-or-ambiguous, digest_rank-ordered) one, acked deletes
+never resurrect through hashbeat, ambiguous ops land identically on
+every replica, orphaned 2PC prepares expire instead of committing
+late, and all replica hashtrees reach root equality within a bounded
+number of hashbeat rounds.
+
+``python -m tools.clusterchaos`` runs the deterministic scenario
+matrix; any randomized sweep round replays bit-for-bit from its seed.
+"""
+
+from tools.clusterchaos.checker import check_run
+from tools.clusterchaos.harness import (
+    SCENARIOS,
+    run_matrix,
+    run_scenario,
+    run_sweep,
+    sweep_spec,
+)
+
+__all__ = ["SCENARIOS", "check_run", "run_matrix", "run_scenario",
+           "run_sweep", "sweep_spec"]

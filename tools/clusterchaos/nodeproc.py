@@ -1,0 +1,76 @@
+"""One cluster node as a real subprocess — the clusterchaos kill target.
+
+The driver spawns this (fixed port, shared bootstrap peer set), SIGKILLs
+it mid-workload, and respawns it on the same data directory: the restart
+has to recover raft state, rejoin gossip, and converge through hashbeat
+like any crashed production node. Faults (including the node's own side
+of a partition, and crashpoints that fire mid-2PC) arm from
+``WEAVIATE_TPU_FAULTLINE`` BEFORE the node opens its stores, exactly
+like the crashtest worker, so schedules inside recovery fire too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="clusterchaos-nodeproc")
+    ap.add_argument("name")
+    ap.add_argument("data_dir")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--peers", required=True, help="csv bootstrap names")
+    ap.add_argument("--seeds", default="", help="csv seed addresses")
+    ap.add_argument("--gossip", type=float, default=0.1)
+    ap.add_argument("--elect", default="0.2,0.4")
+    ap.add_argument("--dead-after", type=float, default=1.5)
+    ap.add_argument("--remote-timeout", type=float, default=1.5)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from weaviate_tpu.runtime import faultline
+
+    # arm BEFORE the node opens anything: a crashpoint scheduled inside
+    # recovery/boot must be reachable, and this node's own partition
+    # rules must govern its very first gossip/raft packets
+    armed = faultline.arm_from_env()
+    faultline.bind_node(args.name)
+
+    from weaviate_tpu.cluster.node import ClusterNode
+
+    lo, hi = (float(x) for x in args.elect.split(","))
+    node = ClusterNode(args.name, args.data_dir,
+                       raft_peers=args.peers.split(","),
+                       port=args.port,
+                       gossip_interval=args.gossip,
+                       election_timeout=(lo, hi),
+                       remote_timeout=args.remote_timeout)
+    node.membership.dead_after = args.dead_after
+    node.membership.suspect_after = args.dead_after * 0.6
+
+    def status(_payload):
+        return {"ok": True, "name": args.name,
+                "collections": sorted(node.db.collections),
+                "leader": node.raft.leader_id,
+                "role": node.raft.role,
+                "term": node.raft.current_term,
+                # armed schedule progress — how the driver diagnoses a
+                # crashpoint that is not being driven toward firing
+                "faults": [{"point": s.point, "action": s.action,
+                            "calls": s.calls, "injected": s.injected}
+                           for s in armed
+                           if isinstance(s, faultline.Schedule)]}
+
+    node.server.route("/chaos/status", status)
+    seeds = [s for s in args.seeds.split(",") if s]
+    node.start(seed_addrs=seeds or None)
+    # serve until killed — the driver owns this process's lifetime
+    while True:
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

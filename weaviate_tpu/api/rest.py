@@ -27,7 +27,7 @@ API_VERSION = "1.25.2"
 from weaviate_tpu.cluster.transport import CircuitOpenError
 from weaviate_tpu.db.shard import ShardReadOnlyError
 from weaviate_tpu.filters.filters import Filter
-from weaviate_tpu.runtime import degrade, retry, tracing
+from weaviate_tpu.runtime import degrade, faultline, retry, tracing
 from weaviate_tpu.runtime.memwatch import InsufficientMemoryError
 from weaviate_tpu.schema.config import CollectionConfig, Property
 
@@ -450,7 +450,8 @@ class RestServer:
                         except ForbiddenError as e:
                             raise ApiError(403, str(e))
                     with trace_cm, retry.deadline(budget), \
-                            degrade.collecting():
+                            degrade.collecting(), \
+                            faultline.node_scope(outer.db.local_node):
                         body = json.loads(raw) if raw else None
                         status, payload = outer.dispatch(
                             method, parsed.path, params, body)
@@ -634,6 +635,8 @@ class RestServer:
             return 200, self._debug_memory()
         if seg == ["debug", "storage"]:
             return 200, self._debug_storage()
+        if seg == ["debug", "replication"]:
+            return 200, self._debug_replication()
         if seg == ["debug", "perf"]:
             # last benchkeeper gate verdict + per-section trend deltas
             # (tools/benchkeeper persists the artifact; perfgate loads
@@ -1006,6 +1009,35 @@ class RestServer:
             # the raft bucket ignores syncWal — pinned durable
             "raftBucketPinnedSync": self.node is not None,
         }
+        return out
+
+    def _debug_replication(self) -> dict:
+        """GET /v1/debug/replication: anti-entropy convergence state —
+        per-shard last-beat age, rounds run, entries reconciled, last
+        diff size and divergence estimate, plus read-path divergence
+        observations and any armed partition topology (what the
+        clusterchaos checker watches while replicas heal). The same
+        registry feeds weaviate_tpu_hashbeat_rounds_total and
+        weaviate_tpu_replica_divergent_entries."""
+        from weaviate_tpu.replication.hashbeater import replication_status
+        from weaviate_tpu.runtime import faultline as _faultline
+
+        out = replication_status.snapshot()
+        # staged-2PC visibility: live (un-committed, un-aborted) entries
+        # per loaded shard and how many the TTL path expired
+        staged = {}
+        for cname in self.db.list_collections():
+            col = self.db.get_collection(cname)
+            with col._lock:
+                items = sorted(col.shards.items())
+            for sname, shard in items:
+                st = shard.staged_status()
+                if st["staged"] or st["expired_total"]:
+                    staged[f"{cname}/{sname}"] = st
+        out["staged"] = staged
+        topo = _faultline.topology_snapshot()
+        if topo:
+            out["partitions"] = topo  # armed topology faults (chaos runs)
         return out
 
     def _local_shard_details(self) -> list[dict]:

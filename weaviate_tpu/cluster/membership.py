@@ -16,13 +16,22 @@ import random
 import threading
 import time
 
-from weaviate_tpu.cluster.transport import RpcError, rpc
+from weaviate_tpu.cluster.transport import RpcError, on_peer_alive, rpc
+from weaviate_tpu.runtime import faultline
 
 logger = logging.getLogger(__name__)
 
 ALIVE = "alive"
 SUSPECT = "suspect"
 DEAD = "dead"
+
+#: every Nth gossip tick also probes one DEAD peer. Without this a
+#: partition that outlives ``dead_after`` never heals at the membership
+#: layer: both sides mark each other DEAD, DEAD peers are excluded from
+#: gossip targets, and with nobody left to talk to the views stay split
+#: forever even though the network recovered (hashicorp/memberlist
+#: solves the same problem with its dead-node gossip probability).
+DEAD_PROBE_EVERY = 4
 
 
 class NodeInfo:
@@ -73,6 +82,8 @@ class Membership:
         self._nodes: dict[str, NodeInfo] = {name: self_info}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._tick_count = 0
+        faultline.register_node(name, server.address)
         server.route("/cluster/gossip", self._handle_gossip)
 
     # -- views ---------------------------------------------------------------
@@ -114,15 +125,17 @@ class Membership:
     def join(self, seed_addrs: list[str]) -> int:
         """Push our view to seeds and adopt theirs (state.go:61 Init)."""
         joined = 0
-        for addr in seed_addrs:
-            if addr == self.server.address:
-                continue
-            try:
-                view = rpc(addr, "/cluster/gossip", {"nodes": self._view()})
-                self._merge(view.get("nodes", []))
-                joined += 1
-            except RpcError as e:
-                logger.warning("join via %s failed: %s", addr, e)
+        with faultline.node_scope(self.name):
+            for addr in seed_addrs:
+                if addr == self.server.address:
+                    continue
+                try:
+                    view = rpc(addr, "/cluster/gossip",
+                               {"nodes": self._view()})
+                    self._merge(view.get("nodes", []))
+                    joined += 1
+                except RpcError as e:
+                    logger.warning("join via %s failed: %s", addr, e)
         return joined
 
     def start(self) -> None:
@@ -152,6 +165,7 @@ class Membership:
                         self._thread = None
 
     def _loop(self) -> None:
+        faultline.bind_node(self.name)  # this thread gossips AS us
         while not self._stop.wait(self.interval):
             try:
                 self.tick()
@@ -169,18 +183,38 @@ class Membership:
 
     def tick(self) -> bool:
         """One gossip round: push view to ``fanout`` random peers, merge
-        what they answer; then sweep liveness."""
+        what they answer; then sweep liveness. Every
+        ``DEAD_PROBE_EVERY``-th round additionally probes one DEAD peer
+        (round-robin) — the heal path for partitions that outlived
+        ``dead_after``, after which both sides would otherwise have
+        nobody left willing to gossip to the other."""
         with self._lock:
             peers = [n for n in self._nodes.values()
                      if n.name != self.name and n.status != DEAD]
-        for peer in random.sample(peers, min(self.fanout, len(peers))):
-            try:
-                reply = rpc(peer.addr, "/cluster/gossip",
-                            {"nodes": self._view()}, timeout=2.0)
-                self._merge(reply.get("nodes", []))
-                self._touch(peer.name)
-            except RpcError:
-                pass  # liveness sweep handles persistent failures
+            dead = sorted((n for n in self._nodes.values()
+                           if n.name != self.name and n.status == DEAD),
+                          key=lambda n: n.name)
+            self._tick_count += 1
+            tick = self._tick_count
+        targets = [(p, 2.0) for p in
+                   random.sample(peers, min(self.fanout, len(peers)))]
+        if dead and tick % DEAD_PROBE_EVERY == 0:
+            # short timeout: a black-holed dead peer must not stall the
+            # single gossip thread (and the liveness sweep behind it)
+            # for the full 2s ceiling every probe round — the probe only
+            # needs to catch a peer that is actually back
+            targets.append(
+                (dead[(tick // DEAD_PROBE_EVERY) % len(dead)],
+                 min(2.0, max(0.25, self.interval * 2))))
+        with faultline.node_scope(self.name):
+            for peer, timeout in targets:
+                try:
+                    reply = rpc(peer.addr, "/cluster/gossip",
+                                {"nodes": self._view()}, timeout=timeout)
+                    self._merge(reply.get("nodes", []))
+                    self._touch(peer.name)
+                except RpcError:
+                    pass  # liveness sweep handles persistent failures
         self._sweep()
         return True
 
@@ -189,17 +223,27 @@ class Membership:
         return {"nodes": self._view()}
 
     def _touch(self, name: str) -> None:
+        addr = None
         with self._lock:
             info = self._nodes.get(name)
             if info is not None:
                 info.last_seen = time.time()
                 self._set_status(info, ALIVE)
+                addr = info.addr
+        # DIRECT round-trip proof the peer (and therefore its shared
+        # data-plane port) is reachable from HERE: release any open
+        # circuit breaker for an immediate half-open probe. Only _touch
+        # gets this — a relayed third-party view in _merge proves
+        # nothing about OUR link under an asymmetric partition.
+        if addr is not None:
+            on_peer_alive(addr)
 
     def _merge(self, remote_nodes: list[dict]) -> None:
         for d in remote_nodes:
             info = NodeInfo.from_dict(d)
             if info.name == self.name:
                 continue
+            faultline.register_node(info.name, info.addr)
             with self._lock:
                 mine = self._nodes.get(info.name)
                 if mine is None:

@@ -43,6 +43,10 @@ class ClusterNode:
         either way)."""
         self.name = name
         self.server = InternalServer(host, port, advertise=advertise)
+        # handlers that fan out (raft forwarding, 2PC, read repair) and
+        # the faultline partition topology need to know which node a
+        # thread acts as
+        self.server.node_name = name
         self.membership = Membership(name, self.server,
                                      interval=gossip_interval)
         self.remote = RemoteShardClient(self.membership.resolve,
@@ -126,11 +130,14 @@ class ClusterNode:
 
     def _hashbeat_cycle(self) -> bool:
         from weaviate_tpu.replication import HashBeater
+        from weaviate_tpu.runtime import faultline
 
         did = False
-        for col in list(self.db.collections.values()):
-            if col.config.replication.factor > 1:
-                did = HashBeater(col).beat() or did
+        # the cycle thread beats AS this node (partition topology src)
+        with faultline.node_scope(self.name):
+            for col in list(self.db.collections.values()):
+                if col.config.replication.factor > 1:
+                    did = HashBeater(col).beat() or did
         return did
 
     def serve_rest(self, host: str = "127.0.0.1", port: int = 0,

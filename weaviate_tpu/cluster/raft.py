@@ -57,12 +57,18 @@ class RaftNode:
                  election_timeout: tuple[float, float] = (0.3, 0.6),
                  heartbeat_interval: float = 0.08,
                  snapshot_fn=None, restore_fn=None,
-                 snapshot_threshold: int = 256):
+                 snapshot_threshold: int = 256,
+                 step_down_timeout: float | None = None):
         """``peers``: bootstrap member names incl. self (later changed via
         conf entries). ``resolver(name) -> addr``. ``apply_fn(op)``
         applies a committed entry to the FSM. ``snapshot_fn() -> dict`` /
         ``restore_fn(state)`` serialize/install FSM state for compaction
-        and joiner catch-up. ``store_bucket``: KV bucket for persistence."""
+        and joiner catch-up. ``store_bucket``: KV bucket for persistence.
+        ``step_down_timeout``: a leader that has heard no reply from a
+        majority for this long abdicates (default 4x the upper election
+        timeout) — without it, a one-way-partitioned leader that can
+        SEND but not RECEIVE keeps heartbeating followers forever, no
+        election ever fires, and the cluster wedges unavailable."""
         self.name = name
         self.bootstrap_peers = sorted(set(peers) | {name})
         self.peers = list(self.bootstrap_peers)
@@ -74,6 +80,9 @@ class RaftNode:
         self._bucket = store_bucket
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
+        self.step_down_timeout = (4 * election_timeout[1]
+                                  if step_down_timeout is None
+                                  else step_down_timeout)
 
         self._lock = threading.RLock()
         self._applied_cv = threading.Condition(self._lock)
@@ -88,6 +97,12 @@ class RaftNode:
         self.leader_id: str | None = None
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
+        # last reply (ANY reply — an unsuccessful append still proves
+        # connectivity) received from each peer while leading, and the
+        # last time a leader's RPC reached US while following — the
+        # inputs to step-down and vote stickiness respectively
+        self._peer_contact: dict[str, float] = {}
+        self._last_leader_contact = 0.0
         self._deadline = self._new_deadline()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -266,8 +281,10 @@ class RaftNode:
         last: Exception | None = None
         while time.time() < deadline:
             try:
-                reply = rpc(member_addr, "/raft/join", {"name": self.name},
-                            timeout=min(5.0, deadline - time.time()))
+                with faultline.node_scope(self.name):
+                    reply = rpc(member_addr, "/raft/join",
+                                {"name": self.name},
+                                timeout=min(5.0, deadline - time.time()))
                 with self._lock:
                     # learn the existing membership from the reply — the
                     # original members predate any conf entry in the log
@@ -354,6 +371,7 @@ class RaftNode:
         return time.monotonic() + random.uniform(*self.election_timeout)
 
     def _loop(self) -> None:
+        faultline.bind_node(self.name)  # this thread campaigns AS us
         while not self._stop.wait(0.01):
             try:
                 with self._lock:
@@ -388,10 +406,11 @@ class RaftNode:
             if peer == self.name:
                 continue
             try:
-                reply = rpc(self.resolver(peer), "/raft/vote",
-                            {"term": term, "candidate": self.name,
-                             "last_log_index": last_index,
-                             "last_log_term": last_term}, timeout=1.0)
+                with faultline.node_scope(self.name):
+                    reply = rpc(self.resolver(peer), "/raft/vote",
+                                {"term": term, "candidate": self.name,
+                                 "last_log_index": last_index,
+                                 "last_log_term": last_term}, timeout=1.0)
             except (RpcError, KeyError):
                 continue
             with self._lock:
@@ -412,8 +431,13 @@ class RaftNode:
         self.role = LEADER
         self.leader_id = self.name
         n = self._abs_last() + 1
+        now = time.monotonic()
         self._next_index = {p: n for p in self.peers if p != self.name}
         self._match_index = {p: -1 for p in self.peers if p != self.name}
+        # fresh lease: every peer counts as heard-from at election time
+        # (they just voted) so the quorum-contact check gets a full
+        # step_down_timeout grace window before it can fire
+        self._peer_contact = {p: now for p in self.peers if p != self.name}
         self._reanchor_warned: set[str] = set()
         # no-op barrier entry so the new leader can commit prior-term
         # entries (Raft §5.4.2)
@@ -434,11 +458,44 @@ class RaftNode:
     def _replicate_all(self) -> None:
         with self._lock:
             peers = list(self.peers)
-        for peer in peers:
-            if peer != self.name:
-                self._replicate_one(peer)
+        with faultline.node_scope(self.name):
+            for peer in peers:
+                if peer != self.name:
+                    self._replicate_one(peer)
+        self._check_quorum_contact()
         self._advance_commit()
         self._maybe_snapshot()
+
+    def _recent_quorum_contact(self, window: float) -> bool:
+        """Did a majority (incl. self) answer within ``window``?
+        Caller holds ``_lock``."""
+        now = time.monotonic()
+        heard = 1 + sum(
+            1 for p in self.peers if p != self.name
+            and now - self._peer_contact.get(p, 0.0) <= window)
+        return heard > len(self.peers) // 2
+
+    def _check_quorum_contact(self) -> None:
+        """Leader lease check: step down when no majority has answered
+        within ``step_down_timeout``. The one-way partition this exists
+        for: a leader that can SEND but not RECEIVE keeps resetting its
+        followers' election deadlines with heartbeats whose acks all
+        vanish — nobody ever campaigns, nothing ever commits. Abdicating
+        stops the heartbeats so the reachable majority elects a leader
+        that can actually hear acks. Same term kept: this is a lease
+        expiry, not a new election."""
+        with self._lock:
+            if self.role != LEADER or len(self.peers) <= 1:
+                return
+            if self._recent_quorum_contact(self.step_down_timeout):
+                return
+            logger.warning(
+                "raft %s: no majority contact in the last %.1fs — "
+                "stepping down (term %d kept)", self.name,
+                self.step_down_timeout, self.current_term)
+            self.role = FOLLOWER
+            self.leader_id = None
+            self._deadline = self._new_deadline()
 
     def _replicate_one(self, peer: str) -> None:
         with self._lock:
@@ -484,6 +541,7 @@ class RaftNode:
                 reply = rpc(self.resolver(peer), "/raft/snapshot", payload,
                             timeout=5.0)
                 with self._lock:
+                    self._peer_contact[peer] = time.monotonic()
                     if reply["term"] > self.current_term:
                         self._become_follower(reply["term"])
                         return
@@ -498,6 +556,7 @@ class RaftNode:
         except (RpcError, KeyError):
             return
         with self._lock:
+            self._peer_contact[peer] = time.monotonic()
             if reply["term"] > self.current_term:
                 self._become_follower(reply["term"])
                 return
@@ -591,6 +650,7 @@ class RaftNode:
             if term > self.current_term or self.role != FOLLOWER:
                 self._become_follower(term)
             self.leader_id = payload["leader"]
+            self._last_leader_contact = time.monotonic()
             self._deadline = self._new_deadline()
             last = payload["last_index"]
             if last <= self.last_applied:
@@ -620,6 +680,27 @@ class RaftNode:
     def _handle_vote(self, payload: dict) -> dict:
         with self._lock:
             term = payload["term"]
+            # leader stickiness (Raft §4.2.3): refuse higher-term vote
+            # requests WITHOUT adopting the term while the cluster
+            # demonstrably has a live leader. The one-way-partitioned
+            # old leader ("can send but not receive") times out and
+            # campaigns at ever-growing terms; honoring those requests
+            # would bump the healthy majority's term every cycle and
+            # keep deposing the leader it just elected. Two cases:
+            # a FOLLOWER is sticky while heartbeats keep arriving; the
+            # ACTIVE LEADER is sticky while its own quorum lease is
+            # fresh (it never receives heartbeats, so the follower
+            # clock alone would leave it permanently deposable).
+            if term > self.current_term \
+                    and self.leader_id != payload["candidate"]:
+                sticky = (
+                    self._recent_quorum_contact(self.election_timeout[0])
+                    if self.role == LEADER and len(self.peers) > 1
+                    else self.leader_id is not None
+                    and time.monotonic() - self._last_leader_contact
+                    < self.election_timeout[0])
+                if sticky:
+                    return {"term": self.current_term, "granted": False}
             if term > self.current_term:
                 self._become_follower(term)
             granted = False
@@ -643,6 +724,7 @@ class RaftNode:
             if term > self.current_term or self.role != FOLLOWER:
                 self._become_follower(term)
             self.leader_id = payload["leader"]
+            self._last_leader_contact = time.monotonic()
             self._deadline = self._new_deadline()
 
             prev_i = payload["prev_index"]
@@ -705,9 +787,12 @@ class RaftNode:
                 return self.propose_local(op, timeout=deadline - time.time())
             if leader is not None:
                 try:
-                    reply = rpc(self.resolver(leader), "/raft/propose",
-                                {"op": op, "timeout": max(0.1, deadline - time.time())},
-                                timeout=max(0.1, deadline - time.time()))
+                    with faultline.node_scope(self.name):
+                        reply = rpc(
+                            self.resolver(leader), "/raft/propose",
+                            {"op": op,
+                             "timeout": max(0.1, deadline - time.time())},
+                            timeout=max(0.1, deadline - time.time()))
                     index = reply["index"]
                     # wait until OUR node applies it too (read-your-writes
                     # for schema; the reference schema manager reads its

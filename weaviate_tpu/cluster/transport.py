@@ -34,6 +34,13 @@ logger = logging.getLogger(__name__)
 # caller (base64 json) so a distributed query stitches into ONE trace
 TRACE_SPANS_HEADER = "X-Trace-Spans"
 
+# request headers for the faultline partition topology: which cluster
+# node issued this RPC, and whether the sender's process already
+# consulted its topology registry (so an in-process server does not
+# double-count the same rule the client side just evaluated)
+SOURCE_NODE_HEADER = "X-Weaviate-Node"
+TOPOLOGY_CHECKED_HEADER = "X-Topology-Checked"
+
 
 def _encode_spans(spans: list[dict] | None) -> str | None:
     if not spans:
@@ -110,6 +117,11 @@ class InternalServer:
         CLUSTER_ADVERTISE_ADDR/PORT in usecases/cluster config)."""
         self._advertise = advertise
         self.routes: dict[str, object] = {}
+        #: owning cluster node's name (set by ClusterNode) — handlers
+        #: that fan out further RPCs (raft forwarding, replication,
+        #: read repair) issue them AS this node, which is what the
+        #: faultline topology layer partitions on
+        self.node_name: str | None = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -121,13 +133,31 @@ class InternalServer:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length)
+                # server-side partition topology: requests from callers
+                # that did NOT consult this process's registry (a
+                # subprocess cluster node) are checked here. "never
+                # arrived" = close without dispatching; "ack lost" =
+                # dispatch, then close without answering. Either way the
+                # caller sees a dead connection, never an HTTP status —
+                # a partitioned peer must not look alive.
+                link = None
+                if outer.node_name is not None and \
+                        self.headers.get(TOPOLOGY_CHECKED_HEADER) \
+                        != faultline.PROCESS_TOKEN:
+                    link = faultline.check_link_incoming(
+                        self.headers.get(SOURCE_NODE_HEADER),
+                        outer.node_name)
+                    if link == "unreachable":
+                        self.close_connection = True
+                        return
                 # adopt an incoming traceparent: spans recorded while
                 # handling chain to the caller's span and are exported
                 # back in the response for cross-node stitching
                 seg = None
                 try:
                     payload = loads(raw) if raw else {}
-                    with tracing.remote_segment(
+                    with faultline.node_scope(outer.node_name), \
+                            tracing.remote_segment(
                             self.headers.get("traceparent"),
                             name="rpc.server", path=self.path) as seg:
                         result = outer.dispatch(self.path, payload)
@@ -140,6 +170,11 @@ class InternalServer:
                     logger.exception("internal handler %s failed", self.path)
                     body = dumps({"error": str(e)})
                     code = 500
+                if link == "drop":
+                    # the handler ran; its ack dies on the cut reply
+                    # direction — close the connection unanswered
+                    self.close_connection = True
+                    return
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -300,6 +335,18 @@ class CircuitBreaker:
         with self._lock:
             self._probing = False
 
+    def notify_alive(self) -> None:
+        """Membership proved DIRECT contact with this peer (a gossip
+        round-trip on the same host:port the data plane uses): collapse
+        whatever cooldown remains so the very next call runs the
+        half-open probe. Recovery latency after a partition heals is
+        then probe-bound, not cooldown-bound — without this, a breaker
+        opened moments before the heal kept fail-fasting a provably
+        alive peer for the full CB_COOLDOWN_S."""
+        with self._lock:
+            if self._state == OPEN:
+                self._transition(HALF_OPEN)
+
     def _transition(self, to: str) -> None:
         """Caller holds ``_lock``."""
         self._state = to
@@ -332,6 +379,15 @@ def breaker_for(addr: str) -> CircuitBreaker:
         if br is None:
             br = _breakers[addr] = CircuitBreaker(addr)
         return br
+
+
+def on_peer_alive(addr: str) -> None:
+    """Gossip's membership-alive signal for ``addr`` (direct contact
+    only — relayed third-party views don't prove OUR link works). A
+    breaker that never opened is a cheap no-op."""
+    br = _breakers.get(addr)
+    if br is not None:
+        br.notify_alive()
 
 
 def reset_breakers() -> None:
@@ -384,6 +440,15 @@ def rpc(addr: str, path: str, payload=None, timeout: float | None = None):
     # must not consume (and then leak) a half-open probe slot
     body = dumps(payload or {})
     headers = {"Content-Type": "application/json"}
+    src_node = faultline.current_node()
+    if src_node is not None:
+        headers[SOURCE_NODE_HEADER] = src_node
+    if faultline.topology_armed():
+        # this process's registry is consulted below — tell a server in
+        # the SAME process (token match) not to evaluate the same rules
+        # again; a server in another process with its OWN armed rules
+        # still enforces them
+        headers[TOPOLOGY_CHECKED_HEADER] = faultline.PROCESS_TOKEN
     breaker = None if path.startswith(BREAKER_EXEMPT_PREFIXES) \
         else breaker_for(addr)
     if breaker is not None and not breaker.allow():
@@ -400,6 +465,17 @@ def rpc(addr: str, path: str, payload=None, timeout: float | None = None):
             try:
                 directive = faultline.fire("transport.rpc.send", addr=addr,
                                            path=path)
+                # topology layer: a cut REQUEST direction fails like an
+                # unreachable peer (raised here, mapped to RpcError +
+                # breaker below); a cut REPLY direction completes the
+                # send — the handler runs — and loses the ack via the
+                # same drop directive a scheduled reply-loss uses
+                link = faultline.check_link(addr, path=path)
+                if link == "unreachable":
+                    raise faultline.LinkDown(
+                        faultline.current_node(), addr, "topology")
+                if link == "drop" and directive is None:
+                    directive = "drop"
                 conn = http.client.HTTPConnection(host, int(port),
                                                   timeout=timeout)
                 try:

@@ -28,6 +28,12 @@ class ShardReadOnlyError(RuntimeError):
     """Write refused: shard status is READONLY
     (PUT /v1/schema/{class}/shards/{shard})."""
 
+
+class StagedExpiredError(RuntimeError):
+    """2PC commit refused: the staged entry outlived the staged-entry
+    TTL (WEAVIATE_TPU_STAGED_TTL_S). The coordinator treats this like
+    any other per-replica commit failure — abort + anti-entropy."""
+
 # bucket names (reference: helpers/helpers.go:22-25)
 BUCKET_OBJECTS = "objects"
 BUCKET_DOCID = "docid"  # uuid -> doc_id  (adapters/repos/db/docid)
@@ -182,8 +188,19 @@ class Shard:
         # deletion tombstones (uuid -> mtime ms) so anti-entropy can tell
         # "deleted here" from "never seen" and not resurrect deletes
         self.tombstones = self.store.bucket("tombstones", "replace")
-        # staged 2PC batches: request id -> ("put", [objs]) | ("delete", uuid)
+        # staged 2PC batches: request id -> ("put", [objs]) | ("delete", uuid).
+        # In-memory ON PURPOSE — that is what makes the abort-unreachable
+        # path crash-safe: a replica that dies between prepare and
+        # commit restarts with nothing staged (an implicit abort), and
+        # the write converges through anti-entropy if it committed
+        # elsewhere. Live orphans (coordinator died / stayed partitioned)
+        # expire after ``staged_ttl_s``: gc drops them, and commit_staged
+        # REFUSES them even before gc ran, so a straggler commit racing a
+        # partition heal can never land a stale write late.
         self._staged: dict[str, tuple] = {}
+        self.staged_ttl_s = float(os.environ.get(
+            "WEAVIATE_TPU_STAGED_TTL_S", str(self.STAGED_TTL_S)))
+        self._staged_expired = 0
         # epoch-migration routing overrides (uuid -> destination shard),
         # durable in the meta bucket; the in-memory count makes the
         # common case (no migrations) a zero-cost check on reads/puts
@@ -1016,20 +1033,53 @@ class Shard:
     def gc_staged(self) -> int:
         """Drop staged batches whose coordinator never came back (crash
         between prepare and commit/abort) — anti-entropy re-delivers the
-        write if it committed elsewhere."""
+        write if it committed elsewhere. Every expiry is counted
+        (``weaviate_tpu_replication_staged_expired_total``): an orphaned
+        prepare must neither leak nor commit, and the counter is how a
+        chaos run proves the TTL path actually fired."""
         import time as _time
 
-        cutoff = _time.monotonic() - self.STAGED_TTL_S
+        cutoff = _time.monotonic() - self.staged_ttl_s
         with self._lock:
             stale = [rid for rid, (t, _task) in self._staged.items()
                      if t < cutoff]
             for rid in stale:
                 del self._staged[rid]
+            self._staged_expired += len(stale)
+        if stale:
+            self._count_staged_expired(len(stale))
         return len(stale)
 
+    def _count_staged_expired(self, n: int) -> None:
+        try:
+            from weaviate_tpu.runtime.metrics import (
+                replication_staged_expired)
+
+            replication_staged_expired.labels(
+                self.collection_name, self.name).inc(n)
+        except Exception:  # pragma: no cover — registry unavailable
+            pass
+
     def commit_staged(self, request_id: str):
+        """2PC commit. An entry past its TTL is REFUSED, not applied:
+        without this, a commit that sat in flight across a partition
+        (or a coordinator straggler thread racing the heal) could land
+        a stale write long after the rest of the replica set aborted —
+        the expiry has to be deterministic at the commit boundary, not
+        dependent on whether the gc cycle happened to run first."""
+        import time as _time
+
         with self._lock:
             entry = self._staged.pop(request_id, None)
+            if entry is not None \
+                    and _time.monotonic() - entry[0] > self.staged_ttl_s:
+                self._staged_expired += 1
+                self._count_staged_expired(1)
+                raise StagedExpiredError(
+                    f"replication request {request_id!r} staged "
+                    f"{_time.monotonic() - entry[0]:.1f}s ago, past the "
+                    f"{self.staged_ttl_s:.0f}s TTL — refused (late "
+                    "commit after partition heal)")
         if entry is None:
             raise KeyError(f"unknown replication request {request_id!r}")
         _t, task = entry
@@ -1039,6 +1089,15 @@ class Shard:
         if kind == "delete":
             return self.delete_object(task[1], tombstone_ms=task[2])
         raise ValueError(f"unknown staged task kind {kind!r}")
+
+    def staged_status(self) -> dict:
+        """Introspection for the chaos checker's leak invariant: live
+        staged entries (gc'd first so the answer is TTL-deterministic)
+        and the total this shard ever expired."""
+        self.gc_staged()
+        with self._lock:
+            return {"staged": len(self._staged),
+                    "expired_total": self._staged_expired}
 
     def abort_staged(self, request_id: str) -> None:
         with self._lock:
@@ -1101,6 +1160,16 @@ class Shard:
         with self._lock:
             for raw in raw_objects:
                 obj = StorageObject.from_bytes(raw)
+                if self.migrated_to(obj.uuid):
+                    # the durable cutover moved this uuid to its marker
+                    # destination: re-applying a peer's (stale) copy here
+                    # would resurrect the moved-away object at its old
+                    # ring home — double-present to search, and the next
+                    # hashbeat would propagate the zombie back out.
+                    # Anti-entropy must respect the marker like reads do.
+                    logger.debug("apply_sync: skipping %s — migrated to "
+                                 "%s", obj.uuid, self.migrated_to(obj.uuid))
+                    continue
                 mine = self.object_digest(obj.uuid)
                 incoming = {"mtime": obj.last_update_time_ms,
                             "deleted": False, "hash": obj.content_hash()}

@@ -110,6 +110,15 @@ class Finder:
 
         stale = [n for n, d in digests.items()
                  if d is None or digest_rank(d) < digest_rank(winner)]
+        if stale:
+            # read-path divergence signal: a consistency-level read just
+            # caught replicas disagreeing between anti-entropy beats —
+            # feeds /v1/debug/replication alongside the beat stats
+            from weaviate_tpu.replication.hashbeater import (
+                replication_status)
+
+            replication_status.record_read_divergence(
+                self.col.config.name, shard_name, len(stale))
 
         if winner["deleted"]:
             for node in stale:

@@ -252,6 +252,11 @@ def register_replication(server, db) -> None:
         if op == "abort":
             shard.abort_staged(payload["request_id"])
             return {"ok": True}
+        if op == "staged:status":
+            # chaos-checker probe: an orphaned prepare must neither leak
+            # (staged > 0 past the TTL) nor commit (expired_total is the
+            # proof the TTL path fired)
+            return shard.staged_status()
         if op == "digest":
             d = shard.object_digest(payload["uuid"])
             return {"digest": d}

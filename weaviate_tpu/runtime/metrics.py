@@ -345,6 +345,20 @@ replication_phase_total = registry.counter(
 hashbeat_repairs_total = registry.counter(
     "weaviate_tpu_hashbeat_objects_repaired_total",
     "Objects propagated by Merkle anti-entropy", ("direction",))
+replication_staged_expired = registry.counter(
+    "weaviate_tpu_replication_staged_expired_total",
+    "Staged 2PC entries dropped or refused past the staged-entry TTL "
+    "(orphaned prepares whose coordinator never came back, and late "
+    "commits racing a partition heal)", ("collection", "shard"))
+hashbeat_rounds = registry.counter(
+    "weaviate_tpu_hashbeat_rounds_total",
+    "Anti-entropy rounds run per locally-owned shard (one round = one "
+    "Merkle walk against every peer replica)", ("collection", "shard"))
+replica_divergent_entries = registry.gauge(
+    "weaviate_tpu_replica_divergent_entries",
+    "Divergence estimate from the last anti-entropy round: entries "
+    "whose digests disagreed with at least one peer replica (0 once "
+    "the replicas converged)", ("collection", "shard"))
 
 # -- dynamic query batching ---------------------------------------------------
 

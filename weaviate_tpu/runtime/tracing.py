@@ -483,21 +483,25 @@ def annotate(**attrs) -> None:
 def propagate(fn):
     """Wrap ``fn`` to carry the CURRENT request context into worker
     threads (pool.map / Thread targets don't inherit contextvars).
-    Carries the whole request triple: trace span, deadline budget, and
-    the degraded-marker sink — a shard fan-out thread must spend the
-    same budget and report into the same response as its request."""
-    from weaviate_tpu.runtime import degrade, retry
+    Carries the whole request quad: trace span, deadline budget, the
+    degraded-marker sink, and the faultline node identity — a shard
+    fan-out thread must spend the same budget, report into the same
+    response, and issue its RPCs AS the same cluster node (the
+    partition topology layer cuts links by (src, dst) node pair)."""
+    from weaviate_tpu.runtime import degrade, faultline, retry
 
     ctx = _current.get()
     dl = retry.current_deadline()
     markers = degrade.current_markers()
-    if ctx is None and dl is None and markers is None:
+    node = faultline.current_node()
+    if ctx is None and dl is None and markers is None and node is None:
         return fn
 
     def wrapper(*args, **kwargs):
         tokens = (retry.set_deadline(dl), degrade.set_markers(markers))
         try:
-            return run_in(ctx, fn, *args, **kwargs)
+            with faultline.node_scope(node):
+                return run_in(ctx, fn, *args, **kwargs)
         finally:
             retry.reset_deadline(tokens[0])
             degrade.reset_markers(tokens[1])
