@@ -150,7 +150,7 @@ def _tracing():
 
 #: sections that measure the tracing substrate itself — wrapping them in
 #: the harness's forced trace would contaminate their "plain" baselines
-UNTRACED_SECTIONS = {"tracing_overhead"}
+UNTRACED_SECTIONS = {"tracing_overhead", "observability_overhead"}
 
 
 def _emit_partial():
@@ -820,6 +820,127 @@ def sec_tracing_overhead(ctx):
     log(f"[tracing] plain {plain:.3f} ms, unsampled trace "
         f"{unsampled:.3f} ms (+{out['unsampled_overhead_ms']:.3f}), "
         f"sampled {sampled:.3f} ms")
+    return out
+
+
+def sec_observability_overhead(ctx):
+    """Always-on attribution cost (ISSUE 15 gate): what the tailboard
+    timeline adds to a served request, held to the <=3% budget.
+
+    The gated metric is COMPOSED from two stable estimators rather than
+    read off a direct throughput A/B — on a shared/noisy host, per-round
+    served QPS moves +-10-15%, so a direct on/off ratio cannot resolve
+    3% (the r05 lesson: a gate on a number noisier than its band is a
+    coin flip). Instead:
+
+    - ``timeline_cost_us``: tight-loop delta of the full edge machinery
+      (timeline CM + root trace + phase folds + complete + amortized
+      fold share) measured on-minus-off with drift-cancelling
+      alternation — stable to fractions of a microsecond;
+    - ``request_cpu_us``: per-request CPU time of a real served loop
+      (concurrent clients through the query batcher), timeline off —
+      the denominator a percentage overhead is meaningful against;
+    - ``on_over_off_qps`` = 1 / (1 + cost/request_cpu): the throughput
+      ratio those two numbers imply, which IS the gated entry.
+
+    A direct concurrent A/B still runs and lands in the section output
+    (``ab_on_qps``/``ab_off_qps``) for eyeball confirmation on quiet
+    rigs; it is deliberately not the gate."""
+    import threading as _threading
+
+    import numpy as np
+
+    from weaviate_tpu.engine.flat import FlatIndex
+    from weaviate_tpu.runtime import tailboard, tracing
+    from weaviate_tpu.runtime.query_batcher import QueryBatcher
+
+    rng = np.random.default_rng(11)
+    idx = FlatIndex(dim=64, capacity=8192)
+    idx.add_batch(np.arange(4096),
+                  rng.standard_normal((4096, 64)).astype(np.float32))
+    q = rng.standard_normal(64).astype(np.float32)
+    qb = QueryBatcher(idx.search_by_vector_batch, max_batch=64)
+
+    def served_one():
+        # the REST edge stack in miniature: timeline CM, root trace,
+        # batcher search (whose stamps fold into the timeline), complete
+        with tailboard.request("bench"):
+            with tracing.trace("rest.bench"):
+                qb.search(q, 10)
+            tailboard.complete(200)
+
+    def edge_one():
+        # the same per-request machinery minus the batcher round trip
+        # (phases injected synthetically) — isolates the timeline cost
+        with tailboard.request("bench"):
+            with tracing.trace("rest.bench"):
+                tailboard.phase("queue_wait", 0.0001)
+                tailboard.phase("device", 0.0002)
+            tailboard.complete(200)
+
+    def tight_us(reps=20000, rounds=3):
+        best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                edge_one()
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1e6
+
+    def served_round(clients=8, reps=150):
+        def drive():
+            for _ in range(reps):
+                served_one()
+
+        threads = [_threading.Thread(target=drive)
+                   for _ in range(clients)]
+        c0 = time.process_time()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        n = clients * reps
+        return (n / (time.perf_counter() - t0),
+                (time.process_time() - c0) / n * 1e6)
+
+    try:
+        for state in (True, False, True):  # warm both states' caches
+            tailboard.force_enabled(state)
+            for _ in range(200):
+                edge_one()
+            for _ in range(30):
+                served_one()
+        # timeline cost: alternating on/off tight rounds, min of each
+        # side (drift hits both; min-of discards preemption outliers)
+        on_us, off_us = [], []
+        for i in range(4):
+            tailboard.force_enabled(i % 2 == 0)
+            (on_us if i % 2 == 0 else off_us).append(tight_us())
+        timeline_cost_us = max(0.0, min(on_us) - min(off_us))
+        # served denominator + informational A/B
+        tailboard.force_enabled(True)
+        ab_on_qps, _cpu_on = served_round()
+        tailboard.force_enabled(False)
+        ab_off_qps, request_cpu_us = served_round()
+    finally:
+        tailboard.force_enabled(None)
+        qb.stop()
+        tracing.clear_traces()
+    overhead = timeline_cost_us / max(request_cpu_us, 1e-9)
+    ratio = 1.0 / (1.0 + overhead)
+    out = {
+        "timeline_cost_us": round(timeline_cost_us, 3),
+        "request_cpu_us": round(request_cpu_us, 2),
+        "on_over_off_qps": round(ratio, 4),
+        "overhead_frac": round(1.0 - ratio, 4),
+        "ab_on_qps": round(ab_on_qps, 1),
+        "ab_off_qps": round(ab_off_qps, 1),
+    }
+    log(f"[observability] timeline {timeline_cost_us:.2f} us/req over "
+        f"{request_cpu_us:.0f} us served cpu -> ratio {ratio:.4f} "
+        f"(overhead {out['overhead_frac'] * 100:.2f}%); A/B "
+        f"{ab_on_qps:.0f}/{ab_off_qps:.0f} qps")
     return out
 
 
@@ -1564,6 +1685,7 @@ SECTIONS = [
     ("filtered_scan", sec_filtered_scan, ("x", "rtt_s")),
     ("quantized", sec_quantized, ("x", "rtt_s")),
     ("tracing_overhead", sec_tracing_overhead, ()),
+    ("observability_overhead", sec_observability_overhead, ()),
     ("durability_tax", sec_durability_tax, ()),
     ("antientropy_convergence", sec_antientropy_convergence, ()),
     ("mixed_rw", sec_mixed_rw, ("rng",)),

@@ -46,7 +46,7 @@ def _faultline_isolation():
     yield
     from weaviate_tpu.cluster.transport import reset_breakers
     from weaviate_tpu.replication.hashbeater import replication_status
-    from weaviate_tpu.runtime import degrade, faultline
+    from weaviate_tpu.runtime import degrade, faultline, metrics, tailboard
     from weaviate_tpu.storage import recovery
 
     faultline.disarm()
@@ -55,3 +55,8 @@ def _faultline_isolation():
     reset_breakers()
     recovery.reset()
     replication_status.reset()
+    # tailboard/SLO/flight registries + the metric series-cap cache:
+    # sliding-window SLO counts or a tail ring leaking across tests
+    # would make incident assertions order-dependent
+    tailboard.reset_for_tests()
+    metrics.reset_series_cap_for_tests()

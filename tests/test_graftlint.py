@@ -723,6 +723,73 @@ def test_g5_timing_conventions_accept_repo_idiom(tmp_path):
     assert [v for v in res.violations if v.check == "G5"] == []
 
 
+G5_HISTOGRAM_POSITIVE = """
+    from weaviate_tpu.runtime.metrics import registry
+
+    # P1: timing histogram not named *_seconds (le bounds are seconds)
+    a = registry.histogram("weaviate_tpu_scan_duration_ms",
+                           "scan latency in milliseconds")
+    # P2: buckets declared out of order
+    b = registry.histogram("weaviate_tpu_drain_seconds", "drain time",
+                           (), buckets=(0.1, 0.05, 1.0))
+    # P3: duplicated bound
+    c = registry.histogram("weaviate_tpu_wait_latency_seconds", "waits",
+                           ("op",), buckets=(0.1, 0.1, 1.0))
+"""
+
+G5_HISTOGRAM_NEGATIVE = """
+    from weaviate_tpu.runtime.metrics import registry
+
+    # timing histogram with the *_seconds suffix + ascending buckets
+    a = registry.histogram("weaviate_tpu_scan_duration_seconds", "scans",
+                           ("op",), buckets=(0.01, 0.1, 1.0))
+    # count histogram: not timey, integer buckets fine
+    b = registry.histogram("weaviate_tpu_batch_size", "batch sizes", (),
+                           buckets=(1, 2, 4, 8))
+    # dynamic buckets: the runtime lint's job, not the static pass
+    B = tuple(sorted([0.5, 0.1]))
+    c = registry.histogram("weaviate_tpu_x_seconds", "x", (), buckets=B)
+"""
+
+
+def test_g5_histogram_conventions_flag_violations(tmp_path):
+    """ISSUE 15 G5 growth: timing histograms must be *_seconds (their
+    le bounds are seconds repo-wide) and literal bucket sets must be
+    strictly ascending."""
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/runtime/fx.py": G5_HISTOGRAM_POSITIVE})
+    g5 = [v for v in res.violations if v.check == "G5"]
+    msgs = " | ".join(v.message for v in g5)
+    assert len(g5) == 3, msgs
+    assert "weaviate_tpu_scan_duration_ms" in msgs and "_seconds" in msgs
+    assert "weaviate_tpu_drain_seconds" in msgs and "ascending" in msgs
+    assert "weaviate_tpu_wait_latency_seconds" in msgs
+
+
+def test_g5_histogram_conventions_accept_clean(tmp_path):
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/runtime/fx.py": G5_HISTOGRAM_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G5"] == []
+
+
+def test_g5_runtime_lint_checks_exemplar_grammar():
+    """The runtime half validates OpenMetrics exemplar rendering: a
+    well-formed registry passes; buckets ascending is enforced too."""
+    from weaviate_tpu.runtime.metrics import MetricsRegistry
+
+    from tools.graftlint import g5_metrics
+
+    reg = MetricsRegistry()
+    h = reg.histogram("weaviate_tpu_ok_seconds", "fine", ("op",),
+                      buckets=(0.1, 1.0))
+    h.labels("q").observe(0.05, exemplar={"trace_id": 'tr"icky\nid'})
+    assert g5_metrics.lint(reg) == []
+    reg2 = MetricsRegistry()
+    reg2.histogram("weaviate_tpu_bad_seconds", "misordered", (),
+                   buckets=(1.0, 0.1))
+    assert any("ascending" in p for p in g5_metrics.lint(reg2))
+
+
 def test_g5_timing_fields_gate_bench_and_benchkeeper(tmp_path):
     """bench.py and tools/benchkeeper are in G5 scope (their JSON is
     benchkeeper's wire format); tests stay excluded."""

@@ -1,0 +1,398 @@
+"""Tailboard (ISSUE 15): always-on phase attribution, tail-based trace
+retention, the SLO burn engine, and the flight recorder.
+
+The acceptance scenarios run BLACK-BOX over a real RestServer with
+``TRACE_SAMPLE_RATE=1000`` (so background sampling effectively never
+fires): the requests an operator needs — errored, deadline-exceeded,
+fault-slowed — must be retrievable from the tail ring with phase
+timings, and a phase-histogram bucket exemplar must resolve to a
+retained trace id through the strict exposition parser."""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from test_metrics_exposition import parse_openmetrics  # noqa: E402
+from weaviate_tpu.api.client import Client, RestError
+from weaviate_tpu.api.rest import DEBUG_ENDPOINTS, RestServer
+from weaviate_tpu.db.database import Database
+from weaviate_tpu.runtime import degrade, faultline, tailboard, tracing
+
+
+@pytest.fixture
+def served(tmp_path, monkeypatch):
+    """Real server, 1-in-1000 sampling (so device sampling effectively
+    never fires), tail slow threshold 30ms for graphql."""
+    monkeypatch.setenv("TRACE_SAMPLE_RATE", "0.001")
+    monkeypatch.setenv("WEAVIATE_TPU_TAIL_SLOW_MS",
+                       json.dumps({"graphql": 30, "*": 250}))
+    tracing.reset_policy_for_tests()
+    tailboard.reset_for_tests()
+    db = Database(str(tmp_path))
+    srv = RestServer(db)
+    srv.start()
+    client = Client(srv.address)
+    client.create_class({"name": "Tail"})
+    rng = np.random.default_rng(3)
+    for i in range(16):
+        client.create_object(
+            "Tail", {}, vector=[float(x) for x in
+                                rng.standard_normal(8)])
+    yield client, srv, db
+    srv.stop()
+    db.close()
+    tracing.reset_policy_for_tests()
+
+
+def _graphql_search(client, timeout_s: float | None = None):
+    q = ('{ Get { Tail(limit: 3, nearVector: {vector: '
+         '[0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8]}) '
+         '{ _additional { id } } } }')
+    path = "/v1/graphql"
+    if timeout_s is not None:
+        path += f"?timeout={timeout_s}"
+    return client.request("POST", path,
+                          body={"query": q, "variables": {}})
+
+
+def _tail_entries(client, reason=None):
+    out = client.request("GET", "/v1/debug/traces?tail=true")["traces"]
+    return [e for e in out if reason is None or e["reason"] == reason]
+
+
+def test_tail_retention_under_hostile_sampling(served):
+    """An errored, a deadline-exceeded, and a fault-slowed request are
+    each kept in the tail ring with phase timings — at 1-in-1000
+    sampling — and a bucket exemplar resolves to a retained trace."""
+    client, srv, db = served
+
+    # warm the compiled path first (the first search carries XLA compile
+    # time and is legitimately tail-kept as slow), then prove a FAST
+    # clean request is NOT tail-kept
+    for _ in range(2):
+        _graphql_search(client)
+    tailboard.clear_tail()
+    _graphql_search(client)
+    assert _tail_entries(client) == []
+
+    # 1. errored: every batcher dispatch faults (the retry too) -> the
+    #    search surfaces a 500 through the graphql edge
+    faultline.arm("batcher.dispatch", "error", every=1)
+    with pytest.raises(RestError) as err:
+        _graphql_search(client)
+    faultline.disarm()
+    assert err.value.status == 500
+    errored = _tail_entries(client, "error")
+    assert errored, _tail_entries(client)
+    assert errored[0]["operation"] == "graphql"
+    assert errored[0]["status"] == 500
+
+    # 2. deadline-exceeded: injected dispatch latency far past a tiny
+    #    request budget -> typed 504 -> reason "deadline"
+    faultline.arm("batcher.dispatch", "latency", latency_s=0.30, every=1)
+    with pytest.raises(RestError) as e:
+        _graphql_search(client, timeout_s=0.05)
+    faultline.disarm()
+    assert e.value.status == 504
+    deadline = _tail_entries(client, "deadline")
+    assert deadline and deadline[0]["operation"] == "graphql"
+    assert deadline[0]["status"] == 504
+
+    # 3. fault-injected latency slow request: 60ms injected latency vs
+    #    the 30ms graphql threshold -> completes fine, kept as slow,
+    #    with the batcher phase split present
+    faultline.arm("batcher.dispatch", "latency", latency_s=0.06, every=1)
+    resp = _graphql_search(client)
+    faultline.disarm()
+    assert "errors" not in resp or not resp["errors"]
+    slow = _tail_entries(client, "slow")
+    assert slow, _tail_entries(client)
+    entry = slow[0]
+    assert entry["duration_ms"] >= 30
+    phases = entry["phases_ms"]
+    # the injected latency fired inside the dispatch window -> the
+    # always-on "device" phase (dispatch wall) absorbed it, no sync
+    assert phases["device"] >= 50, phases
+    assert "queue_wait" in phases and "host" in phases
+    # the retained entry carries the full trace, trace_id included
+    assert entry["trace"] and entry["trace"]["trace_id"]
+    assert entry["trace"]["sampled"] is False  # retention beat sampling
+
+    # 4. exemplar resolution: a request_phase_seconds bucket exemplar
+    #    names a trace id that IS retrievable from the tail ring
+    req = urllib.request.Request(
+        f"http://{srv.address}/v1/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    parsed = parse_openmetrics(urllib.request.urlopen(req).read().decode())
+    exemplar_ids = {
+        s["exemplar"]["labels"]["trace_id"]
+        for s in parsed["samples"]
+        if s["name"] == "weaviate_tpu_request_phase_seconds_bucket"
+        and s["exemplar"] is not None}
+    assert exemplar_ids
+    retained_ids = {e["trace"]["trace_id"] for e in _tail_entries(client)
+                    if e.get("trace")}
+    assert exemplar_ids & retained_ids
+
+    # 5. the same traces NEVER depended on the sampled ring: with
+    #    TRACE_SAMPLE_RATE=1000 none of these were device-sampled
+    all_traces = client.request("GET", "/v1/debug/traces")["traces"]
+    assert all(not t["sampled"] for t in all_traces)
+
+
+def test_degraded_request_is_tail_kept(served):
+    client, srv, db = served
+    # a degraded marker reported during handling flags the timeline
+    from weaviate_tpu.api import rest as rest_mod
+
+    orig = srv.dispatch
+
+    def degraded_dispatch(method, path, params, body):
+        if path == "/v1/graphql":
+            degrade.report("replica_skipped", collection="Tail",
+                           detail="test")
+        return orig(method, path, params, body)
+
+    srv.dispatch = degraded_dispatch
+    try:
+        resp = _graphql_search(client)
+    finally:
+        srv.dispatch = orig
+    assert resp.get("degraded")
+    entries = _tail_entries(client, "degraded")
+    assert entries and entries[0]["operation"] == "graphql"
+
+
+def test_phase_histogram_always_on(served):
+    """Every request lands phase observations — queue_wait/device from
+    the batcher stamps, host as the remainder — with collection/tenant
+    labels passing the top-K guard."""
+    client, srv, db = served
+    from weaviate_tpu.runtime.metrics import request_phase_seconds
+
+    _graphql_search(client)
+    tailboard.flush()  # a scrape would do this; tests read directly
+    child = request_phase_seconds.labels("graphql", "device", "Tail", "-")
+    assert child.count >= 1
+    host = request_phase_seconds.labels("graphql", "host", "Tail", "-")
+    assert host.count >= 1
+    wait = request_phase_seconds.labels("graphql", "queue_wait", "Tail",
+                                        "-")
+    assert wait.count >= 1
+
+
+def test_debug_index_lists_every_endpoint(served):
+    """GET /v1/debug enumerates the debug surface; every listed endpoint
+    serves 200; every registered endpoint is listed (the dict drives
+    both, and this test pins the round trip)."""
+    client, srv, db = served
+    index = client.request("GET", "/v1/debug")
+    listed = {e["path"] for e in index["endpoints"]}
+    assert listed == {f"/v1/debug/{n}" for n in DEBUG_ENDPOINTS}
+    for name in DEBUG_ENDPOINTS:
+        payload = client.request("GET", f"/v1/debug/{name}")
+        assert isinstance(payload, dict), name
+    for e in index["endpoints"]:
+        assert e["description"].strip()
+    # unknown debug routes still 404
+    with pytest.raises(RestError) as err:
+        client.request("GET", "/v1/debug/nonsense")
+    assert err.value.status == 404
+
+
+def test_flight_recorder_dispatch_records(served):
+    client, srv, db = served
+    for _ in range(3):
+        _graphql_search(client)
+    flight = client.request("GET", "/v1/debug/flight")
+    recs = [r for r in flight["dispatches"] if r["plane"] == "batcher"]
+    assert recs
+    r = recs[-1]
+    for field in ("batch", "k", "queue_depth", "wait_ms",
+                  "window_inflight", "epochs", "seq", "t"):
+        assert field in r, r
+    assert r["batch"] >= 1 and r["wait_ms"] >= 0
+    assert "slowlog" in flight and "snapshots" in flight
+
+
+def test_slo_engine_end_to_end(tmp_path, monkeypatch):
+    """Acceptance: injected latency drives the burn rate over threshold,
+    flips the component-health registry, and writes a flight-recorder
+    snapshot into the data dir."""
+    monkeypatch.setenv("WEAVIATE_TPU_SLO", json.dumps([
+        {"slo": "search-latency", "operation": "graphql",
+         "kind": "latency", "objective": 0.99, "threshold_ms": 5},
+        {"slo": "availability", "operation": "*",
+         "kind": "availability", "objective": 0.999},
+    ]))
+    monkeypatch.setenv("TRACE_SAMPLE_RATE", "0.001")
+    tracing.reset_policy_for_tests()
+    tailboard.reset_for_tests()
+    db = Database(str(tmp_path))  # wires the tailboard data dir
+    srv = RestServer(db)
+    srv.start()
+    client = Client(srv.address)
+    client.create_class({"name": "Tail"})
+    client.create_object("Tail", {},
+                         vector=[1.0, 0.0, 0.0, 0.0,
+                                 0.0, 0.0, 0.0, 0.0])
+    try:
+        _graphql_search(client)  # compile warm-up, un-injected
+        faultline.arm("batcher.dispatch", "latency", latency_s=0.02,
+                      every=1)
+        for _ in range(6):
+            _graphql_search(client)
+        faultline.disarm()
+        # the debug endpoint refreshes gauges AND runs the incident sweep
+        slo = client.request("GET", "/v1/debug/slo")
+        lat = next(s for s in slo["slos"] if s["slo"] == "search-latency")
+        fast = f"{int(slo['fastWindowSeconds'])}s"
+        assert lat["windows"][fast]["bad"] >= 6
+        assert lat["windows"][fast]["burnRate"] >= slo["burnThreshold"]
+        assert lat["burning"] is True
+        # component-health registry flipped (PR 8 wiring): visible to
+        # /v1/nodes consumers through degrade.health()
+        health = degrade.health()
+        assert "slo:search-latency" in health["unhealthy"]
+        assert "burn rate" in \
+            health["unhealthy"]["slo:search-latency"]["reason"]
+        # burn gauge republished over threshold
+        from weaviate_tpu.runtime.metrics import slo_burn_rate
+
+        g = slo_burn_rate.labels("search-latency", fast)
+        assert g.value >= slo["burnThreshold"]
+        # flight-recorder snapshot written into the data dir
+        snapdir = os.path.join(str(tmp_path), "flightrecorder")
+        assert os.path.isdir(snapdir)
+        snaps = [f for f in os.listdir(snapdir) if f.endswith(".json")]
+        assert snaps
+        with open(os.path.join(snapdir, sorted(snaps)[-1])) as f:
+            snap = json.load(f)
+        assert snap["reason"] == "slo:search-latency"
+        assert any(r["plane"] == "batcher" for r in snap["dispatches"])
+        assert snap["componentHealth"]["unhealthy"]
+        # availability SLO stayed clean: injected latency, not errors
+        avail = next(s for s in slo["slos"] if s["slo"] == "availability")
+        assert avail["burning"] is False
+        # recovery: fast traffic drains the bad fraction -> healthy again
+        eng = tailboard.slo_engine()
+        obj = next(o for o in eng._load()
+                   if o.name == "search-latency")
+        bucket = int(time.monotonic() // tailboard._BUCKET_S)
+        for _ in range(4000):
+            obj.record(bucket, True, eng.horizon_buckets())
+        eng.refresh()
+        assert "slo:search-latency" not in degrade.health()["unhealthy"]
+    finally:
+        faultline.disarm()
+        srv.stop()
+        db.close()
+        tracing.reset_policy_for_tests()
+
+
+def test_component_flip_writes_snapshot(tmp_path):
+    tailboard.reset_for_tests()
+    tailboard.set_data_dir(str(tmp_path))
+    tailboard.record_dispatch("batcher", batch=4, k=16, queue_depth=0,
+                              wait_ms=0.1, window_inflight=0, epochs=0)
+    degrade.mark_unhealthy("query_batcher:test", "dispatch failed twice")
+    try:
+        snapdir = os.path.join(str(tmp_path), "flightrecorder")
+        snaps = os.listdir(snapdir)
+        assert snaps
+        with open(os.path.join(snapdir, snaps[0])) as f:
+            snap = json.load(f)
+        assert snap["reason"] == "component:query_batcher:test"
+        assert snap["dispatches"][0]["batch"] == 4
+        # the cooldown suppresses a flapping component's snapshot spam
+        degrade.mark_healthy("query_batcher:test")
+        degrade.mark_unhealthy("query_batcher:test", "again")
+        assert len(os.listdir(snapdir)) == len(snaps)
+    finally:
+        degrade.mark_healthy("query_batcher:test")
+
+
+def test_mapped_client_error_is_not_an_availability_failure():
+    """The gRPC edge maps 4xx then context.abort() raises through the
+    timeline CM — a handled client error must neither count against the
+    availability SLO nor be tail-kept as 'error'."""
+    tailboard.reset_for_tests()
+    with pytest.raises(RuntimeError):
+        with tailboard.request("grpc.search"):
+            tailboard.complete(404)
+            raise RuntimeError("abort control flow")
+    assert tailboard.tail_traces() == []
+    tailboard.flush()
+    eng = tailboard.slo_engine()
+    avail = next(o for o in eng._load() if o.kind == "availability")
+    bucket = int(time.monotonic() // tailboard._BUCKET_S)
+    good, bad = avail.window_counts(bucket, 60)
+    assert (good, bad) == (1.0, 0.0)
+    # an UNMAPPED exception (no complete()) still counts as an error
+    with pytest.raises(RuntimeError):
+        with tailboard.request("grpc.search"):
+            raise RuntimeError("unhandled")
+    assert tailboard.tail_traces()[0]["reason"] == "error"
+    tailboard.flush()
+    good, bad = avail.window_counts(bucket, 60)
+    assert bad == 1.0
+
+
+# -- unit-level pieces --------------------------------------------------------
+
+
+def test_label_guard_top_k():
+    g = tailboard.LabelGuard(2)
+    assert g.clamp("a") == "a"
+    assert g.clamp("b") == "b"
+    assert g.clamp("c") == "other"
+    assert g.clamp("a") == "a"  # established values keep their series
+    assert g.clamp(None) == "-"
+    assert g.clamp("") == "-"
+
+
+def test_slow_threshold_per_operation(monkeypatch):
+    monkeypatch.setenv("WEAVIATE_TPU_TAIL_SLOW_MS",
+                       json.dumps({"grpc.*": 40, "objects": 10}))
+    tailboard.reset_for_tests()
+    assert tailboard.slow_threshold_s("objects") == pytest.approx(0.010)
+    assert tailboard.slow_threshold_s("grpc.search") == pytest.approx(0.040)
+    assert tailboard.slow_threshold_s("schema") == pytest.approx(0.250)
+    monkeypatch.setenv("WEAVIATE_TPU_TAIL_SLOW_MS", "75")
+    tailboard.reset_for_tests()
+    assert tailboard.slow_threshold_s("anything") == pytest.approx(0.075)
+
+
+def test_timeline_disabled_is_noop(monkeypatch):
+    monkeypatch.setenv("WEAVIATE_TPU_TAILBOARD", "0")
+    tailboard.reset_for_tests()
+    with tailboard.request("objects") as tl:
+        assert tl is None
+        tailboard.phase("device", 1.0)  # no live timeline: dropped
+        tailboard.complete(500)
+    assert tailboard.tail_traces() == []
+
+
+def test_standalone_trace_slow_is_tail_kept(monkeypatch):
+    """Direct tracing users (no edge timeline) still get tail-kept when
+    slow — on_trace_complete's standalone path."""
+    monkeypatch.setenv("WEAVIATE_TPU_TAIL_SLOW_MS", "1")
+    tailboard.reset_for_tests()
+    with tracing.trace("bulk.rebuild"):
+        time.sleep(0.01)
+    kept = tailboard.tail_traces()
+    assert kept and kept[0]["reason"] == "slow"
+    assert kept[0]["operation"] == "bulk.rebuild"
+
+
+def test_flight_ring_wraps_and_orders():
+    ring = tailboard.FlightRing(8)
+    for i in range(20):
+        ring.append({"i": i})
+    snap = ring.snapshot()
+    assert len(snap) == 8
+    assert [r["i"] for r in snap] == list(range(12, 20))

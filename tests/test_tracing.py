@@ -127,8 +127,23 @@ def test_record_span_and_slow_query_log(monkeypatch, caplog):
                                 batch=3)
     tr = tracing.recent_traces(1)[0]
     assert _spans(tr, "external.bit")[0]["attrs"]["batch"] == 3
-    assert any("slow query slow.root" in r.message
-               for r in caplog.records)
+    # the slow-root log is STRUCTURED (ISSUE 15 satellite): one line,
+    # machine-parseable, same record that lands in the flight recorder's
+    # slowlog ring
+    slow = [r.message for r in caplog.records
+            if r.message.startswith("slow_query ")]
+    assert slow, [r.message for r in caplog.records]
+    import json
+
+    rec = json.loads(slow[0].split(" ", 1)[1])
+    assert rec["root"] == "slow.root"
+    assert rec["trace_id"] == tr["trace_id"]
+    assert rec["duration_ms"] >= rec["threshold_ms"] == 1.0
+    assert any(s["name"] == "external.bit" for s in rec["spans"])
+    from weaviate_tpu.runtime import tailboard
+
+    entries = tailboard.debug_flight()["slowlog"]
+    assert any(e["trace_id"] == tr["trace_id"] for e in entries)
     tracing.reset_policy_for_tests()
 
 

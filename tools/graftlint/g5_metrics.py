@@ -66,6 +66,22 @@ _AMBIG_FIELD_RE = re.compile(
 #: device-attributed timing aliases that fork the ``device_ms`` schema
 _DEVICE_ALIAS_RE = re.compile(r"^(dev_ms|device_time_ms|device_timing_ms)$")
 
+# -- histogram conventions (ISSUE 15: the phase histograms made these
+#    load-bearing — ``le`` bucket bounds are SECONDS repo-wide, and the
+#    OpenMetrics exemplar grammar is part of the scrape wire format) ----------
+
+#: a TIMING histogram must be named ``*_seconds``: observe() feeds it
+#: perf_counter deltas in seconds and the declared ``le`` bounds are
+#: compared against those — a ``_ms`` (or unsuffixed) timing histogram
+#: either lies about its unit or its buckets silently never match
+_HISTOGRAM_SECONDS_RE = re.compile(r"_seconds$")
+
+#: exemplar line grammar for the runtime lint: `` # {labels} value [ts]``
+_EXEMPLAR_RE = re.compile(
+    r' # \{[a-zA-Z_][\w]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+    r'(?:,[a-zA-Z_][\w]*="(?:[^"\\\n]|\\\\|\\n|\\")*")*\} '
+    r"\S+( \S+)?$")
+
 
 # -- runtime lint (the lint_metrics seed, unchanged semantics) ----------------
 
@@ -95,6 +111,22 @@ def lint(registry=None) -> list[str]:
         if f"# HELP {name} " not in exposition \
                 or f"# TYPE {name} " not in exposition:
             problems.append(f"{name}: absent from the text exposition")
+        buckets = getattr(m, "buckets", None)
+        if buckets is not None and list(buckets) != sorted(set(buckets)):
+            problems.append(f"{name}: histogram buckets must be "
+                            "strictly ascending")
+    # OpenMetrics exemplar hygiene: every exemplar the registry renders
+    # must match the `` # {labels} value [ts]`` grammar with escaped
+    # label values — a malformed exemplar corrupts the whole scrape
+    try:
+        om = registry.expose(openmetrics=True)
+    except TypeError:  # foreign registry without the openmetrics flavor
+        om = ""
+    for ln in om.splitlines():
+        if ln.startswith("#") or " # {" not in ln:
+            continue
+        if not _EXEMPLAR_RE.search(ln):
+            problems.append(f"malformed OpenMetrics exemplar: {ln!r}")
     return problems
 
 
@@ -201,7 +233,10 @@ class MetricsConventionChecker(Checker):
                 f"metric {name!r} registered without HELP text — a "
                 "blank HELP is invisible until a dashboard goes blank"))
         if _TIMEY_NAME_RE.search(name) \
-                and not _UNIT_SUFFIX_RE.search(name):
+                and not _UNIT_SUFFIX_RE.search(name) \
+                and call.func.attr != "histogram":
+            # histograms get the STRICTER *_seconds rule below instead —
+            # one finding per site, not two
             help_txt = (help_node.value
                         if isinstance(help_node, ast.Constant)
                         and isinstance(help_node.value, str) else "")
@@ -223,4 +258,45 @@ class MetricsConventionChecker(Checker):
                         ctx, el,
                         f"metric {name!r} label {el.value!r} is not "
                         "snake_case"))
+        if call.func.attr == "histogram":
+            out.extend(self._check_histogram(ctx, call, name, name_node,
+                                             args, kwargs))
+        return out
+
+    def _check_histogram(self, ctx, call: ast.Call, name: str, name_node,
+                         args, kwargs) -> list[Violation]:
+        """Histogram-only conventions: timing histograms are ``*_seconds``
+        (``le`` bucket bounds are seconds repo-wide — observe() feeds
+        perf_counter deltas), and literal bucket sets are declared
+        strictly ascending (the child slots each observation by
+        ``bisect_left`` over the declared tuple, so a misordered or
+        duplicated bound lands observations in the wrong slot and the
+        cumulative exposition miscounts silently)."""
+        out = []
+        if _TIMEY_NAME_RE.search(name) \
+                and not _HISTOGRAM_SECONDS_RE.search(name):
+            out.append(self._violation(
+                ctx, name_node,
+                f"timing histogram {name!r} must be named '*_seconds' — "
+                "its le bucket bounds are seconds by repo convention "
+                "(DEFAULT_BUCKETS, _Timer.observe); a _ms or unsuffixed "
+                "timing histogram either lies about its unit or its "
+                "buckets never match"))
+        buckets_node = (args[3] if len(args) > 3 else kwargs.get("buckets"))
+        if isinstance(buckets_node, (ast.Tuple, ast.List)):
+            vals = []
+            for el in buckets_node.elts:
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, (int, float)) \
+                        and not isinstance(el.value, bool):
+                    vals.append(float(el.value))
+                else:
+                    return out  # dynamic bucket expr — runtime lint's job
+            if vals != sorted(set(vals)):
+                out.append(self._violation(
+                    ctx, buckets_node,
+                    f"histogram {name!r} buckets must be declared "
+                    "strictly ascending — a misordered or duplicated "
+                    "bound miscounts observations and breaks le-based "
+                    "quantile math in dashboards"))
         return out

@@ -343,6 +343,11 @@ class GraphQLExecutor:
         limit = int(args.get("limit", 25))
         offset = int(args.get("offset", 0))
         tenant = args.get("tenant")
+        # identity for the always-on phase histograms (tailboard top-K
+        # guard clamps the label values)
+        from weaviate_tpu.runtime import tailboard
+
+        tailboard.annotate(collection=f.name, tenant=tenant)
         autocut = int(args.get("autocut", 0))
         where = self._parse_where(args.get("where"))
         k = limit + offset

@@ -37,7 +37,7 @@ import numpy as np
 
 from weaviate_tpu.api.grpc import v1_pb2 as pb
 from weaviate_tpu.native import dataplane as dpn
-from weaviate_tpu.runtime import degrade
+from weaviate_tpu.runtime import degrade, tailboard
 from weaviate_tpu.runtime.transfer import TransferPipeline
 
 logger = logging.getLogger(__name__)
@@ -324,6 +324,14 @@ class NativeDataPlane:
         (``objects_by_doc_ids`` -> ``kv.get_many``) and seed the cache
         so the next occurrence of those docs never leaves C++."""
         miss = self.dp.post_batch(batch, ids, dists, counts, took)
+        # flight-recorder record for the native plane's dispatch loop —
+        # the C++ fast path has no per-request Python, so per-BATCH
+        # records are its only always-on attribution
+        tailboard.record_dispatch(
+            "native", batch=int(len(batch.tokens)),
+            k=int(batch.ks.max()) if len(batch.ks) else 0,
+            took_ms=round(took * 1000.0, 3), cache_misses=int(len(miss)),
+            window_inflight=self._transfer.inflight)
         if len(miss) == 0:
             return
         tok_pos = {int(t): i for i, t in enumerate(batch.tokens)}
@@ -380,28 +388,43 @@ class NativeDataPlane:
 
         req_type = _REQ_TYPES[method]
         ctx = _Ctx()
-        try:
-            req = req_type.FromString(item.payload)
-            reply = handler(req, ctx)
-            self.dp.post_raw(item.token, reply.SerializeToString())
-            # a Search that fell back on an unregistered collection
-            # registers it so the NEXT plain query takes the fast path
-            if method == "Search" and req.collection:
-                self._maybe_register(req.collection)
-        except (_Ctx.Abort, ApiError) as e:
-            code = e.code.value[0] if hasattr(e.code, "value") else int(e.code)
-            self.dp.post_raw(item.token, b"", code, str(e.message))
-        except KeyError as e:
-            self.dp.post_raw(item.token, b"",
-                             grpc.StatusCode.NOT_FOUND.value[0], str(e))
-        except ValueError as e:
-            self.dp.post_raw(
-                item.token, b"",
-                grpc.StatusCode.INVALID_ARGUMENT.value[0], str(e))
-        except Exception as e:  # noqa: BLE001
-            logger.exception("fallback handler failed")
-            self.dp.post_raw(item.token, b"",
-                             grpc.StatusCode.INTERNAL.value[0], str(e))
+        # fallback requests bypass GrpcServer._wrap, so they open their
+        # own always-on timeline (the fast path is per-batch C++ and is
+        # covered by the flight recorder instead)
+        with tailboard.request(f"grpc.{method.lower()}"):
+            try:
+                req = req_type.FromString(item.payload)
+                reply = handler(req, ctx)
+                tailboard.complete(200)
+                self.dp.post_raw(item.token, reply.SerializeToString())
+                # a Search that fell back on an unregistered collection
+                # registers it so the NEXT plain query takes the fast path
+                if method == "Search" and req.collection:
+                    self._maybe_register(req.collection)
+            except (_Ctx.Abort, ApiError) as e:
+                code = e.code.value[0] if hasattr(e.code, "value") \
+                    else int(e.code)
+                # same gRPC->HTTP-ish mapping as the wrapped edge, so
+                # UNAVAILABLE/DEADLINE failures count against the SLO
+                # here too instead of masquerading as client errors
+                from weaviate_tpu.api.grpc.server import GrpcServer
+
+                tailboard.complete(GrpcServer._grpc_http_status(e.code))
+                self.dp.post_raw(item.token, b"", code, str(e.message))
+            except KeyError as e:
+                tailboard.complete(404)
+                self.dp.post_raw(item.token, b"",
+                                 grpc.StatusCode.NOT_FOUND.value[0], str(e))
+            except ValueError as e:
+                tailboard.complete(422)
+                self.dp.post_raw(
+                    item.token, b"",
+                    grpc.StatusCode.INVALID_ARGUMENT.value[0], str(e))
+            except Exception as e:  # noqa: BLE001
+                logger.exception("fallback handler failed")
+                tailboard.complete(500)
+                self.dp.post_raw(item.token, b"",
+                                 grpc.StatusCode.INTERNAL.value[0], str(e))
 
 
 class _Res:

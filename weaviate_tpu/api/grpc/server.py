@@ -347,6 +347,33 @@ class GrpcServer:
 
     # -- plumbing -----------------------------------------------------------
 
+    @staticmethod
+    def _grpc_http_status(code) -> int:
+        """gRPC status -> HTTP-ish status for the tailboard's SLO/tail
+        accounting (>=500 counts against availability). Client-caused
+        codes must land BELOW 500 — UNIMPLEMENTED (nearText without a
+        vectorizer module) and FAILED_PRECONDITION (tenant ops on a
+        non-MT collection) are request mistakes, not server failures,
+        and a stream of them must not page the availability SLO."""
+        try:
+            return {
+                grpc.StatusCode.UNAUTHENTICATED: 401,
+                grpc.StatusCode.PERMISSION_DENIED: 403,
+                grpc.StatusCode.NOT_FOUND: 404,
+                grpc.StatusCode.ALREADY_EXISTS: 409,
+                grpc.StatusCode.ABORTED: 409,
+                grpc.StatusCode.INVALID_ARGUMENT: 422,
+                grpc.StatusCode.OUT_OF_RANGE: 422,
+                grpc.StatusCode.FAILED_PRECONDITION: 422,
+                grpc.StatusCode.UNIMPLEMENTED: 422,
+                grpc.StatusCode.CANCELLED: 499,
+                grpc.StatusCode.RESOURCE_EXHAUSTED: 503,
+                grpc.StatusCode.UNAVAILABLE: 503,
+                grpc.StatusCode.DEADLINE_EXCEEDED: 504,
+            }.get(code, 500)
+        except TypeError:  # unhashable stub in tests
+            return 500
+
     def _wrap(self, fn, verb: str = "write", rpc_name: str = "rpc"):
         from weaviate_tpu.runtime import tracing
 
@@ -385,43 +412,58 @@ class GrpcServer:
             if expired:
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
                               "deadline expired before handling began")
-            try:
-                # auth precedes the trace: rejected clients must not be
-                # able to fill the debug-trace ring
-                self._check_auth(context, verb)
-                from weaviate_tpu.runtime import degrade
+            from weaviate_tpu.runtime import tailboard
 
-                with tracing.trace(f"grpc.{rpc_name}", force=force), \
-                        retry.deadline(budget), degrade.collecting():
-                    reply = fn(request, context)
-                    # a degraded (partial) answer must be visible on
-                    # the gRPC surface too: marker list rides trailing
-                    # metadata (protos carry no spare field for it)
-                    markers = degrade.snapshot()
-                    if markers:
-                        import json as _json
+            # always-on timeline (tailboard): the rpc name is the
+            # operation label; complete() runs BEFORE each abort (abort
+            # raises) so the tail keep/drop decision sees the status
+            with tailboard.request(f"grpc.{rpc_name.lower()}"):
+                try:
+                    # auth precedes the trace: rejected clients must not
+                    # be able to fill the debug-trace ring
+                    self._check_auth(context, verb)
+                    from weaviate_tpu.runtime import degrade
 
-                        try:
-                            context.set_trailing_metadata((
-                                ("x-degraded", _json.dumps(markers)),))
-                        except Exception:  # noqa: BLE001 — stubbed ctx
-                            pass
-                    return reply
-            except ApiError as e:
-                context.abort(e.code, e.message)
-            except KeyError as e:
-                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
-            except ValueError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            except retry.DeadlineExceeded as e:
-                # typed: the budget ran out mid-flight — not INTERNAL
-                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
-            except (retry.OverloadedError, CircuitOpenError) as e:
-                # retriable overload / open breaker: clients back off
-                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
-            except Exception as e:  # noqa: BLE001 — surface as INTERNAL
-                logger.exception("grpc handler failed")
-                context.abort(grpc.StatusCode.INTERNAL, str(e))
+                    with tracing.trace(f"grpc.{rpc_name}", force=force), \
+                            retry.deadline(budget), degrade.collecting():
+                        reply = fn(request, context)
+                        # a degraded (partial) answer must be visible on
+                        # the gRPC surface too: marker list rides
+                        # trailing metadata (protos carry no spare field
+                        # for it)
+                        markers = degrade.snapshot()
+                        if markers:
+                            import json as _json
+
+                            try:
+                                context.set_trailing_metadata((
+                                    ("x-degraded", _json.dumps(markers)),))
+                            except Exception:  # noqa: BLE001 — stubbed ctx
+                                pass
+                        tailboard.complete(200, degraded=bool(markers))
+                        return reply
+                except ApiError as e:
+                    tailboard.complete(self._grpc_http_status(e.code))
+                    context.abort(e.code, e.message)
+                except KeyError as e:
+                    tailboard.complete(404)
+                    context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                except ValueError as e:
+                    tailboard.complete(422)
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                except retry.DeadlineExceeded as e:
+                    # typed: budget ran out mid-flight — not INTERNAL
+                    tailboard.complete(504)
+                    context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                  str(e))
+                except (retry.OverloadedError, CircuitOpenError) as e:
+                    # retriable overload / open breaker: clients back off
+                    tailboard.complete(503)
+                    context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                except Exception as e:  # noqa: BLE001 — INTERNAL
+                    logger.exception("grpc handler failed")
+                    tailboard.complete(500)
+                    context.abort(grpc.StatusCode.INTERNAL, str(e))
         return handler
 
     def _check_auth(self, context, verb: str):
@@ -448,6 +490,11 @@ class GrpcServer:
         start = time.perf_counter()
         col = self._collection(req.collection)
         tenant = req.tenant or None
+        # identity for the always-on phase histograms (tailboard top-K
+        # guard clamps the label values)
+        from weaviate_tpu.runtime import tailboard
+
+        tailboard.annotate(collection=req.collection, tenant=tenant)
         limit = req.limit or 10
         where = filters_from_pb(req.filters) if req.HasField("filters") else None
         autocut = req.autocut

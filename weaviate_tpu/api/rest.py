@@ -27,7 +27,8 @@ API_VERSION = "1.25.2"
 from weaviate_tpu.cluster.transport import CircuitOpenError
 from weaviate_tpu.db.shard import ShardReadOnlyError
 from weaviate_tpu.filters.filters import Filter
-from weaviate_tpu.runtime import degrade, faultline, retry, tracing
+from weaviate_tpu.runtime import (degrade, faultline, retry, tailboard,
+                                  tracing)
 from weaviate_tpu.runtime.memwatch import InsufficientMemoryError
 from weaviate_tpu.schema.config import CollectionConfig, Property
 
@@ -64,6 +65,39 @@ _ROUTE_CLASSES = frozenset((
 # traces from the debug ring — they are not traced unless forced
 _UNTRACED_ROUTES = frozenset(
     (".well-known", "meta", "metrics", "nodes", "debug", "unmatched"))
+
+
+# the debug surface, declaratively: this table drives BOTH dispatch and
+# the GET /v1/debug index, so an endpoint cannot exist without being
+# listed (tests assert the round trip). Keys are the /v1/debug/<name>
+# path segment.
+DEBUG_ENDPOINTS = {
+    "traces": "Finished-trace ring (newest first; ?limit=N). "
+              "?tail=true serves the tail-retained ring instead: slow, "
+              "errored, deadline-exceeded, degraded and fault-injected "
+              "requests kept at completion regardless of "
+              "TRACE_SAMPLE_RATE, with per-phase timings.",
+    "memory": "HBM ledger breakdown: per-collection/shard/component "
+              "device bytes, allocator-vs-ledger delta, admission "
+              "watermarks and pressure state.",
+    "storage": "Per-bucket crash-recovery reports from the last open: "
+               "WAL frames replayed, torn tails truncated, files "
+               "quarantined .corrupt, segments rebuilt.",
+    "replication": "Anti-entropy convergence: hashbeat rounds, "
+                   "divergent-entry estimates, staged-2PC state and "
+                   "breaker/peer health per replicated shard.",
+    "perf": "Last benchkeeper perf-gate verdict: per-entry values, "
+            "deltas vs the reasoned baseline, regressions/stale/"
+            "missing counts.",
+    "slo": "SLO engine state: per-objective availability/latency "
+           "windows, good/bad counts, multi-window burn rates, and "
+           "which objectives are currently burning.",
+    "flight": "Flight recorder: recent batcher and native-plane "
+              "dispatch records (batch size, k bucket, queue depth, "
+              "wait, epoch fanout, transfer-window occupancy), the "
+              "structured slow-query log, and on-disk incident "
+              "snapshots.",
+}
 
 
 def _route_class(path: str) -> str:
@@ -427,92 +461,123 @@ class RestServer:
                         budget = float(raw_budget)
                 except ValueError:
                     budget = outer.query_deadline_s
+                # content negotiation for /v1/metrics (OpenMetrics with
+                # exemplars) rides params — dispatch has no header access
+                accept = self.headers.get("Accept", "")
+                if "application/openmetrics-text" in accept:
+                    params["_accept_openmetrics"] = "true"
                 extra_headers: dict[str, str] = {}
                 markers: list = []
-                try:
-                    if outer.auth is not None and \
-                            not parsed.path.startswith("/.well-known"):
-                        from weaviate_tpu.auth import (
-                            AuthError,
-                            ForbiddenError,
-                        )
+                # always-on timeline (tailboard): opened for the same
+                # request set tracing covers; wraps the WHOLE handling
+                # INCLUDING the error mapping below, so the tail-based
+                # keep/drop decision sees the response status
+                timeline_cm = (
+                    contextlib.nullcontext()
+                    if route in _UNTRACED_ROUTES and not force
+                    else tailboard.request(route, method=method))
+                def _handle():
+                    nonlocal markers
+                    try:
+                        if outer.auth is not None and \
+                                not parsed.path.startswith("/.well-known"):
+                            from weaviate_tpu.auth import (
+                                AuthError,
+                                ForbiddenError,
+                            )
 
-                        # POST /v1/graphql is query-only (this API has
-                        # no mutations) — same verb as gRPC Search
-                        verb = "read" if method in ("GET", "HEAD") \
-                            or parsed.path == "/v1/graphql" else "write"
-                        try:
-                            outer.auth.check(
-                                self.headers.get("Authorization"),
-                                verb)
-                        except AuthError as e:
-                            raise ApiError(401, str(e))
-                        except ForbiddenError as e:
-                            raise ApiError(403, str(e))
-                    with trace_cm, retry.deadline(budget), \
-                            degrade.collecting(), \
-                            faultline.node_scope(outer.db.local_node):
-                        body = json.loads(raw) if raw else None
-                        status, payload = outer.dispatch(
-                            method, parsed.path, params, body)
-                        # explicit partial-result marker: a degraded
-                        # scatter-gather or downgraded-consistency read
-                        # must be visible to the client, not silent
-                        markers = degrade.snapshot()
-                        if markers and isinstance(payload, dict):
-                            payload["degraded"] = markers
-                except ApiError as e:
-                    status, payload = e.status, {"error": [{"message": e.message}]}
-                except (KeyError, FileNotFoundError) as e:
-                    status, payload = 404, {"error": [{"message": str(e)}]}
-                except ValueError as e:
-                    status, payload = 422, {"error": [{"message": str(e)}]}
-                except ShardReadOnlyError as e:
-                    status, payload = 422, {"error": [{"message": str(e)}]}
-                except InsufficientMemoryError as e:
-                    # typed 507 Insufficient Storage: admission control
-                    # refused BEFORE allocating (memwatch watermarks) —
-                    # the client should back off or free capacity, not
-                    # retry blindly
-                    status, payload = 507, {"error": [{
-                        "message": str(e),
-                        "code": "INSUFFICIENT_MEMORY",
-                        "projectedBytes": e.projected,
-                        "budgetBytes": e.budget,
-                        "usageSource": e.source,
-                    }]}
-                except retry.DeadlineExceeded as e:
-                    # typed 504: the request's time budget ran out — not
-                    # a generic 500, so clients/gateways can distinguish
-                    # "took too long" from "broke"
-                    status, payload = 504, {"error": [{
-                        "message": str(e),
-                        "code": "DEADLINE_EXCEEDED",
-                        "layer": e.layer,
-                    }]}
-                except retry.OverloadedError as e:
-                    # RFC 9110: integer delta-seconds (fractions would
-                    # be ignored by conforming clients), floor of 1
-                    extra_headers["Retry-After"] = \
-                        str(max(1, -(-int(e.retry_after_s * 1000) // 1000)))
-                    status, payload = 503, {"error": [{
-                        "message": str(e),
-                        "code": "OVERLOADED",
-                    }]}
-                except CircuitOpenError as e:
-                    # the whole request depended on a peer whose breaker
-                    # is open (e.g. an unreplicated remote shard write):
-                    # retriable 503 with the breaker's cooldown hint
-                    # (integer delta-seconds per RFC 9110, floor of 1)
-                    extra_headers["Retry-After"] = \
-                        str(max(1, -(-int(e.retry_after_s * 1000) // 1000)))
-                    status, payload = 503, {"error": [{
-                        "message": str(e),
-                        "code": "CIRCUIT_OPEN",
-                    }]}
-                except Exception as e:
-                    logger.exception("REST %s %s failed", method, self.path)
-                    status, payload = 500, {"error": [{"message": str(e)}]}
+                            # POST /v1/graphql is query-only (this API
+                            # has no mutations) — same verb as gRPC
+                            # Search
+                            verb = "read" if method in ("GET", "HEAD") \
+                                or parsed.path == "/v1/graphql" else "write"
+                            try:
+                                outer.auth.check(
+                                    self.headers.get("Authorization"),
+                                    verb)
+                            except AuthError as e:
+                                raise ApiError(401, str(e))
+                            except ForbiddenError as e:
+                                raise ApiError(403, str(e))
+                        with trace_cm, retry.deadline(budget), \
+                                degrade.collecting(), \
+                                faultline.node_scope(outer.db.local_node):
+                            body = json.loads(raw) if raw else None
+                            status, payload = outer.dispatch(
+                                method, parsed.path, params, body)
+                            # explicit partial-result marker: a degraded
+                            # scatter-gather or downgraded-consistency
+                            # read must be visible to the client, never
+                            # silent
+                            markers = degrade.snapshot()
+                            if markers and isinstance(payload, dict):
+                                payload["degraded"] = markers
+                        return status, payload
+                    except ApiError as e:
+                        return e.status, {"error": [{"message": e.message}]}
+                    except (KeyError, FileNotFoundError) as e:
+                        return 404, {"error": [{"message": str(e)}]}
+                    except ValueError as e:
+                        return 422, {"error": [{"message": str(e)}]}
+                    except ShardReadOnlyError as e:
+                        return 422, {"error": [{"message": str(e)}]}
+                    except InsufficientMemoryError as e:
+                        # typed 507 Insufficient Storage: admission
+                        # control refused BEFORE allocating (memwatch
+                        # watermarks) — the client should back off or
+                        # free capacity, not retry blindly
+                        return 507, {"error": [{
+                            "message": str(e),
+                            "code": "INSUFFICIENT_MEMORY",
+                            "projectedBytes": e.projected,
+                            "budgetBytes": e.budget,
+                            "usageSource": e.source,
+                        }]}
+                    except retry.DeadlineExceeded as e:
+                        # typed 504: the request's time budget ran out —
+                        # not a generic 500, so clients/gateways can
+                        # distinguish "took too long" from "broke"
+                        return 504, {"error": [{
+                            "message": str(e),
+                            "code": "DEADLINE_EXCEEDED",
+                            "layer": e.layer,
+                        }]}
+                    except retry.OverloadedError as e:
+                        # RFC 9110: integer delta-seconds (fractions
+                        # would be ignored by conforming clients),
+                        # floor of 1
+                        extra_headers["Retry-After"] = \
+                            str(max(1,
+                                    -(-int(e.retry_after_s * 1000) // 1000)))
+                        return 503, {"error": [{
+                            "message": str(e),
+                            "code": "OVERLOADED",
+                        }]}
+                    except CircuitOpenError as e:
+                        # the whole request depended on a peer whose
+                        # breaker is open (e.g. an unreplicated remote
+                        # shard write): retriable 503 with the breaker's
+                        # cooldown hint (integer delta-seconds per
+                        # RFC 9110, floor of 1)
+                        extra_headers["Retry-After"] = \
+                            str(max(1,
+                                    -(-int(e.retry_after_s * 1000) // 1000)))
+                        return 503, {"error": [{
+                            "message": str(e),
+                            "code": "CIRCUIT_OPEN",
+                        }]}
+                    except Exception as e:
+                        logger.exception("REST %s %s failed", method,
+                                         self.path)
+                        return 500, {"error": [{"message": str(e)}]}
+
+                with timeline_cm:
+                    # the error mapping runs INSIDE the timeline (and the
+                    # trace closes inside _handle), so the tail keep/drop
+                    # decision sees both the finished trace AND the
+                    # response status
+                    status, payload = _handle()
+                    tailboard.complete(status, degraded=bool(markers))
                 if isinstance(payload, RawResponse):
                     self.send_response(status)
                     self.send_header("Content-Type", payload.content_type)
@@ -607,51 +672,19 @@ class RestServer:
                          "modules": self.modules.meta()
                          if self.modules is not None else {}}
         if seg == ["metrics"]:
-            # real Prometheus text exposition (the reference serves text
-            # on the monitoring port; serving it here too lets Prometheus
+            # real Prometheus exposition (the reference serves text on
+            # the monitoring port; serving it here too lets Prometheus
             # scrape either port). A JSON wrapper would not parse.
-            from weaviate_tpu.runtime import perfgate
-            from weaviate_tpu.runtime.metrics import registry
+            # OpenMetrics negotiation (Accept header, or ?format=) gets
+            # exemplar-carrying buckets + the # EOF terminator; the
+            # shared scrape() helper runs the read-point refreshes
+            from weaviate_tpu.runtime.metrics import scrape
 
-            # pick up a fresh benchkeeper verdict so a scrape-only
-            # Prometheus setup sees the perf-gate gauges (mtime-cached;
-            # must never fail the scrape)
-            try:
-                perfgate.refresh()
-            except Exception:
-                pass
-            # scrape-time per-host HBM refresh: the host split depends
-            # on live totals, so recompute here (never fail the scrape)
-            try:
-                from weaviate_tpu.runtime.hbm_ledger import ledger
-
-                ledger.refresh_host_gauge()
-            except Exception:
-                pass
-            return 200, RawResponse(
-                registry.expose().encode(),
-                "text/plain; version=0.0.4; charset=utf-8")
-        if seg == ["debug", "memory"]:
-            return 200, self._debug_memory()
-        if seg == ["debug", "storage"]:
-            return 200, self._debug_storage()
-        if seg == ["debug", "replication"]:
-            return 200, self._debug_replication()
-        if seg == ["debug", "perf"]:
-            # last benchkeeper gate verdict + per-section trend deltas
-            # (tools/benchkeeper persists the artifact; perfgate loads
-            # it and republishes the weaviate_tpu_bench_* gauges)
-            from weaviate_tpu.runtime import perfgate
-
-            return 200, perfgate.snapshot()
-        if seg == ["debug", "traces"]:
-            # finished-trace ring buffer (tracing tentpole; sampled
-            # traces carry device_ms attribution)
-            try:
-                limit = int(params.get("limit", 50))
-            except ValueError:
-                raise ApiError(422, "limit must be an integer")
-            return 200, {"traces": tracing.recent_traces(limit)}
+            om = (params.get("_accept_openmetrics") == "true"
+                  or params.get("format") == "openmetrics")
+            return 200, RawResponse(*scrape(openmetrics=om))
+        if seg[:1] == ["debug"]:
+            return self._debug(seg[1:], params)
         if seg == ["nodes"]:
             verbose = params.get("output") == "verbose"
             return 200, {"nodes": self._nodes_payload(verbose=verbose)}
@@ -958,6 +991,49 @@ class RestServer:
             raise ApiError(422, str(e))
         raise KeyError("/v1/backups/" + "/".join(seg))
 
+    def _debug(self, seg: list[str], params: dict):
+        """The /v1/debug surface. ``GET /v1/debug`` is the index: every
+        endpoint in :data:`DEBUG_ENDPOINTS` with a one-line description
+        (the same table this dispatcher routes by, so listing and
+        serving cannot drift apart)."""
+        if not seg:
+            return 200, {"endpoints": [
+                {"path": f"/v1/debug/{name}", "description": desc}
+                for name, desc in sorted(DEBUG_ENDPOINTS.items())]}
+        name = seg[0]
+        if seg[1:] or name not in DEBUG_ENDPOINTS:
+            raise KeyError("/v1/debug/" + "/".join(seg))
+        if name == "memory":
+            return 200, self._debug_memory()
+        if name == "storage":
+            return 200, self._debug_storage()
+        if name == "replication":
+            return 200, self._debug_replication()
+        if name == "perf":
+            # last benchkeeper gate verdict + per-section trend deltas
+            # (tools/benchkeeper persists the artifact; perfgate loads
+            # it and republishes the weaviate_tpu_bench_* gauges)
+            from weaviate_tpu.runtime import perfgate
+
+            return 200, perfgate.snapshot()
+        if name == "slo":
+            # objectives + sliding-window burn rates (refreshes the
+            # weaviate_tpu_slo_burn_rate gauges + incident sweep)
+            return 200, tailboard.debug_slo()
+        if name == "flight":
+            # dispatch-record ring + structured slowlog + snapshots
+            return 200, tailboard.debug_flight()
+        # traces: the finished-trace ring (tracing tentpole; sampled
+        # traces carry device_ms attribution), or — ?tail=true — the
+        # tail-retained ring the keep-at-completion decision feeds
+        try:
+            limit = int(params.get("limit", 50))
+        except ValueError:
+            raise ApiError(422, "limit must be an integer")
+        if params.get("tail") == "true":
+            return 200, {"traces": tailboard.tail_traces(limit)}
+        return 200, {"traces": tracing.recent_traces(limit)}
+
     def _debug_memory(self) -> dict:
         """GET /v1/debug/memory: the HBM ledger's labeled breakdown —
         top allocations, per-collection rollup, and (when the backend
@@ -1256,6 +1332,12 @@ class RestServer:
 
     def _objects(self, method: str, seg: list[str], params: dict, body):
         tenant = params.get("tenant")
+        # collection/tenant identity for the always-on phase histograms
+        # (label values pass the tailboard's top-K cardinality guard)
+        if len(seg) >= 2 and seg[0] != "validate":
+            tailboard.annotate(collection=seg[0], tenant=tenant)
+        elif tenant:
+            tailboard.annotate(tenant=tenant)
         if not seg:
             if method == "GET":
                 return self._list_objects(params)
@@ -1336,6 +1418,8 @@ class RestServer:
         class_name = body.get("class") or body.get("collection")
         if not class_name:
             raise ApiError(422, "object is missing a class")
+        tailboard.annotate(collection=class_name,
+                           tenant=tenant or body.get("tenant"))
         col = self.db.get_collection(class_name)
         spec = {"properties": body.get("properties", {}),
                 "vector": body.get("vector"), "vectors": body.get("vectors")}

@@ -86,6 +86,12 @@ class ServerConfig:
     # observability
     prometheus_enabled: bool = False
     prometheus_port: int = 2112
+    # tailboard (always-on latency attribution): the per-request phase
+    # timeline can be disabled wholesale (bench A/B, emergencies); SLO
+    # objectives are a JSON list (WEAVIATE_TPU_SLO) overriding the
+    # built-in availability/latency defaults — see runtime/tailboard.py
+    tailboard_enabled: bool = True
+    slo_config: str = ""
     profiling_port: int = 0  # 0 = profiler server off (PROFILING_PORT)
     log_level: str = "info"
     log_format: str = "text"
@@ -134,6 +140,8 @@ class ServerConfig:
             auto_schema_enabled=_flag(env, "AUTOSCHEMA_ENABLED", True),
             prometheus_enabled=_flag(env, "PROMETHEUS_MONITORING_ENABLED"),
             prometheus_port=_int(env, "PROMETHEUS_MONITORING_PORT", 2112),
+            tailboard_enabled=_flag(env, "WEAVIATE_TPU_TAILBOARD", True),
+            slo_config=env.get("WEAVIATE_TPU_SLO", ""),
             profiling_port=_int(env, "PROFILING_PORT", 0),
             log_level=env.get("LOG_LEVEL", "info"),
             log_format=env.get("LOG_FORMAT", "text"),

@@ -27,6 +27,12 @@ class Database:
                  sync_wal: bool | None = None):
         self.data_dir = data_dir
         self.mesh = mesh
+        # incident flight-recorder snapshots (tailboard) follow the data
+        # dir of the most recently opened database — embedded/test use
+        # gets on-disk snapshots without Server wiring
+        from weaviate_tpu.runtime import tailboard
+
+        tailboard.set_data_dir(data_dir)
         # host-count hint for scrape-time hbm_host_bytes refreshes
         from weaviate_tpu.parallel.mesh import host_count
         from weaviate_tpu.runtime.hbm_ledger import ledger as _hbm_ledger

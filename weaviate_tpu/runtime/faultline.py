@@ -348,6 +348,15 @@ def _record(point: str, action: str, attrs: dict) -> None:
         tracing.annotate(fault_point=point, fault_action=action, **extra)
     except Exception:  # pragma: no cover
         pass
+    try:
+        from weaviate_tpu.runtime import tailboard
+
+        # tail retention: a fault fired on the REQUEST thread marks the
+        # live timeline directly; worker-thread injections are found by
+        # the armed-only span scan at completion instead
+        tailboard.note_fault()
+    except Exception:  # pragma: no cover
+        pass
 
 
 # -- topology faults: partitions over (src, dst) node pairs --------------------

@@ -8,7 +8,45 @@ with label vectors and the /metrics text format.
 
 from __future__ import annotations
 
+import os
 import threading
+import time
+from bisect import bisect_left
+
+# -- registry-level label-cardinality guard (ISSUE 15 satellite) --------------
+#
+# An adversarial (or just unbounded) tenant/collection stream must not be
+# able to grow the exposition without bound: past the per-metric series
+# cap, NEW label tuples collapse into one reserved all-``other`` series
+# and the redirect is counted in
+# ``weaviate_tpu_metric_series_dropped_total{metric}``. Existing series
+# keep updating — the cap bounds growth, it never forgets live series.
+
+_SERIES_CAP: int | None = None  # lazy env read (None = unread)
+
+
+def _series_cap() -> int:
+    global _SERIES_CAP
+    if _SERIES_CAP is None:
+        try:
+            _SERIES_CAP = int(os.environ.get(
+                "WEAVIATE_TPU_METRIC_MAX_SERIES", "2000"))
+        except ValueError:
+            _SERIES_CAP = 2000
+    return _SERIES_CAP
+
+
+def reset_series_cap_for_tests() -> None:
+    """Re-read WEAVIATE_TPU_METRIC_MAX_SERIES on next use."""
+    global _SERIES_CAP
+    _SERIES_CAP = None
+
+
+def _count_series_dropped(metric_name: str) -> None:
+    try:
+        metric_series_dropped.labels(metric_name).inc()
+    except Exception:  # registration order — must never fail callers
+        pass
 
 
 def escape_label_value(v) -> str:
@@ -33,6 +71,15 @@ class _Metric:
         self.label_names = tuple(label_names)
         self._lock = threading.Lock()
         self._children: dict[tuple, object] = {}
+        # reserved overflow series for the cardinality guard (the guard
+        # itself is exempt — its one label is metric names, bounded)
+        self._overflow = tuple("other" for _ in self.label_names)
+        self._guarded = bool(self.label_names) and \
+            name != "weaviate_tpu_metric_series_dropped_total"
+        # per-metric cap override (None = the registry-wide env cap):
+        # a metric whose label budget is deliberately larger than the
+        # generic default (the tailboard phase histogram) sets this
+        self.max_series: int | None = None
 
     def labels(self, *values, **kw):
         if kw:
@@ -41,12 +88,25 @@ class _Metric:
             values = tuple(str(v) for v in values)
         if len(values) != len(self.label_names):
             raise ValueError(f"{self.name}: expected labels {self.label_names}")
+        dropped = False
         with self._lock:
             child = self._children.get(values)
             if child is None:
-                child = self._new_child()
-                self._children[values] = child
-            return child
+                cap = (self.max_series if self.max_series is not None
+                       else _series_cap())
+                if (self._guarded and values != self._overflow
+                        and len(self._children) >= cap):
+                    # cardinality guard: redirect the NEW tuple into the
+                    # reserved all-"other" series instead of growing
+                    dropped = True
+                    values = self._overflow
+                    child = self._children.get(values)
+                if child is None:
+                    child = self._new_child()
+                    self._children[values] = child
+        if dropped:
+            _count_series_dropped(self.name)
+        return child
 
     def _default(self):
         if self.label_names:
@@ -89,9 +149,16 @@ class Counter(_Metric):
     def inc(self, amount: float = 1.0):
         self._default().inc(amount)
 
-    def expose(self) -> list[str]:
-        out = [f"# HELP {self.name} {_escape_help(self.help)}",
-               f"# TYPE {self.name} counter"]
+    def expose(self, openmetrics: bool = False) -> list[str]:
+        # OpenMetrics names the FAMILY without the reserved _total
+        # suffix while samples keep it — a strict OM parser (real
+        # Prometheus negotiating openmetrics-text) rejects a family
+        # ending in _total; 0.0.4 text keeps the historical full name
+        family = self.name
+        if openmetrics and family.endswith("_total"):
+            family = family[: -len("_total")]
+        out = [f"# HELP {family} {_escape_help(self.help)}",
+               f"# TYPE {family} counter"]
         with self._lock:  # labels() inserts race the scrape iteration
             children = sorted(self._children.items())
         for lv, child in children:
@@ -148,22 +215,55 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 
 
 class _HistogramChild:
-    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+    """Observations land in ONE slot (their lowest bucket, found by
+    bisect) and cumulate lazily at expose time — O(log buckets) on the
+    hot path instead of a linear walk under the lock. The always-on
+    request-phase histograms (tailboard) observe on every served
+    request, so this is serving-path code, not just scrape plumbing."""
+
+    __slots__ = ("buckets", "slot_counts", "total", "count", "exemplars",
+                 "_lock")
 
     def __init__(self, buckets):
         self.buckets = buckets
-        self.counts = [0] * len(buckets)
+        # slot_counts[i]: observations whose LOWEST bucket is i;
+        # index len(buckets) = fell past every bound (+Inf only)
+        self.slot_counts = [0] * (len(buckets) + 1)
         self.total = 0.0
         self.count = 0
+        # per-bucket last exemplar (index len(buckets) = +Inf), lazily
+        # allocated — most histograms never carry one
+        self.exemplars: list | None = None
         self._lock = threading.Lock()
 
-    def observe(self, v: float):
+    def observe(self, v: float, exemplar: dict | None = None):
+        """``exemplar``: OpenMetrics exemplar labels (e.g.
+        ``{"trace_id": ...}``) attached to the lowest bucket ``v`` falls
+        in (and +Inf) — how a phase-histogram bucket links to a
+        tail-retained trace."""
+        # v <= buckets[idx] for the first idx with buckets[idx] >= v
+        idx = bisect_left(self.buckets, v)
         with self._lock:
             self.total += v
             self.count += 1
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    self.counts[i] += 1
+            self.slot_counts[idx] += 1
+            if exemplar is not None:
+                if self.exemplars is None:
+                    self.exemplars = [None] * (len(self.buckets) + 1)
+                ex = (dict(exemplar), float(v), time.time())
+                self.exemplars[min(idx, len(self.buckets))] = ex
+                self.exemplars[len(self.buckets)] = ex
+
+    def cumulative_counts(self) -> list[int]:
+        """Per-``le`` cumulative counts (the exposition's bucket lines).
+        Caller need not hold the lock; a racing observe skews one scrape
+        by one observation at worst."""
+        out = []
+        running = 0
+        for c in self.slot_counts[:-1]:
+            running += c
+            out.append(running)
+        return out
 
     def time(self):
         return _Timer(self)
@@ -179,25 +279,44 @@ class Histogram(_Metric):
     def _new_child(self):
         return _HistogramChild(self.buckets)
 
-    def observe(self, v: float):
-        self._default().observe(v)
+    def observe(self, v: float, exemplar: dict | None = None):
+        self._default().observe(v, exemplar=exemplar)
 
     def time(self):
         """Context manager observing elapsed seconds."""
         return _Timer(self._default())
 
-    def expose(self) -> list[str]:
+    @staticmethod
+    def _exemplar_str(ex) -> str:
+        """OpenMetrics exemplar rendering: `` # {labels} value ts`` —
+        label values pass the same escaping as ordinary labels (a
+        trace id is opaque input; an embedded quote must not corrupt
+        the scrape)."""
+        labels, value, ts = ex
+        pairs = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in labels.items())
+        return f" # {{{pairs}}} {value} {round(ts, 3)}"
+
+    def expose(self, openmetrics: bool = False) -> list[str]:
         out = [f"# HELP {self.name} {_escape_help(self.help)}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
             children = sorted(self._children.items())
         for lv, child in children:
             base = self._label_str(lv)[1:-1] if lv else ""
-            for b, c in zip(self.buckets, child.counts):
+            exemplars = child.exemplars if openmetrics else None
+            for i, (b, c) in enumerate(zip(self.buckets,
+                                           child.cumulative_counts())):
                 lbl = f'{{{base}{"," if base else ""}le="{b}"}}'
-                out.append(f"{self.name}_bucket{lbl} {c}")
+                line = f"{self.name}_bucket{lbl} {c}"
+                if exemplars is not None and exemplars[i] is not None:
+                    line += self._exemplar_str(exemplars[i])
+                out.append(line)
             lbl_inf = f'{{{base}{"," if base else ""}le="+Inf"}}'
-            out.append(f"{self.name}_bucket{lbl_inf} {child.count}")
+            line = f"{self.name}_bucket{lbl_inf} {child.count}"
+            if exemplars is not None and exemplars[-1] is not None:
+                line += self._exemplar_str(exemplars[-1])
+            out.append(line)
             suffix = "{" + base + "}" if base else ""
             out.append(f"{self.name}_sum{suffix} {child.total}")
             out.append(f"{self.name}_count{suffix} {child.count}")
@@ -249,13 +368,21 @@ class MetricsRegistry:
         return self._register(Histogram, name, help_text, label_names,
                               buckets=buckets)
 
-    def expose(self) -> str:
-        """Prometheus text exposition format."""
+    def expose(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition format. ``openmetrics=True`` emits
+        the OpenMetrics flavor: histogram buckets carry their exemplars
+        and the stream ends with ``# EOF`` — what a client negotiating
+        ``Accept: application/openmetrics-text`` receives."""
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
-            lines.extend(m.expose())
+            if isinstance(m, (Histogram, Counter)):
+                lines.extend(m.expose(openmetrics=openmetrics))
+            else:
+                lines.extend(m.expose())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 
@@ -499,6 +626,56 @@ span_duration = registry.histogram(
     "weaviate_tpu_span_duration_seconds",
     "Trace span durations by span name", ("span",))
 
+# -- tailboard: always-on latency attribution (runtime/tailboard.py) ----------
+
+request_phase_seconds = registry.histogram(
+    "weaviate_tpu_request_phase_seconds",
+    "Always-on per-request latency attribution from monotonic edge/"
+    "batcher/transfer stamps (no device sync on unsampled paths): phase "
+    "is queue_wait (batcher queue), device (dispatch to drain-start wall "
+    "window), transfer (D2H drain) or host (everything else); tenant and "
+    "collection pass the top-K cardinality guard (overflow: other). "
+    "Buckets carry OpenMetrics exemplars naming tail-retained trace ids",
+    ("operation", "phase", "collection", "tenant"),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+# the phase histogram's own series budget must dominate the generic
+# per-metric cap: its label space is operations x 4 phases x the
+# tailboard top-K guards (64 collections, 32 tenants) — a modest
+# multi-tenant deployment legitimately exceeds the 2000 default, and
+# collapsing the headline attribution labels to "other" would defeat
+# the metric's purpose while the guards already bound it
+try:
+    request_phase_seconds.max_series = int(os.environ.get(
+        "WEAVIATE_TPU_PHASE_MAX_SERIES", "16000") or 16000)
+except ValueError:
+    request_phase_seconds.max_series = 16000
+tail_retained_total = registry.counter(
+    "weaviate_tpu_tail_retained_total",
+    "Traces kept by the tail-based retention decision at request "
+    "completion (always kept regardless of TRACE_SAMPLE_RATE), by "
+    "reason: slow, error, deadline, degraded, fault",
+    ("reason",))
+slo_burn_rate = registry.gauge(
+    "weaviate_tpu_slo_burn_rate",
+    "Error-budget burn rate per SLO objective and sliding window "
+    "(bad-fraction / (1 - objective)): 1.0 burns exactly the budget, "
+    "14.4x on the fast window is the classic page threshold; refreshed "
+    "at scrape and by /v1/debug/slo",
+    ("slo", "window"))
+metric_series_dropped = registry.counter(
+    "weaviate_tpu_metric_series_dropped_total",
+    "Label-set lookups redirected into the reserved 'other' overflow "
+    "series by the per-metric cardinality cap "
+    "(WEAVIATE_TPU_METRIC_MAX_SERIES) — nonzero means some stream of "
+    "label values (tenants, collections) outgrew the exposition budget",
+    ("metric",))
+flight_snapshots_total = registry.counter(
+    "weaviate_tpu_flight_snapshots_total",
+    "Flight-recorder snapshots written to the data dir on incident "
+    "(SLO burn threshold crossed, component flipped unhealthy), by "
+    "incident reason", ("reason",))
+
 # -- perf gate (runtime/perfgate.py republishes these from the last
 #    persisted benchkeeper verdict; see tools/benchkeeper) --------------------
 
@@ -537,6 +714,41 @@ jit_compile_duration = registry.histogram(
              60.0, 120.0))
 
 
+TEXT_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def scrape(openmetrics: bool = False) -> tuple[bytes, str]:
+    """One metrics scrape, shared by the REST /v1/metrics route and the
+    monitoring port: run the read-point refreshes (benchkeeper verdict
+    pickup, per-host HBM attribution, tailboard fold + SLO burn
+    gauges), then render the negotiated exposition. Returns
+    ``(body, content_type)``; every refresh is best-effort — a broken
+    helper must never fail a scrape."""
+    try:
+        from weaviate_tpu.runtime import perfgate
+
+        perfgate.refresh()
+    except Exception:
+        pass
+    try:
+        from weaviate_tpu.runtime.hbm_ledger import ledger
+
+        ledger.refresh_host_gauge()
+    except Exception:
+        pass
+    try:
+        from weaviate_tpu.runtime import tailboard
+
+        tailboard.scrape_refresh()
+    except Exception:
+        pass
+    body = registry.expose(openmetrics=openmetrics).encode()
+    return body, (OPENMETRICS_CONTENT_TYPE if openmetrics
+                  else TEXT_CONTENT_TYPE)
+
+
 def serve_metrics(host: str = "127.0.0.1", port: int = 2112):
     """Start the Prometheus /metrics listener (reference: a dedicated
     monitoring port, configure_api.go:148-153). Returns the HTTP server;
@@ -551,27 +763,11 @@ def serve_metrics(host: str = "127.0.0.1", port: int = 2112):
                 self.send_header("Content-Length", "0")
                 self.end_headers()
                 return
-            # benchkeeper verdict pickup (mtime-cached) — the perf-gate
-            # gauges must appear on the monitoring port without anyone
-            # reading /v1/debug/perf first
-            try:
-                from weaviate_tpu.runtime import perfgate
-
-                perfgate.refresh()
-            except Exception:
-                pass
-            # per-host HBM attribution depends on live totals — refresh
-            # at scrape so the gauge sums to the live ledger total
-            try:
-                from weaviate_tpu.runtime.hbm_ledger import ledger
-
-                ledger.refresh_host_gauge()
-            except Exception:
-                pass
-            body = registry.expose().encode()
+            accept = self.headers.get("Accept", "")
+            body, ctype = scrape(
+                openmetrics="application/openmetrics-text" in accept)
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -53,7 +53,8 @@ import time
 
 import numpy as np
 
-from weaviate_tpu.runtime import degrade, faultline, retry, tracing
+from weaviate_tpu.runtime import (degrade, faultline, retry, tailboard,
+                                  tracing)
 from weaviate_tpu.runtime.transfer import TransferPipeline
 
 #: bounded intake: past this queue depth the batcher sheds load with a
@@ -72,15 +73,18 @@ def _next_pow2(n: int) -> int:
 
 class _Pending:
     __slots__ = ("query", "k", "allow", "event", "ids", "dists", "error",
-                 "ctx", "t_exec_start", "t_exec_end", "batch_size",
-                 "t_mask_start", "t_mask_end", "t_fetch_start",
-                 "t_fetch_end", "epochs")
+                 "ctx", "t_enqueue", "t_exec_start", "t_exec_end",
+                 "batch_size", "t_mask_start", "t_mask_end",
+                 "t_fetch_start", "t_fetch_end", "epochs")
 
     def __init__(self, query, k, allow):
         self.query = query
         self.k = k
         self.allow = allow
         self.event = threading.Event()
+        # enqueue stamp: the flight recorder's wait_ms and the tailboard
+        # queue_wait phase both derive from it
+        self.t_enqueue = 0.0
         self.ids = None
         self.dists = None
         self.error: Exception | None = None
@@ -157,6 +161,7 @@ class QueryBatcher:
         self._queue: list[_Pending] = []
         self._worker: threading.Thread | None = None
         self._stopped = False
+        self._queue_depth_at_drain = 0
         # observability (tools/bench_e2e asserts coalescing happens;
         # tests/test_query_batcher.py asserts the pipeline overlaps)
         self.dispatches = 0
@@ -211,7 +216,7 @@ class QueryBatcher:
         latency the budget can't absorb."""
         retry.check("batcher")
         item = _Pending(np.asarray(query, dtype=np.float32), k, allow)
-        t_enqueue = time.perf_counter()
+        t_enqueue = item.t_enqueue = time.perf_counter()
         with self._cv:
             if len(self._queue) >= self.max_queue:
                 raise retry.OverloadedError(
@@ -266,6 +271,21 @@ class QueryBatcher:
 
                 batcher_transfer_duration.observe(
                     item.t_fetch_end - item.t_fetch_start)
+            # always-on phase attribution (tailboard): the SAME stamps,
+            # folded into this request's live timeline on the request
+            # thread — queue_wait is the batcher queue, "device" the
+            # dispatch→drain-start wall window (block_until_ready-free;
+            # real device_ms stays sampled-only), transfer the D2H drain
+            tailboard.phase("queue_wait", item.t_exec_start - t_enqueue)
+            if item.t_fetch_start is not None:
+                tailboard.phase("device",
+                                item.t_fetch_start - item.t_exec_start)
+                tailboard.phase("transfer",
+                                (item.t_fetch_end or item.t_fetch_start)
+                                - item.t_fetch_start)
+            elif item.t_exec_end is not None:
+                tailboard.phase("device",
+                                item.t_exec_end - item.t_exec_start)
         if item.error is not None:
             raise item.error
         return item.ids, item.dists
@@ -292,6 +312,9 @@ class QueryBatcher:
                     return
                 drained = self._queue[: self.max_batch]
                 del self._queue[: len(drained)]
+                # queue depth AFTER the drain (what the next batch
+                # inherits) — the flight recorder's congestion signal
+                self._queue_depth_at_drain = len(self._queue)
             try:
                 from weaviate_tpu.runtime.metrics import batcher_batch_size
 
@@ -349,6 +372,18 @@ class QueryBatcher:
             it.t_exec_end = time.perf_counter()
             it.event.set()
         if not coal:
+            # a purely-solo drain still leaves a flight-recorder record
+            # (batch=0): the solo/gathered path is exactly the regression
+            # surface an r05-style post-hoc investigation digs through
+            if solo:
+                tailboard.record_dispatch(
+                    "batcher", batch=0, b_pad=0, k=0,
+                    queue_depth=self._queue_depth_at_drain,
+                    wait_ms=round(max(
+                        ((it.t_exec_start or it.t_enqueue) - it.t_enqueue)
+                        * 1000.0 for it in solo), 3),
+                    filtered=len(solo), solo=len(solo),
+                    window_inflight=0, epochs=0)
             return
         b = len(coal)
         # pow2 B/k buckets bound the number of compiled variants (one
@@ -391,6 +426,18 @@ class QueryBatcher:
             it.batch_size = b
             if filtered:
                 it.t_mask_start, it.t_mask_end = t_mask0, t_mask1
+        # flight-recorder dispatch record (lock-free ring): the dispatch
+        # history a post-hoc regression investigation replays. epochs is
+        # patched in below once the async handle reports its fanout.
+        tp0 = self._transfer
+        flight_rec = tailboard.record_dispatch(
+            "batcher", batch=b, b_pad=b_pad, k=k_bucket,
+            queue_depth=self._queue_depth_at_drain,
+            wait_ms=round(max(
+                (t0 - it.t_enqueue) * 1000.0 for it in coal), 3),
+            filtered=len(filtered), solo=len(solo),
+            window_inflight=tp0.inflight if tp0 is not None else 0,
+            epochs=0)
         # the pow2-padded query block becomes a device upload inside
         # batch_fn — ledger-registered until the results leave the
         # device (sync: end of this call; async: transfer completion) so
@@ -463,6 +510,7 @@ class QueryBatcher:
                 if handle is not None:
                     n_ep = int(handle.attrs.get("epochs", 0) or 0)
                     if n_ep:
+                        flight_rec["epochs"] = n_ep
                         for it in coal:
                             it.epochs = n_ep
             if handle is None:

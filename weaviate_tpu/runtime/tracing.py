@@ -298,17 +298,45 @@ def trace(name: str, force: bool = False, **attrs):
 
 
 def _finalize(tr: Trace, root: Span) -> None:
+    d = tr.to_dict()
     with _ring_lock:
-        _ring.append(tr.to_dict())
+        _ring.append(d)
+    # tail-based retention (ISSUE 15): the keep/drop decision happens at
+    # COMPLETION — inside an edge timeline the tailboard attaches this
+    # trace and decides when the timeline closes (status known); outside
+    # one it makes a standalone slow/fault decision
+    try:
+        from weaviate_tpu.runtime import tailboard
+
+        tailboard.on_trace_complete(d, root.name, root.duration_ms)
+    except Exception:  # observability must never fail the request
+        pass
     threshold = _get_slow_threshold()
     took = root.duration_ms / 1000.0
     if threshold > 0 and took >= threshold:
-        breakdown = ", ".join(
-            f"{s['name']}={s['duration_ms']:.1f}ms"
-            for s in sorted(tr.spans, key=lambda s: -s["duration_ms"])[:8])
-        slow_logger.warning(
-            "slow query %s: %.3fs (threshold %.3fs) trace=%s [%s]",
-            root.name, took, threshold, tr.trace_id, breakdown)
+        # structured slowlog (ISSUE 15 satellite): one machine-parseable
+        # line AND a retrievable entry in the flight recorder's slowlog
+        # ring (/v1/debug/flight) instead of free text only
+        record = {
+            "trace_id": tr.trace_id,
+            "root": root.name,
+            "duration_ms": round(root.duration_ms, 3),
+            "threshold_ms": round(threshold * 1000.0, 3),
+            "spans": [
+                {"name": s["name"],
+                 "duration_ms": round(s["duration_ms"], 3)}
+                for s in sorted(tr.spans,
+                                key=lambda s: -s["duration_ms"])[:8]],
+        }
+        import json as _json
+
+        slow_logger.warning("slow_query %s", _json.dumps(record))
+        try:
+            from weaviate_tpu.runtime import tailboard
+
+            tailboard.slow_root(record)
+        except Exception:
+            pass
 
 
 class _SpanCM:

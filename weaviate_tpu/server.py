@@ -117,6 +117,14 @@ class Server:
                                async_indexing=cfg.async_indexing or None,
                                sync_wal=cfg.wal_sync, mesh=mesh)
 
+        # tailboard wiring: incident flight-recorder snapshots land in
+        # the data dir; explicit SLO config (if any) replaces defaults
+        from weaviate_tpu.runtime import tailboard
+
+        tailboard.configure(data_dir=cfg.data_path,
+                            enabled=cfg.tailboard_enabled,
+                            slos_json=cfg.slo_config or None)
+
         modules = default_provider(self.db, enabled=cfg.enabled_modules)
 
         # FROZEN tenant tier: ship offloaded tenants through a backup
