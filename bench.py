@@ -1295,6 +1295,106 @@ def sec_quantized(ctx):
     return {"stats": quant}
 
 
+def sec_ivf_ann(ctx):
+    """Learned partitioned ANN (ISSUE 16): residual IVF-PQ through the
+    REAL serving path (multi-probe ADC + device plane rescore) on a
+    clustered corpus, next to the exhaustive BQ flat scan at the SAME
+    scale — the crossover partitioning exists to win.
+
+    Reported: recall@10 through ``search()``, chained device ms of the
+    probe kernel, the fraction of lists actually probed, and
+    ``qps_vs_bq_flat`` (>1 = probing a few lists beats scanning every
+    code)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from weaviate_tpu.engine.ivf import (IVFIndex, _dummy_bits,
+                                         _ivf_probe_topk_pq)
+    from weaviate_tpu.ops import bq as bq_ops
+
+    dim, k, batch = ctx["dim"], ctx["k"], ctx["batch"]
+    rng, dev = ctx["rng"], ctx["dev"]
+    # bounded build: the probe cost story is per-list, not per-corpus —
+    # tools/bench_ivf.py owns the 1M/10M builds
+    n = min(ctx["n"], 262_144)
+    cl = clustered_corpus(rng, n, dim)
+    q = (cl[rng.integers(0, n, batch)]
+         + 0.05 * rng.standard_normal((batch, dim))).astype(np.float32)
+    _, gt = _cpu_exact_knn(cl, q, k)
+
+    idx = IVFIndex(dim=dim, train_threshold=min(n, 131_072),
+                   delta_threshold=65_536, quantization="pq")
+    t0 = time.perf_counter()
+    for s in range(0, n, 65_536):
+        idx.add_batch(np.arange(s, min(s + 65_536, n)),
+                      cl[s:s + 65_536])
+    if not idx.trained:
+        idx.train()
+    idx.store.flush_delta()
+    build_s = time.perf_counter() - t0
+    st = idx.store
+
+    # recall + probe config through the real serving path
+    ids, _ = _retry_transient(lambda: idx.search_by_vector_batch(q, k),
+                              what="ivf recall search")
+    ids = np.asarray(ids)
+    rec = np.mean([len(set(ids[r][ids[r] >= 0].tolist())
+                       & set(gt[r].tolist())) / k for r in range(batch)])
+    h = st.search_async(q, k)
+    h.result()
+    nprobe = int(h.attrs["nprobe"])
+    lists_frac = float(h.attrs["lists_frac"])
+
+    qd = _retry_transient(lambda: jax.device_put(jnp.asarray(q), dev),
+                          what="ivf query upload")
+    allow = _dummy_bits()
+    k_eff = min(k * st.rescore_limit, nprobe * st.list_cap)
+    ms_ivf = _chained_ms(
+        ctx,
+        lambda off, q_, c_, cn_, lc_, lv_, ls_, lt_, pc_:
+        _ivf_probe_topk_pq(q_, c_, cn_, lc_, lv_, ls_, lt_, pc_, allow,
+                           k_eff, nprobe, "l2-squared", False),
+        (qd, st.centroids, st._c_norms, st.list_codes, st.list_valid,
+         st.list_slots, st.list_tvals, st.codebook.centroids))
+
+    # exhaustive BQ flat at the SAME corpus size: the comparator the
+    # qps ratio is defined against
+    n_pad2 = 1 << (n - 1).bit_length()
+    pad = np.zeros((n_pad2, dim), np.float32)
+    pad[:n] = cl
+    xw = _retry_transient(
+        lambda: jax.block_until_ready(bq_ops.bq_encode(jnp.asarray(pad))),
+        what="bq encode")
+    qw = bq_ops.bq_encode(qd)
+    valid2 = jnp.asarray(np.arange(n_pad2) < n)
+    ms_bq = _chained_ms(
+        ctx,
+        lambda off, qw_, xw_, v_: bq_ops.bq_topk(
+            qw_, xw_, k=min(100, n_pad2),
+            chunk_size=min(ctx["chunk"], n_pad2), valid=v_,
+            use_pallas=True, id_offset=off),
+        (qw, xw, valid2))
+
+    out = {
+        "n": n, "nlist": st.nlist, "nprobe": nprobe,
+        "lists_frac": round(lists_frac, 4),
+        "recall_at_10": round(float(rec), 4),
+        "device_probe_ms": round(ms_ivf, 3),
+        "qps": round(batch / (ms_ivf / 1e3)),
+        "bq_flat_ms": round(ms_bq, 3),
+        "qps_vs_bq_flat": round(ms_bq / ms_ivf, 2),
+        "build_vec_per_s": round(n / build_s),
+    }
+    log(f"[ivf_ann] recall@10 {rec:.4f} probing "
+        f"{lists_frac*100:.1f}% of {st.nlist} lists; probe "
+        f"{ms_ivf:.2f} ms vs BQ flat {ms_bq:.2f} ms "
+        f"({out['qps_vs_bq_flat']}x)")
+    ctx["ivf_ann"] = out
+    return {"stats": out}
+
+
 def sec_conformance(ctx):
     import numpy as np
 
@@ -1684,6 +1784,7 @@ SECTIONS = [
     ("selection_microbench", sec_selection_microbench, ("x", "rtt_s")),
     ("filtered_scan", sec_filtered_scan, ("x", "rtt_s")),
     ("quantized", sec_quantized, ("x", "rtt_s")),
+    ("ivf_ann", sec_ivf_ann, ("rtt_s",)),
     ("tracing_overhead", sec_tracing_overhead, ()),
     ("observability_overhead", sec_observability_overhead, ()),
     ("durability_tax", sec_durability_tax, ()),
@@ -1720,6 +1821,7 @@ def main():
         "selection_microbench": sections.get("selection_microbench"),
         "filtered_scan": sections.get("filtered_scan"),
         "quantized_clustered_1M_128d": ctx.get("quant"),
+        "ivf_ann": ctx.get("ivf_ann"),
         "kernel_conformance": ctx.get("conformance"),
         "serving_fabric_null_device": ctx.get("fabric"),
         "tunnel_rtt_ms": round(ctx.get("rtt_s", 0.0) * 1e3, 1),

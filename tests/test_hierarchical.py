@@ -220,6 +220,7 @@ def test_ivf_two_level_parity(rng):
     codes = rng.integers(0, 255, (nlist, cap, m)).astype(np.uint8)
     valid = rng.random((nlist, cap)) > 0.2
     slots = np.arange(nlist * cap, dtype=np.int32).reshape(nlist, cap)
+    tvals = rng.standard_normal((nlist, cap)).astype(np.float32)
     pqc = rng.standard_normal((m, 256, d // m)).astype(np.float32)
     q = rng.standard_normal((b, d)).astype(np.float32)
     outs = []
@@ -230,6 +231,7 @@ def test_ivf_two_level_parity(rng):
             shard_array(jnp.asarray(codes), mesh),
             shard_array(jnp.asarray(valid), mesh),
             shard_array(jnp.asarray(slots), mesh),
+            shard_array(jnp.asarray(tvals), mesh),
             replicate_array(jnp.asarray(pqc), mesh),
             k=k, nprobe=4, metric="l2-squared", mesh=mesh))
     _assert_bit_identical(outs[0], outs[1], "ivf")

@@ -108,7 +108,9 @@ def main():
         out["ivf_pq_1M_128d"] = {"build_vec_per_s": round(n / build_s),
                                  "nlist": st.nlist, "sweep": {}}
         qd = jnp.asarray(q)
-        allow = jnp.ones(1, dtype=bool)
+        from weaviate_tpu.engine.ivf import _dummy_bits
+
+        allow = _dummy_bits()
         for nprobe in (8, 16, 32):
             # recall through the REAL search path (probe + exact rescore)
             st.nprobe = nprobe
@@ -117,11 +119,13 @@ def main():
                            / k for r in range(nq)])
             k_eff = min(k * st.rescore_limit, nprobe * st.list_cap)
             ms = chained_ms(
-                lambda q_, c_, cn_, lc_, lv_, ls_, pc_: _ivf_probe_topk_pq(
-                    q_, c_, cn_, lc_, lv_, ls_, pc_, allow,
+                lambda q_, c_, cn_, lc_, lv_, ls_, lt_, pc_:
+                _ivf_probe_topk_pq(
+                    q_, c_, cn_, lc_, lv_, ls_, lt_, pc_, allow,
                     k_eff, nprobe, "l2-squared", False),
                 (qd, st.centroids, st._c_norms, st.list_codes,
-                 st.list_valid, st.list_slots, st.codebook.centroids))
+                 st.list_valid, st.list_slots, st.list_tvals,
+                 st.codebook.centroids))
             out["ivf_pq_1M_128d"]["sweep"][str(nprobe)] = {
                 "recall_at_10": round(float(rec), 4),
                 "device_probe_ms_b256": round(ms, 3),
@@ -168,19 +172,23 @@ def main():
             f"({gb:.1f} GB codes)")
         out["ivf_pq_10M_768d"] = {"nlist": nlist, "list_cap": cap,
                                   "hbm_gb": round(gb, 2), "sweep": {}}
+        list_tvals = jnp.zeros((nlist, cap), jnp.float32)
+        from weaviate_tpu.engine.ivf import _dummy_bits
+
         for b in (64, 256):
             qb = jax.random.normal(jax.random.PRNGKey(2), (b, d),
                                    dtype=jnp.float32)
-            allow = jnp.ones(1, dtype=bool)
+            allow = _dummy_bits()
             for nprobe in (8, 16, 32):
                 k_eff = min(160, nprobe * cap)
                 try:
                     ms = chained_ms(
-                        lambda q_, c_, cn_, lc_, ls_, pc_, f_:
+                        lambda q_, c_, cn_, lc_, ls_, lt_, pc_, f_:
                         _ivf_probe_topk_pq(
-                            q_, c_, cn_, lc_, f_, ls_, pc_, allow,
+                            q_, c_, cn_, lc_, f_, ls_, lt_, pc_, allow,
                             k_eff, nprobe, "l2-squared", False),
-                        (qb, cent, cn, list_codes, list_slots, pqc, fill),
+                        (qb, cent, cn, list_codes, list_slots, list_tvals,
+                         pqc, fill),
                         reps=30)
                 except Exception as e:  # noqa: BLE001
                     log(f"  b={b} nprobe={nprobe}: failed {e}")

@@ -560,8 +560,10 @@ class Shard:
                 vec_name,
                 QueryBatcher(
                     batch_fn,
-                    supports_filter_batching=bool(
-                        getattr(idx, "supports_batched_filters", False)),
+                    # callable: DynamicIndex upgrades / compress() can
+                    # change the capability under the cached batcher
+                    supports_filter_batching=lambda i=idx: bool(
+                        getattr(i, "supports_batched_filters", False)),
                     capacity_fn=_gathered_capacity,
                     pad_pow2=bool(getattr(idx, "compiled_batch_shapes",
                                           True)),
@@ -1004,13 +1006,18 @@ class Shard:
         """Run the epoch policy for every epoch-backed index on this
         shard: seal overfull actives, drop empty sealed epochs, fold
         tombstone-heavy ones (reclaims HBM through the ledger
-        finalizers). Returns True when work was done (cyclemanager
+        finalizers). Indexes exposing their own ``maintain`` hook (IVF
+        delta fold / drift retrain, dynamic's deferred upgrade) get the
+        same tick. Returns True when work was done (cyclemanager
         backoff signal)."""
         did = False
         for idx in self.vector_indexes.values():
             es = getattr(idx, "epoch_store", None)
             if es is not None:
                 did = es.maintain() or did
+            idx_maintain = getattr(idx, "maintain", None)
+            if idx_maintain is not None:
+                idx_maintain()
         return did
 
     # -- replication support -------------------------------------------------

@@ -30,6 +30,7 @@ class DynamicIndex:
     def __init__(self, dim: int, metric: str = "l2-squared",
                  threshold: int = 100_000, mesh=None, capacity: int = 8192,
                  chunk_size: int = 8192, nlist: int = 0, nprobe: int = 0,
+                 upgrade_quantization: str | None = None,
                  **flat_kwargs):
         self.dim = dim
         self.metric = metric
@@ -38,6 +39,10 @@ class DynamicIndex:
         self._nlist = nlist
         self._nprobe = nprobe
         self._chunk_size = chunk_size
+        # residency for the upgrade TARGET: the flat regime stays full
+        # precision (exact scan is the point), but the IVF index it
+        # migrates into can start life residual-quantized
+        self._upgrade_quantization = upgrade_quantization
         self._lock = threading.RLock()
         # captured so the runtime flat->IVF upgrade (which runs on an
         # insert thread, outside any shard owner scope) keeps the new
@@ -82,7 +87,8 @@ class DynamicIndex:
                                chunk_size=self._chunk_size,
                                nlist=self._nlist, nprobe=self._nprobe,
                                train_threshold=max(self.threshold, 256),
-                               dtype=getattr(flat.store, "dtype", None))
+                               dtype=getattr(flat.store, "dtype", None),
+                               quantization=self._upgrade_quantization)
             if live:
                 ids = slot_to_id[live]
                 vecs = snap["vectors"][live]
@@ -102,6 +108,18 @@ class DynamicIndex:
             if self.should_upgrade():
                 self.upgrade()
 
+    def maintain(self) -> None:
+        """Maintenance tick (db/shard.py epoch_maintenance): catch a
+        deferred upgrade (e.g. after a restore that landed above the
+        threshold without an insert) and forward the tick to the live
+        impl — the IVF regime folds its delta / retrains here."""
+        with self._lock:
+            if self.should_upgrade():
+                self.upgrade()
+            impl_maintain = getattr(self._impl, "maintain", None)
+            if impl_maintain is not None:
+                impl_maintain()
+
     def __getattr__(self, name):
         # everything else (search/delete/len/compact/...) hits the live impl
         return getattr(self._impl, name)
@@ -114,6 +132,7 @@ class DynamicIndex:
         snap["index_type"] = "dynamic"
         snap["dynamic_threshold"] = self.threshold
         snap["dynamic_upgraded"] = self.upgraded
+        snap["dynamic_upgrade_quantization"] = self._upgrade_quantization
         return snap
 
     @classmethod
@@ -126,7 +145,11 @@ class DynamicIndex:
         idx._nlist = snap.get("nlist", 0)
         idx._nprobe = snap.get("nprobe", 0)
         idx._chunk_size = snap.get("chunk_size", 8192)
+        idx._upgrade_quantization = snap.get("dynamic_upgrade_quantization")
         idx._lock = threading.RLock()
+        from weaviate_tpu.runtime import hbm_ledger
+
+        idx._hbm_owner = hbm_ledger.current_owner()
         if snap.get("dynamic_upgraded"):
             idx._impl = IVFIndex.restore(snap, **kwargs)
         else:

@@ -541,6 +541,12 @@ class EpochStore:
         rl = template.rescore_limit
         snaps = []  # (base, span, local_of, tiers, count) at dispatch
         parts, maps = [], []
+        # "plane" (single-device bf16 rows) degrades to "post" here: the
+        # merged candidates span per-epoch tier SNAPSHOTS, so the exact
+        # pass must route through the epoch-aware _vectors_for gather —
+        # the device plane has no cross-snapshot view
+        if mode == "plane":
+            mode = "post"
         # both rescore modes need the oversampled candidate set — the
         # inline (in-SPMD) rescore sees k_cand code-distance candidates
         # per epoch exactly like the single-buffer path; only

@@ -204,9 +204,10 @@ class FlatIndex:
                 kind, allow_mask = self._translate_batch_allow(
                     queries, allow_list, per_query)
                 if kind == "rowwise":
-                    # store takes shared 1-D masks only (e.g. the
-                    # IVF probe) — serve per-query filters row by
-                    # row rather than crashing on a 2-D mask
+                    # a store with supports_batched_filters=False takes
+                    # shared 1-D masks only — serve per-query filters
+                    # row by row rather than crashing on a 2-D mask
+                    # (IVF now takes the batched bitmask path above)
                     d = np.full((len(queries), k), np.float32(np.inf),
                                 dtype=np.float32)
                     slots = np.full((len(queries), k), -1,
@@ -258,9 +259,9 @@ class FlatIndex:
         ``DeviceResultHandle`` (resolving to the same (doc_ids [B,k],
         dists [B,k]) contract). Returns ``None`` when this index cannot
         serve the request async — injected stores without
-        ``search_async`` (IVF), or per-query filters on stores without
-        batched-filter support — and the caller falls back to the sync
-        path.
+        ``search_async``, or per-query filters on stores without
+        batched-filter support (the IVF store now provides both) — and
+        the caller falls back to the sync path.
 
         The slot -> doc-id resolution in the finish step runs against
         the ``_slot_to_id`` table captured AT DISPATCH: ``compact()``

@@ -9,15 +9,34 @@ possible shape for a systolic array. The TPU-idiomatic replacement
 - **layout**: posting lists as ONE dense padded tensor ``[nlist, cap, d]``
   in HBM (+ valid mask, slot ids, cached norms) — uniform shapes so the
   probe gather is a static-shape `take`, not ragged pointer chasing
-- **search**: query→centroid matmul → top-nprobe lists → gather probed
-  blocks → batched distance → masked top-k. Two matmuls and one gather
-  replace thousands of dependent graph hops.
+- **search**: query→centroid matmul → top-nprobe lists → candidate-slot
+  plane (ops/candidates.py): one gather-matmul over the probed blocks,
+  per-query ``allow_bits`` folded per candidate, exact top-k. Dispatch
+  only — ``search_async`` returns a DeviceResultHandle and ``search`` is
+  its ``.result()``, so sync and async are bit-exact by construction.
+- **residual PQ** (quantization="pq"): posting lists hold uint8 codes of
+  the RESIDUAL ``r = x - centroid[assign]`` (IVF-ADC; the residual has
+  ~nlist× less variance than the raw vector, so the same code budget
+  buys a tighter quantizer). The probe scores candidates by ADC —
+  ``||q-c-r̂||² = ||q-c||² - 2·q·r̂ + t_row`` with
+  ``t_row = 2·c·r̂ + ||r̂||²`` precomputed per row at encode — then
+  oversampled candidates rescore EXACTLY on device against a full-rows
+  tier (gather-matmul via the plane). The f32 host mirror survives only
+  for retrain/rebuild/persistence and is ledger-accounted as a host-tier
+  component, like HNSW's host graph.
 - **delta buffer**: recent inserts land in a small brute-force scanned
   DeviceVectorStore (exact), merged into lists when it fills (the LSM
   memtable idea applied to HBM; mirrors how the reference's async index
   queue batches graph inserts, index_queue.go:42).
 
-Deletes tombstone rows in place (valid mask), exactly like the flat store.
+Maintenance is incremental: deletes tombstone rows AND record the hole
+(list, pos); later scatters refill holes before extending the tail, and
+a row that finds its home list full spills to the next-nearest centroid
+with room. ``compact()`` therefore just folds the delta in — no full
+rebuild (``rebuild_count`` stays flat across compactions) — and
+``maintain()`` retrains only past a centroid-drift proxy (live count
+grew ``retrain_factor``× since training).
+
 Updates re-route the slot through the delta buffer. Global slot ids are
 stable across flushes, so the FlatIndex id<->slot bookkeeping works
 unchanged — IVFIndex subclasses FlatIndex and swaps the store.
@@ -35,14 +54,34 @@ import jax.numpy as jnp
 import numpy as np
 
 from weaviate_tpu.engine.flat import FlatIndex
-from weaviate_tpu.engine.store import DeviceVectorStore, _next_pow2
-from weaviate_tpu.runtime import hbm_ledger
+from weaviate_tpu.engine.store import (DeviceVectorStore, _next_pow2,
+                                       normalize_allow_mask)
+from weaviate_tpu.ops.candidates import gather_rescore_topk
 from weaviate_tpu.ops.distances import (MASKED_DISTANCE, normalize,
                                         normalize_np, pairwise_distance)
 from weaviate_tpu.ops.kmeans import kmeans_assign, kmeans_fit
+from weaviate_tpu.ops.pallas_kernels import _MASK_WORDS, allow_bits_for_ids
 from weaviate_tpu.ops.topk import topk_smallest
+from weaviate_tpu.runtime import hbm_ledger, tracing
+from weaviate_tpu.runtime.transfer import DeviceResultHandle
 
 _SUPPORTED_METRICS = ("l2-squared", "dot", "cosine", "cosine-dot")
+
+
+@functools.lru_cache(maxsize=1)
+def _dummy_bits_cached():
+    return jnp.zeros((1, _MASK_WORDS), dtype=jnp.uint32)
+
+
+def _dummy_bits():
+    """Placeholder ``allow_bits`` operand for ``use_allow=False`` probe
+    variants: one cached buffer so repeated unfiltered searches reuse the
+    same device constant instead of uploading a fresh dummy per call.
+    Under an active trace the cache must be bypassed — caching the
+    tracer would poison every later eager caller."""
+    if jax.core.trace_state_clean():
+        return _dummy_bits_cached()
+    return jnp.zeros((1, _MASK_WORDS), dtype=jnp.uint32)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
@@ -72,60 +111,83 @@ def _clear_list_rows(list_valid, flat_idx):
     return flat.at[flat_idx].set(False, mode="drop").reshape(nlist, cap)
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-def _scatter_code_lists(list_codes, list_valid, list_slots,
-                        flat_idx, codes, slots, write_mask):
-    """PQ-mode scatter: codes [m] uint8 rows into [nlist, cap, m] lists."""
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _scatter_code_lists(list_codes, list_valid, list_slots, list_tvals,
+                        flat_idx, codes, tvals, slots, write_mask):
+    """PQ-mode scatter: residual codes [m] uint8 + per-row ADC constant
+    ``t_row`` into the [nlist, cap, …] list tensors."""
     nlist, cap, m = list_codes.shape
     fc = list_codes.reshape(nlist * cap, m)
     fva = list_valid.reshape(nlist * cap)
     fs = list_slots.reshape(nlist * cap)
+    ft = list_tvals.reshape(nlist * cap)
     tgt = jnp.where(write_mask, flat_idx, nlist * cap)
     fc = fc.at[tgt].set(codes, mode="drop")
     fva = fva.at[tgt].set(True, mode="drop")
     fs = fs.at[tgt].set(slots, mode="drop")
+    ft = ft.at[tgt].set(tvals, mode="drop")
     return (fc.reshape(nlist, cap, m), fva.reshape(nlist, cap),
-            fs.reshape(nlist, cap))
+            fs.reshape(nlist, cap), ft.reshape(nlist, cap))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows_at(rows, idx, vecs, write_mask):
+    """Scatter f32 rows into the device rescore tier (PQ mode)."""
+    tgt = jnp.where(write_mask, idx, rows.shape[0])  # OOB rows drop
+    return rows.at[tgt].set(vecs.astype(rows.dtype), mode="drop")
 
 
 @functools.partial(jax.jit,
                    static_argnames=("k", "nprobe", "metric", "use_allow"))
 def _ivf_probe_topk_pq(q, centroids, c_norms, list_codes, list_valid,
-                       list_slots, pq_centroids, allow_by_slot, k: int,
-                       nprobe: int, metric: str, use_allow: bool):
-    """PQ-resident probe: gather CODES from the probed lists and score by
-    per-query ADC lookup (ops/pq.py:pq_lut) — a lax.scan over segments
-    accumulating [B, P] gathers, never materializing d-wide
-    reconstructions (an earlier reconstruct-matmul formulation held
-    [B, nprobe*cap, d] temporaries and OOM'd one chip at nprobe>=64).
-    HBM reads per probed row are m bytes instead of 4d — the capacity
-    regime IVF-PQ exists for (reference: PQ inside each shard's HNSW,
-    compressionhelpers/product_quantization.go:372)."""
-    from weaviate_tpu.ops.pq import pq_lut
+                       list_slots, list_tvals, pq_centroids, allow_bits,
+                       k: int, nprobe: int, metric: str, use_allow: bool):
+    """Residual-PQ probe: gather CODES from the probed lists and score by
+    residual ADC. Codes encode ``r = x - centroid[assign]``, so the
+    distance decomposes into a per-(query, probe) base term the coarse
+    matmul already produced, a per-row constant ``t_row`` cached at
+    encode, and the only data-dependent part — ``q·r̂`` — which the
+    one-hot int8 LUT matmul computes on the MXU:
+
+        l2:     ||q-c-r̂||² = ||q-c||²  - 2·q·r̂ + (2·c·r̂ + ||r̂||²)
+        dot:    -q·x̂       = -q·c      -   q·r̂
+        cosine: 1 - q·x̂    = 1 + (-q·c -   q·r̂)
+
+    ADC order is approximate (rank-only): callers exact-rescore the
+    oversampled survivors via the candidate plane. HBM reads per probed
+    row are m+4 bytes instead of 4d — the capacity regime IVF-PQ exists
+    for (reference: PQ inside each shard's HNSW,
+    compressionhelpers/product_quantization.go:372). The one-hot int8
+    matmul ADC (chunked over probed rows, bounded [B, Pc, kc*m]
+    transients) replaced a per-segment take_along_axis formulation that
+    issued B*P*m VPU random gathers and OOM'd beyond nprobe=8.
+    Per-query allow bitmasks fold per candidate (allow_bits_for_ids) —
+    never a dense [B, capacity] unpack."""
+    from weaviate_tpu.ops.pq import quantize_lut_int8
 
     nlist, cap, m = list_codes.shape
+    b = q.shape[0]
     q32 = q.astype(jnp.float32)
     if metric in ("cosine", "cosine-dot"):
         q32 = normalize(q32)
     cd = pairwise_distance(q32, centroids, metric="l2-squared",
                            x_sq_norms=c_norms)
-    _, probes = jax.lax.top_k(-cd, nprobe)  # [B, nprobe]
+    _, probes = jax.lax.top_k(-cd, nprobe)          # [B, nprobe]
+    cd_p = jnp.take_along_axis(cd, probes, axis=1)  # ||q-c||² per probe
 
-    codes = list_codes[probes].reshape(q.shape[0], nprobe * cap, m)
-    vld = list_valid[probes].reshape(q.shape[0], nprobe * cap)
-    slots = list_slots[probes].reshape(q.shape[0], nprobe * cap)
-    b, p = codes.shape[0], codes.shape[1]
-    lut = pq_lut(q32, pq_centroids, metric, m)  # [B, m, kc]
-    kc = lut.shape[2]
-    # ADC via ONE-HOT int8 MATMUL, chunked over the probed rows — the
-    # earlier per-segment take_along_axis formulation issued B*P*m VPU
-    # random gathers (~2 s/batch at capacity-scale probes and an OOM
-    # crash beyond nprobe=8); one-hot + batched matvec puts the sum on
-    # the MXU with bounded [B, Pc, kc*m] transients. LUT is per-query
-    # int8-quantized (rank-preserving per query; candidates get exactly
-    # rescored downstream).
-    from weaviate_tpu.ops.pq import quantize_lut_int8
-
+    codes = list_codes[probes].reshape(b, nprobe * cap, m)
+    vld = list_valid[probes].reshape(b, nprobe * cap)
+    slots = list_slots[probes].reshape(b, nprobe * cap)
+    tval = list_tvals[probes].reshape(b, nprobe * cap)
+    p = codes.shape[1]
+    # residual LUT: factor * q_seg · codeword (factor −2 for l2, −1 for
+    # the dot family) — no qn/cn terms, those live in base/t_row
+    ds = pq_centroids.shape[2]
+    kc = pq_centroids.shape[1]
+    qs = q32.reshape(b, m, ds)
+    rdots = jnp.einsum("bms,mks->bmk", qs, pq_centroids,
+                       preferred_element_type=jnp.float32)
+    lut = (-2.0 if metric == "l2-squared" else -1.0) * rdots
     lut8, scale = quantize_lut_int8(lut)
     # ~128 MB one-hot transient per scan step ACROSS the query batch
     # (b * pc * kc * m int8)
@@ -147,55 +209,53 @@ def _ivf_probe_topk_pq(q, centroids, c_norms, list_codes, list_valid,
         return carry, dots
 
     _, d8 = jax.lax.scan(one_chunk, None, codes_c)
-    d = (jnp.transpose(d8, (1, 0, 2)).reshape(b, n_chunks * pc)[:, :p]
-         .astype(jnp.float32) / scale[:, None])
+    adc = (jnp.transpose(d8, (1, 0, 2)).reshape(b, n_chunks * pc)[:, :p]
+           .astype(jnp.float32) / scale[:, None])     # ≈ factor · q·r̂
     if metric == "l2-squared":
-        d = jnp.maximum(d, 0.0)
+        d = jnp.maximum(jnp.repeat(cd_p, cap, axis=1) + adc + tval, 0.0)
+    else:
+        qn = jnp.sum(q32 * q32, axis=-1, keepdims=True)
+        base = -0.5 * (qn + c_norms[probes] - cd_p)   # = -q·c per probe
+        d = jnp.repeat(base, cap, axis=1) + adc
+        if metric != "dot":
+            d = 1.0 + d
     if use_allow:
-        ok = allow_by_slot[jnp.clip(slots, 0, allow_by_slot.shape[0] - 1)]
-        vld = vld & ok & (slots >= 0) & (slots < allow_by_slot.shape[0])
+        vld = vld & allow_bits_for_ids(allow_bits, slots)
     d = jnp.where(vld, d, MASKED_DISTANCE)
-    return topk_smallest(d, slots, min(k, nprobe * cap))
+    td, ts = topk_smallest(d, slots, min(k, p))
+    # masked rows keep their slot through top_k — drop them HERE or the
+    # exact rescore downstream would resurrect them with real distances
+    return td, jnp.where(td >= MASKED_DISTANCE, -1, ts)
 
 
-@functools.partial(jax.jit, static_argnames=("k", "nprobe", "metric", "use_allow"))
+@functools.partial(jax.jit,
+                   static_argnames=("k", "nprobe", "metric", "use_allow"))
 def _ivf_probe_topk(q, centroids, c_norms, list_vecs, list_valid, list_slots,
-                    list_norms, allow_by_slot, k: int, nprobe: int,
+                    list_norms, allow_bits, k: int, nprobe: int,
                     metric: str, use_allow: bool):
-    """Probe + score + select for a query batch.
-
-    q [B,d] → centroid distances [B,nlist] (MXU matmul) → top-nprobe →
-    gather [B, nprobe, cap, …] → per-query batched distance → masked top-k.
-    Returns (dists [B,k], slots [B,k]) ascending; dead/filtered rows never
-    surface. Memory is O(B * nprobe * cap * d): callers chunk B.
-    """
+    """Full-rows probe: q [B,d] → centroid distances [B,nlist] (MXU
+    matmul) → top-nprobe → flattened probed positions feed the shared
+    candidate plane (ops/candidates.py), which gathers, scores, folds
+    per-query ``allow_bits`` per candidate, and exact-top-k's. Returns
+    (dists [B,k'], slots [B,k']) ascending; dead/filtered rows never
+    surface. Memory is O(B * nprobe * cap * d): callers chunk B."""
     nlist, cap, dim = list_vecs.shape
+    b = q.shape[0]
     q32 = q.astype(jnp.float32)
     if metric in ("cosine", "cosine-dot"):
         q32 = normalize(q32)
     cd = pairwise_distance(q32, centroids, metric="l2-squared",
                            x_sq_norms=c_norms)
     _, probes = jax.lax.top_k(-cd, nprobe)  # [B, nprobe]
-
-    vecs = list_vecs[probes].reshape(q.shape[0], nprobe * cap, dim)
-    vld = list_valid[probes].reshape(q.shape[0], nprobe * cap)
-    slots = list_slots[probes].reshape(q.shape[0], nprobe * cap)
-    nrm = list_norms[probes].reshape(q.shape[0], nprobe * cap)
-
-    dots = jnp.einsum("bd,bpd->bp", q32, vecs.astype(jnp.float32),
-                      preferred_element_type=jnp.float32)
-    if metric == "l2-squared":
-        qn = jnp.sum(q32 * q32, axis=-1)[:, None]
-        d = jnp.maximum(qn - 2.0 * dots + nrm, 0.0)
-    elif metric == "dot":
-        d = -dots
-    else:  # cosine: rows stored normalized
-        d = 1.0 - dots
-    if use_allow:
-        ok = allow_by_slot[jnp.clip(slots, 0, allow_by_slot.shape[0] - 1)]
-        vld = vld & ok & (slots >= 0) & (slots < allow_by_slot.shape[0])
-    d = jnp.where(vld, d, MASKED_DISTANCE)
-    return topk_smallest(d, slots, min(k, nprobe * cap))
+    pos = jax.lax.broadcasted_iota(jnp.int32, (b, nprobe, cap), 2)
+    flat = (probes[:, :, None].astype(jnp.int32) * cap
+            + pos).reshape(b, nprobe * cap)
+    return gather_rescore_topk(
+        q32, flat, list_vecs.reshape(nlist * cap, dim), k, metric,
+        ids_of_row=list_slots.reshape(nlist * cap),
+        row_norms=list_norms.reshape(nlist * cap),
+        valid=list_valid.reshape(nlist * cap),
+        allow_bits=allow_bits if use_allow else None)
 
 
 class IVFStore:
@@ -214,7 +274,8 @@ class IVFStore:
                  quantization: str | None = None,
                  pq_segments: int | None = None,
                  pq_centroids: int = 16,
-                 rescore_limit: int = 16):
+                 rescore_limit: int = 16,
+                 retrain_factor: float = 4.0):
         if metric not in _SUPPORTED_METRICS:
             raise ValueError(
                 f"ivf supports {_SUPPORTED_METRICS}, not {metric!r}")
@@ -230,9 +291,11 @@ class IVFStore:
         self.train_threshold = train_threshold
         self.delta_threshold = delta_threshold
         self.query_chunk = query_chunk
-        # IVF-PQ residency (VERDICT r2 item 4b): posting lists hold uint8
-        # PQ codes instead of full rows; oversampled candidates rescore
-        # exactly against the host f32 mirror. The delta buffer stays
+        # Residual IVF-PQ residency: posting lists hold uint8 codes of
+        # x - centroid[assign]; oversampled candidates rescore EXACTLY on
+        # device against the _rescore_rows tier. The host f32 mirror
+        # survives for retrain/rebuild/persistence only (ledger: a
+        # "host_mirror" host-tier component). The delta buffer stays
         # exact either way.
         self.quantization = quantization
         self.pq_centroids = pq_centroids
@@ -242,14 +305,22 @@ class IVFStore:
             pq_segments = default_pq_segments(dim, pq_centroids)
         self.pq_segments = pq_segments
         self.rescore_limit = rescore_limit
+        self.retrain_factor = retrain_factor
         self.codebook = None
         self.list_codes = None
+        self.list_tvals = None  # [nlist, cap] f32 per-row ADC constant
         self._host_rows = (
             np.zeros((max(capacity, 1024), dim), dtype=np.float32)
             if quantization else None)
+        self._rescore_rows = None  # device [pow2, d] exact-rescore tier
         self.normalize_on_add = metric in ("cosine", "cosine-dot")
         self._lock = threading.RLock()
         self._count = 0  # global slot high-water mark
+        # maintenance counters (asserted by tests: compaction must not
+        # full-rebuild, retrain only fires past the drift proxy)
+        self.rebuild_count = 0
+        self.retrain_count = 0
+        self._live_at_train = 0
         # HBM ledger: centroid + posting-list tensors publish under the
         # owner labels captured here; the delta store self-accounts (it
         # is a DeviceVectorStore constructed in this same owner scope)
@@ -266,6 +337,7 @@ class IVFStore:
         self._slot_loc: dict[int, tuple] = {}
         # list tensors (allocated at train time)
         self.centroids = None  # jnp [nlist, d]
+        self._centroids_np = None  # host twin (assign/residuals/spill)
         self._c_norms = None
         self.list_vecs = None  # [nlist, cap, d]
         self.list_valid = None
@@ -273,10 +345,14 @@ class IVFStore:
         self.list_norms = None
         self.list_cap = 0
         self._fill: np.ndarray | None = None  # host per-list fill count
+        # freed (list, pos) positions, refilled LIFO before the tail
+        # grows — positions survive cap growth, flat indices would not
+        self._holes: dict[int, list[int]] = {}
 
     def _hbm_sync(self):
-        """Publish centroid + posting-list device bytes to the ledger
-        (the delta DeviceVectorStore accounts for itself)."""
+        """Publish centroid + posting-list + rescore-tier device bytes
+        and the host mirror (host tier) to the ledger (the delta
+        DeviceVectorStore accounts for itself)."""
         cent = 0 if self.centroids is None else (
             int(self.centroids.nbytes) + int(self._c_norms.nbytes))
         hbm_ledger.ledger.set_keyed(
@@ -284,11 +360,21 @@ class IVFStore:
             dtype="float32")
         lists = sum(int(a.nbytes) for a in (
             self.list_vecs, self.list_codes, self.list_norms,
-            self.list_valid, self.list_slots) if a is not None)
+            self.list_tvals, self.list_valid, self.list_slots)
+            if a is not None)
         hbm_ledger.ledger.set_keyed(
             self._hbm_keys, "lists", lists, owner=self._hbm_owner,
             dtype=("uint8" if self.quantization
                    else jnp.dtype(self.dtype).name))
+        hbm_ledger.ledger.set_keyed(
+            self._hbm_keys, "rescore_rows",
+            0 if self._rescore_rows is None
+            else int(self._rescore_rows.nbytes),
+            owner=self._hbm_owner, dtype=jnp.dtype(self.dtype).name)
+        hbm_ledger.ledger.set_keyed(
+            self._hbm_keys, "host_mirror",
+            0 if self._host_rows is None else int(self._host_rows.nbytes),
+            owner=self._hbm_owner, dtype="float32", placement="host")
 
     # -- properties mirrored from DeviceVectorStore ---------------------------
 
@@ -325,8 +411,10 @@ class IVFStore:
             return slots
 
     def _remember_rows(self, slots: np.ndarray, vectors: np.ndarray):
-        """PQ mode keeps an f32 host mirror (codes are lossy): rescore +
-        retrain + rebuild all read from here. Caller holds ``_lock``."""
+        """PQ mode keeps the originals twice: an f32 host mirror (codes
+        are lossy — retrain/rebuild/persistence read from here) and the
+        device ``_rescore_rows`` tier the exact candidate rescore gathers
+        from. Caller holds ``_lock``."""
         if self._host_rows is None or len(slots) == 0:
             return
         if self.normalize_on_add:
@@ -337,12 +425,38 @@ class IVFStore:
             grown[: len(self._host_rows)] = self._host_rows
             self._host_rows = grown
         self._host_rows[slots] = vectors
+        need = _next_pow2(max(mx + 1, 1024))
+        if self._rescore_rows is None:
+            self._rescore_rows = jnp.zeros((need, self.dim),
+                                           dtype=self.dtype)
+        elif mx >= self._rescore_rows.shape[0]:
+            old = self._rescore_rows
+            self._rescore_rows = (jnp.zeros((need, self.dim),
+                                            dtype=self.dtype)
+                                  .at[: old.shape[0]].set(old))
+        bucket = _next_pow2(max(len(slots), 8))
+        i_buf = np.zeros(bucket, np.int32)
+        i_buf[: len(slots)] = slots
+        v_buf = np.zeros((bucket, self.dim), np.float32)
+        v_buf[: len(slots)] = vectors
+        m_buf = np.zeros(bucket, bool)
+        m_buf[: len(slots)] = True
+        self._rescore_rows = _scatter_rows_at(
+            self._rescore_rows, jnp.asarray(i_buf), jnp.asarray(v_buf),
+            jnp.asarray(m_buf))
+        self._hbm_sync()
 
     def _add_to_delta(self, slots: np.ndarray, vectors: np.ndarray):
         dslots = self.delta.add(vectors)
         for g, d in zip(slots.tolist(), dslots.tolist()):
             self._delta_slots[int(d)] = int(g)
             self._slot_loc[int(g)] = ("delta", int(d))
+
+    def _punch_hole(self, flat_idx: int):
+        """Record a freed list position for hole-first refill. Caller
+        holds ``_lock``; positions (not flat indices) survive cap growth."""
+        l, p = divmod(int(flat_idx), self.list_cap)
+        self._holes.setdefault(l, []).append(p)
 
     def set_at(self, slots: np.ndarray, vectors: np.ndarray):
         """Overwrite slots in place. List-resident slots are tombstoned there
@@ -365,6 +479,7 @@ class IVFStore:
                 else:
                     if loc is not None:  # list-resident: tombstone there
                         clear_flat.append(loc[1])
+                        self._punch_hole(loc[1])
                     fresh_s.append(int(s))
                     fresh_v.append(v)
             if clear_flat:
@@ -390,6 +505,7 @@ class IVFStore:
                     self._delta_slots.pop(loc[1], None)
                 else:
                     clear_flat.append(loc[1])
+                    self._punch_hole(loc[1])
             if delta_del:
                 self.delta.delete(np.asarray(delta_del))
             if clear_flat:
@@ -413,31 +529,62 @@ class IVFStore:
     def train(self, force_nlist: int | None = None):
         """Learn the coarse partition from current contents and move
         everything into posting lists (reference analog: hnsw compress.go:38
-        trains PQ once enough data exists — same lifecycle hook)."""
+        trains PQ once enough data exists — same lifecycle hook). On an
+        already-trained store this is the RETRAIN path (``maintain``'s
+        drift gate lands here); routine delta absorption goes through
+        ``flush_delta`` without touching the centroids."""
         with self._lock:
             vecs, slots = self._all_live_host()
             n = len(vecs)
             if n == 0:
                 raise RuntimeError("cannot train IVF on an empty store")
+            was_trained = self.trained
             nlist = force_nlist or self.nlist or self._auto_nlist(n)
             nlist = min(nlist, n)
-            train_vecs = vecs
             self.nlist = nlist
-            cents = kmeans_fit(train_vecs, nlist, iters=10)
+            cents = kmeans_fit(vecs, nlist, iters=10)
             if self.normalize_on_add:
                 # keep centroids on the sphere so probe distances stay comparable
                 cents = normalize_np(cents)
-            self.centroids = jnp.asarray(cents)
+            self._centroids_np = np.asarray(cents, dtype=np.float32)
+            self.centroids = jnp.asarray(self._centroids_np)
             self._c_norms = jnp.sum(self.centroids * self.centroids, axis=1)
+            assign = kmeans_assign(vecs, self._centroids_np)
             if self.quantization:
                 from weaviate_tpu.ops.pq import pq_fit
 
-                self.codebook = pq_fit(train_vecs, m=self.pq_segments,
+                # the codebook quantizes RESIDUALS, not raw vectors — the
+                # coarse assignment has already absorbed most of the
+                # variance, so the same m×kc budget codes a much tighter
+                # distribution (classic IVFADC)
+                res = vecs - self._centroids_np[assign]
+                self.codebook = pq_fit(res, m=self.pq_segments,
                                        k=self.pq_centroids, iters=8)
-            self._rebuild_lists(vecs, slots)
+            self._rebuild_lists(vecs, slots, assign=assign)
             # delta fully absorbed
             self._reset_delta()
+            self._live_at_train = len(self._slot_loc)
+            if was_trained:
+                self.retrain_count += 1
             self._hbm_sync()
+
+    def maintain(self) -> None:
+        """Incremental maintenance hook (db/shard.py epoch maintenance):
+        fold the delta into lists; RETRAIN only when the corpus outgrew
+        its partition (live count >= retrain_factor x live-at-train — the
+        centroid-drift proxy). Compaction never lands here, so steady
+        tombstone churn costs hole-refills, not full rebuilds."""
+        with self._lock:
+            if not self.trained:
+                if len(self._slot_loc) >= self.train_threshold:
+                    self.train()
+                return
+            if (len(self._slot_loc)
+                    >= self.retrain_factor * max(self._live_at_train, 1)):
+                self.train()
+                return
+            if self._delta_slots:
+                self.flush_delta()
 
     def _all_live_host(self):
         """(vectors [L,d] f32, slots [L] int64) for every live slot."""
@@ -467,50 +614,134 @@ class IVFStore:
                     np.empty(0, np.int64))
         return np.concatenate(out_v), np.concatenate(out_s)
 
-    def _rebuild_lists(self, vecs: np.ndarray, slots: np.ndarray):
+    def _rebuild_lists(self, vecs: np.ndarray, slots: np.ndarray,
+                       assign: np.ndarray | None = None):
         """Assign + scatter everything into fresh list tensors.
-        Caller holds ``_lock`` (train/retrain section)."""
-        assign = (kmeans_assign(vecs, np.asarray(self.centroids))
-                  if len(vecs) else np.empty(0, np.int64))
-        counts = np.bincount(assign, minlength=self.nlist)
-        cap = max(8, _next_pow2(int(counts.max()) if len(counts) else 8))
+        Caller holds ``_lock`` (train/retrain/compress section)."""
+        if assign is None:
+            assign = (kmeans_assign(vecs, self._centroids_np)
+                      if len(vecs) else np.empty(0, np.int64))
+        assign = np.asarray(assign, dtype=np.int64)
+        n = len(vecs)
+        counts = (np.bincount(assign, minlength=self.nlist) if n
+                  else np.zeros(self.nlist, dtype=np.int64))
+        # cap targets ~2x the perfectly-even fill (pow2) instead of the
+        # fullest list: one hot cluster no longer pads EVERY list to its
+        # size — overfull lists spill their farthest members to the
+        # next-nearest centroid with room (imbalance-aware nprobe)
+        cap = max(8, _next_pow2(-(-2 * n // max(self.nlist, 1))) if n else 8)
+        while self.nlist * cap < n:
+            cap *= 2
+        if n:
+            cap = min(cap, max(8, _next_pow2(int(counts.max()))))
+        while True:
+            spilled = self._spill_overfull(vecs, assign, cap)
+            if spilled is not None:
+                assign = spilled
+                break
+            cap *= 2  # unplaceable at this cap — relax and retry
         self.list_cap = cap
         if self.quantization:
             self.list_codes = jnp.zeros(
                 (self.nlist, cap, self.pq_segments), dtype=jnp.uint8)
+            self.list_tvals = jnp.zeros((self.nlist, cap),
+                                        dtype=jnp.float32)
             self.list_vecs = None
             self.list_norms = None
         else:
             self.list_vecs = jnp.zeros((self.nlist, cap, self.dim),
                                        dtype=self.dtype)
             self.list_norms = jnp.zeros((self.nlist, cap), dtype=jnp.float32)
+            self.list_codes = None
+            self.list_tvals = None
         self.list_valid = jnp.zeros((self.nlist, cap), dtype=jnp.bool_)
         self.list_slots = jnp.full((self.nlist, cap), -1, dtype=jnp.int32)
         self._fill = np.zeros(self.nlist, dtype=np.int64)
+        self._holes = {}
+        self.rebuild_count += 1
         self._hbm_sync()
         self._scatter_assigned(vecs, slots, assign)
 
+    def _spill_overfull(self, vecs: np.ndarray, assign: np.ndarray,
+                        cap: int) -> np.ndarray | None:
+        """Rebalance at train time: each overfull list keeps its ``cap``
+        CLOSEST members (ties break toward the lower row index —
+        deterministic) and spills the rest to the nearest centroid with
+        room. Returns the adjusted assignment, or None when some row
+        cannot be placed anywhere at this cap (caller doubles cap).
+        Keeps cap-padding honest: without it one hot cluster sets cap
+        for every list and the probe gathers mostly dead padding."""
+        counts = np.bincount(assign, minlength=self.nlist)
+        over = np.flatnonzero(counts > cap)
+        if len(over) == 0:
+            return assign
+        cents = self._centroids_np
+        assign = assign.copy()
+        room = np.clip(cap - counts, 0, None)
+        for l in over.tolist():
+            members = np.flatnonzero(assign == l)
+            d_own = np.sum((vecs[members] - cents[l]) ** 2, axis=1)
+            # lexsort's LAST key is primary: distance asc, index tiebreak
+            order = members[np.lexsort((members, d_own))]
+            for r in order[cap:].tolist():
+                d_all = np.sum((cents - vecs[r]) ** 2, axis=1)
+                d_all[l] = np.inf
+                for t in np.argsort(d_all, kind="stable").tolist():
+                    if room[t] > 0:
+                        assign[r] = t
+                        room[t] -= 1
+                        break
+                else:
+                    return None
+        return assign
+
+    def _take_position(self, l: int) -> int:
+        """Next free position in list ``l``: holes first (LIFO), then the
+        tail. -1 when the list is full. Caller holds ``_lock``."""
+        hs = self._holes.get(l)
+        if hs:
+            return hs.pop()
+        if self._fill[l] < self.list_cap:
+            p = int(self._fill[l])
+            self._fill[l] += 1
+            return p
+        return -1
+
+    def _find_room(self, vec: np.ndarray, exclude: int) -> int:
+        """Nearest centroid (excluding ``exclude``) whose list has a hole
+        or tail room — the runtime spill target. -1 if every list is full."""
+        d = np.sum((self._centroids_np - vec) ** 2, axis=1)
+        d[exclude] = np.inf
+        for t in np.argsort(d, kind="stable").tolist():
+            if self._holes.get(t) or self._fill[t] < self.list_cap:
+                return int(t)
+        return -1
+
     def _scatter_assigned(self, vecs, slots, assign):
-        """Place (vec, slot) pairs at the next free position of their list."""
+        """Place (vec, slot) pairs: holes first, then the list tail, then
+        spill to the next-nearest centroid with room; only when EVERY
+        list is full does capacity grow. Residual-PQ encodes against the
+        FINAL assignment (spill included), so codes always quantize the
+        residual of the centroid actually probed."""
         if len(vecs) == 0:
             return
+        assign = np.asarray(assign, dtype=np.int64).copy()
         pos = np.empty(len(assign), dtype=np.int64)
-        order = np.argsort(assign, kind="stable")
-        sorted_assign = assign[order]
-        # per-list sequential positions after current fill
-        starts = {}
-        for idx, l in zip(order.tolist(), sorted_assign.tolist()):
-            p = starts.get(l)
-            if p is None:
-                p = int(self._fill[l])
-            pos[idx] = p
-            starts[l] = p + 1
-        for l, nxt in starts.items():
-            self._fill[l] = nxt
-        max_needed = int(self._fill.max()) if len(self._fill) else 0
-        while max_needed > self.list_cap:
-            self._grow_cap()
-        flat_idx = assign.astype(np.int64) * self.list_cap + pos
+        for i, l in enumerate(assign.tolist()):
+            p = self._take_position(int(l))
+            if p >= 0:
+                pos[i] = p
+                continue
+            t = self._find_room(vecs[i], exclude=int(l))
+            if t >= 0:
+                assign[i] = t
+                pos[i] = self._take_position(t)
+            else:
+                self._grow_cap()
+                pos[i] = self._take_position(int(l))
+        # positions stay valid across _grow_cap (p < old_cap < new_cap);
+        # flat indices are computed once, against the FINAL cap
+        flat_idx = assign * self.list_cap + pos
         bucket = _next_pow2(max(len(vecs), 8))
         i_buf = np.zeros(bucket, np.int32)
         i_buf[:len(vecs)] = flat_idx
@@ -519,16 +750,26 @@ class IVFStore:
         m_buf = np.zeros(bucket, bool)
         m_buf[:len(vecs)] = True
         if self.quantization:
-            from weaviate_tpu.ops.pq import pq_encode
+            from weaviate_tpu.ops.pq import pq_encode, pq_reconstruct
 
-            codes = pq_encode(self.codebook, vecs)
+            cents = self._centroids_np[assign]
+            res = vecs - cents
+            codes = pq_encode(self.codebook, res)
+            rhat = np.asarray(pq_reconstruct(  # graftlint: disable=G1 — maintenance-time boundary (encode, not serving)
+                jnp.asarray(codes), self.codebook.centroids,
+                self.codebook.m))
+            tvals = (2.0 * np.sum(cents * rhat, axis=1)
+                     + np.sum(rhat * rhat, axis=1)).astype(np.float32)
             c_buf = np.zeros((bucket, self.pq_segments), np.uint8)
             c_buf[:len(vecs)] = codes
-            (self.list_codes, self.list_valid,
-             self.list_slots) = _scatter_code_lists(
+            t_buf = np.zeros(bucket, np.float32)
+            t_buf[:len(vecs)] = tvals
+            (self.list_codes, self.list_valid, self.list_slots,
+             self.list_tvals) = _scatter_code_lists(
                 self.list_codes, self.list_valid, self.list_slots,
-                jnp.asarray(i_buf), jnp.asarray(c_buf), jnp.asarray(s_buf),
-                jnp.asarray(m_buf))
+                self.list_tvals,
+                jnp.asarray(i_buf), jnp.asarray(c_buf), jnp.asarray(t_buf),
+                jnp.asarray(s_buf), jnp.asarray(m_buf))
         else:
             v_buf = np.zeros((bucket, self.dim), np.float32)
             v_buf[:len(vecs)] = vecs
@@ -551,6 +792,9 @@ class IVFStore:
                 [self.list_codes,
                  jnp.zeros((self.nlist, pad, self.pq_segments),
                            dtype=jnp.uint8)], axis=1)
+            self.list_tvals = jnp.concatenate(
+                [self.list_tvals,
+                 jnp.zeros((self.nlist, pad), dtype=jnp.float32)], axis=1)
         else:
             self.list_vecs = jnp.concatenate(
                 [self.list_vecs,
@@ -568,13 +812,15 @@ class IVFStore:
         self.list_cap = new_cap
         self._hbm_sync()
         # flat indices shift: old flat l*old_cap+p -> l*new_cap+p
+        # (hole POSITIONS are cap-invariant and carry over untouched)
         for s, loc in self._slot_loc.items():
             if loc[0] == "list":
                 l, p = divmod(loc[1], old_cap)
                 self._slot_loc[s] = ("list", l * new_cap + p)
 
     def flush_delta(self):
-        """Merge the delta buffer into posting lists (memtable flush)."""
+        """Merge the delta buffer into posting lists (memtable flush) —
+        an INCREMENTAL scatter into holes/tails, never a rebuild."""
         with self._lock:
             if not self.trained:
                 return
@@ -594,9 +840,11 @@ class IVFStore:
                     return
                 from weaviate_tpu.ops.pq import pq_fit
 
-                self.codebook = pq_fit(vecs, m=self.pq_segments,
+                a0 = kmeans_assign(vecs, self._centroids_np)
+                self.codebook = pq_fit(vecs - self._centroids_np[a0],
+                                       m=self.pq_segments,
                                        k=self.pq_centroids, iters=8)
-            assign = kmeans_assign(vecs, np.asarray(self.centroids))
+            assign = kmeans_assign(vecs, self._centroids_np)
             self._scatter_assigned(vecs, slots, assign)
             self._reset_delta()
 
@@ -613,125 +861,155 @@ class IVFStore:
 
     # -- queries -------------------------------------------------------------
 
-    def _rescore(self, queries: np.ndarray, cand_slots: np.ndarray, k: int):
-        """Exact f32 rescore of PQ candidates against the host mirror
-        (reference rescore pattern: flat/index.go:347). Normalizes the
-        query side for cosine; mirror rows were normalized at insert."""
-        q = queries
-        if self.normalize_on_add:
-            q = normalize_np(q)
-        b, kc = cand_slots.shape
-        safe = np.clip(cand_slots, 0, len(self._host_rows) - 1)
-        cand = self._host_rows[safe]  # [B, kc, d]
-        if self.metric == "dot":
-            dd = -np.einsum("bd,bkd->bk", q, cand)
-        elif self.metric in ("cosine", "cosine-dot"):
-            dd = 1.0 - np.einsum("bd,bkd->bk", q, cand)
-        else:
-            diff = q[:, None, :] - cand
-            dd = np.einsum("bkd,bkd->bk", diff, diff)
-        dd = np.where(cand_slots >= 0, dd, MASKED_DISTANCE)
-        k_eff = min(k, kc)
-        part = np.argpartition(dd, k_eff - 1, axis=1)[:, :k_eff]
-        pd = np.take_along_axis(dd, part, axis=1)
-        order = np.argsort(pd, axis=1, kind="stable")
-        sel = np.take_along_axis(part, order, axis=1)
-        out_d = np.take_along_axis(dd, sel, axis=1).astype(np.float32)
-        out_s = np.take_along_axis(cand_slots, sel, axis=1)
-        out_s = np.where(out_d >= MASKED_DISTANCE, -1, out_s)
-        return out_d, out_s
-
     def _effective_nprobe(self) -> int:
         if self.nprobe:
             return min(self.nprobe, self.nlist)
         return min(self.nlist, max(8, self.nlist // 8))
 
+    def _delta_allow(self, allow_mask, b: int):
+        """Project the GLOBAL allow mask ([cap] shared or [B, cap]
+        per-query) onto delta-local slots. Caller holds ``_lock``."""
+        if allow_mask is None:
+            return None
+        cap_d = self.delta.capacity
+        if allow_mask.ndim == 2:
+            out = np.zeros((b, cap_d), dtype=bool)
+            for ds, g in self._delta_slots.items():
+                if ds < cap_d and g < allow_mask.shape[1]:
+                    out[:, ds] = allow_mask[:, g]
+            return out
+        out = np.zeros(cap_d, dtype=bool)
+        for ds, g in self._delta_slots.items():
+            if ds < cap_d and g < len(allow_mask) and allow_mask[g]:
+                out[ds] = True
+        return out
+
     def search(self, queries: np.ndarray, k: int,
                allow_mask: np.ndarray | None = None,
                nprobe: int | None = None):
-        """Merged top-k over delta (exact) + probed lists (ANN)."""
+        """Merged top-k over delta (exact) + probed lists (ANN). This IS
+        ``search_async(...).result()`` — sync and async agree bit-for-bit
+        by construction; the D2H transfer rides the handle's sanctioned
+        boundary (transfer.d2h span)."""
+        return self.search_async(queries, k, allow_mask,
+                                 nprobe=nprobe).result()
+
+    def search_async(self, queries: np.ndarray, k: int,
+                     allow_mask: np.ndarray | None = None,
+                     nprobe: int | None = None) -> DeviceResultHandle:
+        """Dispatch-only twin of ``search``: both legs — the exact delta
+        scan (``epoch_scan``, ids remapped to global ON DEVICE) and the
+        probe (+ residual-PQ exact rescore via the candidate plane) —
+        launch under ``_lock`` and merge on device; results stay
+        device-resident in the returned handle. ``allow_mask`` takes the
+        DeviceVectorStore forms: [cap] bool shared, or [B, cap] bool
+        per-query (packed once to block-strided ``allow_bits`` and folded
+        per candidate inside the probe — B differently-filtered requests
+        run as ONE device program, which is what lets the QueryBatcher
+        coalesce filtered IVF traffic)."""
         queries = np.asarray(queries, dtype=np.float32)
         squeeze = queries.ndim == 1
         if squeeze:
             queries = queries[None, :]
         b = len(queries)
-        with self._lock:
-            # --- delta leg (exact scan over the small recent set)
-            d_d = np.full((b, 0), MASKED_DISTANCE, np.float32)
-            d_s = np.full((b, 0), -1, np.int64)
+        allow_mask = normalize_allow_mask(allow_mask, b)
+        np_probe = 0
+        with tracing.span("ivf.search", queries=b, k=k,
+                          filtered=allow_mask is not None) as sp, \
+                self._lock:
+            legs_d, legs_i = [], []
             if self.delta.live_count() > 0:
-                delta_allow = None
-                if allow_mask is not None:
-                    delta_allow = np.zeros(self.delta.capacity, dtype=bool)
-                    for ds, g in self._delta_slots.items():
-                        if g < len(allow_mask) and allow_mask[g]:
-                            delta_allow[ds] = True
-                dd, dslots = self.delta.search(queries, min(k, self.delta.capacity),
-                                              delta_allow)
-                # delta slot -> global slot
-                gmap = np.full(self.delta.capacity + 1, -1, np.int64)
+                dd, di = self.delta.epoch_scan(
+                    queries, min(k, self.delta.capacity),
+                    self._delta_allow(allow_mask, b))
+                gmap = np.full(max(self.delta.capacity, 1), -1, np.int32)
                 for ds, g in self._delta_slots.items():
-                    gmap[ds] = g
-                d_s = np.where(dslots >= 0, gmap[np.clip(dslots, 0, None)], -1)
-                d_d = np.where(d_s >= 0, dd, MASKED_DISTANCE)
-            # --- list leg
-            l_d = np.full((b, 0), MASKED_DISTANCE, np.float32)
-            l_s = np.full((b, 0), -1, np.int64)
-            if self.trained and self._fill is not None and self._fill.sum() > 0:
-                np_probe = min((nprobe or self._effective_nprobe()), self.nlist)
+                    if ds < len(gmap):
+                        gmap[ds] = g
+                gd = jnp.asarray(gmap)
+                di = jnp.where(di >= 0,
+                               gd[jnp.clip(di, 0, len(gmap) - 1)], -1)
+                legs_d.append(jnp.where(di >= 0, dd, MASKED_DISTANCE))
+                legs_i.append(di.astype(jnp.int32))
+            if (self.trained and self._fill is not None
+                    and int(self._fill.sum()) > 0):
+                np_probe = min((nprobe or self._effective_nprobe()),
+                               self.nlist)
                 use_allow = allow_mask is not None
-                allow_dev = jnp.asarray(
-                    allow_mask if use_allow else np.ones(1, bool))
+                if use_allow:
+                    from weaviate_tpu.ops.pallas_kernels import (
+                        mask_pad_cols, pack_allow_bitmask)
+
+                    bits = jnp.asarray(pack_allow_bitmask(
+                        allow_mask, mask_pad_cols(self.capacity)))
+                    hbm_ledger.ledger.track("allow_bitmask", bits,
+                                            **self._hbm_owner)
+                else:
+                    bits = _dummy_bits()
                 k_cand = k * self.rescore_limit if self.quantization else k
                 k_eff = min(k_cand, np_probe * self.list_cap)
-                outs_d, outs_s = [], []
+                outs_d, outs_i = [], []
                 for s in range(0, b, self.query_chunk):
+                    q_dev = jnp.asarray(queries[s:s + self.query_chunk])
+                    bch = (bits if bits.shape[0] == 1
+                           else bits[s:s + self.query_chunk])
                     if self.quantization:
-                        qd, qs = _ivf_probe_topk_pq(
-                            jnp.asarray(queries[s:s + self.query_chunk]),
-                            self.centroids, self._c_norms,
+                        _, cand = _ivf_probe_topk_pq(
+                            q_dev, self.centroids, self._c_norms,
                             self.list_codes, self.list_valid,
-                            self.list_slots, self.codebook.centroids,
-                            allow_dev, k_eff, np_probe,
-                            self.metric, use_allow)
+                            self.list_slots, self.list_tvals,
+                            self.codebook.centroids, bch, k_eff,
+                            np_probe, self.metric, use_allow)
+                        # exact device rescore of the ADC oversample —
+                        # masks already folded (dropped slots are -1)
+                        qd, qs_ = gather_rescore_topk(
+                            q_dev, cand, self._rescore_rows,
+                            min(k, k_eff), self.metric)
                     else:
-                        qd, qs = _ivf_probe_topk(
-                            jnp.asarray(queries[s:s + self.query_chunk]),
-                            self.centroids, self._c_norms,
-                            self.list_vecs, self.list_valid, self.list_slots,
-                            self.list_norms, allow_dev, k_eff, np_probe,
-                            self.metric, use_allow)
-                    outs_d.append(np.asarray(qd))
-                    outs_s.append(np.asarray(qs, dtype=np.int64))
-                l_d = np.concatenate(outs_d)
-                l_s = np.concatenate(outs_s)
-                # masked rows (deleted / filtered) keep their slot ids in
-                # the top-k output — map them to -1 BEFORE rescore, which
-                # would otherwise resurrect them with exact distances
-                l_s = np.where(l_d >= MASKED_DISTANCE, -1, l_s)
-                if self.quantization:
-                    l_d, l_s = self._rescore(queries, l_s, k)
-        # --- host merge of the two legs
-        cat_d = np.concatenate([d_d, l_d], axis=1)
-        cat_s = np.concatenate([d_s, l_s], axis=1)
-        k_out = min(k, cat_d.shape[1]) if cat_d.shape[1] else 0
-        if k_out == 0:
-            empty_d = np.full((b, k), MASKED_DISTANCE, np.float32)
-            empty_s = np.full((b, k), -1, np.int64)
-            return (empty_d[0], empty_s[0]) if squeeze else (empty_d, empty_s)
-        cat_d = np.where(cat_s >= 0, cat_d, MASKED_DISTANCE)
-        order = np.argsort(cat_d, axis=1, kind="stable")[:, :k]
-        out_d = np.take_along_axis(cat_d, order, axis=1)
-        out_s = np.take_along_axis(cat_s, order, axis=1)
-        out_s = np.where(out_d >= MASKED_DISTANCE, -1, out_s)
-        if out_d.shape[1] < k:  # pad to k like the flat store contract
-            pad = k - out_d.shape[1]
-            out_d = np.pad(out_d, ((0, 0), (0, pad)),
-                           constant_values=MASKED_DISTANCE)
-            out_s = np.pad(out_s, ((0, 0), (0, pad)), constant_values=-1)
-        if squeeze:
-            return out_d[0], out_s[0]
-        return out_d, out_s
+                        qd, qs_ = _ivf_probe_topk(
+                            q_dev, self.centroids, self._c_norms,
+                            self.list_vecs, self.list_valid,
+                            self.list_slots, self.list_norms, bch, k_eff,
+                            np_probe, self.metric, use_allow)
+                    outs_d.append(qd)
+                    outs_i.append(qs_)
+                legs_d.append(outs_d[0] if len(outs_d) == 1
+                              else jnp.concatenate(outs_d))
+                legs_i.append((outs_i[0] if len(outs_i) == 1
+                               else jnp.concatenate(outs_i))
+                              .astype(jnp.int32))
+            sp.set(nprobe=np_probe, nlist=self.nlist)
+            if not legs_d:
+                d_e = np.full((b, k), MASKED_DISTANCE, np.float32)
+                i_e = np.full((b, k), -1, np.int64)
+                return DeviceResultHandle.ready(
+                    (d_e[0], i_e[0]) if squeeze else (d_e, i_e))
+            if len(legs_d) == 1:
+                md, mi = legs_d[0], legs_i[0]
+            else:
+                cat_d = jnp.concatenate(legs_d, axis=1)
+                cat_i = jnp.concatenate(legs_i, axis=1)
+                md, mi = topk_smallest(cat_d, cat_i,
+                                       min(k, cat_d.shape[1]))
+
+        def _finish(d_np, i_np, _k=k, _squeeze=squeeze):
+            d_np = np.asarray(d_np, dtype=np.float32)
+            i_np = np.asarray(i_np, dtype=np.int64)
+            i_np = np.where(d_np >= MASKED_DISTANCE, -1, i_np)
+            if d_np.shape[1] < _k:  # pad to k like the flat store contract
+                pad = _k - d_np.shape[1]
+                d_np = np.pad(d_np, ((0, 0), (0, pad)),
+                              constant_values=MASKED_DISTANCE)
+                i_np = np.pad(i_np, ((0, 0), (0, pad)), constant_values=-1)
+            if _squeeze:
+                return d_np[0], i_np[0]
+            return d_np, i_np
+
+        lists_frac = (np_probe / self.nlist) if self.nlist else 0.0
+        return DeviceResultHandle(
+            (md, mi), finish=_finish,
+            attrs={"queries": b, "k": k, "nprobe": np_probe,
+                   "nlist": self.nlist, "lists_frac": lists_frac})
 
     def search_by_distance(self, query: np.ndarray, max_distance: float,
                            allow_mask: np.ndarray | None = None):
@@ -746,20 +1024,18 @@ class IVFStore:
     # -- maintenance ---------------------------------------------------------
 
     def compact(self) -> np.ndarray:
-        """Drop tombstones and repack lists. Slot ids stay stable (identity
-        mapping for live slots) — the IVF layout doesn't tie slots to
-        physical rows the way the flat store does."""
+        """Epoch/tombstone compaction is INCREMENTAL now: deletes already
+        punched reusable holes, so compaction just folds the delta into
+        lists — no full rebuild (``rebuild_count`` stays flat; the
+        epochstore's maintain() relies on this being cheap). Slot ids
+        stay stable (identity mapping for live slots) — the IVF layout
+        doesn't tie slots to physical rows the way the flat store does."""
         with self._lock:
             mapping = np.full(self.capacity, -1, dtype=np.int64)
             for s in self._slot_loc:
                 mapping[s] = s
             if self.trained:
-                vecs, slots = self._all_live_host()
-                # keep only live (slot_loc) entries
-                keep = np.asarray([s in self._slot_loc for s in slots.tolist()])
-                self._fill = np.zeros(self.nlist, dtype=np.int64)
-                self._rebuild_lists(vecs[keep], slots[keep])
-                self._reset_delta()
+                self.flush_delta()
             return mapping
 
     # -- persistence ---------------------------------------------------------
@@ -793,6 +1069,7 @@ class IVFStore:
                 "pq_segments": self.pq_segments,
                 "pq_centroids": self.pq_centroids,
                 "rescore_limit": self.rescore_limit,
+                "retrain_factor": self.retrain_factor,
                 "pq_codebook": (np.asarray(self.codebook.centroids)
                                 if self.codebook is not None else None),
             }
@@ -817,7 +1094,8 @@ class IVFStore:
                     quantization=snap.get("ivf_quantization"),
                     pq_segments=snap.get("pq_segments"),
                     pq_centroids=snap.get("pq_centroids", 16),
-                    rescore_limit=snap.get("rescore_limit", 16))
+                    rescore_limit=snap.get("rescore_limit", 16),
+                    retrain_factor=snap.get("retrain_factor", 4.0))
         slots = np.asarray(snap["live_slots"], dtype=np.int64)
         vecs = np.asarray(snap["live_vectors"], dtype=np.float32)
         store._count = snap["count"]
@@ -833,7 +1111,8 @@ class IVFStore:
             store.normalize_on_add = norm
         if snap.get("centroids") is not None:
             store.nlist = snap["nlist"]
-            store.centroids = jnp.asarray(snap["centroids"])
+            store._centroids_np = np.asarray(snap["centroids"], np.float32)
+            store.centroids = jnp.asarray(store._centroids_np)
             store._c_norms = jnp.sum(store.centroids * store.centroids, axis=1)
             if store.quantization and store.codebook is None:
                 # quantization enabled before any codebook could train
@@ -843,26 +1122,12 @@ class IVFStore:
                                      np.empty(0, np.int64))
                 if len(vecs):
                     store._add_to_delta(slots, vecs)
-            elif len(vecs):
-                store._rebuild_lists(vecs, slots)
             else:
-                # trained-but-empty: allocate empty list tensors so later
+                # empty corpora still allocate list tensors so later
                 # delta flushes have somewhere to scatter (a None _fill
                 # would crash the first _maybe_reorganize)
-                cap = 8
-                store.list_cap = cap
-                if store.quantization:
-                    store.list_codes = jnp.zeros(
-                        (store.nlist, cap, store.pq_segments),
-                        dtype=jnp.uint8)
-                else:
-                    store.list_vecs = jnp.zeros(
-                        (store.nlist, cap, store.dim), dtype=store.dtype)
-                    store.list_norms = jnp.zeros((store.nlist, cap),
-                                                 dtype=jnp.float32)
-                store.list_valid = jnp.zeros((store.nlist, cap), dtype=jnp.bool_)
-                store.list_slots = jnp.full((store.nlist, cap), -1, dtype=jnp.int32)
-                store._fill = np.zeros(store.nlist, dtype=np.int64)
+                store._rebuild_lists(vecs, slots)
+            store._live_at_train = len(store._slot_loc)
             store._hbm_sync()  # centroids set outside _rebuild_lists
         elif len(vecs):
             # untrained: everything back into the delta buffer
@@ -876,9 +1141,10 @@ class IVFIndex(FlatIndex):
     contract docs (reference: vector_index.go:24-45)."""
 
     index_type = "ivf"
-    # IVFStore.search takes shared [capacity] masks only — the batcher
-    # keeps filtered requests on the solo path for this index type
-    supports_batched_filters = False
+    # IVFStore folds [B, capacity] per-query masks into packed allow_bits
+    # inside the probe — the QueryBatcher coalesces filtered IVF requests
+    # into one device program instead of dispatching them solo
+    supports_batched_filters = True
 
     def __init__(self, dim: int, metric: str = "l2-squared",
                  capacity: int = 8192, chunk_size: int = 8192,
@@ -902,11 +1168,20 @@ class IVFIndex(FlatIndex):
         with self._lock:
             self.store.train(force_nlist=nlist)
 
+    def maintain(self) -> None:
+        """Incremental maintenance (db/shard.py epoch_maintenance): delta
+        flush always, retrain only past the drift gate — never a
+        compaction-triggered full rebuild."""
+        with self._lock:
+            self.store.maintain()
+
     def compress(self, quantization: str = "pq", **quant_kwargs) -> None:
-        """Runtime switch to PQ residency: fit a codebook on live contents
-        and rebuild the posting lists as codes (reference lifecycle:
-        hnsw/compress.go:38 via config update). Slot ids are stable, so
-        the id<->slot maps carry over untouched."""
+        """Runtime switch to residual-PQ residency: fit a codebook on the
+        residuals of live contents and rebuild the posting lists as codes
+        (reference lifecycle: hnsw/compress.go:38 via config update).
+        Slot ids are stable, so the id<->slot maps carry over untouched.
+        On an untrained store the codebook deferral stands (residuals
+        need centroids): it trains alongside the coarse partition."""
         if quantization != "pq":
             raise ValueError("ivf supports quantization='pq'")
         from weaviate_tpu.ops.pq import default_pq_segments, pq_fit
@@ -926,8 +1201,11 @@ class IVFIndex(FlatIndex):
                 raise RuntimeError(
                     f"need >= {pq_centroids} live vectors to train PQ, "
                     f"have {len(vecs)}")
-            codebook = (pq_fit(vecs, m=pq_segments, k=pq_centroids, iters=8)
-                        if len(vecs) else None)
+            codebook = None
+            if len(vecs) and st.trained:
+                assign = kmeans_assign(vecs, st._centroids_np)
+                codebook = pq_fit(vecs - st._centroids_np[assign],
+                                  m=pq_segments, k=pq_centroids, iters=8)
             st.quantization = "pq"
             st.pq_segments = pq_segments
             st.pq_centroids = pq_centroids
@@ -949,6 +1227,7 @@ class IVFIndex(FlatIndex):
                 # empty case still rebuilds so _fill reflects reality.
                 st._rebuild_lists(vecs, slots)
                 st._reset_delta()
+            st._hbm_sync()
 
     @property
     def trained(self) -> bool:

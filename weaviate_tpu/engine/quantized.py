@@ -40,6 +40,7 @@ import numpy as np
 
 from weaviate_tpu.ops import bq as bq_ops
 from weaviate_tpu.ops import pq as pq_ops
+from weaviate_tpu.ops.candidates import gather_rescore_topk
 from weaviate_tpu.ops.distances import normalize_np
 from weaviate_tpu.parallel.mesh import n_row_shards, shardable_capacity
 from weaviate_tpu.runtime import hbm_ledger, tracing
@@ -561,10 +562,16 @@ class QuantizedVectorStore:
     def rescore_mode(self) -> str:
         """Where the exact rescore happens for this store's config:
         ``"inline"`` (inside the SPMD program, distances already exact),
-        ``"post"`` (oversampled candidates come back for a host rescore),
-        or ``"none"`` (code-distance order is the contract)."""
+        ``"plane"`` (single-device bf16 rows: the oversampled candidates
+        rescore ON DEVICE through the shared candidate plane — the epoch
+        store treats this like ``"post"`` because its candidates span
+        per-epoch tier snapshots), ``"post"`` (oversampled candidates
+        come back for a host rescore), or ``"none"`` (code-distance
+        order is the contract)."""
         if self.rescore == "device" and self.mesh is not None:
             return "inline"
+        if self.rescore == "device" and self.rescore_rows is not None:
+            return "plane"
         if (self._host_vectors is not None
                 or (self.rescore == "device" and self.mesh is None)
                 or (self.rescore == "none" and self.fetch_fn is not None)):
@@ -657,6 +664,7 @@ class QuantizedVectorStore:
         # dispatch so the two paths can never drift.
         mode = self.rescore_mode()
         inline_rescore = mode == "inline"
+        plane_rescore = mode == "plane"
         post_rescore = mode == "post"
         with tracing.span("store.quantized_scan", rows=self.capacity,
                           queries=len(queries), k=k,
@@ -684,7 +692,7 @@ class QuantizedVectorStore:
                 if inline_rescore:
                     k_cand = min(max(k * self.rescore_limit, k), capacity)
                     k_out = min(k, capacity)
-                elif post_rescore:
+                elif post_rescore or plane_rescore:
                     k_cand = min(max(k * self.rescore_limit, k), capacity)
                     k_out = k_cand
                 else:
@@ -693,6 +701,18 @@ class QuantizedVectorStore:
                 d, i = self._scan(jnp.asarray(queries), k_cand, valid,
                                   k_out, allow_bits=allow_bits,
                                   allow_rows=allow_rows_dev)
+                if plane_rescore:
+                    # oversampled candidates rescore ON DEVICE against
+                    # the bf16 rescore rows through the shared candidate
+                    # plane — the full-precision tier is already in HBM,
+                    # so the old host gather roundtrip buys nothing
+                    sp.set(path="device_plane_rescore")
+                    metric = ("cosine"
+                              if self.metric in ("cosine", "cosine-dot")
+                              else self.metric)
+                    d, i = gather_rescore_topk(
+                        jnp.asarray(queries), i.astype(jnp.int32),
+                        self.rescore_rows, min(k, k_out), metric)
                 # dispatch-time snapshot for the finish step's rescore:
                 # the scan's candidate slot-ids are only meaningful
                 # against THIS capacity/row layout — compact()/_grow()

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from weaviate_tpu.ops.candidates import shared_candidates_topk
 from weaviate_tpu.ops.distances import normalize
 from weaviate_tpu.ops.topk import chunked_topk_distances
 from weaviate_tpu.runtime import hbm_ledger, tracing
@@ -670,22 +671,14 @@ class DeviceVectorStore:
         (d_dev, i_dev, slot_buf)."""
         m = len(allowed)
         bucket = 1 << max(7, (m - 1).bit_length())
-        slot_buf = np.zeros(bucket, dtype=np.int32)
+        slot_buf = np.full(bucket, -1, dtype=np.int32)
         slot_buf[:m] = allowed
-        vmask = np.zeros(bucket, dtype=bool)
-        vmask[:m] = True
-        slots_dev = jnp.asarray(slot_buf)
-        rows = self.vectors[slots_dev]
-        valid_g = jnp.logical_and(self.valid[slots_dev],
-                                  jnp.asarray(vmask))
-        norms_g = (self.sq_norms[slots_dev]
-                   if self.sq_norms is not None else None)
         metric = ("cosine" if self.metric in ("cosine", "cosine-dot")
                   else self.metric)
-        d, i = chunked_topk_distances(
-            jnp.asarray(queries), rows, k=min(k, bucket),
-            chunk_size=bucket, metric=metric, valid=valid_g,
-            x_sq_norms=norms_g, use_pallas=self.use_pallas,
+        d, i = shared_candidates_topk(
+            jnp.asarray(queries), jnp.asarray(slot_buf), self.vectors,
+            min(k, bucket), metric, row_norms=self.sq_norms,
+            valid=self.valid, use_pallas=self.use_pallas,
             selection=self.selection,
         )
         return d, i, slot_buf
@@ -693,11 +686,9 @@ class DeviceVectorStore:
     @staticmethod
     def _finish_gathered(d_np: np.ndarray, i_np: np.ndarray,
                          slot_buf: np.ndarray, k: int):
-        """Host half of the gathered path: bucket-local indices back to
-        store slots, -1/inf padding up to search()'s [B, k] contract."""
-        bucket = len(slot_buf)
-        live = i_np >= 0
-        i_np = np.where(live, slot_buf[np.clip(i_np, 0, bucket - 1)], -1)
+        """Host half of the gathered path. The candidate plane remaps
+        bucket-local winners to global slots ON DEVICE (row_ids), so
+        this is pad-only up to search()'s [B, k] contract."""
         if i_np.shape[1] < k:
             pad = k - i_np.shape[1]
             i_np = np.pad(i_np, ((0, 0), (0, pad)), constant_values=-1)

@@ -1,0 +1,124 @@
+"""Shared device candidate-slot gather/rescore plane (ISSUE 16).
+
+One idea, two memory regimes: score a BOUNDED candidate set instead of
+the whole corpus, entirely on device, and return exact top-k over it.
+Candidate sets arrive as static padded int32 slot tensors (-1 = empty
+slot), so every consumer compiles to the same gather → matmul → fused
+top-k shape regardless of how many candidates are actually live:
+
+- ``gather_rescore_topk`` — PER-QUERY candidate sets ``[B, C]`` (IVF
+  multi-probe unions, residual-PQ rescore oversets, ISSUE-3 posting
+  candidates later): one batched row gather ``[B, C, d]``, one einsum
+  distance, masked exact top-k. Per-query allow bitmasks (the PR 3
+  block-strided ``allow_bits`` format) fold per CANDIDATE via
+  ``allow_bits_for_ids`` — a word gather per slot, never a dense
+  ``[B, capacity]`` unpack.
+- ``shared_candidates_topk`` — ONE candidate set shared by the whole
+  batch (the low-selectivity filter cutover in ``engine/store.py``):
+  gather the bucket once ``[C, d]``, run the standard chunked scan over
+  the dense bucket, and remap bucket-local winners back to global slots
+  ON DEVICE (so the host finish step only pads — no host remap).
+
+The reference engine has no equivalent: its HNSW walk re-reads
+neighbours pointer-by-pointer from an in-RAM graph. Here the candidate
+set is materialized as one gather so the MXU sees a dense matmul
+(SURVEY §7 step 5 — "recast the walk as gather-matmuls").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from weaviate_tpu.ops.distances import MASKED_DISTANCE, normalize
+from weaviate_tpu.ops.pallas_kernels import allow_bits_for_ids
+from weaviate_tpu.ops.topk import chunked_topk_distances, topk_smallest
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def gather_rescore_topk(q, cand_idx, rows, k: int, metric: str, *,
+                        ids_of_row=None, row_norms=None, valid=None,
+                        allow_bits=None):
+    """Exact top-k over per-query candidate sets, one gather-matmul.
+
+    ``q`` [B, d] f32; ``cand_idx`` [B, C] (or [1, C], broadcast) int32
+    gather indices into ``rows`` [N, d]; negative indices are empty
+    padding. ``ids_of_row`` [N] int32 optionally maps row positions to
+    the GLOBAL ids reported in the result (and folded against
+    ``allow_bits``) — IVF passes flattened list positions as
+    ``cand_idx`` and ``list_slots`` as ``ids_of_row``; plain rescore
+    passes slot ids directly and omits it. ``valid`` [N] bool masks dead
+    rows; ``allow_bits`` [B or 1, W] uint32 is the packed per-query
+    allow mask over global ids. Returns ``(dists [B, k'], ids [B, k'])``
+    ascending with ``k' = min(k, C)``; empty/masked tail is
+    ``(MASKED_DISTANCE, -1)``. Cosine queries are normalized here; ``rows`` are
+    expected pre-normalized (the store invariant).
+    """
+    b = q.shape[0]
+    n = rows.shape[0]
+    c = cand_idx.shape[1]
+    idx = jnp.broadcast_to(cand_idx, (b, c))
+    safe = jnp.clip(idx, 0, n - 1)
+    live = (idx >= 0) & (idx < n)
+    g = rows[safe].astype(jnp.float32)                    # [B, C, d]
+    q32 = q.astype(jnp.float32)
+    if metric in ("cosine", "cosine-dot"):
+        q32 = normalize(q32)
+    dots = jnp.einsum("bd,bcd->bc", q32, g,
+                      preferred_element_type=jnp.float32)
+    if metric == "l2-squared":
+        if row_norms is not None:
+            g_norms = row_norms[safe].astype(jnp.float32)
+        else:
+            g_norms = jnp.sum(g * g, axis=-1)
+        q_norms = jnp.sum(q32 * q32, axis=-1, keepdims=True)
+        d = jnp.maximum(q_norms - 2.0 * dots + g_norms, 0.0)
+    elif metric == "dot":
+        d = -dots
+    else:  # cosine family: rows and q unit-norm -> distance 1 - cos
+        d = 1.0 - dots
+    if ids_of_row is not None:
+        ids = jnp.where(live, ids_of_row[safe], -1)
+    else:
+        ids = jnp.where(live, idx, -1)
+    ok = live & (ids >= 0)
+    if valid is not None:
+        ok = ok & valid[safe]
+    if allow_bits is not None:
+        ok = ok & allow_bits_for_ids(allow_bits, ids)
+    d = jnp.where(ok, d, MASKED_DISTANCE)
+    fd, fi = topk_smallest(d, ids, min(k, c))
+    fi = jnp.where(fd >= MASKED_DISTANCE, -1, fi)
+    return fd, fi
+
+
+def shared_candidates_topk(q, cand_slots, rows, k: int, metric: str, *,
+                           row_norms=None, valid=None, use_pallas=False,
+                           selection: str = "exact"):
+    """Top-k over ONE candidate slot set shared by the whole batch.
+
+    ``cand_slots`` [C] int32 global slots (-1 padding, C a power of
+    two); the bucket is gathered ONCE to ``[C, d]`` and scanned with the
+    standard chunked kernel (fused Pallas top-k when eligible), then
+    bucket-local winner positions remap to global slots on device via
+    ``row_ids`` — callers get global ids straight off the handle. This
+    is the low-selectivity gathered path: total work is O(B·C), not
+    O(B·N), and C tracks the allow-list size.
+    """
+    n = rows.shape[0]
+    slots = jnp.asarray(cand_slots, dtype=jnp.int32)
+    safe = jnp.clip(slots, 0, n - 1)
+    live = (slots >= 0) & (slots < n)
+    g_rows = jnp.where(live[:, None], rows[safe], 0)
+    g_valid = live if valid is None else live & valid[safe]
+    g_norms = None
+    if metric == "l2-squared":
+        g_norms = (row_norms[safe].astype(jnp.float32)
+                   if row_norms is not None
+                   else jnp.sum(g_rows.astype(jnp.float32) ** 2, axis=-1))
+    return chunked_topk_distances(
+        q, g_rows, k=min(k, slots.shape[0]), chunk_size=slots.shape[0],
+        metric=metric, valid=g_valid, x_sq_norms=g_norms,
+        use_pallas=use_pallas, selection=selection, row_ids=slots)

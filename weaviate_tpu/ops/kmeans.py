@@ -24,15 +24,17 @@ from weaviate_tpu.ops.distances import pairwise_distance
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def _assign_accumulate(chunk, centroids, c_norms, k: int):
-    """One chunk's Lloyd contribution: (assign [n], sums [k,d], counts [k])."""
+    """One chunk's Lloyd contribution:
+    (assign [n], assigned_dist [n], sums [k,d], counts [k])."""
     d = pairwise_distance(chunk, centroids, metric="l2-squared",
                           x_sq_norms=c_norms)
     assign = jnp.argmin(d, axis=1)
+    dmin = jnp.min(d, axis=1)
     one_hot = jax.nn.one_hot(assign, k, dtype=jnp.float32)  # [n, k]
     sums = jnp.einsum("nk,nd->kd", one_hot, chunk.astype(jnp.float32),
                       preferred_element_type=jnp.float32)
     counts = jnp.sum(one_hot, axis=0)
-    return assign.astype(jnp.int32), sums, counts
+    return assign.astype(jnp.int32), dmin, sums, counts
 
 
 def kmeans_fit(vectors: np.ndarray, k: int, iters: int = 10,
@@ -57,15 +59,63 @@ def kmeans_fit(vectors: np.ndarray, k: int, iters: int = 10,
         sums = jnp.zeros((k, dim), dtype=jnp.float32)
         counts = jnp.zeros((k,), dtype=jnp.float32)
         for s in range(0, n, batch):
-            _, cs, cc = _assign_accumulate(jnp.asarray(vectors[s:s + batch]),
-                                           centroids, c_norms, k)
+            _, _, cs, cc = _assign_accumulate(
+                jnp.asarray(vectors[s:s + batch]), centroids, c_norms, k)
             sums = sums + cs
             counts = counts + cc
         fresh = sums / jnp.maximum(counts, 1.0)[:, None]
         centroids = jnp.where((counts > 0)[:, None], fresh, centroids)
+        counts_np = np.asarray(counts)  # graftlint: disable=G1 — training-time boundary
+        if (counts_np == 0).any():
+            centroids = _reseed_empty(vectors, centroids, counts_np, batch)
     # np.asarray already materializes (and therefore waits for) the
     # result; the extra block_until_ready was a redundant second sync
     return np.asarray(centroids)  # graftlint: disable=G1 — training-time boundary: callers consume host centroids
+
+
+def _reseed_empty(vectors: np.ndarray, centroids, counts_np: np.ndarray,
+                  batch: int):
+    """Reseed EMPTY clusters from the farthest-assigned points of the
+    fullest cluster (deterministic — ties break toward the lowest point
+    index, no RNG, so ``kmeans_fit`` stays reproducible under ``seed``).
+
+    Without this, ``jnp.where(counts > 0, fresh, centroids)`` pins a dead
+    centroid at its stale position FOREVER: nothing reassigns to it, so
+    it stays empty every remaining iteration and the trained partition
+    silently runs with fewer effective lists (ISSUE 16 satellite). An
+    extra assignment pass only runs on iterations that actually have
+    empties.
+    """
+    n = len(vectors)
+    k = centroids.shape[0]
+    empties = np.flatnonzero(counts_np == 0)
+    fullest = int(np.argmax(counts_np))
+    c_norms = jnp.sum(centroids * centroids, axis=1)
+    assign_all = np.empty(n, dtype=np.int32)
+    dist_all = np.empty(n, dtype=np.float32)
+    for s in range(0, n, batch):
+        a, dm, _, _ = _assign_accumulate(
+            jnp.asarray(vectors[s:s + batch]), centroids, c_norms, k)
+        assign_all[s:s + batch] = np.asarray(a)
+        dist_all[s:s + batch] = np.asarray(dm)
+    pool = np.flatnonzero(assign_all == fullest)
+    # farthest first; lexsort's LAST key is primary, `pool` breaks ties
+    order = pool[np.lexsort((pool, -dist_all[pool]))]
+    chosen = list(order[: len(empties)])
+    if len(chosen) < len(empties):
+        # degenerate fullest cluster (fewer members than empty slots):
+        # top up with the globally farthest-from-assigned points
+        taken = set(chosen)
+        for idx in np.argsort(-dist_all, kind="stable"):
+            if int(idx) not in taken:
+                chosen.append(int(idx))
+                taken.add(int(idx))
+                if len(chosen) == len(empties):
+                    break
+    # np.array (not asarray): device arrays materialize as read-only views
+    cents = np.array(centroids)  # graftlint: disable=G1 — training-time boundary
+    cents[empties] = vectors[np.asarray(chosen, dtype=np.int64)]
+    return jnp.asarray(cents)
 
 
 def kmeans_assign(vectors: np.ndarray, centroids: np.ndarray,
@@ -77,7 +127,7 @@ def kmeans_assign(vectors: np.ndarray, centroids: np.ndarray,
     k = cent.shape[0]
     out = np.empty(len(vectors), dtype=np.int32)
     for s in range(0, len(vectors), batch):
-        a, _, _ = _assign_accumulate(jnp.asarray(vectors[s:s + batch]),
-                                     cent, c_norms, k)
+        a, _, _, _ = _assign_accumulate(jnp.asarray(vectors[s:s + batch]),
+                                        cent, c_norms, k)
         out[s:s + batch] = np.asarray(a)
     return out

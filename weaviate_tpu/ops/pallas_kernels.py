@@ -148,6 +148,32 @@ def unpack_allow_bitmask(bits: jnp.ndarray, n_cols: int | None = None):
     return out
 
 
+def allow_bits_for_ids(bits: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """Per-CANDIDATE allow lookup in the block-strided packed layout.
+
+    ``bits`` [Ba, W] uint32 (``Ba == 1`` broadcasts over the batch),
+    ``ids`` [B, C] int32 global column ids -> [B, C] bool. This is the
+    candidate plane's fold (ops/candidates.py): instead of unpacking a
+    dense [B, capacity] mask, each candidate gathers its ONE word —
+    column c lives at word ``(c // MASK_BLOCK) * W_blk + (c % MASK_BLOCK)
+    % W_blk``, bit ``(c % MASK_BLOCK) // W_blk`` (the packer's
+    block-strided order above). Ids outside [0, 32·W) — including the -1
+    empty-slot sentinel — read as disallowed, matching the packer's
+    zeros-past-C convention.
+    """
+    b, c = ids.shape
+    n_cols = bits.shape[1] * 32
+    safe = jnp.clip(ids, 0, n_cols - 1)
+    off = safe % MASK_BLOCK
+    word = (safe // MASK_BLOCK) * _MASK_WORDS + (off % _MASK_WORDS)
+    bit = (off // _MASK_WORDS).astype(jnp.uint32)
+    wb = jnp.broadcast_to(jnp.asarray(bits, dtype=jnp.uint32),
+                          (b, bits.shape[1]))
+    w = jnp.take_along_axis(wb, word, axis=1)
+    ok = ((w >> bit) & jnp.uint32(1)) != 0
+    return ok & (ids >= 0) & (ids < n_cols)
+
+
 def _fit_mask_words(allow_bits, b_pad: int, n_cols: int):
     """Pad/slice packed words to [b_pad, n_cols // 32] int32 (Mosaic wants
     signed lanes; bit extraction is sign-agnostic). Padding rows/columns

@@ -157,6 +157,7 @@ def chunked_topk_distances(
     selection: str = "exact",
     allow_bits: jnp.ndarray | None = None,
     allow_rows: jnp.ndarray | None = None,
+    row_ids: jnp.ndarray | None = None,
 ):
     """Brute-force top-k of ``q`` [B,d] against ``x`` [N,d], scanning in chunks.
 
@@ -174,6 +175,12 @@ def chunked_topk_distances(
     tile. ``allow_rows`` ([B, N] bool) is the unpacked equivalent for
     callers that already hold a sliced bool mask (the sharded local path);
     pass at most one of the two.
+
+    ``row_ids`` ([N] int32) remaps scanned row POSITIONS to global ids on
+    device before returning — the candidate plane's slot remap
+    (ops/candidates.shared_candidates_topk scans a gathered bucket whose
+    row r is global slot ``row_ids[r]``; -1 marks bucket padding). Use
+    with ``id_offset=0``; winners carrying a -1 row id surface as -1.
 
     ``selection`` picks the per-chunk candidate selector:
 
@@ -216,6 +223,9 @@ def chunked_topk_distances(
                 x_sq_norms=x_sq_norms, allow_bits=allow_bits,
                 allow_rows=allow_rows,
             )
+            if row_ids is not None:
+                return d, jnp.where(
+                    i < 0, i, row_ids[jnp.clip(i, 0, n - 1)])
             return d, jnp.where(i < 0, i, i + id_offset)
         # degrade gracefully: non-Pallas metrics take the exact XLA scan,
         # oversized k the approx per-chunk selection (same recall story)
@@ -303,6 +313,9 @@ def chunked_topk_distances(
         )
     else:
         (final_d, final_i), _ = jax.lax.scan(body, (init_d, init_i), xs)
+    if row_ids is not None:
+        final_i = jnp.where(final_i < 0, final_i,
+                            row_ids[jnp.clip(final_i, 0, n - 1)])
     return final_d, final_i
 
 

@@ -70,7 +70,8 @@ QUANTIZED_RULES = (
 IVF_RULES = (
     (r"^q$", REPLICATED),
     (r"^pq_centroids$", REPLICATED),
-    (r"^(centroids|list_codes|list_valid|list_slots)$", ROW_SHARDED),
+    (r"^(centroids|list_codes|list_valid|list_slots|list_tvals)$",
+     ROW_SHARDED),
 )
 
 def _is_scalar(arr) -> bool:

@@ -56,6 +56,7 @@ except ImportError:  # jax 0.4.x: experimental home, check_rep
 
     _SHARD_MAP_CHECK_KW = "check_rep"
 
+from weaviate_tpu.ops.pallas_kernels import _MASK_WORDS
 from weaviate_tpu.ops.topk import chunked_topk_distances, topk_smallest
 from weaviate_tpu.parallel import partition
 from weaviate_tpu.parallel.mesh import (
@@ -571,6 +572,7 @@ def sharded_ivf_pq_topk(
     list_codes: jnp.ndarray,
     list_valid: jnp.ndarray,
     list_slots: jnp.ndarray,
+    list_tvals: jnp.ndarray,
     pq_centroids: jnp.ndarray,
     k: int,
     nprobe: int,
@@ -583,8 +585,9 @@ def sharded_ivf_pq_topk(
 
     The 100M-per-chip capacity layout (SURVEY §7): ``centroids``
     [nlist, d], ``list_codes`` [nlist, cap, m], ``list_valid``
-    [nlist, cap], ``list_slots`` [nlist, cap] are all sharded over the
-    mesh's row axes on the LIST dim; ``q`` and the PQ codebook are
+    [nlist, cap], ``list_slots`` [nlist, cap], ``list_tvals``
+    [nlist, cap] (per-row residual-ADC constant) are all sharded over
+    the mesh's row axes on the LIST dim; ``q`` and the PQ codebook are
     replicated. Each device ranks ITS local centroids, probes its local
     top-nprobe lists (so the union covers >= the global top-nprobe;
     recall can only exceed the single-device equivalent), scores codes
@@ -604,14 +607,16 @@ def sharded_ivf_pq_topk(
     """
     from weaviate_tpu.engine.ivf import _ivf_probe_topk_pq
 
-    dummy_allow = jnp.ones((1,), dtype=bool)
+    # inline, NOT engine.ivf._dummy_bits(): this function body runs under
+    # its own jit trace, and a cached helper must never capture a tracer
+    dummy_bits = jnp.zeros((1, _MASK_WORDS), dtype=jnp.uint32)
 
-    def local_probe(q_, cent_, codes_, valid_, slots_, pqc_):
+    def local_probe(q_, cent_, codes_, valid_, slots_, tvals_, pqc_):
         local_nlist = cent_.shape[0]
         cn = jnp.sum(cent_.astype(jnp.float32) ** 2, axis=-1)
         d, s = _ivf_probe_topk_pq(
-            q_, cent_, cn, codes_, valid_, slots_, pqc_,
-            dummy_allow, min(k, local_nlist * codes_.shape[1]),
+            q_, cent_, cn, codes_, valid_, slots_, tvals_, pqc_,
+            dummy_bits, min(k, local_nlist * codes_.shape[1]),
             min(nprobe, local_nlist), metric, False)
         return _merge_topk_mesh(d, s, mesh, axis, k, compact=dcn_compact)
 
@@ -619,17 +624,17 @@ def sharded_ivf_pq_topk(
         partition.IVF_RULES,
         {"q": q, "centroids": centroids, "list_codes": list_codes,
          "list_valid": list_valid, "list_slots": list_slots,
-         "pq_centroids": pq_centroids},
+         "list_tvals": list_tvals, "pq_centroids": pq_centroids},
         mesh)
     fn = shard_map(
         local_probe,
         mesh=mesh,
         in_specs=(specs["q"], specs["centroids"], specs["list_codes"],
                   specs["list_valid"], specs["list_slots"],
-                  specs["pq_centroids"]),
+                  specs["list_tvals"], specs["pq_centroids"]),
         out_specs=(partition.replicated_spec(),
                    partition.replicated_spec()),
         check_vma=False,
     )
     return fn(q, centroids, list_codes, list_valid, list_slots,
-              pq_centroids)
+              list_tvals, pq_centroids)

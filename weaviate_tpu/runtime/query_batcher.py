@@ -111,7 +111,12 @@ class QueryBatcher:
     ``batch_fn(queries [B,d], k, allow) -> (ids [B,k], dists [B,k])``
     where ``allow`` is None, one shared allow list, or — only when
     ``supports_filter_batching`` — a list of per-request allow lists
-    (None entries = unfiltered). ``capacity_fn`` (optional, returns the
+    (None entries = unfiltered). ``supports_filter_batching`` may be a
+    bool or a zero-arg callable re-read at every dispatch: index
+    capabilities change at runtime (DynamicIndex's flat->IVF upgrade,
+    ``compress()`` swapping the backing store), and a stale snapshot
+    would keep routing filtered requests solo after the index learned
+    to coalesce them. ``capacity_fn`` (optional, returns the
     backing store's row capacity) powers the per-dispatch selectivity
     heuristic that routes tiny filters to the solo/gathered path — wire
     it ONLY when the store has a gathered cutover; otherwise solo is a
@@ -141,7 +146,7 @@ class QueryBatcher:
         self.max_batch = max_batch
         self.max_queue = DEFAULT_MAX_QUEUE if max_queue is None \
             else max_queue
-        self.filter_batching = supports_filter_batching
+        self.filter_batching = supports_filter_batching  # bool | callable
         self._capacity_fn = capacity_fn
         self.pad_pow2 = pad_pow2
         # HBM-ledger labels for the padded dispatch buffer (the shard
@@ -354,9 +359,11 @@ class QueryBatcher:
         # without batched-filter support and highly selective filters
         # (gathered cutover) dispatch solo
         solo, coal = [], []
+        fb = self.filter_batching
+        filter_batching = bool(fb() if callable(fb) else fb)
         for it in drained:
             if it.allow is not None and (
-                    not self.filter_batching or self._prefer_solo(it)):
+                    not filter_batching or self._prefer_solo(it)):
                 solo.append(it)
             else:
                 coal.append(it)
