@@ -904,6 +904,31 @@ def sec_observability_overhead(ctx):
         return (n / (time.perf_counter() - t0),
                 (time.process_time() - c0) / n * 1e6)
 
+    from weaviate_tpu.runtime import kernelscope
+
+    def explain_one():
+        # the ?explain=true request shape: request sink installed at the
+        # edge, dispatch plan merged back after the batcher round trip
+        token = kernelscope.explain_begin()
+        try:
+            served_one()
+        finally:
+            kernelscope.explain_end(token)
+
+    def explain_us(reps=2000, rounds=3):
+        # drift-cancelling alternation, same discipline as tight_us
+        on_best = off_best = float("inf")
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                explain_one()
+            on_best = min(on_best, (time.perf_counter() - t0) / reps)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                served_one()
+            off_best = min(off_best, (time.perf_counter() - t0) / reps)
+        return max(0.0, (on_best - off_best) * 1e6)
+
     try:
         for state in (True, False, True):  # warm both states' caches
             tailboard.force_enabled(state)
@@ -918,28 +943,61 @@ def sec_observability_overhead(ctx):
             tailboard.force_enabled(i % 2 == 0)
             (on_us if i % 2 == 0 else off_us).append(tight_us())
         timeline_cost_us = max(0.0, min(on_us) - min(off_us))
-        # served denominator + informational A/B
+        # explain cost: same composed-estimator treatment — the sink
+        # install + per-section dict merges + plan fold, on-minus-off
         tailboard.force_enabled(True)
+        explain_cost_us = explain_us()
+        # served denominator + informational A/B
         ab_on_qps, _cpu_on = served_round()
         tailboard.force_enabled(False)
         ab_off_qps, request_cpu_us = served_round()
+        # metering accuracy: serve two tenants through their own
+        # batchers, then check the per-tenant meters SUM back to the
+        # total device residency kernelscope attributed — the
+        # apportionment rule (shares sum to the dispatch window) is the
+        # invariant the 5% gate band pins
+        kernelscope.reset_for_tests()
+        tenants = []
+        for t in ("t0", "t1"):
+            tqb = QueryBatcher(idx.search_by_vector_batch, max_batch=64,
+                               owner={"collection": "bench", "tenant": t})
+            tenants.append(tqb)
+        try:
+            for tqb in tenants:
+                for _ in range(100):
+                    tqb.search(q, 10)
+        finally:
+            for tqb in tenants:
+                tqb.stop()
+        metered = sum(kernelscope.meters_snapshot().values())
+        total_dev = kernelscope.total_device_seconds()
+        metering_sum_over_total = (metered / total_dev
+                                   if total_dev > 0 else 1.0)
     finally:
         tailboard.force_enabled(None)
         qb.stop()
         tracing.clear_traces()
+        kernelscope.reset_for_tests()
     overhead = timeline_cost_us / max(request_cpu_us, 1e-9)
     ratio = 1.0 / (1.0 + overhead)
+    explain_ratio = 1.0 / (1.0 + explain_cost_us
+                           / max(request_cpu_us, 1e-9))
     out = {
         "timeline_cost_us": round(timeline_cost_us, 3),
         "request_cpu_us": round(request_cpu_us, 2),
         "on_over_off_qps": round(ratio, 4),
         "overhead_frac": round(1.0 - ratio, 4),
+        "explain_cost_us": round(explain_cost_us, 3),
+        "explain_on_over_off_qps": round(explain_ratio, 4),
+        "metering_sum_over_total": round(metering_sum_over_total, 4),
         "ab_on_qps": round(ab_on_qps, 1),
         "ab_off_qps": round(ab_off_qps, 1),
     }
     log(f"[observability] timeline {timeline_cost_us:.2f} us/req over "
         f"{request_cpu_us:.0f} us served cpu -> ratio {ratio:.4f} "
-        f"(overhead {out['overhead_frac'] * 100:.2f}%); A/B "
+        f"(overhead {out['overhead_frac'] * 100:.2f}%); explain "
+        f"{explain_cost_us:.2f} us -> {explain_ratio:.4f}; metering "
+        f"sum/total {metering_sum_over_total:.4f}; A/B "
         f"{ab_on_qps:.0f}/{ab_off_qps:.0f} qps")
     return out
 

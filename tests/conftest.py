@@ -46,7 +46,8 @@ def _faultline_isolation():
     yield
     from weaviate_tpu.cluster.transport import reset_breakers
     from weaviate_tpu.replication.hashbeater import replication_status
-    from weaviate_tpu.runtime import degrade, faultline, metrics, tailboard
+    from weaviate_tpu.runtime import (degrade, faultline, kernelscope,
+                                      metrics, tailboard)
     from weaviate_tpu.storage import recovery
 
     faultline.disarm()
@@ -60,3 +61,7 @@ def _faultline_isolation():
     # would make incident assertions order-dependent
     tailboard.reset_for_tests()
     metrics.reset_series_cap_for_tests()
+    # kernelscope: memcpy EWMAs, variant residency, tenant meters and
+    # the capture dir all live at module level — a leaked explain sink
+    # or meter total would corrupt the next test's attribution math
+    kernelscope.reset_for_tests()

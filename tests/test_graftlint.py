@@ -772,6 +772,104 @@ def test_g5_histogram_conventions_accept_clean(tmp_path):
     assert [v for v in res.violations if v.check == "G5"] == []
 
 
+G5_METER_POSITIVE = """
+    from weaviate_tpu.runtime.metrics import registry
+
+    # P1: time-accumulating counter in milliseconds
+    a = registry.counter("weaviate_tpu_device_ms_total", "device ms")
+    # P2: seconds meter missing the _total suffix
+    b = registry.counter("weaviate_tpu_tenant_seconds", "tenant time",
+                         ("collection", "tenant"))
+"""
+
+G5_METER_NEGATIVE = """
+    from weaviate_tpu.runtime.metrics import registry
+
+    # THE metering shape: seconds + _total
+    a = registry.counter("weaviate_tpu_device_seconds_total", "chip time",
+                         ("collection", "tenant"))
+    # count counters are not meters — no unit token, no rule
+    b = registry.counter("weaviate_tpu_requests_total", "requests")
+    # *_seconds HISTOGRAMS stay governed by the histogram rule alone
+    c = registry.histogram("weaviate_tpu_drain_seconds", "drain", ("op",))
+"""
+
+
+def test_g5_meter_counters_must_be_seconds_total(tmp_path):
+    """ISSUE 17 G5 growth: a time-accumulating counter is a meter, and
+    meters are '*_seconds_total' — seconds repo-wide, _total per the
+    Prometheus counter convention."""
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/runtime/fx.py": G5_METER_POSITIVE})
+    g5 = [v for v in res.violations if v.check == "G5"]
+    msgs = " | ".join(v.message for v in g5)
+    assert len(g5) == 2, msgs
+    assert "weaviate_tpu_device_ms_total" in msgs
+    assert "weaviate_tpu_tenant_seconds" in msgs
+    assert "_seconds_total" in msgs
+
+
+def test_g5_meter_counters_accept_repo_shape(tmp_path):
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/runtime/fx.py": G5_METER_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G5"] == []
+
+
+G5_EXPLAIN_POSITIVE = """
+    import jax.numpy as jnp
+    from weaviate_tpu.runtime import kernelscope
+
+    def search(queries, allow_mask, k):
+        d = jnp.sum(allow_mask)
+        # P1: device value as an explain field — deferred host sync
+        kernelscope.explain_note("store", selectivity=d)
+        # P2: device expression built inline
+        kernelscope.explain_note("store", rows=jnp.count_nonzero(allow_mask))
+        return k
+"""
+
+G5_EXPLAIN_NEGATIVE = """
+    import jax.numpy as jnp
+    from weaviate_tpu.runtime import kernelscope
+
+    def search(queries, allow_list, capacity, k):
+        # host scalars only: lens, ints, precomputed fractions
+        kernelscope.explain_note(
+            "store", rows=capacity, queries=len(queries), k=k,
+            filtered=allow_list is not None,
+            selectivity=round(len(allow_list or ()) / capacity, 6))
+        d = jnp.zeros((4,))
+        return d
+"""
+
+
+def test_g5_explain_emissions_reject_device_args(tmp_path):
+    """ISSUE 17 G5 growth: explain_note() args are eagerly evaluated
+    and JSON-serialized at the API edge — a device arg is a deferred
+    host sync the G1 hot-path pass cannot see. Piggybacks G1's taint
+    machinery."""
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/engine/fx.py": G5_EXPLAIN_POSITIVE})
+    g5 = [v for v in res.violations if v.check == "G5"]
+    msgs = " | ".join(v.message for v in g5)
+    assert len(g5) == 2, msgs
+    assert "device value" in msgs and "host scalars" in msgs
+
+
+def test_g5_explain_emissions_accept_host_scalars(tmp_path):
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/engine/fx.py": G5_EXPLAIN_NEGATIVE})
+    assert [v for v in res.violations if v.check == "G5"] == []
+
+
+def test_g5_explain_emissions_scoped_to_dispatch_path(tmp_path):
+    """The taint rule only governs the dispatch-path modules — an API
+    module may legitimately note a value numpy already materialized."""
+    res = lint_tree(tmp_path,
+                    {"weaviate_tpu/api/fx.py": G5_EXPLAIN_POSITIVE})
+    assert [v for v in res.violations if v.check == "G5"] == []
+
+
 def test_g5_runtime_lint_checks_exemplar_grammar():
     """The runtime half validates OpenMetrics exemplar rendering: a
     well-formed registry passes; buckets ascending is enforced too."""

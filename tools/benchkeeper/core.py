@@ -349,6 +349,65 @@ def update_baseline(runs: list[dict], baseline: dict, *,
     return out, warnings
 
 
+# -- kernel explain (ISSUE 17) ------------------------------------------------
+
+
+def load_capture_file(path: str) -> dict:
+    """A kernelscope capture JSON (the ``/v1/debug/profile`` record
+    shape: ``kernels`` ranked by ``device_ms`` + ``total_device_ms``)."""
+    with open(path) as f:
+        cap = json.load(f)
+    if not isinstance(cap, dict) or not isinstance(
+            cap.get("kernels"), list):
+        raise ValueError(f"{path}: not a kernelscope capture JSON "
+                         "(no 'kernels' list)")
+    return cap
+
+
+def attach_kernel_explain(verdict: dict, captures: list[dict],
+                          paths: list[str] | None = None) -> dict:
+    """Fold per-kernel device-ms evidence into a gate verdict: with two
+    or more captures, the FIRST is the reference and the LAST the
+    current run — per-kernel deltas ranked by absolute movement say
+    WHICH compiled kernel a wall-level regression lives in. One capture
+    attaches its ranking alone (no deltas). Mutates and returns
+    ``verdict``."""
+    if not captures:
+        return verdict
+    before, after = captures[0], captures[-1]
+
+    def _ms(cap: dict) -> dict:
+        return {str(k.get("kernel")): float(k.get("device_ms") or 0.0)
+                for k in cap.get("kernels", ()) if isinstance(k, dict)}
+
+    after_ms = _ms(after)
+    explain = {
+        "captures": [c.get("id") for c in captures],
+        "paths": list(paths or []),
+        "total_device_ms": after.get("total_device_ms"),
+    }
+    if len(captures) >= 2:
+        before_ms = _ms(before)
+        rows = []
+        for name in sorted(set(before_ms) | set(after_ms)):
+            b, a = before_ms.get(name, 0.0), after_ms.get(name, 0.0)
+            row = {"kernel": name, "before_ms": round(b, 3),
+                   "after_ms": round(a, 3),
+                   "delta_ms": round(a - b, 3)}
+            if b > 0:
+                row["delta_frac"] = round((a - b) / b, 4)
+            rows.append(row)
+        rows.sort(key=lambda r: -abs(r["delta_ms"]))
+        explain["total_device_ms_before"] = before.get("total_device_ms")
+        explain["kernels"] = rows
+    else:
+        explain["kernels"] = [
+            {"kernel": k.get("kernel"), "after_ms": k.get("device_ms")}
+            for k in after.get("kernels", ()) if isinstance(k, dict)]
+    verdict["kernel_explain"] = explain
+    return verdict
+
+
 # -- verdict artifact ---------------------------------------------------------
 
 
@@ -419,6 +478,23 @@ def render(verdict: dict, out=None) -> None:
                 if "error" in n:
                     bits.append(f"error={n['error']}")
                 p("      section noise: " + ", ".join(bits))
+    ke = verdict.get("kernel_explain")
+    if ke:
+        n = len(ke.get("captures") or ())
+        p(f"  kernel explain ({n} capture{'' if n == 1 else 's'}, total "
+          f"{_fmt_value(ke.get('total_device_ms'), 'ms')} device):")
+        for row in (ke.get("kernels") or ())[:8]:
+            if "delta_ms" in row:
+                line = (f"    {row['kernel']}: "
+                        f"{_fmt_value(row['before_ms'], 'ms')} -> "
+                        f"{_fmt_value(row['after_ms'], 'ms')} "
+                        f"(delta {row['delta_ms']:+.3f} ms")
+                if row.get("delta_frac") is not None:
+                    line += f", {row['delta_frac'] * 100:+.1f}%"
+                p(line + ")")
+            else:
+                p(f"    {row['kernel']}: "
+                  f"{_fmt_value(row.get('after_ms'), 'ms')}")
     p(f"benchkeeper: {verdict['checked']} checked, "
       f"{verdict['passed']} passed, {verdict['regressions']} regressions, "
       f"{verdict['stale']} stale, {verdict['missing']} missing -> "
@@ -454,6 +530,13 @@ def main(argv: list[str] | None = None) -> int:
                          "/v1/debug/perf (default BENCHKEEPER_VERDICT_"
                          "PATH or tools/benchkeeper/last_verdict.json; "
                          "'-' disables)")
+    ap.add_argument("--explain", nargs="+", metavar="CAPTURE",
+                    default=None,
+                    help="kernelscope capture JSONs (GET /v1/debug/"
+                         "profile?ms=N records) to attach to the "
+                         "verdict: with two+, per-kernel device-ms "
+                         "deltas (first=reference, last=current) say "
+                         "which compiled kernel a regression lives in")
     ap.add_argument("--smoke", action="store_true",
                     help="self-test the gate machinery end-to-end on a "
                          "tiny CPU bench run (parsing, band math, stale "
@@ -508,6 +591,13 @@ def main(argv: list[str] | None = None) -> int:
         return EXIT_REFUSED
     verdict = compare(runs[0], baseline, runs=list(args.runs),
                       baseline_path=baseline_path)
+    if args.explain:
+        try:
+            captures = [load_capture_file(p) for p in args.explain]
+        except (OSError, ValueError) as e:
+            print(f"benchkeeper: error: {e}", file=sys.stderr)
+            return EXIT_REFUSED
+        attach_kernel_explain(verdict, captures, paths=list(args.explain))
     vp = args.verdict_path or default_verdict_path()
     # a REFUSED comparison is noise, not signal — it must not clobber
     # the last real verdict (and read as a gate failure on the

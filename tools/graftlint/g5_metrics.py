@@ -66,6 +66,22 @@ _AMBIG_FIELD_RE = re.compile(
 #: device-attributed timing aliases that fork the ``device_ms`` schema
 _DEVICE_ALIAS_RE = re.compile(r"^(dev_ms|device_time_ms|device_timing_ms)$")
 
+# -- metering-counter conventions (ISSUE 17: kernelscope's per-tenant
+#    device metering made these load-bearing — a time-accumulating
+#    COUNTER is a meter, and meters are ``*_seconds_total``: seconds
+#    because rate() math and the phase histograms are seconds repo-wide,
+#    _total because Prometheus counters carry it and recording rules
+#    key on the suffix) --------------------------------------------------------
+
+#: a counter NAME that claims a time unit suffix. Two-letter unit
+#: tokens (_us/_ns) are excluded on purpose: they collide with English
+#: plurals (``other_ns_total`` is a namespace count, not nanoseconds)
+_COUNTER_TIME_RE = re.compile(
+    r"_(seconds|ms|milliseconds|microseconds|nanoseconds|minutes)"
+    r"(_total)?$")
+#: the ONE accepted shape for time-accumulating counters
+_METER_COUNTER_RE = re.compile(r"_seconds_total$")
+
 # -- histogram conventions (ISSUE 15: the phase histograms made these
 #    load-bearing — ``le`` bucket bounds are SECONDS repo-wide, and the
 #    OpenMetrics exemplar grammar is part of the scrape wire format) ----------
@@ -155,6 +171,76 @@ class MetricsConventionChecker(Checker):
                     and node.func.attr in _REGISTER_METHODS:
                 out.extend(self._check_registration(ctx, node))
             out.extend(self._check_timing_fields(ctx, node))
+        out.extend(self._check_explain_emissions(ctx))
+        return out
+
+    # -- explain-emission hygiene ---------------------------------------------
+    #
+    # kernelscope.explain_note() arguments are evaluated EAGERLY even
+    # when no sink is installed (it's a plain call), and the collected
+    # plan is JSON-serialized at the API edge. A device value passed as
+    # an explain field is therefore a deferred host sync G1 cannot see
+    # (the sync happens in json.dumps, outside the hot dirs). Piggyback
+    # G1's taint machinery: in the dispatch-path modules, every
+    # explain_note argument must already be a host scalar.
+
+    _EXPLAIN_DIRS = ("weaviate_tpu/engine/", "weaviate_tpu/ops/",
+                     "weaviate_tpu/parallel/")
+    _EXPLAIN_FILES = ("weaviate_tpu/runtime/query_batcher.py",)
+
+    def _check_explain_emissions(self, ctx) -> list[Violation]:
+        if not (ctx.path in self._EXPLAIN_FILES
+                or any(ctx.path.startswith(d) for d in self._EXPLAIN_DIRS)):
+            return []
+        from tools.graftlint.core import walk_shallow
+        from tools.graftlint.g1_host_sync import _FunctionPass
+
+        out: list[Violation] = []
+        units: list[list[ast.stmt]] = []
+        module_level = [s for s in ctx.tree.body
+                        if not isinstance(s, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.ClassDef))]
+        if module_level:
+            units.append(module_level)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                units.append(node.body)
+        for body in units:
+            fp = _FunctionPass(body)
+            fp.propagate()
+            # replay assignments in source order so each emission is
+            # judged against the taint state at its own position (same
+            # discipline as the G1 checker)
+            events = []
+            for node in walk_shallow(body):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "explain_note":
+                    events.append((node.lineno, 0, node.col_offset,
+                                   "note", node))
+                if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign, ast.NamedExpr)):
+                    end = node.lineno if node.value is None else \
+                        getattr(node.value, "end_lineno", node.lineno)
+                    events.append((end, 1, node.col_offset,
+                                   "assign", node))
+            events.sort(key=lambda e: e[:3])
+            for _, _, _, kind, node in events:
+                if kind == "assign":
+                    fp.apply_assign(node)
+                    continue
+                for val in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if fp.is_device(val):
+                        out.append(self._violation(
+                            ctx, val,
+                            "explain_note() argument is a device value "
+                            "— explain fields are JSON-serialized at "
+                            "the API edge, so this is a deferred "
+                            "host sync G1 cannot see; pass host "
+                            "scalars (lens, ints, precomputed "
+                            "fractions) only"))
         return out
 
     # -- timing-field conventions ---------------------------------------------
@@ -261,6 +347,16 @@ class MetricsConventionChecker(Checker):
         if call.func.attr == "histogram":
             out.extend(self._check_histogram(ctx, call, name, name_node,
                                              args, kwargs))
+        if call.func.attr == "counter" \
+                and _COUNTER_TIME_RE.search(name) \
+                and not _METER_COUNTER_RE.search(name):
+            out.append(self._violation(
+                ctx, name_node,
+                f"time-accumulating counter {name!r} must be named "
+                "'*_seconds_total' — device/time meters are seconds "
+                "repo-wide (rate() math, phase histograms) and "
+                "Prometheus counters carry the _total suffix; a _ms "
+                "meter or a missing _total forks the metering schema"))
         return out
 
     def _check_histogram(self, ctx, call: ast.Call, name: str, name_node,

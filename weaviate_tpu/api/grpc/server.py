@@ -386,6 +386,10 @@ class GrpcServer:
             except Exception:  # noqa: BLE001 — tests stub the context
                 md = {}
             force = md.get("x-trace") == "true"
+            # "x-explain: true" metadata is the gRPC analog of the REST
+            # ?explain=true param: the structured query plan rides back
+            # as trailing metadata (protos carry no spare field for it)
+            explain = md.get("x-explain") == "true"
             # adopt the client's gRPC deadline as this request's budget:
             # the contextvar propagates it down through the batcher,
             # shard fan-out and every transport call
@@ -426,18 +430,39 @@ class GrpcServer:
 
                     with tracing.trace(f"grpc.{rpc_name}", force=force), \
                             retry.deadline(budget), degrade.collecting():
-                        reply = fn(request, context)
+                        plan = None
+                        if explain:
+                            from weaviate_tpu.runtime import kernelscope
+
+                            token = kernelscope.explain_begin()
+                            try:
+                                reply = fn(request, context)
+                            finally:
+                                plan = kernelscope.explain_end(token)
+                        else:
+                            reply = fn(request, context)
                         # a degraded (partial) answer must be visible on
                         # the gRPC surface too: marker list rides
                         # trailing metadata (protos carry no spare field
-                        # for it)
+                        # for it). set_trailing_metadata may only be
+                        # called once, so degrade markers and the
+                        # explain plan share one call.
                         markers = degrade.snapshot()
+                        trailers = []
                         if markers:
                             import json as _json
 
+                            trailers.append(
+                                ("x-degraded", _json.dumps(markers)))
+                        if plan is not None:
+                            import json as _json
+
+                            trailers.append(
+                                ("x-explain", _json.dumps(plan)))
+                        if trailers:
                             try:
-                                context.set_trailing_metadata((
-                                    ("x-degraded", _json.dumps(markers)),))
+                                context.set_trailing_metadata(
+                                    tuple(trailers))
                             except Exception:  # noqa: BLE001 — stubbed ctx
                                 pass
                         tailboard.complete(200, degraded=bool(markers))

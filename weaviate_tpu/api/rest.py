@@ -94,9 +94,21 @@ DEBUG_ENDPOINTS = {
            "which objectives are currently burning.",
     "flight": "Flight recorder: recent batcher and native-plane "
               "dispatch records (batch size, k bucket, queue depth, "
-              "wait, epoch fanout, transfer-window occupancy), the "
-              "structured slow-query log, and on-disk incident "
-              "snapshots.",
+              "wait, epoch fanout, attributed device ms + source, "
+              "transfer-window occupancy), the structured slow-query "
+              "log, and on-disk incident snapshots.",
+    "kernelscope": "Device-time truth plane: per-(kind, batch, k) "
+                   "compiled-variant residency EWMAs with their "
+                   "drain/wall attribution source, the sampled memcpy "
+                   "estimator, per-tenant device-seconds meters and "
+                   "dispatch totals. Per-query plans ride "
+                   "?explain=true on /v1/graphql (or x-explain gRPC "
+                   "metadata).",
+    "profile": "On-demand kernel profiles: paramless lists the last K "
+               "persisted captures; ?ms=N runs a jax.profiler capture "
+               "for N ms and returns per-kernel device-ms ranked by "
+               "the kernel registry (?id=<capture> fetches a full "
+               "persisted capture).",
 }
 
 
@@ -722,7 +734,23 @@ class RestServer:
         if seg == ["graphql"] and method == "POST":
             if self.graphql_executor is None:
                 raise ApiError(501, "graphql not enabled")
-            out = self.graphql_executor(body or {})
+            if params.get("explain") == "true":
+                # per-query EXPLAIN (kernelscope): install a request-
+                # level sink on THIS thread; the batcher merges each
+                # dispatch's plan back here after the waiter wakes.
+                # Explain never perturbs the dispatch itself — same
+                # program, padding and slicing as the unexplained path.
+                from weaviate_tpu.runtime import kernelscope
+
+                token = kernelscope.explain_begin()
+                try:
+                    out = self.graphql_executor(body or {})
+                finally:
+                    explain_plan = kernelscope.explain_end(token)
+                if isinstance(out, dict):
+                    out["_explain"] = explain_plan
+            else:
+                out = self.graphql_executor(body or {})
             if isinstance(out, dict) and params.get("trace") == "true" \
                     and tracing.is_sampled():
                 # the inline breakdown rides ONLY explicitly requested
@@ -1023,6 +1051,33 @@ class RestServer:
         if name == "flight":
             # dispatch-record ring + structured slowlog + snapshots
             return 200, tailboard.debug_flight()
+        if name == "kernelscope":
+            # device-time truth plane: compiled-variant residency
+            # EWMAs, memcpy model, per-tenant meters, capture index
+            from weaviate_tpu.runtime import kernelscope
+
+            return 200, kernelscope.snapshot()
+        if name == "profile":
+            # paramless: cheap — list persisted captures only. A
+            # capture is an explicit ?ms=N opt-in (the paramless form
+            # is exercised by the debug-index round-trip test and must
+            # never spin the profiler).
+            from weaviate_tpu.runtime import kernelscope
+
+            if "id" in params:
+                cap = kernelscope.load_capture(params["id"])
+                if cap is None:
+                    raise KeyError("/v1/debug/profile?id=" + params["id"])
+                return 200, cap
+            if "ms" in params:
+                try:
+                    ms = int(params["ms"])
+                except ValueError:
+                    raise ApiError(422, "ms must be an integer")
+                if not 0 < ms <= 10_000:
+                    raise ApiError(422, "ms must be in (0, 10000]")
+                return 200, kernelscope.capture_profile(ms)
+            return 200, {"captures": kernelscope.list_captures()}
         # traces: the finished-trace ring (tracing tentpole; sampled
         # traces carry device_ms attribution), or — ?tail=true — the
         # tail-retained ring the keep-at-completion decision feeds

@@ -93,6 +93,9 @@ class ServerConfig:
     tailboard_enabled: bool = True
     slo_config: str = ""
     profiling_port: int = 0  # 0 = profiler server off (PROFILING_PORT)
+    # kernelscope: how many /v1/debug/profile?ms=N captures to keep
+    # persisted under <data_dir>/kernelscope (PROFILING_KEEP)
+    profile_keep: int = 8
     log_level: str = "info"
     log_format: str = "text"
     disable_telemetry: bool = False
@@ -143,6 +146,7 @@ class ServerConfig:
             tailboard_enabled=_flag(env, "WEAVIATE_TPU_TAILBOARD", True),
             slo_config=env.get("WEAVIATE_TPU_SLO", ""),
             profiling_port=_int(env, "PROFILING_PORT", 0),
+            profile_keep=_int(env, "PROFILING_KEEP", 8),
             log_level=env.get("LOG_LEVEL", "info"),
             log_format=env.get("LOG_FORMAT", "text"),
             disable_telemetry=_flag(env, "DISABLE_TELEMETRY"),

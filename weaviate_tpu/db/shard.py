@@ -572,6 +572,9 @@ class Shard:
                     owner={"collection": self.collection_name,
                            "shard": self.name,
                            "tenant": self._tenant_label()},
+                    # kernelscope variant label: residency EWMAs key on
+                    # (index kind, b bucket, k bucket) compiled variants
+                    kind=str(getattr(idx, "index_type", "index")),
                 ))
         ids, dists = b.search(query, k, allow_list)
         live = ids >= 0

@@ -46,7 +46,7 @@ import numpy as np
 from weaviate_tpu.engine.quantized import QuantizedVectorStore
 from weaviate_tpu.engine.store import DeviceVectorStore, normalize_allow_mask
 from weaviate_tpu.ops.topk import merge_epoch_topk
-from weaviate_tpu.runtime import hbm_ledger, tracing, transfer
+from weaviate_tpu.runtime import hbm_ledger, kernelscope, tracing, transfer
 from weaviate_tpu.runtime.transfer import DeviceResultHandle
 
 #: default seal threshold (rows) when epochs are enabled without an
@@ -517,6 +517,11 @@ class EpochStore:
                 queries, k, self._slice_allow(allow_mask, ep))
             parts.append((d, i))
             maps.append(ep.slot_map_device())
+        # EXPLAIN (host ints, no-op without a sink): epoch fanout and
+        # the on-device merge shape of this dispatch
+        kernelscope.explain_note(
+            "epochs", epochs=len(parts), merge_fanin=len(parts),
+            k_merge=k, rescore_mode="none", queries=len(queries), k=k)
         md, mi = merge_epoch_topk(tuple(parts), tuple(maps), k=k,
                                   selection=self.selection)
 
@@ -565,6 +570,12 @@ class EpochStore:
                           else ep.local_of.copy(), tiers,
                           int(ep.store.count)))
         k_merge = k_cand if mode == "post" else k
+        # EXPLAIN: epoch fanout, merge shape and the (possibly plane->
+        # post degraded) rescore mode of this dispatch — host ints only
+        kernelscope.explain_note(
+            "epochs", epochs=len(parts), merge_fanin=len(parts),
+            k_merge=k_merge, k_cand=k_cand, rescore_mode=mode,
+            queries=len(queries), k=k)
         md, mi = merge_epoch_topk(tuple(parts), tuple(maps), k=k_merge,
                                   selection=self.selection)
         cap_total = self.capacity

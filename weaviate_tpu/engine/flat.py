@@ -20,7 +20,7 @@ import numpy as np
 
 from weaviate_tpu import native
 from weaviate_tpu.engine.store import DeviceVectorStore
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import kernelscope, tracing
 
 
 def _per_query_allow(allow_list) -> bool:
@@ -203,6 +203,11 @@ class FlatIndex:
             with self._lock:
                 kind, allow_mask = self._translate_batch_allow(
                     queries, allow_list, per_query)
+                kernelscope.explain_note(
+                    "index", kind=str(self.index_type),
+                    per_query_filters=bool(per_query),
+                    filtered=allow_list is not None,
+                    queries=len(queries), k=k)
                 if kind == "rowwise":
                     # a store with supports_batched_filters=False takes
                     # shared 1-D masks only — serve per-query filters
@@ -282,6 +287,13 @@ class FlatIndex:
                     queries, allow_list, per_query)
                 if kind == "rowwise":
                     return None
+                # EXPLAIN: index-level plan facts (host ints only; the
+                # store layer notes the cutover it actually takes)
+                kernelscope.explain_note(
+                    "index", kind=str(self.index_type),
+                    per_query_filters=bool(per_query),
+                    filtered=allow_mask is not None,
+                    queries=len(queries), k=k)
                 handle = self.store.search_async(queries, k, allow_mask)
                 table = self._slot_to_id  # replaced (not resized) by compact
 

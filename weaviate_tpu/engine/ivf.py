@@ -62,7 +62,7 @@ from weaviate_tpu.ops.distances import (MASKED_DISTANCE, normalize,
 from weaviate_tpu.ops.kmeans import kmeans_assign, kmeans_fit
 from weaviate_tpu.ops.pallas_kernels import _MASK_WORDS, allow_bits_for_ids
 from weaviate_tpu.ops.topk import topk_smallest
-from weaviate_tpu.runtime import hbm_ledger, tracing
+from weaviate_tpu.runtime import hbm_ledger, kernelscope, tracing
 from weaviate_tpu.runtime.transfer import DeviceResultHandle
 
 _SUPPORTED_METRICS = ("l2-squared", "dot", "cosine", "cosine-dot")
@@ -948,6 +948,18 @@ class IVFStore:
                     bits = _dummy_bits()
                 k_cand = k * self.rescore_limit if self.quantization else k
                 k_eff = min(k_cand, np_probe * self.list_cap)
+                # EXPLAIN: the probe plan, host ints only (no device
+                # reads — G1 stays empty); a no-op unless a sink is
+                # installed for this dispatch
+                kernelscope.explain_note(
+                    "ivf", nprobe=np_probe, nlist=self.nlist,
+                    lists_frac=(round(np_probe / self.nlist, 6)
+                                if self.nlist else 0.0),
+                    candidates=k_eff,
+                    rescored=(k_eff if self.quantization else 0),
+                    quantized=bool(self.quantization),
+                    filtered=bool(use_allow), queries=b, k=k,
+                    delta_leg=bool(legs_d))
                 outs_d, outs_i = [], []
                 for s in range(0, b, self.query_chunk):
                     q_dev = jnp.asarray(queries[s:s + self.query_chunk])
@@ -979,6 +991,7 @@ class IVFStore:
                                else jnp.concatenate(outs_i))
                               .astype(jnp.int32))
             sp.set(nprobe=np_probe, nlist=self.nlist)
+            kernelscope.explain_note("ivf", merge_legs=len(legs_d))
             if not legs_d:
                 d_e = np.full((b, k), MASKED_DISTANCE, np.float32)
                 i_e = np.full((b, k), -1, np.int64)

@@ -43,7 +43,7 @@ from weaviate_tpu.ops import pq as pq_ops
 from weaviate_tpu.ops.candidates import gather_rescore_topk
 from weaviate_tpu.ops.distances import normalize_np
 from weaviate_tpu.parallel.mesh import n_row_shards, shardable_capacity
-from weaviate_tpu.runtime import hbm_ledger, tracing
+from weaviate_tpu.runtime import hbm_ledger, kernelscope, tracing
 from weaviate_tpu.runtime.transfer import DeviceResultHandle
 
 _DEFAULT_CHUNK = 8192
@@ -698,6 +698,15 @@ class QuantizedVectorStore:
                 else:
                     k_cand = min(k, capacity)
                     k_out = k_cand
+                # EXPLAIN: host ints only (no device reads), a no-op
+                # when nobody asked — the rescore plan of this dispatch
+                kernelscope.explain_note(
+                    "quantized", quantization=str(self.quantization),
+                    rescore_mode=mode, k_cand=k_cand, rows=capacity,
+                    queries=len(queries), k=k,
+                    path=("bitmask_batched" if allow_bits is not None
+                          else "shared_mask" if allow_mask is not None
+                          else "full_scan"))
                 d, i = self._scan(jnp.asarray(queries), k_cand, valid,
                                   k_out, allow_bits=allow_bits,
                                   allow_rows=allow_rows_dev)

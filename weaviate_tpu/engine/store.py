@@ -35,7 +35,7 @@ import numpy as np
 from weaviate_tpu.ops.candidates import shared_candidates_topk
 from weaviate_tpu.ops.distances import normalize
 from weaviate_tpu.ops.topk import chunked_topk_distances
-from weaviate_tpu.runtime import hbm_ledger, tracing
+from weaviate_tpu.runtime import hbm_ledger, kernelscope, tracing
 from weaviate_tpu.runtime import transfer
 from weaviate_tpu.runtime.transfer import DeviceResultHandle
 from weaviate_tpu.parallel.mesh import n_row_shards, shardable_capacity
@@ -544,6 +544,12 @@ class DeviceVectorStore:
                 if allow_mask is not None and allow_mask.ndim == 2:
                     slot_buf = None
                     sp.set(path="bitmask_batched")
+                    # EXPLAIN notes are host ints only (no device reads
+                    # — graftlint G1/G5 pin it) and a one-contextvar-
+                    # read no-op when nobody asked
+                    kernelscope.explain_note(
+                        "store", path="bitmask_batched", rows=capacity,
+                        queries=len(queries), k=k)
                     allow_bits, allow_rows_dev = batched_mask_operands(
                         allow_mask, len(queries), capacity, self.mesh,
                         owner=self._hbm_owner)
@@ -566,14 +572,29 @@ class DeviceVectorStore:
                             and m_allowed <= capacity // 8
                             and bucket * row_bytes <= (1 << 30)):
                         sp.set(path="gathered", allowed=m_allowed)
+                        kernelscope.explain_note(
+                            "store", path="gathered", rows=capacity,
+                            m_allowed=m_allowed, queries=len(queries),
+                            k=k, selectivity=round(
+                                m_allowed / capacity, 6) if capacity
+                            else 0.0)
                         d, i, slot_buf = self._dispatch_gathered(
                             queries, k, allowed)
                     else:
+                        kernelscope.explain_note(
+                            "store", path="shared_mask", rows=capacity,
+                            m_allowed=m_allowed, queries=len(queries),
+                            k=k, selectivity=round(
+                                m_allowed / capacity, 6) if capacity
+                            else 0.0)
                         full = np.zeros(capacity, dtype=bool)
                         full[: len(allow_mask)] = allow_mask
                         valid = jnp.logical_and(valid, self._placed(full))
                         slot_buf = None
                 else:
+                    kernelscope.explain_note(
+                        "store", path="full_scan", rows=capacity,
+                        queries=len(queries), k=k)
                     slot_buf = None
                 if slot_buf is None:
                     k_eff = min(k, capacity)

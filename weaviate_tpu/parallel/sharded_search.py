@@ -66,7 +66,7 @@ from weaviate_tpu.parallel.mesh import (
     is_hierarchical,
     n_row_shards,
 )
-from weaviate_tpu.runtime import tracing
+from weaviate_tpu.runtime import kernelscope, tracing
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
@@ -321,6 +321,16 @@ def sharded_topk(q, x, valid, x_sq_norms, *, k, chunk_size, metric, mesh,
                       k=k, rows=int(x.shape[0]),
                       hierarchical=is_hierarchical(mesh),
                       filtered=allow_rows is not None):
+        # EXPLAIN: ICI/DCN merge shape — pure topology ints computed on
+        # the host at dispatch (mesh axis sizes), never device reads
+        hier = is_hierarchical(mesh)
+        kernelscope.explain_note(
+            "merge",
+            shards=n_row_shards(mesh), hierarchical=bool(hier),
+            hosts=int(mesh.shape[HOST_AXIS]) if hier else 1,
+            ici=(int(mesh.shape[ICI_AXIS]) if hier
+                 else n_row_shards(mesh)),
+            dcn_compact=bool(dcn_compact), k=k)
         return _sharded_topk_jit(
             q, x, valid, x_sq_norms, k=k, chunk_size=chunk_size,
             metric=metric, mesh=mesh, axis=axis, use_pallas=use_pallas,
@@ -481,6 +491,14 @@ def sharded_quantized_topk(q, q_words, codes, valid, rescore_rows,
                       quantization=quantization,
                       hierarchical=is_hierarchical(mesh),
                       filtered=allow_rows is not None):
+        hier = is_hierarchical(mesh)
+        kernelscope.explain_note(
+            "merge",
+            shards=n_row_shards(mesh), hierarchical=bool(hier),
+            hosts=int(mesh.shape[HOST_AXIS]) if hier else 1,
+            ici=(int(mesh.shape[ICI_AXIS]) if hier
+                 else n_row_shards(mesh)),
+            dcn_compact=bool(dcn_compact), k=k_out)
         return _sharded_quantized_topk_jit(
             q, q_words, codes, valid, rescore_rows, centroids, k=k,
             k_out=k_out, chunk_size=chunk_size, quantization=quantization,

@@ -632,8 +632,10 @@ request_phase_seconds = registry.histogram(
     "weaviate_tpu_request_phase_seconds",
     "Always-on per-request latency attribution from monotonic edge/"
     "batcher/transfer stamps (no device sync on unsampled paths): phase "
-    "is queue_wait (batcher queue), device (dispatch to drain-start wall "
-    "window), transfer (D2H drain) or host (everything else); tenant and "
+    "is queue_wait (batcher queue), device (kernelscope-attributed chip "
+    "residency: drain window minus the memcpy EWMA, source=drain; wall "
+    "window on sync paths), transfer (memcpy share of the D2H drain) or "
+    "host (everything else); tenant and "
     "collection pass the top-K cardinality guard (overflow: other). "
     "Buckets carry OpenMetrics exemplars naming tail-retained trace ids",
     ("operation", "phase", "collection", "tenant"),
@@ -675,6 +677,27 @@ flight_snapshots_total = registry.counter(
     "Flight-recorder snapshots written to the data dir on incident "
     "(SLO burn threshold crossed, component flipped unhealthy), by "
     "incident reason", ("reason",))
+
+# -- kernelscope: device-time truth (runtime/kernelscope.py) ------------------
+
+dispatch_device_seconds = registry.histogram(
+    "weaviate_tpu_dispatch_device_seconds",
+    "Attributed device residency per coalesced dispatch, by compiled "
+    "variant (index kind, padded batch bucket, k bucket) and attribution "
+    "source: 'drain' = drain-thread stamps minus the sampled memcpy "
+    "EWMA (zero-sync), 'wall' = dispatch wall window (sync engines and "
+    "null-device bench stubs)",
+    ("kind", "b", "k", "source"),
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
+device_seconds_total = registry.counter(
+    "weaviate_tpu_device_seconds_total",
+    "Cumulative attributed device seconds apportioned per tenant "
+    "(dispatch residency split across the requests it coalesced, "
+    "weighted by rows scanned) — the interference signal for per-tenant "
+    "QoS; sums to within the metering tolerance of total pipeline "
+    "device residency",
+    ("collection", "tenant"))
 
 # -- perf gate (runtime/perfgate.py republishes these from the last
 #    persisted benchkeeper verdict; see tools/benchkeeper) --------------------

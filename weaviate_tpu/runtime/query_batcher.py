@@ -53,8 +53,8 @@ import time
 
 import numpy as np
 
-from weaviate_tpu.runtime import (degrade, faultline, retry, tailboard,
-                                  tracing)
+from weaviate_tpu.runtime import (degrade, faultline, kernelscope, retry,
+                                  tailboard, tracing)
 from weaviate_tpu.runtime.transfer import TransferPipeline
 
 #: bounded intake: past this queue depth the batcher sheds load with a
@@ -75,7 +75,9 @@ class _Pending:
     __slots__ = ("query", "k", "allow", "event", "ids", "dists", "error",
                  "ctx", "t_enqueue", "t_exec_start", "t_exec_end",
                  "batch_size", "t_mask_start", "t_mask_end",
-                 "t_fetch_start", "t_fetch_end", "epochs")
+                 "t_fetch_start", "t_fetch_end", "epochs",
+                 "device_s", "transfer_s", "device_source",
+                 "explain_on", "explain")
 
     def __init__(self, query, k, allow):
         self.query = query
@@ -103,6 +105,17 @@ class _Pending:
         # store's handle reports how many per-epoch scans fused into
         # the one merged program) — 0 for single-buffer stores
         self.epochs = 0
+        # kernelscope attribution of the dispatch this request rode in:
+        # device residency vs memcpy split (source "drain") or the
+        # dispatch wall window (source "wall" — sync/null-device paths)
+        self.device_s: float | None = None
+        self.transfer_s = 0.0
+        self.device_source: str | None = None
+        # per-query EXPLAIN: captured on the request thread at enqueue
+        # (the worker has no request context); the dispatch plan is
+        # merged back into the request sink after the waiter wakes
+        self.explain_on = kernelscope.explain_enabled()
+        self.explain: dict | None = None
 
 
 class QueryBatcher:
@@ -131,10 +144,13 @@ class QueryBatcher:
                  capacity_fn=None, pad_pow2: bool = True,
                  owner: dict | None = None, async_batch_fn=None,
                  transfer_depth: int = 2,
-                 max_queue: int | None = None):
+                 max_queue: int | None = None, kind: str = "index"):
         from weaviate_tpu.runtime import hbm_ledger
 
         self._batch_fn = batch_fn
+        # index kind label for kernelscope's per-compiled-variant
+        # residency EWMA (the shard passes the index's ``index_type``)
+        self.kind = str(kind)
         # zero-sync pipeline: ``async_batch_fn(queries, k, allow) ->
         # DeviceResultHandle | None`` (None = this dispatch can't run
         # async, fall back to batch_fn). When set, coalesced drains
@@ -153,6 +169,11 @@ class QueryBatcher:
         # layer passes its collection/shard; standalone batchers fall
         # back to the ambient owner scope)
         self._hbm_owner = owner or hbm_ledger.current_owner()
+        # metering labels: one batcher serves one (shard, vector), so
+        # every request a dispatch coalesces shares these
+        self._meter_labels = (
+            str(self._hbm_owner.get("collection") or "-"),
+            str(self._hbm_owner.get("tenant") or "-"))
         # health key scoped to THIS batcher's owner: batchers are
         # per-shard/per-vector, and a healthy shard's batch must not
         # clear the unhealthy flag a persistently-broken shard set
@@ -276,13 +297,20 @@ class QueryBatcher:
 
                 batcher_transfer_duration.observe(
                     item.t_fetch_end - item.t_fetch_start)
-            # always-on phase attribution (tailboard): the SAME stamps,
-            # folded into this request's live timeline on the request
-            # thread — queue_wait is the batcher queue, "device" the
-            # dispatch→drain-start wall window (block_until_ready-free;
-            # real device_ms stays sampled-only), transfer the D2H drain
+            # always-on phase attribution (tailboard), folded into this
+            # request's live timeline on the request thread. "device" is
+            # kernelscope's attributed residency: the drain-thread stamp
+            # window minus the sampled-memcpy EWMA (source=drain,
+            # block_until_ready-free) or the dispatch wall window on
+            # sync/null-device paths (source=wall); "transfer" is the
+            # memcpy share. The pre-kernelscope wall split stays as the
+            # fallback for dispatches that died before attribution.
             tailboard.phase("queue_wait", item.t_exec_start - t_enqueue)
-            if item.t_fetch_start is not None:
+            if item.device_s is not None:
+                tailboard.phase("device", item.device_s)
+                if item.transfer_s > 0:
+                    tailboard.phase("transfer", item.transfer_s)
+            elif item.t_fetch_start is not None:
                 tailboard.phase("device",
                                 item.t_fetch_start - item.t_exec_start)
                 tailboard.phase("transfer",
@@ -291,6 +319,10 @@ class QueryBatcher:
             elif item.t_exec_end is not None:
                 tailboard.phase("device",
                                 item.t_exec_end - item.t_exec_start)
+        if item.explain is not None:
+            # fold the dispatch's plan into the request-level explain
+            # sink (installed by the REST/gRPC edge on THIS thread)
+            kernelscope.merge_into_request(item.explain)
         if item.error is not None:
             raise item.error
         return item.ids, item.dists
@@ -368,15 +400,36 @@ class QueryBatcher:
             else:
                 coal.append(it)
         for it in solo:
+            plan = {} if it.explain_on else None
             try:
                 it.t_exec_start = time.perf_counter()
-                ids, dists = tracing.run_in(
-                    it.ctx, self._batch_fn, it.query[None, :], it.k,
-                    it.allow)
+                if plan is None:
+                    ids, dists = tracing.run_in(
+                        it.ctx, self._batch_fn, it.query[None, :], it.k,
+                        it.allow)
+                else:
+                    with kernelscope.explain_scope(plan):
+                        ids, dists = tracing.run_in(
+                            it.ctx, self._batch_fn, it.query[None, :],
+                            it.k, it.allow)
                 it.ids, it.dists = ids[0], dists[0]
             except Exception as e:  # noqa: BLE001
                 it.error = e
             it.t_exec_end = time.perf_counter()
+            # no drain stamps on the solo path (sync device call):
+            # wall-window attribution, metered against this batcher's
+            # owner like any other dispatch
+            wall = max(0.0, it.t_exec_end - it.t_exec_start)
+            it.device_s, it.transfer_s, it.device_source = wall, 0.0, "wall"
+            kernelscope.record_dispatch(self.kind, 1, it.k, wall, "wall")
+            kernelscope.meter(*self._meter_labels, wall)
+            if plan is not None:
+                plan["batcher"] = {
+                    "batch": 1, "b_pad": 1, "k_bucket": it.k,
+                    "queue_depth": self._queue_depth_at_drain,
+                    "filtered": int(it.allow is not None), "solo": True,
+                    "async": False, "kind": self.kind}
+                it.explain = plan
             it.event.set()
         if not coal:
             # a purely-solo drain still leaves a flight-recorder record
@@ -427,6 +480,12 @@ class QueryBatcher:
         # real; every waiter still records its own wait/execute split
         # from the stamps below
         ctx = next((it.ctx for it in coal if it.ctx is not None), None)
+        # per-query EXPLAIN: if any coalesced waiter asked, the engine's
+        # host-side plan notes emitted during THIS dispatch (the program
+        # build on the worker thread) land in one shared sink; explain
+        # never changes WHAT is dispatched — sync and async answers stay
+        # bit-identical
+        plan = {} if any(it.explain_on for it in coal) else None
         t0 = time.perf_counter()
         for it in coal:
             it.t_exec_start = t0
@@ -445,6 +504,31 @@ class QueryBatcher:
             filtered=len(filtered), solo=len(solo),
             window_inflight=tp0.inflight if tp0 is not None else 0,
             epochs=0)
+
+        def _attribute(device_s: float, transfer_s: float, source: str):
+            """Kernelscope fold for this dispatch: stamp every waiter's
+            attribution (each reads it back on its own request thread),
+            feed the per-compiled-variant residency EWMA + histogram,
+            patch the flight record, and meter the apportioned
+            residency per tenant."""
+            device_s = max(0.0, device_s)
+            for it in coal:
+                it.device_s = device_s
+                it.transfer_s = max(0.0, transfer_s)
+                it.device_source = source
+            flight_rec["device_ms"] = round(device_s * 1000.0, 3)
+            flight_rec["t_source"] = source
+            kernelscope.record_dispatch(self.kind, b_pad, k_bucket,
+                                        device_s, source)
+            # apportion across the coalesced requests, weighted by rows
+            # scanned — one batcher serves one (shard, vector), so rows
+            # and owner labels are uniform per dispatch: the weights
+            # degenerate to an even split and the tenant meter sees the
+            # full dispatch residency exactly once
+            for share in kernelscope.apportion(device_s,
+                                               [1.0] * len(coal)):
+                kernelscope.meter(*self._meter_labels, share)
+
         # the pow2-padded query block becomes a device upload inside
         # batch_fn — ledger-registered until the results leave the
         # device (sync: end of this call; async: transfer completion) so
@@ -471,8 +555,12 @@ class QueryBatcher:
             # faultline point: one coalesced device dispatch (the
             # deterministic schedule sees retries as separate calls)
             faultline.fire("batcher.dispatch", batch=b, k=k_bucket)
-            return tracing.run_in(ctx, self._batch_fn, queries,
-                                  k_bucket, allows)
+            if plan is None:
+                return tracing.run_in(ctx, self._batch_fn, queries,
+                                      k_bucket, allows)
+            with kernelscope.explain_scope(plan):
+                return tracing.run_in(ctx, self._batch_fn, queries,
+                                      k_bucket, allows)
 
         def _retry_once(first_err: BaseException):
             """Faulted device batch: ONE sync retry. A second failure
@@ -512,8 +600,17 @@ class QueryBatcher:
                 # device-resident handle to the transfer thread, return
                 # to drain the NEXT batch while this one crosses D2H
                 faultline.fire("batcher.dispatch", batch=b, k=k_bucket)
-                handle = tracing.run_in(ctx, self._async_fn, queries,
-                                        k_bucket, allows)
+                if plan is None:
+                    handle = tracing.run_in(ctx, self._async_fn, queries,
+                                            k_bucket, allows)
+                else:
+                    # engine plan notes are emitted while the program is
+                    # built/launched here (host side); the handle's
+                    # finish step runs later on the transfer thread and
+                    # stays outside the sink by design
+                    with kernelscope.explain_scope(plan):
+                        handle = tracing.run_in(ctx, self._async_fn,
+                                                queries, k_bucket, allows)
                 if handle is not None:
                     n_ep = int(handle.attrs.get("epochs", 0) or 0)
                     if n_ep:
@@ -528,9 +625,23 @@ class QueryBatcher:
                 return
             ids, dists = result
             handle = None
+        if plan is not None:
+            plan["batcher"] = {
+                "batch": b, "b_pad": b_pad, "k_bucket": k_bucket,
+                "queue_depth": self._queue_depth_at_drain,
+                "filtered": len(filtered), "solo": False,
+                "async": handle is not None, "kind": self.kind}
+            for it in coal:
+                if it.explain_on:
+                    it.explain = plan
         if handle is None:
             _hbm.release(pad_key)
-            self._deliver(coal, ids, dists, time.perf_counter())
+            t1 = time.perf_counter()
+            # sync path: no drain stamps exist — wall-window attribution
+            # with an explicit source label (the null-device deflake
+            # guard: degrade, don't crash or report zeros)
+            _attribute(t1 - t0, 0.0, "wall")
+            self._deliver(coal, ids, dists, t1)
             _mark_served()
             return
         self.async_dispatches += 1
@@ -554,6 +665,14 @@ class QueryBatcher:
             for it in coal:
                 it.t_fetch_start, it.t_fetch_end = t_fetch0, t_fetch1
             if err is None:
+                # drain-thread stamps: dispatch-submit (t0) .. transfer-
+                # complete (t_fetch1), minus the sampled-memcpy EWMA for
+                # this result size = attributed device residency with
+                # ZERO added syncs — the drain blocked on this handle's
+                # D2H anyway
+                dev_s, mem_s = kernelscope.attribute(
+                    t_fetch1 - t0, kernelscope.result_nbytes(res))
+                _attribute(dev_s, mem_s, "drain")
                 _finish(res)
                 return
             # the device batch (or its D2H drain) faulted on the
@@ -567,6 +686,10 @@ class QueryBatcher:
             def _retry_path():
                 res2 = _retry_once(err)
                 if res2 is not None:
+                    # the retry served through the sync path: wall
+                    # attribution (the drain stamps belong to the
+                    # faulted attempt, not this result)
+                    _attribute(time.perf_counter() - t0, 0.0, "wall")
                     _finish(res2)
 
             threading.Thread(target=_retry_path, daemon=True,

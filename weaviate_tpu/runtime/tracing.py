@@ -467,6 +467,7 @@ def d2h(*values):
     n_arrays = sum(1 for v in values if v is not None)
     with span("transfer.d2h", arrays=n_arrays) as sp:
         cur = _current.get()
+        synced = False
         if cur is not None and cur[0].sampled and n_arrays:
             try:
                 import jax
@@ -475,9 +476,26 @@ def d2h(*values):
                 jax.block_until_ready([v for v in values if v is not None])
                 sp.set(device_ms=round(
                     (time.perf_counter() - t0) * 1000.0, 3))
+                synced = True
             except Exception:  # a poisoned buffer raises at asarray below
                 pass
+        t_copy = time.perf_counter()
         out = tuple(None if v is None else _np.asarray(v) for v in values)
+        if synced:
+            # the device wait above already drained, so the asarray loop
+            # here is (close to) pure memcpy — the only place the
+            # device/copy split of a D2H window is directly measurable.
+            # Kernelscope's EWMA turns these sampled splits into the
+            # memcpy subtraction that makes the UNsampled drain-stamp
+            # attribution honest.
+            try:
+                from weaviate_tpu.runtime import kernelscope
+
+                kernelscope.observe_memcpy(
+                    time.perf_counter() - t_copy,
+                    sum(a.nbytes for a in out if a is not None))
+            except Exception:
+                pass
     return out
 
 

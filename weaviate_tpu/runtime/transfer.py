@@ -170,6 +170,11 @@ class TransferPipeline:
                 self._cv.wait(timeout=1.0)
 
     def submit(self, handle: DeviceResultHandle, callback, ctx=None):
+        # kernelscope's dispatch-submit stamp: paired with the drain
+        # thread's post-``result()`` stamp (t_fetch_end in the callback)
+        # it bounds the device+memcpy window of this handle without a
+        # single added sync — the drain blocks on the D2H anyway
+        handle.attrs.setdefault("t_submit", time.perf_counter())
         with self._cv:
             while (not self._stopped
                    and len(self._q) + self._inflight >= self.depth):

@@ -125,6 +125,13 @@ class Server:
                             enabled=cfg.tailboard_enabled,
                             slos_json=cfg.slo_config or None)
 
+        # kernelscope wiring: on-demand kernel captures persist under
+        # <data_dir>/kernelscope, pruned to the last PROFILING_KEEP
+        from weaviate_tpu.runtime import kernelscope
+
+        kernelscope.configure(data_dir=cfg.data_path,
+                              keep=cfg.profile_keep)
+
         modules = default_provider(self.db, enabled=cfg.enabled_modules)
 
         # FROZEN tenant tier: ship offloaded tenants through a backup
@@ -169,18 +176,7 @@ class Server:
                                    port=cfg.grpc_port,
                                    modules=modules, auth=auth).start()
 
-        if cfg.profiling_port:
-            # reference: setupGoProfiling serves pprof on PROFILING_PORT
-            # (configure_api.go:1094); the JAX profiler server is the TPU
-            # analog — point TensorBoard/xprof at it for device traces
-            try:
-                import jax
-
-                jax.profiler.start_server(cfg.profiling_port)
-                logger.info("JAX profiler server on :%s",
-                            cfg.profiling_port)
-            except Exception as e:
-                logger.warning("profiler server failed to start: %s", e)
+        self._start_profiler(cfg.profiling_port)
 
         if cfg.prometheus_enabled:
             from weaviate_tpu.runtime.metrics import serve_metrics
@@ -198,6 +194,28 @@ class Server:
         logger.info("weaviate-tpu %s serving REST on %s gRPC on :%s",
                     VERSION, self.rest.address, self.grpc.port)
         return self
+
+    def _start_profiler(self, port: int) -> bool:
+        """Start the JAX profiler server on ``port``. Returns whether a
+        server was started: ``PROFILING_PORT=0`` (the default) means
+        NEVER — the early return is what the config unit test pins.
+
+        Reference: setupGoProfiling serves pprof on PROFILING_PORT
+        (configure_api.go:1094); the JAX profiler server is the TPU
+        analog — point TensorBoard/xprof at it for device traces.
+        One-shot captures don't need this: ``GET
+        /v1/debug/profile?ms=N`` runs a programmatic capture inline."""
+        if not port:
+            return False
+        try:
+            import jax
+
+            jax.profiler.start_server(port)
+            logger.info("JAX profiler server on :%s", port)
+            return True
+        except Exception as e:  # noqa: BLE001 — profiling is best-effort
+            logger.warning("profiler server failed to start: %s", e)
+            return False
 
     def _setup_logging(self) -> None:
         level = getattr(logging, self.config.log_level.upper(),
