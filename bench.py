@@ -1619,6 +1619,133 @@ def sec_served_pipeline(ctx):
     return out
 
 
+def sec_hybrid_search(ctx):
+    """Hybridplane (ISSUE 18): device-resident BM25 + sparse/dense
+    fusion as ONE batched program, measured through the REAL serving
+    path (posting pack -> fused dispatch -> single D2H), against the
+    host scorer + serial dense leg it replaces.
+
+    Reported per batch size: sparse-only (alpha=0), dense-only
+    (alpha=1) and fused (alpha=0.5) served QPS, plus the fused
+    program's device-side batch ms with operands prepacked (isolates
+    the program from host posting-pack cost, which is reported once as
+    ``pack_ms``). ``qps_vs_host`` is fused device QPS at the largest
+    batch over the host-scorer baseline — the number the hybridplane
+    exists to move (>1 = one fused program beats host MaxScore + a
+    serial dense search per query)."""
+    import tempfile
+
+    import numpy as np
+
+    from weaviate_tpu.db.database import Database
+    from weaviate_tpu.schema.config import (CollectionConfig, DataType,
+                                            Property, VectorConfig)
+
+    rng = np.random.default_rng(18)
+    n = int(os.environ.get("BENCH_HYBRID_ROWS", "4096"))
+    dim, k = 64, 10
+    vocab = [f"w{i:03d}" for i in range(256)]
+    db = Database(tempfile.mkdtemp(prefix="bench-hybrid-"))
+    try:
+        col = db.create_collection(CollectionConfig(
+            name="Hy",
+            properties=[Property(name="body", data_type=DataType.TEXT)],
+            vectors=[VectorConfig()],
+        ))
+        t0 = time.perf_counter()
+        draws = rng.zipf(1.3, size=(n, 24)) % len(vocab)
+        vecs = rng.standard_normal((n, dim)).astype(np.float32)
+        for i in range(n):
+            col.put_object({"body": " ".join(vocab[j] for j in draws[i])},
+                           vector=vecs[i])
+        build_s = time.perf_counter() - t0
+        shard = list(col.shards.values())[0]
+        idx = shard._hybrid_index("")
+        assert idx is not None, "device hybrid path unavailable"
+
+        qn = 256
+        qtexts = [" ".join(rng.choice(vocab[:96], size=3)) for _ in range(qn)]
+        qvecs = rng.standard_normal((qn, dim)).astype(np.float32)
+
+        def op_for(j, alpha):
+            return shard._hybrid_operand(idx, qtexts[j], k, alpha,
+                                         "relativeScore", None, None)
+
+        def drive(alpha, batch, iters):
+            """Closed-loop served QPS: pack + fused dispatch + drain."""
+            t0 = time.perf_counter()
+            for it in range(iters):
+                s = (it * batch) % (qn - batch + 1)
+                ops = [op_for(s + j, alpha) for j in range(batch)]
+                h = _retry_transient(
+                    lambda: idx.hybrid_batch_async(
+                        qvecs[s:s + batch], k, None, ops),
+                    what=f"hybrid b={batch}")
+                ids, _ = h.result()
+                assert ids.shape == (batch, k)
+            return (batch * iters) / (time.perf_counter() - t0)
+
+        out = {"rows": n, "dim": dim, "k": k,
+               "build_vec_per_s": round(n / build_s), "batches": {}}
+
+        # posting-pack host cost, once (shared across paths)
+        t0 = time.perf_counter()
+        packed = [op_for(j, 0.5) for j in range(64)]
+        out["pack_ms"] = round((time.perf_counter() - t0) / 64 * 1e3, 3)
+
+        iters = int(os.environ.get("BENCH_HYBRID_ITERS", "64"))
+        for batch in (1, 8, 32):
+            row = {}
+            for name, alpha in (("sparse", 0.0), ("dense", 1.0),
+                                ("fused", 0.5)):
+                drive(alpha, batch, 2)  # warm the (B, k) bucket
+                row[f"{name}_qps"] = round(drive(alpha, batch, iters), 1)
+            # fused device ms with operands prepacked: the program
+            # alone, no per-iteration posting-pack work
+            ops = (packed * batch)[:batch]
+            h = idx.hybrid_batch_async(
+                np.tile(qvecs[:1], (batch, 1)), k, None, ops)
+            h.result()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                idx.hybrid_batch_async(
+                    np.tile(qvecs[:1], (batch, 1)), k, None,
+                    ops).result()
+            row["device_ms"] = round(
+                (time.perf_counter() - t0) / iters * 1e3, 3)
+            out["batches"][str(batch)] = row
+
+        # host-scorer baseline: kill switch off -> host MaxScore BM25 +
+        # a serial dense search + host fusion, one query at a time (the
+        # host path has no batched form — that asymmetry IS the story)
+        shard.device_hybrid = False
+        try:
+            for j in range(4):
+                col.hybrid(qtexts[j], vector=qvecs[j], alpha=0.5, k=k,
+                           fusion="relativeScore", include_objects=False)
+            t0 = time.perf_counter()
+            for it in range(iters):
+                col.hybrid(qtexts[it % qn], vector=qvecs[it % qn],
+                           alpha=0.5, k=k, fusion="relativeScore",
+                           include_objects=False)
+            out["host_fused_qps"] = round(
+                iters / (time.perf_counter() - t0), 1)
+        finally:
+            shard.device_hybrid = True
+
+        top = out["batches"]["32"]
+        out["qps_vs_host"] = round(
+            top["fused_qps"] / max(out["host_fused_qps"], 1e-9), 2)
+        log(f"[hybrid_search] fused b32 {top['fused_qps']} qps "
+            f"(device {top['device_ms']} ms, pack {out['pack_ms']} ms) "
+            f"vs host scorer {out['host_fused_qps']} qps "
+            f"({out['qps_vs_host']}x)")
+        ctx["hybrid_search"] = out
+        return out
+    finally:
+        db.close()
+
+
 def sec_fabric(ctx):
     """Serving fabric (native data plane, null device) — isolates the C++
     gRPC fabric from both the device and the dev tunnel. Best-effort:
@@ -1851,6 +1978,7 @@ SECTIONS = [
     ("kernel_conformance", sec_conformance, ("rng",)),
     ("hierarchical_merge", sec_hierarchical_merge, ()),
     ("served_pipeline", sec_served_pipeline, ()),
+    ("hybrid_search", sec_hybrid_search, ()),
     ("serving_fabric", sec_fabric, ()),
 ]
 
@@ -1880,6 +2008,7 @@ def main():
         "filtered_scan": sections.get("filtered_scan"),
         "quantized_clustered_1M_128d": ctx.get("quant"),
         "ivf_ann": ctx.get("ivf_ann"),
+        "hybrid_search": ctx.get("hybrid_search"),
         "kernel_conformance": ctx.get("conformance"),
         "serving_fabric_null_device": ctx.get("fabric"),
         "tunnel_rtt_ms": round(ctx.get("rtt_s", 0.0) * 1e3, 1),

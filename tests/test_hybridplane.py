@@ -1,0 +1,553 @@
+"""Hybridplane (ISSUE 18): device-resident BM25 + sparse/dense fusion.
+
+Contract points:
+
+1. parity — device BM25F top-k EXACTLY equals the host MaxScore scorer
+   (ids and f32 scores) across b/k1 params, multi-prop boosts,
+   stopword-heavy queries, and empty-postings terms, on tie-free
+   corpora (the host's argpartition tail makes tie ORDER arbitrary, so
+   parity corpora keep scores gapped — score equality holds regardless);
+2. fusion parity — ``ops/bm25.fuse_topk`` ranks identically to the
+   ``text/hybrid.py`` reference for RRF and relative-score, including
+   the dict-insertion-order tie-break at exact fused-score ties;
+3. serving pins (PR 7/16 style) — fused hybrid results identical sync
+   vs async and batched vs solo; a mixed hybrid + pure-vector drain
+   dispatches as ONE device program (counter-asserted); every fallback
+   (kill switch, candidate budget, index without the fused program)
+   lands on the host reference path with correct results;
+4. satellites — fusion functions no longer mutate shared results;
+   tokenizer/stopword round-trips; postings-cache counters.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.db.database import Database
+from weaviate_tpu.ops.bm25 import (FUSION_RANKED, FUSION_RELATIVE,
+                                   SparseOperand, bm25_neg_scores,
+                                   fuse_topk, fusion_kind,
+                                   stack_sparse_operands)
+from weaviate_tpu.ops.candidates import masked_candidate_topk
+from weaviate_tpu.schema.config import (CollectionConfig, DataType,
+                                        Property, VectorConfig)
+from weaviate_tpu.text.hybrid import fusion_ranked, fusion_relative_score
+from weaviate_tpu.text.stopwords import StopwordDetector
+from weaviate_tpu.text.tokenizer import tokenize
+
+
+# -- corpus helpers -----------------------------------------------------------
+
+
+def _make_col(tmp_path, texts, dim=8, seed=0, titles=None):
+    db = Database(str(tmp_path))
+    col = db.create_collection(CollectionConfig(
+        name="Doc",
+        properties=[Property(name="body", data_type=DataType.TEXT),
+                    Property(name="title", data_type=DataType.TEXT)],
+        vectors=[VectorConfig()],
+    ))
+    rng = np.random.default_rng(seed)
+    for i, t in enumerate(texts):
+        props = {"body": t}
+        if titles is not None:
+            props["title"] = titles[i]
+        col.put_object(props, vector=rng.standard_normal(dim))
+    return db, col, rng
+
+
+def _tiefree_texts(n=48):
+    """Doc i carries a doc-UNIQUE alpha term frequency (i+1) so BM25
+    scores stay gapped even at b=0 (pure-tf scoring) — required for
+    exact-ID parity against the host's arbitrary tie order. bravo skips
+    every third doc (distinct df -> distinct idf) with its own unique
+    tf; pad varies doc length."""
+    out = []
+    for i in range(n):
+        words = ["alpha"] * (i + 1)
+        if i % 3:
+            words += ["bravo"] * (i + 2)
+        words += ["pad"] * (1 + (7 * i) % 17)
+        out.append(" ".join(words))
+    return out
+
+
+def _device_bm25(inv, query, props, k, allow=None, max_candidates=4096):
+    """Device-score one query standalone: doc ids double as 'slots' so
+    the shared candidate top-k returns doc ids directly."""
+    pack = inv.bm25_pack(query, props, allow,
+                         max_candidates=max_candidates)
+    if pack is None:
+        return None
+    op = SparseOperand(
+        pack["doc_ids"], pack["doc_ids"].astype(np.int32),
+        pack["seg_tf"], pack["seg_len"], pack["seg_term"],
+        pack["seg_boost"], pack["seg_avg"], pack["idf"], pack["k1"],
+        pack["b"], pack["one_minus_b"], 0.0, FUSION_RANKED, k,
+        pack["stats"])
+    p = stack_sparse_operands([op], 1)
+    neg = bm25_neg_scores(
+        p["seg_tf"], p["seg_len"], p["seg_term"], p["seg_boost"],
+        p["seg_avg"], p["idf"], p["k1"], p["b"], p["omb"], p["slots"],
+        p["cand_bits"], use_pallas=False)
+    d, i = masked_candidate_topk(np.asarray(neg), p["slots"],
+                                 min(k, p["slots"].shape[1]))
+    d, i = np.asarray(d)[0], np.asarray(i)[0]
+    live = i >= 0
+    return i[live].astype(np.int64), (-d[live]).astype(np.float32)
+
+
+def _assert_bm25_parity(inv, query, props, k=10):
+    h_ids, h_scores = inv.bm25_search(query, k, props)
+    dev = _device_bm25(inv, query, props, k)
+    if dev is None:
+        assert len(h_ids) == 0
+        return
+    d_ids, d_scores = dev
+    # tie-free precondition: the host's own scores must be gapped
+    assert len(set(np.float32(h_scores).tolist())) == len(h_scores)
+    np.testing.assert_array_equal(d_ids, h_ids)
+    np.testing.assert_array_equal(np.float32(d_scores),
+                                  np.float32(h_scores))
+
+
+# -- 1. device BM25 parity ----------------------------------------------------
+
+
+@pytest.mark.parametrize("k1,b", [(1.2, 0.75), (0.5, 0.0), (2.0, 1.0),
+                                  (1.0, 0.4)])
+def test_bm25_parity_k1_b_sweep(tmp_path, k1, b):
+    db, col, _ = _make_col(tmp_path, _tiefree_texts())
+    try:
+        inv = list(col.shards.values())[0]._inverted
+        inv.k1, inv.b = k1, b
+        for q in ["alpha", "alpha bravo", "alpha pad"]:
+            _assert_bm25_parity(inv, q, ["body"])
+    finally:
+        db.close()
+
+
+def test_bm25_parity_multiprop_boosts(tmp_path):
+    texts = _tiefree_texts()
+    titles = [" ".join(["alpha"] * (1 + i) + [f"t{i}"])
+              if i % 3 else "bravo only"
+              for i in range(len(texts))]
+    db, col, _ = _make_col(tmp_path, texts, titles=titles, seed=2)
+    try:
+        inv = list(col.shards.values())[0]._inverted
+        for props, q in ((["body^2.5", "title"], "alpha bravo"),
+                         (["body", "title^0.5"], "alpha bravo"),
+                         # title-only: the identical "bravo only" titles
+                         # tie, so the query sticks to alpha (unique tf)
+                         (["title^3"], "alpha")):
+            _assert_bm25_parity(inv, q, props)
+    finally:
+        db.close()
+
+
+def test_bm25_parity_stopword_heavy_and_empty_postings(tmp_path):
+    db, col, _ = _make_col(tmp_path, _tiefree_texts(), seed=3)
+    try:
+        inv = list(col.shards.values())[0]._inverted
+        # stopwords drop out of the plan on both paths
+        _assert_bm25_parity(inv, "the alpha of and bravo to", ["body"])
+        # a term with NO postings contributes nothing on either path
+        _assert_bm25_parity(inv, "alpha zzznothere", ["body"])
+        # all-stopword query: host returns empty, pack declines
+        ids, _ = inv.bm25_search("the and of", 5)
+        assert len(ids) == 0
+        assert inv.bm25_pack("the and of") is None
+    finally:
+        db.close()
+
+
+def test_bm25_pack_budget_and_pruned_frac(tmp_path):
+    db, col, _ = _make_col(tmp_path, _tiefree_texts(), seed=4)
+    try:
+        inv = list(col.shards.values())[0]._inverted
+        assert inv.bm25_pack("alpha", max_candidates=3) is None
+        pack = inv.bm25_pack("alpha bravo")
+        assert pack["stats"]["candidates"] == len(pack["doc_ids"])
+        assert 0.0 <= pack["stats"]["pruned_frac"] < 1.0
+    finally:
+        db.close()
+
+
+def test_bm25_pallas_interpret_bitexact_vs_xla(tmp_path):
+    from weaviate_tpu.ops.bm25 import _bm25_neg_scores_xla
+    from weaviate_tpu.ops.pallas_kernels import bm25_block
+
+    db, col, _ = _make_col(tmp_path, _tiefree_texts(), seed=5)
+    try:
+        shard = list(col.shards.values())[0]
+        inv = shard._inverted
+        idx = shard.vector_indexes[""]
+        ops = []
+        for q in ["alpha bravo", "bravo^0 pad", "alpha"]:
+            pack = inv.bm25_pack(q, ["body", "title^2"])
+            slots = idx.slots_for_doc_ids(pack["doc_ids"])
+            ops.append(SparseOperand(
+                pack["doc_ids"], slots, pack["seg_tf"], pack["seg_len"],
+                pack["seg_term"], pack["seg_boost"], pack["seg_avg"],
+                pack["idf"], pack["k1"], pack["b"],
+                pack["one_minus_b"], 0.5, FUSION_RANKED, 100,
+                pack["stats"]))
+        ops.append(None)  # pure-vector row rides the same pack
+        p = stack_sparse_operands(ops, 4)
+        xla = np.asarray(_bm25_neg_scores_xla(
+            p["seg_tf"], p["seg_len"], p["seg_term"], p["seg_boost"],
+            p["seg_avg"], p["idf"], p["k1"], p["b"], p["omb"],
+            p["slots"]))
+        pal = np.asarray(bm25_block(
+            p["seg_tf"], p["seg_len"], p["seg_term"], p["seg_boost"],
+            p["seg_avg"], p["idf"], p["k1"], p["b"], p["omb"],
+            p["cand_bits"], interpret=True))
+        np.testing.assert_array_equal(xla, pal)
+    finally:
+        db.close()
+
+
+# -- 2. fusion parity (unit level, vs text/hybrid.py) -------------------------
+
+
+class _Res:
+    __slots__ = ("uuid", "score", "distance")
+
+    def __init__(self, uuid, score):
+        self.uuid = uuid
+        self.score = score
+        self.distance = None
+
+
+def _host_fuse(kind, sp, dn, alpha, k):
+    """Host reference on synthetic legs. ``sp``: [(id, score)] best
+    first; ``dn``: [(id, distance)] best first."""
+    legs, weights = [], []
+    if alpha < 1.0:
+        legs.append([_Res(i, s) for i, s in sp])
+        weights.append(1.0 - alpha)
+    if alpha > 0.0:
+        legs.append([_Res(i, -d) for i, d in dn])
+        weights.append(alpha)
+    fuse = fusion_relative_score if kind == FUSION_RELATIVE \
+        else fusion_ranked
+    return [(r.uuid, s) for s, r in fuse(legs, weights, k)]
+
+
+def _device_fuse(kind, sp, dn, alpha, k, fetch=100):
+    sp_ids = np.array([[i for i, _ in sp]], np.int32)
+    sp_neg = np.array([[-s for _, s in sp]], np.float32)
+    dn_i = np.array([[i for i, _ in dn]], np.int32)
+    dn_d = np.array([[d for _, d in dn]], np.float32)
+    d, i = fuse_topk(sp_neg, sp_ids, dn_d, dn_i,
+                     np.array([alpha], np.float32),
+                     np.array([kind], np.int32),
+                     np.array([fetch], np.int32), k)
+    d, i = np.asarray(d)[0], np.asarray(i)[0]
+    live = i >= 0
+    return list(zip(i[live].tolist(), (-d[live]).tolist()))
+
+
+@pytest.mark.parametrize("kind", [FUSION_RANKED, FUSION_RELATIVE])
+@pytest.mark.parametrize("alpha", [0.0, 0.25, 0.5, 0.75, 1.0])
+def test_fusion_parity_overlapping_legs(kind, alpha):
+    sp = [(3, 9.0), (1, 7.5), (7, 4.0), (2, 1.0)]
+    dn = [(1, 0.1), (9, 0.2), (3, 0.35), (8, 0.9)]
+    host = _host_fuse(kind, sp, dn, alpha, 6)
+    dev = _device_fuse(kind, sp, dn, alpha, 6)
+    assert [i for i, _ in dev] == [i for i, _ in host]
+    np.testing.assert_allclose([s for _, s in dev],
+                               [s for _, s in host], rtol=1e-6,
+                               atol=1e-7)
+
+
+@pytest.mark.parametrize("kind", [FUSION_RANKED, FUSION_RELATIVE])
+def test_fusion_tie_break_insertion_order(kind):
+    """EXACT fused-score tie: doc 5 only-sparse at rank 0 and doc 6
+    only-dense at rank 0 tie at alpha=0.5 (same rank, same weight; for
+    relative-score both normalize to 1.0). The host dict inserts the
+    sparse leg first; the device concat must preserve that order."""
+    sp = [(5, 2.0), (1, 1.0)]
+    dn = [(6, 0.3), (2, 0.7)]
+    host = _host_fuse(kind, sp, dn, 0.5, 4)
+    dev = _device_fuse(kind, sp, dn, 0.5, 4)
+    assert host[0][0] == 5 and host[1][0] == 6  # the tie, host order
+    assert [i for i, _ in dev] == [i for i, _ in host]
+    np.testing.assert_allclose([s for _, s in dev],
+                               [s for _, s in host], rtol=1e-6)
+
+
+def test_fusion_relative_constant_leg_normalizes_to_one():
+    # constant sparse leg: host hi==lo branch pins norm to 1.0
+    sp = [(1, 3.0), (2, 3.0), (3, 3.0)]
+    dn = [(2, 0.1), (4, 0.5)]
+    host = _host_fuse(FUSION_RELATIVE, sp, dn, 0.4, 5)
+    dev = _device_fuse(FUSION_RELATIVE, sp, dn, 0.4, 5)
+    assert sorted(i for i, _ in dev) == sorted(i for i, _ in host)
+    np.testing.assert_allclose(sorted(s for _, s in dev),
+                               sorted(s for _, s in host), rtol=1e-6)
+
+
+def test_fusion_fetch_caps_leg_depth():
+    # entries past the fetch horizon must not contribute
+    sp = [(1, 5.0), (2, 4.0), (3, 3.0)]
+    dn = [(4, 0.1)]
+    host = _host_fuse(FUSION_RANKED, sp[:2], dn, 0.5, 4)
+    dev = _device_fuse(FUSION_RANKED, sp, dn, 0.5, 4, fetch=2)
+    assert [i for i, _ in dev] == [i for i, _ in host]
+
+
+# -- 3. satellite: fusion functions must not mutate shared results ------------
+
+
+def test_fusion_returns_scores_without_mutating_results():
+    shared = [_Res(i, float(10 - i)) for i in range(5)]
+    before = [r.score for r in shared]
+    out = fusion_ranked([shared], [1.0], 5)
+    assert [r.score for r in shared] == before
+    assert all(isinstance(t, tuple) and len(t) == 2 for t in out)
+    out2 = fusion_relative_score([shared], [1.0], 5)
+    assert [r.score for r in shared] == before
+    # two concurrent fusions over the SAME result objects with different
+    # weights each see their own scores (the in-place bug clobbered one)
+    a = dict((r.uuid, s) for s, r in fusion_ranked([shared], [1.0], 5))
+    b = dict((r.uuid, s) for s, r in fusion_ranked([shared], [0.5], 5))
+    for u in a:
+        assert a[u] == pytest.approx(2.0 * b[u])
+    assert [r.score for r in shared] == before
+    assert out2[0][0] == pytest.approx(1.0)
+
+
+# -- 4. serving pins ----------------------------------------------------------
+
+
+@pytest.fixture
+def served(tmp_path):
+    db, col, rng = _make_col(tmp_path, _tiefree_texts(), seed=9)
+    try:
+        yield col, list(col.shards.values())[0], rng
+    finally:
+        db.close()
+
+
+def test_hybrid_device_equals_host_reference(served):
+    col, shard, rng = served
+    qv = rng.standard_normal(8).astype(np.float32)
+    for fusion in ("rankedFusion", "relativeScore"):
+        for alpha in (0.0, 0.3, 0.75, 1.0):
+            dev = col.hybrid("alpha bravo", vector=qv, alpha=alpha, k=8,
+                             fusion=fusion)
+            shard.device_hybrid = False
+            host = col.hybrid("alpha bravo", vector=qv, alpha=alpha,
+                              k=8, fusion=fusion)
+            shard.device_hybrid = True
+            assert [r.uuid for r in dev] == [r.uuid for r in host]
+            np.testing.assert_allclose([r.score for r in dev],
+                                       [r.score for r in host],
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_hybrid_sync_async_batched_solo_identical(served):
+    col, shard, rng = served
+    qv = rng.standard_normal(8).astype(np.float32)
+    args = dict(k=8, alpha=0.5, fusion="rankedFusion")
+    batched = shard.hybrid_search("alpha bravo", qv, **args)
+    shard.dynamic_batching = False
+    solo = shard.hybrid_search("alpha bravo", qv, **args)
+    shard.dynamic_batching = True
+    h = shard.hybrid_search_async("alpha bravo", qv, **args)
+    assert h is not None
+    a_ids, a_scores = h.result()
+    for ids, scores in (solo, (a_ids, a_scores)):
+        np.testing.assert_array_equal(batched[0], ids)
+        np.testing.assert_array_equal(np.float32(batched[1]),
+                                      np.float32(scores))
+
+
+def test_hybrid_mixed_drain_one_dispatch(served):
+    from weaviate_tpu.runtime.query_batcher import _Pending
+
+    col, shard, rng = served
+    idx = shard.vector_indexes[""]
+    qb = shard._query_batcher("", idx)
+    op = shard._hybrid_operand(idx, "alpha bravo", 5, 0.5,
+                               "rankedFusion", None, None)
+    assert op is not None
+    qs = rng.standard_normal((3, 8)).astype(np.float32)
+    items = [_Pending(qs[0], 5, None),
+             _Pending(qs[1], 5, None, op),
+             _Pending(qs[2], 5, None)]
+    d0, h0 = qb.dispatches, qb.hybrid_batched
+    qb._dispatch(items)
+    for it in items:
+        assert it.event.wait(timeout=10.0)
+        assert it.error is None, it.error
+    # ONE device program served the whole mixed drain
+    assert qb.dispatches == d0 + 1
+    assert qb.hybrid_batched == h0 + 1
+    # pure rows match a plain dense search; the hybrid row matches solo
+    solo_ids, _ = shard.hybrid_search(
+        "alpha bravo", qs[1], 5, alpha=0.5, fusion="rankedFusion")
+    hyb_ids = np.asarray(items[1].ids)
+    np.testing.assert_array_equal(hyb_ids[hyb_ids >= 0], solo_ids)
+    for row in (0, 2):
+        ids, dists = idx.search_by_vector(qs[row], 5)
+        got = np.asarray(items[row].ids)
+        np.testing.assert_array_equal(got[got >= 0], ids)
+
+
+def test_hybrid_async_handle_defers_resolution(served):
+    col, shard, rng = served
+    qv = rng.standard_normal(8).astype(np.float32)
+    h = shard.hybrid_search_async("alpha bravo", qv, k=5, alpha=0.5)
+    assert h is not None
+    # the handle is a real deferred result (API of the TransferPipeline
+    # drain), and resolving twice is stable
+    r1, r2 = h.result(), h.result()
+    np.testing.assert_array_equal(r1[0], r2[0])
+
+
+def test_hybrid_fallbacks_reach_host_path(served):
+    col, shard, rng = served
+    qv = rng.standard_normal(8).astype(np.float32)
+    # kill switch
+    shard.device_hybrid = False
+    assert shard.hybrid_search("alpha", qv, 5) is None
+    assert len(col.hybrid("alpha", vector=qv, k=5)) > 0
+    shard.device_hybrid = True
+    # candidate budget
+    shard.hybrid_max_candidates = 2
+    assert shard.hybrid_search("alpha", qv, 5) is None
+    assert len(col.hybrid("alpha", vector=qv, k=5)) > 0
+    shard.hybrid_max_candidates = 4096
+    # no query vector -> host sparse-only, never the device plane
+    assert shard.hybrid_search("alpha", None, 5) is None
+    assert len(col.hybrid("alpha", vector=None, k=5)) > 0
+
+
+def test_hybrid_batcher_without_fused_program_raises_typed(served):
+    from weaviate_tpu.runtime.query_batcher import (
+        DeviceHybridUnavailable, QueryBatcher, _Pending)
+
+    col, shard, rng = served
+    idx = shard.vector_indexes[""]
+    qb = QueryBatcher(idx.search_by_vector_batch)  # no hybrid_batch_fn
+    try:
+        op = shard._hybrid_operand(idx, "alpha", 5, 0.5, "rankedFusion",
+                                   None, None)
+        qs = rng.standard_normal((2, 8)).astype(np.float32)
+        items = [_Pending(qs[0], 5, None, op), _Pending(qs[1], 5, None)]
+        qb._dispatch(items)
+        for it in items:
+            assert it.event.wait(timeout=10.0)
+        assert isinstance(items[0].error, DeviceHybridUnavailable)
+        # the pure row was re-dispatched through the normal path
+        assert items[1].error is None
+        ids, _ = idx.search_by_vector(qs[1], 5)
+        got = np.asarray(items[1].ids)
+        np.testing.assert_array_equal(got[got >= 0], ids)
+    finally:
+        qb.stop()
+
+
+def test_collection_hybrid_async_twin(served):
+    col, shard, rng = served
+    qv = rng.standard_normal(8).astype(np.float32)
+    h = col.hybrid_async("alpha bravo", vector=qv, alpha=0.5, k=6)
+    sync = col.hybrid("alpha bravo", vector=qv, alpha=0.5, k=6)
+    got = h.result()
+    assert [r.uuid for r in got] == [r.uuid for r in sync]
+    np.testing.assert_allclose([r.score for r in got],
+                               [r.score for r in sync], rtol=1e-6)
+    # host fallback still returns a (pre-resolved) handle
+    shard.device_hybrid = False
+    h2 = col.hybrid_async("alpha bravo", vector=qv, alpha=0.5, k=6)
+    shard.device_hybrid = True
+    assert [r.uuid for r in h2.result()] == [r.uuid for r in sync]
+
+
+def test_hybrid_filtered_parity(served):
+    from weaviate_tpu.filters import Filter
+
+    col, shard, rng = served
+    qv = rng.standard_normal(8).astype(np.float32)
+    w = Filter.where("body", "Equal", "pad")
+    dev = col.hybrid("alpha bravo", vector=qv, alpha=0.4, k=8, where=w)
+    shard.device_hybrid = False
+    host = col.hybrid("alpha bravo", vector=qv, alpha=0.4, k=8, where=w)
+    shard.device_hybrid = True
+    assert [r.uuid for r in dev] == [r.uuid for r in host]
+    np.testing.assert_allclose([r.score for r in dev],
+                               [r.score for r in host], rtol=1e-6)
+
+
+# -- 5. satellite: tokenizer/stopword round-trips + cache counters ------------
+
+
+def test_tokenize_roundtrip_property():
+    rng = np.random.default_rng(0)
+    alphabet = list("abcXYZ019 ,.;:-_/\\\t\n!?()[]«»äöüß日本語")
+    for _ in range(200):
+        s = "".join(rng.choice(alphabet,
+                               size=int(rng.integers(0, 40))))
+        toks = tokenize(s, "word")
+        # invariants: lowercase, non-empty, delimiter-free
+        assert all(t and t == t.lower() for t in toks)
+        # round-trip: re-tokenizing the joined tokens is a fixpoint
+        assert tokenize(" ".join(toks), "word") == toks
+        # whitespace mode round-trips too (case preserved)
+        wtoks = tokenize(s, "whitespace")
+        assert tokenize(" ".join(wtoks), "whitespace") == wtoks
+    assert tokenize(None, "word") == []
+    assert tokenize(["a b", "c"], "word") == ["a", "b", "c"]
+
+
+def test_stopword_detector_roundtrip_property():
+    det = StopwordDetector("en", additions=["Foo"], removals=["the"])
+    rng = np.random.default_rng(1)
+    vocab = ["the", "a", "foo", "FOO", "bar", "baz", "and", "of",
+             "quux", "The"]
+    for _ in range(100):
+        toks = [vocab[int(j)] for j in
+                rng.integers(0, len(vocab), size=int(rng.integers(0, 12)))]
+        kept = det.filter(toks)
+        # filter keeps exactly the non-stopwords, in order
+        assert kept == [t for t in toks if not det.is_stopword(t)]
+        # idempotent
+        assert det.filter(kept) == kept
+    assert not det.is_stopword("the")   # removal wins
+    assert det.is_stopword("foo") and det.is_stopword("FOO")
+    with pytest.raises(ValueError):
+        StopwordDetector("nope")
+
+
+def test_postings_cache_counters(tmp_path):
+    from weaviate_tpu.runtime.metrics import (postings_cache_hits,
+                                              postings_cache_misses)
+
+    db, col, _ = _make_col(tmp_path, _tiefree_texts(), seed=13)
+    try:
+        inv = list(col.shards.values())[0]._inverted
+        inv.bm25_search("alpha", 5)  # warm: decode -> cache
+        hits, misses = (postings_cache_hits.labels(),
+                        postings_cache_misses.labels())
+        h0, m0 = hits.value, misses.value
+        inv.bm25_search("alpha", 5)
+        assert hits.value > h0
+        assert misses.value == m0
+        inv.bm25_search("bravo", 5)  # cold term: at least one miss
+        assert misses.value > m0
+        # G5 conformance: prefixed, snake_case, non-empty HELP
+        for c in (postings_cache_hits, postings_cache_misses):
+            assert c.name.startswith("weaviate_tpu_")
+            assert c.name.endswith("_total")
+            assert c.help.strip()
+    finally:
+        db.close()
+
+
+def test_fusion_kind_mapping():
+    assert fusion_kind("relativeScore") == FUSION_RELATIVE
+    assert fusion_kind("rankedFusion") == FUSION_RANKED
+    assert fusion_kind("ranked") == FUSION_RANKED

@@ -29,7 +29,7 @@ from tools.graftlint.core import (Checker, FileContext, Violation,
                                   walk_shallow)
 
 HOT_DIRS = ("weaviate_tpu/engine/", "weaviate_tpu/ops/",
-            "weaviate_tpu/parallel/")
+            "weaviate_tpu/parallel/", "weaviate_tpu/text/")
 HOT_FILES = ("weaviate_tpu/runtime/query_batcher.py",)
 ALLOWLIST = ("weaviate_tpu/runtime/tracing.py",)
 
@@ -49,6 +49,9 @@ DEVICE_FUNCS = {
     "pack_allow_bitmask_jnp", "unpack_allow_bitmask", "bq_pack",
     "bq_topk", "bq_topk_twostage", "pq_topk", "pq4_topk",
     "pq_topk_twostage", "topk_distances", "_scatter_rows", "_clear_slots",
+    # hybridplane (ops/bm25.py + pallas twin)
+    "bm25_neg_scores", "fuse_topk", "hybrid_topk", "masked_candidate_topk",
+    "bm25_block",
 }
 #: attribute reads on a device value that return host scalars/metadata
 HOST_ATTRS = {"shape", "dtype", "ndim", "size", "nbytes", "sharding",

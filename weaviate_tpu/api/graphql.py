@@ -374,11 +374,12 @@ class GraphQLExecutor:
                                tenant=tenant, where=where, autocut=autocut)
         elif search == "hybrid":
             d = args["hybrid"]
+            tv = d.get("targetVectors")
             hv = d.get("vector")
             if hv is None and self.modules is not None and d.get("query"):
                 try:
                     hv = self.modules.vectorize_query(
-                        col.config, d["query"], "")
+                        col.config, d["query"], tv[0] if tv else "")
                 except Exception:
                     hv = None  # degrade to sparse-only like the reference
             fusion = {"rankedFusion": "ranked",
@@ -387,7 +388,8 @@ class GraphQLExecutor:
             results = col.hybrid(
                 d.get("query", ""), vector=hv,
                 alpha=float(d.get("alpha", 0.75)), k=k,
-                properties=d.get("properties"), tenant=tenant,
+                properties=d.get("properties"),
+                vec_name=tv[0] if tv else "", tenant=tenant,
                 fusion=fusion, where=where, autocut=autocut)
         else:
             # plain listing (with optional sort / cursor)

@@ -577,10 +577,14 @@ class GrpcServer:
         elif search_kind == "hybrid_search":
             h = req.hybrid_search
             vec = _vector_from(h.vector_bytes, h.vector)
+            vec_name = h.target_vectors[0] if h.target_vectors else ""
             if vec is None and h.HasField("near_vector"):
                 vec = _vector_from(h.near_vector.vector_bytes,
                                    h.near_vector.vector)
-            vec_name = h.target_vectors[0] if h.target_vectors else ""
+                # a vector riding in near_vector may name its target
+                # there instead of on the Hybrid message
+                if not vec_name and h.near_vector.target_vectors:
+                    vec_name = h.near_vector.target_vectors[0]
             if vec is None and (h.HasField("near_text") or h.query) \
                     and self._has_vectorizer(col, vec_name):
                 nt = h.near_text if h.HasField("near_text") else None

@@ -1416,7 +1416,13 @@ class Collection:
         """Hybrid sparse+dense search (reference: hybrid/searcher.go:74 runs
         both legs in parallel, then fuses). ``alpha`` weighs the dense leg
         (0 = pure BM25, 1 = pure vector). ``vector=None`` degrades to
-        sparse-only, as the reference does without a vectorizer."""
+        sparse-only, as the reference does without a vectorizer.
+
+        Single-local-shard queries with a query vector take the fused
+        DEVICE path first (ISSUE 18): one batched device program runs the
+        dense scan, scores the packed BM25 candidates, and fuses — the
+        host two-thread reference below stays the fallback (and the
+        parity oracle) for everything the device path declines."""
         from weaviate_tpu.text.hybrid import fusion_ranked, fusion_relative_score
 
         # over-fetch each leg so fusion has overlap to work with; legs run on
@@ -1430,14 +1436,29 @@ class Collection:
         # evaluate the filter once per shard and let both legs reuse the
         # masks — only possible when every target shard is local; with
         # remote shards the filter tree travels down instead
+        names = self._target_shard_names(tenant)
         allow_by_shard = None
         where_down = where
         if where is not None:
-            names = self._target_shard_names(tenant)
             if all(self._is_local(n) for n in names):
                 allow_by_shard = {n: self._load_shard(n).allow_mask(where)
                                   for n in names}
                 where_down = None
+
+        if (vector is not None and len(names) == 1
+                and self._is_local(names[0]) and where_down is None):
+            dev = self._hybrid_device(
+                names[0], query, vector, alpha, k, properties, vec_name,
+                fusion, None if allow_by_shard is None
+                else allow_by_shard.get(names[0]))
+            if dev is not None:
+                if autocut > 0 and dev:
+                    from weaviate_tpu.query.autocut import autocut_results
+
+                    dev = autocut_results(dev, autocut, by="score")
+                if include_objects:
+                    self._attach_objects(dev)
+                return dev
 
         fetch = max(k * 10, 100)
         legs, weights = [], []
@@ -1485,7 +1506,12 @@ class Collection:
         if not legs:
             return []
         fuse = fusion_relative_score if fusion == "relativeScore" else fusion_ranked
-        fused = fuse(legs, weights, k)
+        # fusion returns (fused_score, result) pairs WITHOUT mutating the
+        # leg results (see text/hybrid.py); materialize fresh results so
+        # concurrent queries sharing leg objects never race on .score
+        fused = [SearchResult(uuid=r.uuid, distance=r.distance, score=s,
+                              object=r.object, shard=r.shard)
+                 for s, r in fuse(legs, weights, k)]
         if autocut > 0 and fused:
             from weaviate_tpu.query.autocut import autocut_results
 
@@ -1493,6 +1519,75 @@ class Collection:
         if include_objects:
             self._attach_objects(fused)
         return fused
+
+    def _hybrid_device(self, name: str, query: str, vector, alpha: float,
+                       k: int, properties, vec_name: str, fusion: str,
+                       allow_mask) -> list[SearchResult] | None:
+        """Fused device hybrid for one local shard (ISSUE 18). None =
+        the shard declined (unsupported index, candidate budget, kill
+        switch) and the caller runs the host reference path."""
+        shard = self._load_shard(name)
+        res = shard.hybrid_search(
+            query, np.asarray(vector, np.float32), k, alpha=alpha,
+            fusion=fusion, properties=properties, vec_name=vec_name,
+            allow_mask=allow_mask)
+        if res is None:
+            return None
+        ids, scores = res
+        out = []
+        for doc_id, score in zip(ids.tolist(), scores.tolist()):
+            uuid = shard._doc_to_uuid.get(doc_id)
+            if uuid is not None:
+                out.append(SearchResult(uuid=uuid, score=score,
+                                        shard=name))
+        return out
+
+    def hybrid_async(self, query: str, vector=None, alpha: float = 0.75,
+                     k: int = 10, properties: list[str] | None = None,
+                     vec_name: str = "", tenant: str | None = None,
+                     fusion: str = "relativeScore", where=None,
+                     include_objects: bool = True, autocut: int = 0):
+        """Dispatch-only twin of ``hybrid``: returns a
+        ``DeviceResultHandle`` resolving to the same ``list[SearchResult]``.
+        On the device path the handle's D2H drains through the
+        TransferPipeline while the caller dispatches more work; when the
+        device path declines, the host reference runs inline and the
+        handle is pre-resolved (``DeviceResultHandle.ready``)."""
+        from weaviate_tpu.runtime.transfer import DeviceResultHandle
+
+        names = self._target_shard_names(tenant)
+        if (vector is not None and len(names) == 1
+                and self._is_local(names[0]) and where is None):
+            shard = self._load_shard(names[0])
+            h = shard.hybrid_search_async(
+                query, np.asarray(vector, np.float32), k, alpha=alpha,
+                fusion=fusion, properties=properties, vec_name=vec_name)
+            if h is not None:
+                name = names[0]
+
+                def _finish(res, _shard=shard, _name=name):
+                    ids, scores = res
+                    out = []
+                    for doc_id, score in zip(ids.tolist(),
+                                             scores.tolist()):
+                        uuid = _shard._doc_to_uuid.get(doc_id)
+                        if uuid is not None:
+                            out.append(SearchResult(uuid=uuid,
+                                                    score=score,
+                                                    shard=_name))
+                    if autocut > 0 and out:
+                        from weaviate_tpu.query.autocut import \
+                            autocut_results
+
+                        out = autocut_results(out, autocut, by="score")
+                    if include_objects:
+                        self._attach_objects(out)
+                    return out
+
+                return h.map(_finish)
+        return DeviceResultHandle.ready(self.hybrid(
+            query, vector, alpha, k, properties, vec_name, tenant,
+            fusion, where, include_objects, autocut))
 
     # -- maintenance ---------------------------------------------------------
 

@@ -21,6 +21,7 @@ import numpy as np
 from weaviate_tpu import native
 from weaviate_tpu.engine.store import DeviceVectorStore
 from weaviate_tpu.runtime import kernelscope, tracing
+from weaviate_tpu.runtime.transfer import DeviceResultHandle
 
 
 def _per_query_allow(allow_list) -> bool:
@@ -304,6 +305,108 @@ class FlatIndex:
             return ids, d
 
         return handle.map(_resolve)
+
+    # -- hybrid dataplane (ISSUE 18) ------------------------------------------
+
+    @property
+    def supports_device_hybrid(self) -> bool:
+        """True when this index can run the fused sparse+dense hybrid
+        program: the plain device store only — quantized/epoch/injected
+        stores keep the host hybrid path (their async handles don't
+        expose raw (dist, slot) arrays in store-slot space)."""
+        return type(self.store) is DeviceVectorStore
+
+    def slots_for_doc_ids(self, doc_ids) -> np.ndarray:
+        """Store slots for external doc ids (-1 = not in this index) —
+        the shard layer translates BM25 candidates with this before
+        packing sparse operands."""
+        with self._lock:
+            return np.asarray(
+                [self._id_to_slot.get(int(d), -1) for d in doc_ids],
+                dtype=np.int32)
+
+    def hybrid_batch_async(self, queries: np.ndarray, k: int,
+                           allow_list=None, sparse_ops=None):
+        """One fused device program for a mixed hybrid + pure-vector
+        drain: the dense scan dispatches async, its DEVICE-RESIDENT
+        (dist, slot) arrays feed straight into the BM25 scoring + fusion
+        program (``ops/bm25.py::hybrid_topk``) — one dispatch chain, one
+        D2H through the returned handle. ``sparse_ops`` is a per-row
+        list of ``SparseOperand`` (None = pure-vector row riding the
+        same batch). Returns None when the device hybrid path can't take
+        the request (unsupported store, rowwise filters, or a dispatch
+        shape whose finish step remaps on the host) — callers fall back
+        to the host hybrid path."""
+        from weaviate_tpu.ops.bm25 import hybrid_topk, stack_sparse_operands
+
+        if not self.supports_device_hybrid:
+            return None
+        queries = np.atleast_2d(np.asarray(queries))
+        sparse_ops = list(sparse_ops or [None] * len(queries))
+        live_ops = [op for op in sparse_ops if op is not None]
+        per_query = _per_query_allow(allow_list)
+        # dense leg depth: every row's over-fetch must fit so fusion
+        # ranks match the host reference; pow2 so the scan compiles per
+        # bucket, not per drain
+        fetch = max([k] + [int(op.fetch) for op in live_ops])
+        f_depth = 1 << max(0, fetch - 1).bit_length()
+        with tracing.span("flat.hybrid_batch", k=k, queries=len(queries),
+                          hybrid=len(live_ops), dispatch="async"):
+            with self._lock:
+                kind, allow_mask = self._translate_batch_allow(
+                    queries, allow_list, per_query)
+                if kind == "rowwise":
+                    return None
+                if allow_mask is not None and allow_mask.ndim == 1:
+                    # force the bitmask-batched dispatch: the gathered
+                    # path's finish step remaps slots on the HOST, which
+                    # would break the on-device fusion composition
+                    shared = np.zeros(self.store.capacity, dtype=bool)
+                    shared[:len(allow_mask)] = allow_mask
+                    allow_mask = np.broadcast_to(
+                        shared, (len(queries), self.store.capacity))
+                kernelscope.explain_note(
+                    "hybrid", queries=len(queries),
+                    hybrid_rows=len(live_ops), k=k, fetch=fetch,
+                    terms=int(sum(op.stats.get("terms", 0)
+                                  for op in live_ops)),
+                    candidates=int(sum(op.stats.get("candidates", 0)
+                                       for op in live_ops)),
+                    pruned_frac=round(float(np.mean(
+                        [op.stats.get("pruned_frac", 0.0)
+                         for op in live_ops])), 6) if live_ops else 0.0,
+                    fusion_ranked=int(sum(1 for op in live_ops
+                                          if op.fusion == 0)),
+                    fusion_relative=int(sum(1 for op in live_ops
+                                            if op.fusion == 1)))
+                handle = self.store.search_async(queries, f_depth,
+                                                 allow_mask)
+                if (handle.attrs.get("path") != "device"
+                        or len(handle.arrays) != 2):
+                    return None
+                dn_d, dn_i = handle.arrays
+                pack = stack_sparse_operands(sparse_ops, len(queries))
+                use_pallas = bool(getattr(self.store, "use_pallas",
+                                          False))
+                d, i = hybrid_topk(dn_d, dn_i, pack, k,
+                                   use_pallas=use_pallas)
+                table = self._slot_to_id  # replaced wholesale by compact
+
+        def _resolve(d_np, i_np, _table=table):
+            clipped = np.clip(i_np, 0, len(_table) - 1)
+            ids = np.where(i_np >= 0, _table[clipped], -1)
+            return ids, d_np
+
+        return DeviceResultHandle(
+            (d, i), finish=_resolve,
+            attrs=dict(handle.attrs, hybrid=len(live_ops), k=k))
+
+    def hybrid_batch(self, queries: np.ndarray, k: int, allow_list=None,
+                     sparse_ops=None):
+        """Sync twin of ``hybrid_batch_async`` (same fused program, the
+        D2H just happens inline). Returns None on the same conditions."""
+        h = self.hybrid_batch_async(queries, k, allow_list, sparse_ops)
+        return None if h is None else h.result()
 
     def search_by_vector_distance(self, query: np.ndarray, max_distance: float,
                                   allow_list: np.ndarray | None = None):

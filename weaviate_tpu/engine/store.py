@@ -636,7 +636,12 @@ class DeviceVectorStore:
 
         return DeviceResultHandle(
             (d, i), finish=_finish,
-            attrs={"rows": capacity, "queries": len(queries), "k": k})
+            attrs={"rows": capacity, "queries": len(queries), "k": k,
+                   # which dispatch shape ran: the hybridplane composes
+                   # on the device arrays and must refuse the gathered
+                   # path (its finish step remaps slots on the HOST)
+                   "path": ("gathered" if slot_buf is not None
+                            else "device")})
 
     def epoch_scan(self, queries: np.ndarray, k: int,
                    allow_mask: np.ndarray | None = None):

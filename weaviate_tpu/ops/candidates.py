@@ -37,6 +37,19 @@ from weaviate_tpu.ops.pallas_kernels import allow_bits_for_ids
 from weaviate_tpu.ops.topk import chunked_topk_distances, topk_smallest
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def masked_candidate_topk(vals, ids, k: int):
+    """The candidate plane's shared finishing move: exact top-k over
+    ``(vals [B, M], ids [B, M])`` where dead entries already carry
+    ``MASKED_DISTANCE``, with masked winners normalized to ``-1`` ids so
+    every consumer (dense rescore, IVF probe unions, the hybridplane's
+    sparse/fused legs) hands the SAME (dist, -1) tail convention to its
+    finish step. Ties resolve to the lower index (``lax.top_k``)."""
+    fd, fi = topk_smallest(vals, ids, k)
+    fi = jnp.where(fd >= MASKED_DISTANCE, -1, fi)
+    return fd, fi
+
+
 @functools.partial(jax.jit, static_argnames=("k", "metric"))
 def gather_rescore_topk(q, cand_idx, rows, k: int, metric: str, *,
                         ids_of_row=None, row_norms=None, valid=None,
@@ -89,9 +102,7 @@ def gather_rescore_topk(q, cand_idx, rows, k: int, metric: str, *,
     if allow_bits is not None:
         ok = ok & allow_bits_for_ids(allow_bits, ids)
     d = jnp.where(ok, d, MASKED_DISTANCE)
-    fd, fi = topk_smallest(d, ids, min(k, c))
-    fi = jnp.where(fd >= MASKED_DISTANCE, -1, fi)
-    return fd, fi
+    return masked_candidate_topk(d, ids, min(k, c))
 
 
 def shared_candidates_topk(q, cand_slots, rows, k: int, metric: str, *,

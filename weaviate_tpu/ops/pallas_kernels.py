@@ -1518,3 +1518,120 @@ def bq_hamming_block(
         x_bits = jnp.pad(x_bits, ((0, pn - n), (0, pw - w)))
     out = _bq_tiled(q_bits, x_bits, tile_n, interpret)
     return out[:b, :n]
+
+
+# -- block-sparse BM25F over packed posting candidates (hybridplane) ----------
+#
+# The candidate axis is the hybridplane's "corpus": the host MaxScore
+# planner bounds WHICH docs ship (ops/bm25.py packs them), this kernel
+# scores them. Per grid step one query row's candidate tile sits in VMEM
+# with its [S, tile] tf / prop-length planes; the per-segment scalars
+# (term index, boost, avg-len) and per-term idf/k1/b ride in SMEM like
+# the pallas guide's scalar discipline prescribes, and candidate
+# liveness arrives as block-strided packed words (the PR 3 MASK_BLOCK
+# layout) unpacked tile-locally — the same repeat + lane-iota-shift
+# idiom every masked kernel here uses. The unrolled segment/term loops
+# preserve the HOST scorer's f32 accumulation order exactly (segments in
+# pack order per term, terms in ub order), so the top-k parity oracle
+# holds bit-for-bit against text/inverted.py.
+
+
+def _bm25_kernel(tf_ref, ln_ref, mw_ref, term_ref, boost_ref, avg_ref,
+                 idf_ref, sc_ref, o_ref, *, interpret: bool):
+    s = tf_ref.shape[1]        # static: block shapes carry S and T
+    t = idf_ref.shape[1]
+    tf = tf_ref[0]                                     # [S, tile]
+    ln = ln_ref[0]
+    k1 = sc_ref[0, 0]
+    bb = sc_ref[0, 1]
+    omb = sc_ref[0, 2]
+    contribs = []
+    for si in range(s):
+        norm = omb + (bb * ln[si:si + 1, :]) / avg_ref[0, si]
+        ctb = (boost_ref[0, si] * tf[si:si + 1, :]) \
+            / jnp.maximum(norm, jnp.float32(1e-9))
+        # adding exact 0.0 for misses keeps f32 parity with the host's
+        # skip-the-miss accumulation (and guards padded segments)
+        contribs.append(jnp.where(tf[si:si + 1, :] > 0.0, ctb, 0.0))
+    score = jnp.zeros_like(contribs[0])                # [1, tile]
+    for ti in range(t):
+        acc = jnp.zeros_like(score)
+        for si in range(s):
+            acc = acc + jnp.where(term_ref[0, si] == ti,
+                                  contribs[si], 0.0)
+        score = score + (idf_ref[0, ti] * acc) / (k1 + acc)
+    ok = _mask_unpack_cols(mw_ref[:], score.shape[1], interpret)
+    o_ref[:] = jnp.where(ok > 0, -score, MASKED_DISTANCE)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("s", "t", "tile_c", "interpret"))
+def _bm25_tiled(tf, ln, mw, term, boost, avg, idf, sc, s, t, tile_c,
+                interpret):
+    b, _, c = tf.shape
+    grid = (b, c // tile_c)
+    return pl.pallas_call(
+        functools.partial(_bm25_kernel, interpret=interpret),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, s, tile_c), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s, tile_c), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_c // 32), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, s), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, s), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, t), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 4), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, tile_c), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, c), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=b * c * (4 * s + t * (s + 3)),
+            bytes_accessed=2 * tf.size * 4 + b * c * 4 + mw.size * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(tf, ln, mw, term, boost, avg, idf, sc)
+
+
+def bm25_block(seg_tf, seg_len, seg_term, seg_boost, seg_avg, idf,
+               k1, b, omb, cand_bits, tile_c: int = 512,
+               interpret: bool | None = None):
+    """NEGATED BM25F scores over packed candidates.
+
+    ``seg_tf``/``seg_len`` [B, S, C] f32 per-(term, prop) planes over the
+    candidate axis; ``seg_term`` [B, S] int32 / ``seg_boost``/``seg_avg``
+    [B, S] f32 segment scalars; ``idf`` [B, T] f32; ``k1``/``b``/``omb``
+    [B] f32 per-row BM25 params (``omb`` = host-rounded f32 ``1 - b``);
+    ``cand_bits`` [B, C // 32] uint32 block-strided candidate liveness
+    (``pack_allow_bitmask`` layout). C must be a MASK_BLOCK multiple and
+    S/T at least 1 (ops/bm25.py's ``stack_sparse_operands`` guarantees
+    both). Returns [B, C] f32: ``-score`` on live candidates,
+    MASKED_DISTANCE elsewhere — ready for the candidate-plane top-k.
+    """
+    if interpret is None:
+        interpret = not recommended()
+    b_n, s, c = seg_tf.shape
+    t = idf.shape[1]
+    tile_c = min(tile_c, c)
+    mw = _fit_mask_words(cand_bits, b_n, c)
+    sc = jnp.stack([jnp.asarray(k1, jnp.float32),
+                    jnp.asarray(b, jnp.float32),
+                    jnp.asarray(omb, jnp.float32),
+                    jnp.zeros_like(jnp.asarray(k1, jnp.float32))], axis=1)
+    return _bm25_tiled(seg_tf.astype(jnp.float32),
+                       seg_len.astype(jnp.float32), mw,
+                       seg_term.astype(jnp.int32),
+                       seg_boost.astype(jnp.float32),
+                       seg_avg.astype(jnp.float32),
+                       idf.astype(jnp.float32), sc, s, t, tile_c,
+                       interpret)

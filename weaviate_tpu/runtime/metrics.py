@@ -520,6 +520,21 @@ batcher_transfer_duration = registry.histogram(
     "weaviate_tpu_query_batcher_transfer_seconds",
     "D2H drain time (transfer.d2h window) of the coalesced batch a "
     "query rode in, overlapped with the next dispatch")
+batcher_hybrid_batched = registry.counter(
+    "weaviate_tpu_query_batcher_hybrid_batched_total",
+    "Hybrid (sparse+dense) requests served inside a coalesced device "
+    "dispatch — sparse operands rode the drain the way allow_bits do")
+
+# -- inverted index (text/inverted.py) ----------------------------------------
+
+postings_cache_hits = registry.counter(
+    "weaviate_tpu_postings_cache_hits_total",
+    "Posting-list reads served from the per-shard LRU postings cache")
+postings_cache_misses = registry.counter(
+    "weaviate_tpu_postings_cache_misses_total",
+    "Posting-list reads that went to the LSM searchable bucket — the "
+    "host-side cost floor of BM25 planning and the hybridplane's "
+    "posting pack")
 
 # -- epoch store (engine/epochs.py publishes on seal/compact/drop;
 #    db/collection.py bumps the migration counter) ----------------------------

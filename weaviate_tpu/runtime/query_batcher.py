@@ -71,18 +71,28 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+class DeviceHybridUnavailable(RuntimeError):
+    """The drain carried hybrid (sparse+dense) requests but the index
+    could not run the fused device program for this dispatch shape —
+    the shard layer catches this and serves the query through the host
+    hybrid path instead."""
+
+
 class _Pending:
-    __slots__ = ("query", "k", "allow", "event", "ids", "dists", "error",
-                 "ctx", "t_enqueue", "t_exec_start", "t_exec_end",
+    __slots__ = ("query", "k", "allow", "sparse", "event", "ids", "dists",
+                 "error", "ctx", "t_enqueue", "t_exec_start", "t_exec_end",
                  "batch_size", "t_mask_start", "t_mask_end",
                  "t_fetch_start", "t_fetch_end", "epochs",
                  "device_s", "transfer_s", "device_source",
                  "explain_on", "explain")
 
-    def __init__(self, query, k, allow):
+    def __init__(self, query, k, allow, sparse=None):
         self.query = query
         self.k = k
         self.allow = allow
+        # hybrid requests carry their packed sparse operand
+        # (ops/bm25.SparseOperand) the way filtered ones carry ``allow``
+        self.sparse = sparse
         self.event = threading.Event()
         # enqueue stamp: the flight recorder's wait_ms and the tailboard
         # queue_wait phase both derive from it
@@ -144,10 +154,18 @@ class QueryBatcher:
                  capacity_fn=None, pad_pow2: bool = True,
                  owner: dict | None = None, async_batch_fn=None,
                  transfer_depth: int = 2,
-                 max_queue: int | None = None, kind: str = "index"):
+                 max_queue: int | None = None, kind: str = "index",
+                 hybrid_batch_fn=None):
         from weaviate_tpu.runtime import hbm_ledger
 
         self._batch_fn = batch_fn
+        # hybrid dataplane: ``hybrid_batch_fn(queries, k, allows,
+        # sparses) -> DeviceResultHandle | None`` runs the fused
+        # sparse+dense program for drains carrying sparse operands
+        # (None = unavailable for this dispatch shape -> the hybrid
+        # waiters get a typed DeviceHybridUnavailable and the host path
+        # takes over at the shard layer)
+        self._hybrid_fn = hybrid_batch_fn
         # index kind label for kernelscope's per-compiled-variant
         # residency EWMA (the shard passes the index's ``index_type``)
         self.kind = str(kind)
@@ -193,6 +211,7 @@ class QueryBatcher:
         self.dispatches = 0
         self.batched_queries = 0
         self.filtered_batched = 0
+        self.hybrid_batched = 0
         self.async_dispatches = 0
         # dispatches launched while a previous batch was still in the
         # transfer window — the overlap the double-buffering exists for
@@ -231,8 +250,12 @@ class QueryBatcher:
             return self._transfer
 
     def search(self, query: np.ndarray, k: int,
-               allow: np.ndarray | None = None):
+               allow: np.ndarray | None = None, sparse=None):
         """Blocking per-request entry; coalesces under concurrency.
+
+        ``sparse`` (a packed ``ops/bm25.SparseOperand``) marks a hybrid
+        request: it rides the coalesced dispatch the way allow lists do
+        and the drain runs the fused sparse+dense device program.
 
         Deadline-aware: a request that arrives with its budget spent
         fails typed BEFORE enqueueing, and the wait below is capped at
@@ -241,7 +264,8 @@ class QueryBatcher:
         sheds with a retriable OverloadedError instead of queueing
         latency the budget can't absorb."""
         retry.check("batcher")
-        item = _Pending(np.asarray(query, dtype=np.float32), k, allow)
+        item = _Pending(np.asarray(query, dtype=np.float32), k, allow,
+                        sparse)
         t_enqueue = item.t_enqueue = time.perf_counter()
         with self._cv:
             if len(self._queue) >= self.max_queue:
@@ -394,7 +418,9 @@ class QueryBatcher:
         fb = self.filter_batching
         filter_batching = bool(fb() if callable(fb) else fb)
         for it in drained:
-            if it.allow is not None and (
+            # hybrid requests never go solo: their sparse operand only
+            # dispatches through the fused batched program
+            if it.sparse is None and it.allow is not None and (
                     not filter_batching or self._prefer_solo(it)):
                 solo.append(it)
             else:
@@ -456,12 +482,18 @@ class QueryBatcher:
             b_pad = b
             k_bucket = max(it.k for it in coal)
         filtered = [it for it in coal if it.allow is not None]
+        hybrid = [it for it in coal if it.sparse is not None]
         t_mask0 = time.perf_counter()
         allows = None
         if filtered:
             # per-request allow lists ride along row-aligned; unfiltered
             # and padded rows are None (all-ones downstream)
             allows = [it.allow for it in coal] + [None] * (b_pad - b)
+        sparses = None
+        if hybrid:
+            # sparse operands ride row-aligned exactly like allow lists;
+            # pure-vector and padded rows are None (dense-only downstream)
+            sparses = [it.sparse for it in coal] + [None] * (b_pad - b)
         queries = np.zeros((b_pad,) + coal[0].query.shape, dtype=np.float32)
         for row, it in enumerate(coal):
             queries[row] = it.query
@@ -595,7 +627,44 @@ class QueryBatcher:
         handle = None
         ids = dists = None
         try:
-            if self._async_fn is not None:
+            if hybrid:
+                # fused sparse+dense program: there is NO sync fallback
+                # for hybrid drains (batch_fn has no sparse-operand
+                # slot) — unavailability is a typed error the shard
+                # layer converts into the host hybrid path, and the
+                # pure-vector remainder re-dispatches normally
+                hf = self._hybrid_fn
+                if hf is not None:
+                    faultline.fire("batcher.dispatch", batch=b,
+                                   k=k_bucket)
+                    if plan is None:
+                        handle = tracing.run_in(ctx, hf, queries,
+                                                k_bucket, allows, sparses)
+                    else:
+                        with kernelscope.explain_scope(plan):
+                            handle = tracing.run_in(ctx, hf, queries,
+                                                    k_bucket, allows,
+                                                    sparses)
+                if handle is None:
+                    _hbm.release(pad_key)
+                    err = DeviceHybridUnavailable(
+                        "index cannot run the fused hybrid program for "
+                        "this dispatch")
+                    t1 = time.perf_counter()
+                    for it in hybrid:
+                        it.t_exec_end = t1
+                        it.error = err
+                        it.event.set()
+                    rest = [it for it in coal if it.sparse is None]
+                    if rest:
+                        self._dispatch(rest)
+                    return
+                self.hybrid_batched += len(hybrid)
+                from weaviate_tpu.runtime.metrics import \
+                    batcher_hybrid_batched
+
+                batcher_hybrid_batched.inc(len(hybrid))
+            elif self._async_fn is not None:
                 # dispatch-and-go: launch the program, hand the
                 # device-resident handle to the transfer thread, return
                 # to drain the NEXT batch while this one crosses D2H
@@ -611,15 +680,19 @@ class QueryBatcher:
                     with kernelscope.explain_scope(plan):
                         handle = tracing.run_in(ctx, self._async_fn,
                                                 queries, k_bucket, allows)
-                if handle is not None:
-                    n_ep = int(handle.attrs.get("epochs", 0) or 0)
-                    if n_ep:
-                        flight_rec["epochs"] = n_ep
-                        for it in coal:
-                            it.epochs = n_ep
+            if handle is not None:
+                n_ep = int(handle.attrs.get("epochs", 0) or 0)
+                if n_ep:
+                    flight_rec["epochs"] = n_ep
+                    for it in coal:
+                        it.epochs = n_ep
             if handle is None:
                 ids, dists = _sync_batch()
         except Exception as e:  # noqa: BLE001
+            if hybrid:
+                # no sparse-aware sync retry exists — surface the fault
+                _fail(e)
+                return
             result = _retry_once(e)
             if result is None:
                 return
@@ -629,7 +702,8 @@ class QueryBatcher:
             plan["batcher"] = {
                 "batch": b, "b_pad": b_pad, "k_bucket": k_bucket,
                 "queue_depth": self._queue_depth_at_drain,
-                "filtered": len(filtered), "solo": False,
+                "filtered": len(filtered), "hybrid": len(hybrid),
+                "solo": False,
                 "async": handle is not None, "kind": self.kind}
             for it in coal:
                 if it.explain_on:
@@ -664,6 +738,11 @@ class QueryBatcher:
         def _complete(res, err, t_fetch0, t_fetch1):
             for it in coal:
                 it.t_fetch_start, it.t_fetch_end = t_fetch0, t_fetch1
+            if err is not None and hybrid:
+                # the sync retry path can't re-run a hybrid program
+                # (no sparse-operand slot) — deliver the fault
+                _fail(err)
+                return
             if err is None:
                 # drain-thread stamps: dispatch-submit (t0) .. transfer-
                 # complete (t_fetch1), minus the sampled-memcpy EWMA for

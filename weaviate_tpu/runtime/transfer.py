@@ -76,6 +76,14 @@ class DeviceResultHandle:
         self._lock = threading.Lock()
         self.attrs = dict(attrs or {})
 
+    @property
+    def arrays(self) -> tuple:
+        """The raw device arrays, still resident (empty once ``result()``
+        has drained them, or for ``ready()``/``map()`` handles). Device
+        COMPOSITION hook: the hybridplane feeds a dense scan's arrays
+        into the fusion program without forcing the D2H early."""
+        return self._arrays
+
     @classmethod
     def ready(cls, value) -> "DeviceResultHandle":
         """A handle over an already-host-resident result (sync fallbacks

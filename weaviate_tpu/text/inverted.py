@@ -703,12 +703,17 @@ class InvertedIndex:
         at posting load and cached; they feed the MaxScore per-term score
         upper bound (the analog of the reference's WAND block-max impacts,
         bm25_searcher.go:551) at O(1) per query."""
+        from weaviate_tpu.runtime.metrics import (postings_cache_hits,
+                                                  postings_cache_misses)
+
         key = prop.encode() + _SEP + term.encode()
         with self._lock:
             hit = self._post_cache.get(key)
             if hit is not None:
+                postings_cache_hits.inc()
                 return hit
             version = self._version
+        postings_cache_misses.inc()
         m = self.searchable_bucket.get_map(key)
         if not m:
             out = (np.empty(0, np.int64), np.empty(0, np.float32),
@@ -847,15 +852,15 @@ class InvertedIndex:
         # fall back to every prop with length aggregates (auto-schema'd)
         return sorted(self._meta.get("props", {}).keys())
 
-    def bm25_search(self, query: str, k: int = 10,
-                    properties: list[str] | None = None,
-                    allow_mask: np.ndarray | None = None):
-        """BM25F over ``properties`` (``name^boost`` syntax supported).
-
-        Returns (doc_ids [<=k] int64, scores [<=k] f32) descending.
-        Reference: inverted/bm25_searcher.go:73 (BM25F), boosts parsed the
-        same way (bm25_searcher.go propertyBoosts).
-        """
+    def _bm25_plan(self, query: str,
+                   properties: list[str] | None = None):
+        """Shared BM25F planning prologue (host scorer AND the
+        hybridplane's posting pack): parse ``name^boost`` specs, analyze
+        the query per property, load postings, and compute per-term
+        idf / MaxScore upper bounds. Returns ``(term_rows, avg_len)``
+        with ``term_rows`` a list of ``(idf, ub, fields)`` in sorted-term
+        order (fields = ``(ids, tfs, lens, boost, prop_name)``), or None
+        when no term has a live posting."""
         props: list[tuple[str, float]] = []
         for spec in (properties or self.searchable_props()):
             name, _, boost = spec.partition("^")
@@ -875,7 +880,7 @@ class InvertedIndex:
                     sorted(set(tokenize(query, tok)))):
                 term_fields.setdefault(term, []).append((name, boost))
         if not term_fields:
-            return np.empty(0, np.int64), np.empty(0, np.float32)
+            return None
 
         k1, b = self.k1, self.b
         term_rows = []  # (idf, ub, [(ids, tfs, lens, boost, prop_name)])
@@ -901,7 +906,23 @@ class InvertedIndex:
             ub = idf * s_max / (k1 + s_max)
             term_rows.append((idf, ub, fields))
         if not term_rows:
+            return None
+        return term_rows, avg_len
+
+    def bm25_search(self, query: str, k: int = 10,
+                    properties: list[str] | None = None,
+                    allow_mask: np.ndarray | None = None):
+        """BM25F over ``properties`` (``name^boost`` syntax supported).
+
+        Returns (doc_ids [<=k] int64, scores [<=k] f32) descending.
+        Reference: inverted/bm25_searcher.go:73 (BM25F), boosts parsed the
+        same way (bm25_searcher.go propertyBoosts).
+        """
+        plan = self._bm25_plan(query, properties)
+        if plan is None:
             return np.empty(0, np.int64), np.empty(0, np.float32)
+        term_rows, avg_len = plan
+        k1, b = self.k1, self.b
 
         def score_candidates(cand: np.ndarray) -> np.ndarray:
             """Exact BM25F over ``cand`` (sorted) across ALL query terms —
@@ -987,3 +1008,87 @@ class InvertedIndex:
         top = np.argpartition(-scores, k_eff - 1)[:k_eff]
         order = top[np.argsort(-scores[top], kind="stable")]
         return cand[order], scores[order]
+
+    def bm25_pack(self, query: str,
+                  properties: list[str] | None = None,
+                  allow_mask: np.ndarray | None = None, *,
+                  max_candidates: int = 4096):
+        """Plan one query for DEVICE scoring (the hybridplane pack).
+
+        Same prologue as ``bm25_search`` (analysis, postings, idf, ub
+        ordering) but instead of scoring, the ALLOWED UNION of every
+        term's postings ships as the candidate universe — a superset of
+        the MaxScore essential union, so the device top-k is provably
+        the exhaustive top-k — as dense per-(term, prop) segment planes
+        over the candidate axis (ops/bm25.py layout). Segments pack in
+        ub-DESCENDING term order with fields in query order, mirroring
+        the host scorer's accumulation order for f32 parity. Returns a
+        dict of host arrays + scalars (the shard layer adds store slots
+        and fusion params to make a ``SparseOperand``), or None when the
+        device path should not take the query (no live terms, empty
+        allowed union, or a candidate universe past ``max_candidates``
+        — the planner's budget gate; callers fall back to the host
+        scorer)."""
+        plan = self._bm25_plan(query, properties)
+        if plan is None:
+            return None
+        term_rows, avg_len = plan
+        term_rows = sorted(term_rows, key=lambda t: -t[1])
+        all_ids = np.unique(np.concatenate(
+            [ids for _idf, _ub, fields in term_rows
+             for ids, *_ in fields]))
+        if allow_mask is not None:
+            keep = all_ids[all_ids < len(allow_mask)]
+            cand = keep[allow_mask[keep]]
+        else:
+            cand = all_ids
+        postings_total = int(sum(
+            len(ids) for _idf, _ub, fields in term_rows
+            for ids, *_ in fields))
+        if len(cand) == 0 or len(cand) > max_candidates:
+            return None
+        c = len(cand)
+        seg_tf, seg_len, seg_term, seg_boost, seg_avg = [], [], [], [], []
+        idf_arr = np.zeros(len(term_rows), np.float32)
+        for t_idx, (idf, _ub, fields) in enumerate(term_rows):
+            idf_arr[t_idx] = idf
+            for ids, tfs, lens, boost, name in fields:
+                pos = np.searchsorted(ids, cand)
+                inb = pos < len(ids)
+                pos_c = np.clip(pos, 0, len(ids) - 1)
+                hit = inb & (ids[pos_c] == cand)
+                row_tf = np.zeros(c, np.float32)
+                row_len = np.zeros(c, np.float32)
+                src = pos_c[hit]
+                row_tf[hit] = tfs[src]
+                row_len[hit] = lens[src]
+                seg_tf.append(row_tf)
+                seg_len.append(row_len)
+                seg_term.append(t_idx)
+                seg_boost.append(boost)
+                seg_avg.append(avg_len[name])
+        stats = {
+            "terms": len(term_rows),
+            "candidates": c,
+            "postings_total": postings_total,
+            # posting entries the planner did NOT materialize as
+            # candidate columns (multi-term/multi-prop overlap + allow
+            # filtering) — the explain plane's "pruned frac"
+            "pruned_frac": round(1.0 - c / max(postings_total, 1), 6),
+        }
+        return {
+            "doc_ids": cand.astype(np.int64),
+            "seg_tf": np.stack(seg_tf),
+            "seg_len": np.stack(seg_len),
+            "seg_term": np.asarray(seg_term, np.int32),
+            "seg_boost": np.asarray(seg_boost, np.float32),
+            "seg_avg": np.asarray(seg_avg, np.float32),
+            "idf": idf_arr,
+            "k1": float(self.k1),
+            "b": float(self.b),
+            # host-rounded f32(1 - b): numpy's weak scalar cast makes
+            # the host's ``1.0 - b + <f32>`` effectively f32((1-b)) + x;
+            # shipping the pre-rounded value keeps device parity exact
+            "one_minus_b": float(np.float32(1.0 - self.b)),
+            "stats": stats,
+        }
