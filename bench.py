@@ -973,11 +973,58 @@ def sec_observability_overhead(ctx):
         total_dev = kernelscope.total_device_seconds()
         metering_sum_over_total = (metered / total_dev
                                    if total_dev > 0 else 1.0)
+        # driftwatch: one full cycle (canary probes through a REAL
+        # query batcher + live-telemetry classification against a
+        # self-sealed baseline) timed tight-loop. The plane runs on the
+        # maintenance thread every interval_s, so its served-QPS cost
+        # is the amortized single-core share cycle_s / interval_s —
+        # composed into the same 1/(1+overhead) ratio shape as the
+        # timeline and explain terms
+        from weaviate_tpu.runtime import driftwatch
+
+        driftwatch.reset_for_tests()
+        cvecs = rng.standard_normal((1024, 64)).astype(np.float32)
+        cids = np.arange(1024, dtype=np.int64)
+        cidx = FlatIndex(dim=64, capacity=2048)
+        cidx.add_batch(cids, cvecs)
+        cqb = QueryBatcher(cidx.search_by_vector_batch, max_batch=64)
+
+        def canary_search(queries, k):
+            out = []
+            for cq in np.asarray(queries, dtype=np.float32):
+                ids, _ = cqb.search(cq, k)
+                ids = np.asarray(ids)
+                out.append(ids[ids >= 0].astype(np.int64))
+            return out
+
+        driftwatch.register_canary(
+            "bench/obs/-", collection="bench", shard="obs",
+            search_fn=canary_search,
+            corpus_fn=lambda: (cids, cvecs),
+            epoch_token_fn=lambda: (len(cidx),),
+            pairwise_fn=lambda qs, vs:
+                ((qs[:, None, :] - vs[None, :, :]) ** 2).sum(-1))
+        try:
+            driftwatch.run_cycle()  # seals GT + refs + live baseline
+            t0 = time.perf_counter()
+            drift_reps = 5
+            for _ in range(drift_reps):
+                driftwatch.run_cycle()
+            drift_cycle_us = ((time.perf_counter() - t0)
+                              / drift_reps * 1e6)
+        finally:
+            cqb.stop()
+        drift_period_s = driftwatch.interval_s()
+        drift_ratio = 1.0 / (1.0 + (drift_cycle_us / 1e6)
+                             / max(drift_period_s, 1e-9))
     finally:
         tailboard.force_enabled(None)
         qb.stop()
         tracing.clear_traces()
         kernelscope.reset_for_tests()
+        from weaviate_tpu.runtime import driftwatch as _dw
+
+        _dw.reset_for_tests()
     overhead = timeline_cost_us / max(request_cpu_us, 1e-9)
     ratio = 1.0 / (1.0 + overhead)
     explain_ratio = 1.0 / (1.0 + explain_cost_us
@@ -990,6 +1037,9 @@ def sec_observability_overhead(ctx):
         "explain_cost_us": round(explain_cost_us, 3),
         "explain_on_over_off_qps": round(explain_ratio, 4),
         "metering_sum_over_total": round(metering_sum_over_total, 4),
+        "drift_cycle_us": round(drift_cycle_us, 1),
+        "drift_period_s": drift_period_s,
+        "drift_on_over_off_qps": round(drift_ratio, 4),
         "ab_on_qps": round(ab_on_qps, 1),
         "ab_off_qps": round(ab_off_qps, 1),
     }
@@ -997,8 +1047,9 @@ def sec_observability_overhead(ctx):
         f"{request_cpu_us:.0f} us served cpu -> ratio {ratio:.4f} "
         f"(overhead {out['overhead_frac'] * 100:.2f}%); explain "
         f"{explain_cost_us:.2f} us -> {explain_ratio:.4f}; metering "
-        f"sum/total {metering_sum_over_total:.4f}; A/B "
-        f"{ab_on_qps:.0f}/{ab_off_qps:.0f} qps")
+        f"sum/total {metering_sum_over_total:.4f}; drift cycle "
+        f"{drift_cycle_us:.0f} us / {drift_period_s:.0f}s -> "
+        f"{drift_ratio:.4f}; A/B {ab_on_qps:.0f}/{ab_off_qps:.0f} qps")
     return out
 
 

@@ -46,8 +46,8 @@ def _faultline_isolation():
     yield
     from weaviate_tpu.cluster.transport import reset_breakers
     from weaviate_tpu.replication.hashbeater import replication_status
-    from weaviate_tpu.runtime import (degrade, faultline, kernelscope,
-                                      metrics, tailboard)
+    from weaviate_tpu.runtime import (degrade, driftwatch, faultline,
+                                      kernelscope, metrics, tailboard)
     from weaviate_tpu.storage import recovery
 
     faultline.disarm()
@@ -65,3 +65,7 @@ def _faultline_isolation():
     # the capture dir all live at module level — a leaked explain sink
     # or meter total would corrupt the next test's attribution math
     kernelscope.reset_for_tests()
+    # driftwatch: sealed canary references, open findings and the
+    # self-sealed live baseline are module state — a finding leaking
+    # across tests would poison the next test's health assertions
+    driftwatch.reset_for_tests()

@@ -169,6 +169,14 @@ def _make_rs256_jwt_and_jwks(claims: dict):
     import base64
     import json
 
+    # optional dep (pyproject [test-auth] extra): self-signing an RS256
+    # token needs a real RSA implementation — the validator under test
+    # does not, so only this test skips where cryptography is absent
+    pytest.importorskip(
+        "cryptography",
+        reason="cryptography not installed (pip install "
+               "'weaviate-tpu[test-auth]' to run the real OIDC "
+               "JWKS validation)")
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import padding, rsa
 

@@ -1,0 +1,6 @@
+"""``python -m tools.driftwatch`` — see cli.main for the CLI."""
+
+from tools.driftwatch.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

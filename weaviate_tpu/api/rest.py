@@ -109,6 +109,11 @@ DEBUG_ENDPOINTS = {
                "for N ms and returns per-kernel device-ms ranked by "
                "the kernel registry (?id=<capture> fetches a full "
                "persisted capture).",
+    "drift": "Driftwatch verdict plane: open findings with the gate "
+             "verdict, per-entry trend deltas from the last live "
+             "telemetry classification against benchkeeper bands, and "
+             "per-canary state (probe set, sealed references, recall/"
+             "residency history through the real query batcher).",
 }
 
 
@@ -1057,6 +1062,12 @@ class RestServer:
             from weaviate_tpu.runtime import kernelscope
 
             return 200, kernelscope.snapshot()
+        if name == "drift":
+            # online drift plane: gate verdict + findings + canary and
+            # live-telemetry trends (runtime/driftwatch.py)
+            from weaviate_tpu.runtime import driftwatch
+
+            return 200, driftwatch.snapshot()
         if name == "profile":
             # paramless: cheap — list persisted captures only. A
             # capture is an explicit ?ms=N opt-in (the paramless form

@@ -96,6 +96,10 @@ class ServerConfig:
     # kernelscope: how many /v1/debug/profile?ms=N captures to keep
     # persisted under <data_dir>/kernelscope (PROFILING_KEEP)
     profile_keep: int = 8
+    # driftwatch: online recall/perf drift plane (canary probes + live
+    # telemetry vs benchkeeper bands) on a cyclemanager period
+    driftwatch_enabled: bool = True
+    drift_interval_s: float = 30.0
     log_level: str = "info"
     log_format: str = "text"
     disable_telemetry: bool = False
@@ -147,6 +151,9 @@ class ServerConfig:
             slo_config=env.get("WEAVIATE_TPU_SLO", ""),
             profiling_port=_int(env, "PROFILING_PORT", 0),
             profile_keep=_int(env, "PROFILING_KEEP", 8),
+            driftwatch_enabled=_flag(env, "WEAVIATE_TPU_DRIFTWATCH", True),
+            drift_interval_s=_float(env, "WEAVIATE_TPU_DRIFT_INTERVAL_S",
+                                    30.0),
             log_level=env.get("LOG_LEVEL", "info"),
             log_format=env.get("LOG_FORMAT", "text"),
             disable_telemetry=_flag(env, "DISABLE_TELEMETRY"),

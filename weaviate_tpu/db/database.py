@@ -30,9 +30,10 @@ class Database:
         # incident flight-recorder snapshots (tailboard) follow the data
         # dir of the most recently opened database — embedded/test use
         # gets on-disk snapshots without Server wiring
-        from weaviate_tpu.runtime import tailboard
+        from weaviate_tpu.runtime import driftwatch, tailboard
 
         tailboard.set_data_dir(data_dir)
+        driftwatch.set_data_dir(data_dir)
         # host-count hint for scrape-time hbm_host_bytes refreshes
         from weaviate_tpu.parallel.mesh import host_count
         from weaviate_tpu.runtime.hbm_ledger import ledger as _hbm_ledger
@@ -85,6 +86,12 @@ class Database:
         # instead of letting the quota 507 writes
         self.cycles.register("epoch-maintenance", self._epoch_cycle,
                              maintenance_interval)
+        # driftwatch (ROADMAP item 1c): canary probes through the real
+        # batcher + live-telemetry classification against benchkeeper
+        # bands, on its own (longer) period — run_now("driftwatch") is
+        # the deterministic test entry
+        self.cycles.register("driftwatch", driftwatch.run_cycle,
+                             driftwatch.interval_s())
         if start_cycles:
             self.cycles.start()
         self._load_existing()

@@ -323,7 +323,71 @@ class Shard:
                               tenant=self._tenant_label()):
             idx = _make_vector_index(vc, dim, mesh=self.mesh)
         self.vector_indexes[vec_name] = idx
+        self._register_drift_canary(vec_name)
         return idx
+
+    def _register_drift_canary(self, vec_name: str) -> None:
+        """Hand this vector space to driftwatch as a canary target. The
+        callbacks resolve ``self.vector_indexes[vec_name]`` per call so
+        they survive compress()/DynamicIndex upgrades swapping stores
+        under the same key, and the probe search routes through
+        ``_query_batcher`` — the REAL serving dispatch (coalescing,
+        faultline point, kernelscope attribution), not a side channel."""
+        from weaviate_tpu.runtime import driftwatch
+
+        def _idx():
+            return self.vector_indexes.get(vec_name)
+
+        def corpus_fn():
+            idx = _idx()
+            id_map = getattr(idx, "_id_to_slot", None)
+            if not id_map:
+                return None
+            doc_ids = sorted(int(d) for d in id_map)
+            objs = self.objects_by_doc_ids(doc_ids)
+            ids, vecs = [], []
+            for d, obj in zip(doc_ids, objs):
+                v = None if obj is None else obj.vectors.get(vec_name)
+                if v is not None:
+                    ids.append(d)
+                    vecs.append(np.asarray(v, dtype=np.float32))
+            if not ids:
+                return None
+            return np.asarray(ids, dtype=np.int64), np.stack(vecs)
+
+        def epoch_token_fn():
+            idx = _idx()
+            if idx is None:
+                return None
+            es = getattr(idx, "epoch_store", None)
+            if es is not None:
+                return (tuple((e["epoch"], e["rows"], e["live"])
+                              for e in es.epoch_stats()), len(idx))
+            return (len(idx),)
+
+        def pairwise_fn(qs, vecs):
+            idx = _idx()
+            metric = getattr(idx, "metric", "l2-squared")
+            return Shard._host_pairwise(qs, vecs, metric)
+
+        def search_fn(queries, k):
+            idx = _idx()
+            if idx is None or getattr(idx, "search_by_vector_batch",
+                                      None) is None:
+                return None
+            b = self._query_batcher(vec_name, idx)
+            out = []
+            for q in np.asarray(queries, dtype=np.float32):
+                ids, _ = b.search(q, k, None)
+                ids = np.asarray(ids)
+                out.append(ids[ids >= 0].astype(np.int64))
+            return out
+
+        driftwatch.register_canary(
+            f"{self.collection_name}/{self.name}/{vec_name or '-'}",
+            collection=self.collection_name, shard=self.name,
+            search_fn=search_fn, corpus_fn=corpus_fn,
+            epoch_token_fn=epoch_token_fn, pairwise_fn=pairwise_fn)
 
     def _tenant_label(self) -> str:
         """Tenants ARE shards in this layout (reference: partitioned
@@ -1429,6 +1493,10 @@ class Shard:
         return did
 
     def close(self):
+        from weaviate_tpu.runtime import driftwatch
+
+        driftwatch.unregister_canaries(
+            f"{self.collection_name}/{self.name}/")
         for q in self._index_queues.values():
             q.stop()
         for b in self._query_batchers.values():

@@ -739,6 +739,24 @@ bench_delta_frac = registry.gauge(
     "so positive = regressing direction (slower scan / lower qps)",
     ("entry",))
 
+# -- driftwatch (runtime/driftwatch.py: online recall/perf drift plane) -------
+
+drift_gate_ok = registry.gauge(
+    "weaviate_tpu_drift_gate_ok",
+    "1 when no open driftwatch finding flips health (canary recall "
+    "holds, live telemetry inside its benchkeeper bands), 0 during a "
+    "drift incident")
+drift_findings_total = registry.counter(
+    "weaviate_tpu_drift_findings_total",
+    "Driftwatch findings opened, by leg (canary = serving-path probe "
+    "set, live = telemetry vs benchkeeper bands) and kind (recall, "
+    "residency, regression, stale, refused)", ("leg", "kind"))
+canary_recall = registry.gauge(
+    "weaviate_tpu_canary_recall",
+    "Worst canary recall@10 across a shard's vector spaces in the last "
+    "driftwatch cycle, measured through the real query batcher against "
+    "host-exact ground truth", ("collection", "shard"))
+
 # -- jit compilation (runtime/compile_cache.py installs the listeners) --------
 
 compile_cache_events = registry.counter(
@@ -780,6 +798,12 @@ def scrape(openmetrics: bool = False) -> tuple[bytes, str]:
         from weaviate_tpu.runtime import tailboard
 
         tailboard.scrape_refresh()
+    except Exception:
+        pass
+    try:
+        from weaviate_tpu.runtime import driftwatch
+
+        driftwatch.scrape_refresh()
     except Exception:
         pass
     body = registry.expose(openmetrics=openmetrics).encode()

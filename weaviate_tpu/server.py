@@ -132,6 +132,15 @@ class Server:
         kernelscope.configure(data_dir=cfg.data_path,
                               keep=cfg.profile_keep)
 
+        # driftwatch wiring: history ring + self-sealed live baseline
+        # live under <data_dir>/driftwatch; the cycle itself is
+        # registered by Database (start_cycles=True here runs it)
+        from weaviate_tpu.runtime import driftwatch
+
+        driftwatch.configure(data_dir=cfg.data_path,
+                             enabled=cfg.driftwatch_enabled,
+                             interval=cfg.drift_interval_s)
+
         modules = default_provider(self.db, enabled=cfg.enabled_modules)
 
         # FROZEN tenant tier: ship offloaded tenants through a backup
