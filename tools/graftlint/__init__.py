@@ -29,8 +29,26 @@ or a real TPU to fire):
                       outside parallel/partition.py — placement
                       resolves through the match_partition_rules
                       tables, never per-call-site axis literals
+- G9 thread-discipline whole-program, role-aware: no device sync
+                      reachable from a TransferPipeline drain-thread
+                      callback, and no rpc/fsync reachable while a
+                      db/- or engine/-class lock is held
+- G10 interprocedural-host-sync G1's taint across call and module
+                      boundaries: a host read of a helper's
+                      device-array return is a hidden sync even when
+                      the helper lives elsewhere
+- G11 config-surface  every os.environ read outside config.py is
+                      registered in env_inventory.json (dynamic names
+                      need a reasoned entry, like the baseline)
 
-Run: ``python -m tools.graftlint [--json] [--update-baseline] paths...``
+G9-G11 share the ProgramIndex: per-file module facts (symbols, typed
+call edges, effect/spawn sites, returns-device fixpoints) extracted by
+the ``PI`` pseudo-checker and rebuilt into one call graph every run, so
+the per-file cache never stales an interprocedural verdict.
+
+Run: ``python -m tools.graftlint [--json] [--changed-only]
+[--update-baseline] [--env-inventory] [--update-env-inventory]
+paths...``
 Suppress: ``# graftlint: disable=G1`` on the violating line (give a
 reason in a trailing comment), ``# graftlint: disable-file=G4`` anywhere
 in a file, or a ``tools/graftlint/baseline.json`` entry with a

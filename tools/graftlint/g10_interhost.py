@@ -1,0 +1,74 @@
+"""G10 interprocedural host-sync: G1's taint, lifted across calls.
+
+G1 flags a host read (``np.*``, ``float()``, ``.item()``) of a device
+value inside ONE function — its known blind spot is the helper
+boundary: ``d = self._store.search_device(q)`` followed by
+``np.asarray(d)`` is invisible to G1 because the taint source lives in
+another function (often another module). The ad-hoc G5 explain-taint
+piggyback (PR 17) caught exactly one instance of this shape by hand;
+G10 retires the blind spot generally.
+
+The ProgramIndex records, per function, every host sink applied to a
+call result (directly or through a name bound solely from that call),
+plus a fixpoint returns-device-value summary (G1's own taint pass
+judged at each ``return``, propagated through return-call chains). G10
+joins the two: a sink whose callee — resolved through typed receivers,
+imports, or a globally-unique method name — transitively returns a
+device value is a hidden sync at the sink site.
+
+Scope matches G1 (hot dirs + hot files, same allowlist): the sink must
+be on a hot path; the device-returning helper can live anywhere in
+``weaviate_tpu/``. Callees already in G1's ``DEVICE_FUNCS`` registry
+are skipped — G1 flags those itself, and one violation per sync is
+enough.
+"""
+
+from __future__ import annotations
+
+from tools.graftlint.core import Checker, ProgramIndex, Violation
+from tools.graftlint.g1_host_sync import (ALLOWLIST, DEVICE_FUNCS,
+                                          HOT_DIRS, HOT_FILES)
+
+
+def in_scope(path: str) -> bool:
+    if path in ALLOWLIST:
+        return False
+    return path in HOT_FILES or any(path.startswith(d) for d in HOT_DIRS)
+
+
+class InterHostSyncChecker(Checker):
+    id = "G10"
+    name = "interprocedural-host-sync"
+
+    def applies_to(self, path: str) -> bool:
+        return path.endswith(".py") and in_scope(path)
+
+    def finalize(self, facts: dict[str, dict],
+                 program: ProgramIndex | None = None) -> list[Violation]:
+        if program is None:
+            return []
+        out: list[Violation] = []
+        for fid, fact in program.fn.items():
+            path = program.path_of(fid)
+            if not in_scope(path):
+                continue
+            for ref, line, col, desc in fact.get("sinks", ()):
+                callee = program.resolve_in(fid, ref)
+                if callee is None:
+                    continue
+                if program.qual_of(callee).rsplit(".", 1)[-1] \
+                        in DEVICE_FUNCS:
+                    continue  # G1 flags the sink itself
+                if not program.returns_device(callee):
+                    continue
+                cq = program.qual_of(callee)
+                cw = (f"{program.path_of(callee)}:"
+                      f"{program.fn[callee].get('line', 1)}")
+                out.append(Violation(
+                    self.id, path, line, col,
+                    f"[inter-host-sync] {desc} forces a device->host "
+                    f"sync: {cq} ({cw}) returns a device value — route "
+                    "the transfer through DeviceResultHandle/"
+                    "TransferPipeline (or tracing.d2h on maintenance "
+                    "paths) so the sync is attributed and overlapped"))
+        return out

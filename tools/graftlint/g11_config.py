@@ -1,0 +1,410 @@
+"""G11 config-surface discipline: every env read is accounted for.
+
+``ServerConfig.from_env`` (config.py) is the sanctioned home for
+environment parsing — but 75 ``os.environ``/``os.getenv`` sites across
+30 files grew up around it, and every unregistered read is a knob that
+README never documents, the rig campaign never sets, and a reviewer
+never sees. G11 makes the surface closed:
+
+- a read in ``weaviate_tpu/`` must either live in ``config.py``, or be
+  registered in the checked-in inventory
+  (``tools/graftlint/env_inventory.json``) under its (name, path);
+- reads with non-literal keys (``os.environ.get(self.endpoint_env)``,
+  prefix-composed names) register as ``dynamic`` entries keyed by
+  (path, scope) and — like baseline entries — MUST carry a reason;
+- a registered entry whose read no longer exists is STALE (fix the
+  inventory, or ``--update-env-inventory`` regenerates the literal
+  half and validates the dynamic half).
+
+Recognized indirection (so the repo's real idioms need no entries per
+read site):
+
+- **accessor helpers** — a function whose env-read key is one of its
+  own parameters (``def _env(name, default): os.environ.get(name)``)
+  is an accessor: the read inside it is exempt, and each literal call
+  site of the accessor becomes the registered read instead. Accessors
+  calling accessors chase to a fixpoint.
+- **env-mapping parameters** — functions taking an ``env`` mapping
+  (defaulted from ``os.environ``, the config.py pattern): literal
+  ``env.get("X")`` reads count as reads at that site.
+
+``--env-inventory`` prints the live scan (all ``WEAVIATE_TPU_*`` and
+other env names with their read sites) as JSON; a tier-1 test pins that
+README documents every ``WEAVIATE_TPU_*`` knob the scan finds.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from tools.graftlint.core import (Checker, FileContext, ProgramIndex,
+                                  Violation, walk_shallow)
+
+#: the sanctioned config surface — reads here need no registration
+EXEMPT = ("weaviate_tpu/config.py",)
+
+#: config.py parse helpers usable from other modules — all take
+#: ``(env, name, ...)``, so the knob name is argument index 1
+CONFIG_ACCESSORS = ("_flag", "_csv", "_int", "_float", "_fraction")
+
+
+def default_inventory_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "env_inventory.json")
+
+
+def load_inventory(path: str) -> dict:
+    if not path or not os.path.exists(path):
+        return {"reads": [], "dynamic": []}
+    with open(path) as f:
+        inv = json.load(f)
+    if not isinstance(inv, dict):
+        raise ValueError(f"{path}: inventory must be a JSON object")
+    inv.setdefault("reads", [])
+    inv.setdefault("dynamic", [])
+    return inv
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse failure
+        return "<expr>"
+
+
+class _FileScan:
+    """Env-read extraction for one file: accessor fixpoint + sites."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        # name of module-level functions -> (node, params list)
+        self.fns: dict[str, ast.FunctionDef] = {
+            n.name: n for n in ctx.tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        #: accessor fn name -> key-parameter name
+        self.accessors: dict[str, str] = {}
+        #: imported config.py helpers: local alias -> key argument index
+        self.imported_accessors: dict[str, int] = {}
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.ImportFrom) \
+                    and n.module == "weaviate_tpu.config":
+                for a in n.names:
+                    if a.name in CONFIG_ACCESSORS:
+                        self.imported_accessors[a.asname or a.name] = 1
+        self.env_from_os = any(
+            isinstance(n, ast.ImportFrom) and n.module == "os"
+            and any(a.name == "environ" for a in n.names)
+            for n in ast.walk(ctx.tree))
+        # [name|None, line, col, how, scope]
+        self.sites: list[list] = []
+
+    # -- env-base / read-form detection ---------------------------------------
+
+    def _env_locals(self, fn) -> set[str]:
+        """Names that hold an env mapping inside ``fn``: parameters
+        named env/environ and locals assigned the os.environ mapping
+        itself (``env = os.environ``, ``env = environ if ... else env``
+        — NOT values read out of it)."""
+        names = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                 + fn.args.kwonlyargs)
+                 if a.arg in ("env", "environ")}
+        for n in walk_shallow(fn.body):
+            if isinstance(n, ast.Assign) \
+                    and self._is_env_value(n.value, names):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+    def _is_env_value(self, node, env_locals: set[str]) -> bool:
+        """Is ``node`` the env mapping itself (through or/ternary)?"""
+        if isinstance(node, ast.IfExp):
+            return self._is_env_value(node.body, env_locals) \
+                or self._is_env_value(node.orelse, env_locals)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_env_value(v, env_locals)
+                       for v in node.values)
+        return self._is_env_base(node, env_locals)
+
+    def _is_env_base(self, expr, env_locals: set[str]) -> bool:
+        if isinstance(expr, ast.Attribute) and expr.attr == "environ" \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "os":
+            return True
+        if isinstance(expr, ast.Name):
+            return expr.id in env_locals \
+                or (self.env_from_os and expr.id == "environ")
+        return False
+
+    def _read_key(self, node, env_locals: set[str]):
+        """The key expression if ``node`` is an env read, else None."""
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "get" \
+                    and self._is_env_base(fn.value, env_locals) \
+                    and node.args:
+                return node.args[0]
+            if isinstance(fn, ast.Attribute) and fn.attr == "getenv" \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "os" and node.args:
+                return node.args[0]
+            if isinstance(fn, ast.Name) and fn.id == "getenv" \
+                    and node.args and self._imported_getenv():
+                return node.args[0]
+        if isinstance(node, ast.Subscript) \
+                and self._is_env_base(node.value, env_locals):
+            s = node.slice
+            return s.value if isinstance(s, ast.Index) else s  # py<3.9
+        return None
+
+    def _imported_getenv(self) -> bool:
+        return any(
+            isinstance(n, ast.ImportFrom) and n.module == "os"
+            and any(a.name == "getenv" for a in n.names)
+            for n in ast.walk(self.ctx.tree))
+
+    # -- scan -----------------------------------------------------------------
+
+    def run(self) -> list[list]:
+        # pass 1: direct reads everywhere; seed accessors (locals whose
+        # key is a param, plus imported config.py parse helpers)
+        for alias in self.imported_accessors:
+            self.accessors.setdefault(alias, "name")
+        self._scan_all_functions()
+        self._scan_module_level()
+        # pass 2..n: accessor call sites, chased to a fixpoint (an
+        # accessor calling an accessor with its own param promotes the
+        # caller)
+        for _ in range(6):
+            before = dict(self.accessors)
+            self._scan_accessor_calls()
+            if self.accessors == before:
+                break
+        return self.sites
+
+    def _scan_all_functions(self):
+        """Scan every function; nested defs inherit the enclosing
+        function's env-mapping names (``env`` captured by closure, the
+        ``AuthConfig.from_env`` nested-helper pattern). Nested helpers
+        can be accessors too; module level wins a name collision."""
+
+        def rec(node, inherited):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    self.fns.setdefault(child.name, child)
+                    env_locals = self._env_locals(child) | inherited
+                    self._scan_function(child, env_locals)
+                    rec(child, env_locals)
+                else:
+                    rec(child, inherited)
+
+        rec(self.ctx.tree, set())
+
+    def _params(self, fn) -> list[str]:
+        return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+    def _record(self, name, node, how):
+        self.sites.append([name, node.lineno, node.col_offset, how,
+                           self.ctx.scope_at(node.lineno)])
+
+    def _classify(self, key, node, fn, how):
+        """One env read with key expression ``key`` at ``node``."""
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            self._record(key.value, node, how)
+            return
+        if fn is not None and isinstance(key, ast.Name) \
+                and key.id in self._params(fn):
+            # an accessor: the read is judged at its call sites instead
+            self.accessors.setdefault(fn.name, key.id)
+            return
+        self._record(None, node, f"{how} key={_expr_text(key)}")
+
+    def _scan_function(self, fn, env_locals: set[str]):
+        for node in walk_shallow(fn.body):
+            key = self._read_key(node, env_locals)
+            if key is not None:
+                self._classify(key, node, fn, "env read")
+
+    def _scan_module_level(self):
+        # module-level statements plus class-level attribute defaults
+        # (function bodies are covered by _scan_function)
+        body, stack = [], list(self.ctx.tree.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.ClassDef):
+                stack.extend(n.body)
+            else:
+                body.append(n)
+        for node in walk_shallow(body):
+            key = self._read_key(node, set())
+            if key is not None:
+                self._classify(key, node, None, "env read")
+
+    def _scan_accessor_calls(self):
+        """Literal calls of known accessor functions are the registered
+        reads; a param key promotes the calling function."""
+        seen: set[tuple] = {(s[1], s[2]) for s in self.sites}
+
+        def visit(node, fn):
+            for child in ast.iter_child_nodes(node):
+                inner = child if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    else fn
+                visit(child, inner)
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Name):
+                return
+            pname = self.accessors.get(node.func.id)
+            if pname is None or (node.lineno, node.col_offset) in seen:
+                return
+            acc = self.fns.get(node.func.id)
+            key = None
+            if acc is not None:
+                params = self._params(acc)
+                idx = params.index(pname) if pname in params else -1
+                if 0 <= idx < len(node.args):
+                    key = node.args[idx]
+            elif node.func.id in self.imported_accessors:
+                idx = self.imported_accessors[node.func.id]
+                if idx < len(node.args):
+                    key = node.args[idx]
+            if key is None:
+                key = next((kw.value for kw in node.keywords
+                            if kw.arg == pname), None)
+            if key is None:
+                return
+            seen.add((node.lineno, node.col_offset))
+            self._classify(key, node, fn,
+                           f"via accessor {node.func.id}()")
+
+        visit(self.ctx.tree, None)
+
+
+class ConfigSurfaceChecker(Checker):
+    id = "G11"
+    name = "config-surface"
+
+    def __init__(self, inventory_path: str | None = None):
+        self.inventory_path = inventory_path or default_inventory_path()
+        #: live sites from the last finalize, for --env-inventory
+        self.live: dict[str, list] = {}
+
+    def applies_to(self, path: str) -> bool:
+        return (path.endswith(".py")
+                and path.startswith("weaviate_tpu/")
+                and path not in EXEMPT
+                and "test" not in path.rsplit("/", 1)[-1])
+
+    def facts(self, ctx: FileContext):
+        # empty lists matter: they prove the file was scanned, which is
+        # what scopes stale-entry detection to the scanned set
+        return {"sites": _FileScan(ctx).run()}
+
+    def finalize(self, facts: dict[str, dict],
+                 program: ProgramIndex | None = None) -> list[Violation]:
+        try:
+            inv = load_inventory(self.inventory_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            return [Violation(self.id, os.path.basename(
+                self.inventory_path), 1, 0,
+                f"[config-surface] unreadable env inventory: {e}")]
+        reads = {(e.get("name"), e.get("path")): e
+                 for e in inv.get("reads", [])}
+        dynamic = {(e.get("path"), e.get("scope", "")): e
+                   for e in inv.get("dynamic", [])}
+        self.live = {p: f.get("sites", []) for p, f in facts.items()}
+        out: list[Violation] = []
+        live_reads: set[tuple] = set()
+        live_dyn: set[tuple] = set()
+        for path, fact in sorted(facts.items()):
+            for name, line, col, how, scope in fact.get("sites", []):
+                if name is not None:
+                    live_reads.add((name, path))
+                    if (name, path) in reads:
+                        continue
+                    out.append(Violation(
+                        self.id, path, line, col,
+                        f"[config-surface] env read of {name!r} "
+                        f"({how}) outside config.py and not in the "
+                        "env inventory — route it through "
+                        "ServerConfig.from_env, or register it: "
+                        "python -m tools.graftlint "
+                        "--update-env-inventory", scope=scope))
+                    continue
+                live_dyn.add((path, scope))
+                ent = dynamic.get((path, scope))
+                if ent is not None and str(ent.get("reason",
+                                                   "")).strip():
+                    continue
+                out.append(Violation(
+                    self.id, path, line, col,
+                    f"[config-surface] dynamic env read ({how}) "
+                    "not registered — dynamic names need a reasoned "
+                    "'dynamic' inventory entry for (path, scope), "
+                    "like a baseline entry", scope=scope))
+        # stale entries, scoped to files this run actually scanned
+        scanned = set(facts)
+        for (name, path), _e in sorted(reads.items()):
+            if path in scanned and (name, path) not in live_reads:
+                out.append(Violation(
+                    self.id, path, 1, 0,
+                    f"[config-surface] stale env-inventory entry: "
+                    f"{name!r} is no longer read in this file — "
+                    "delete it or run --update-env-inventory"))
+        for (path, scope), _e in sorted(dynamic.items()):
+            if path in scanned and (path, scope) not in live_dyn:
+                out.append(Violation(
+                    self.id, path, 1, 0,
+                    f"[config-surface] stale dynamic env-inventory "
+                    f"entry for scope {scope!r} — no dynamic read "
+                    "there anymore; delete it"))
+        return out
+
+    # -- inventory emission / regeneration ------------------------------------
+
+    def live_inventory(self) -> dict:
+        """The live scan as an inventory-shaped dict (reads sorted,
+        dynamic sites listed without reasons — those are hand-written)."""
+        counts: dict[tuple, int] = {}
+        dyn: list[dict] = []
+        for path, sites in sorted(self.live.items()):
+            for name, line, col, how, scope in sites:
+                if name is not None:
+                    counts[(name, path)] = counts.get((name, path),
+                                                      0) + 1
+                else:
+                    dyn.append({"path": path, "scope": scope,
+                                "line": line, "how": how})
+        reads = [{"name": n, "path": p} | ({"count": c} if c > 1
+                                           else {})
+                 for (n, p), c in sorted(counts.items())]
+        return {"reads": reads, "dynamic": dyn}
+
+    def update_inventory(self) -> tuple[int, list[dict]]:
+        """Regenerate the literal half from the live scan; keep dynamic
+        entries that still match a live dynamic read (their reasons are
+        hand-written), drop the rest. Returns (dropped_dynamic,
+        unregistered_dynamic_sites)."""
+        inv = load_inventory(self.inventory_path)
+        live = self.live_inventory()
+        live_dyn = {(d["path"], d["scope"]) for d in live["dynamic"]}
+        kept, dropped = [], 0
+        seen: set[tuple] = set()
+        for e in inv.get("dynamic", []):
+            k = (e.get("path"), e.get("scope", ""))
+            if k in live_dyn and k not in seen:
+                kept.append(e)
+                seen.add(k)
+            else:
+                dropped += 1
+        missing = [d for d in live["dynamic"]
+                   if (d["path"], d["scope"]) not in
+                   {(e.get("path"), e.get("scope", "")) for e in kept}]
+        with open(self.inventory_path, "w") as f:
+            json.dump({"reads": live["reads"], "dynamic": kept}, f,
+                      indent=2, sort_keys=True)
+            f.write("\n")
+        return dropped, missing

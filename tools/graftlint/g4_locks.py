@@ -23,105 +23,22 @@ state with ``with self._lock:`` blocks):
    pairs don't produce false self-edges. Call edges resolve by method
    name only when EXACTLY ONE lock-acquiring method in the repo has
    that name — ambiguity is skipped, not guessed.
+
+The lock-attribute detection, "Caller holds" docstring convention, and
+typed-receiver machinery this checker pioneered now live in ``core``
+(shared with the ProgramIndex and the G9 thread-discipline checker);
+this module keeps only the two G4 verdicts.
 """
 
 from __future__ import annotations
 
 import ast
-import re
 
-from tools.graftlint.core import Checker, FileContext, Violation
-
-LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
-              "BoundedSemaphore"}
-
-#: docstring convention marking a helper that runs under the caller's
-#: lock. The "under X" branch requires X to be a lock-ish token
-#: (ends in lock/cv/mutex) — a doc saying "under _normal operating
-#: conditions" must NOT silently exempt the method
-CALLER_HOLDS_RE = re.compile(
-    r"caller\s+(?:must\s+)?hold|held\s+by\s+(?:the\s+)?caller"
-    r"|under\s+`{0,2}(?:self\.)?_?\w*(?:lock|cv|mutex)\b"
-    r"|while\s+holding|with\s+`{0,2}_?\w*(?:lock|cv)`{0,2}\s+held",
-    re.IGNORECASE)
-
-#: method names too generic to resolve by NAME ALONE on an untyped
-#: receiver — file objects, lists, metric children and half the stdlib
-#: answer to these, so a name-only match would wire phantom edges into
-#: the graph (e.g. ``self._f.flush()`` is not ``Bucket.flush``). Calls
-#: on receivers whose class is statically known still resolve.
-UNTYPED_STOPLIST = {
-    "append", "add", "get", "put", "set", "write", "read", "flush",
-    "close", "open", "reset", "clear", "pop", "remove", "update",
-    "extend", "insert", "send", "recv", "join", "acquire", "release",
-    "wait", "notify", "notify_all", "items", "keys", "values", "copy",
-    "index", "count", "sort", "labels", "observe", "inc", "dec", "tell",
-    "seek", "info", "debug", "warning", "error", "run", "start", "stop",
-    "submit", "result", "cancel", "render", "encode", "decode", "next",
-    "register", "track", "search", "delete", "exists", "list", "load",
-    "save", "sync", "commit", "apply", "replace", "split", "strip",
-}
-
-
-def _lock_ctor(node: ast.AST) -> str | None:
-    """'Lock'/'RLock'/'Condition'/... if node is threading.X(...)."""
-    if not isinstance(node, ast.Call):
-        return None
-    fn = node.func
-    if isinstance(fn, ast.Attribute) and fn.attr in LOCK_CTORS \
-            and isinstance(fn.value, ast.Name) \
-            and fn.value.id in ("threading", "mt", "thread"):
-        return fn.attr
-    if isinstance(fn, ast.Name) and fn.id in LOCK_CTORS:
-        return fn.id
-    return None
-
-
-def _self_attr(node: ast.AST) -> str | None:
-    if isinstance(node, ast.Attribute) \
-            and isinstance(node.value, ast.Name) \
-            and node.value.id == "self":
-        return node.attr
-    return None
-
-
-class _ClassLocks:
-    def __init__(self, cls: ast.ClassDef, path: str):
-        self.cls = cls
-        self.path = path
-        self.attrs: set[str] = set()        # canonical lock attrs
-        self.aliases: dict[str, str] = {}   # cv attr -> underlying lock
-        for node in ast.walk(cls):
-            if not isinstance(node, ast.Assign):
-                continue
-            ctor = _lock_ctor(node.value)
-            if ctor is None:
-                continue
-            for tgt in node.targets:
-                attr = _self_attr(tgt)
-                if attr is None:
-                    continue
-                call = node.value
-                if ctor == "Condition" and call.args:
-                    inner = _self_attr(call.args[0])
-                    if inner is not None:
-                        self.aliases[attr] = inner
-                        continue
-                self.attrs.add(attr)
-        # alias targets must exist as locks; otherwise treat the cv as
-        # its own lock
-        for cv, inner in list(self.aliases.items()):
-            if inner not in self.attrs:
-                self.aliases.pop(cv)
-                self.attrs.add(cv)
-
-    def canonical(self, attr: str) -> str | None:
-        if attr in self.aliases:
-            attr = self.aliases[attr]
-        return attr if attr in self.attrs else None
-
-    def node_id(self, attr: str) -> str:
-        return f"{self.path}:{self.cls.name}.{attr}"
+from tools.graftlint.core import (CALLER_HOLDS_RE, UNTYPED_STOPLIST,
+                                  Checker, FileContext, ProgramIndex,
+                                  Violation, _ClassLocks, _lock_ctor,
+                                  _self_attr, class_attr_types,
+                                  held_from_docstring)
 
 
 class LockDisciplineChecker(Checker):
@@ -223,62 +140,6 @@ class LockDisciplineChecker(Checker):
 
     # -- facts for the cross-module acquisition graph -------------------------
 
-    def _attr_types(self, cls: ast.ClassDef) -> dict[str, str]:
-        """self.<attr> -> ClassName, from ``self.x = ClassName(...)``
-        assignments and ``self.x = self._maker()`` where ``_maker``'s
-        returns are all ``ClassName(...)`` constructor calls."""
-        maker_returns: dict[str, str | None] = {}
-        for meth in cls.body:
-            if not isinstance(meth, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                continue
-            rets = [n for n in ast.walk(meth)
-                    if isinstance(n, ast.Return) and n.value is not None]
-            names = set()
-            for r in rets:
-                if isinstance(r.value, ast.Call) \
-                        and isinstance(r.value.func, ast.Name) \
-                        and r.value.func.id[:1].isupper():
-                    names.add(r.value.func.id)
-                else:
-                    names.add(None)
-            if len(names) == 1 and None not in names:
-                maker_returns[meth.name] = names.pop()
-        types: dict[str, str] = {}
-        for node in ast.walk(cls):
-            if not isinstance(node, ast.Assign):
-                continue
-            for tgt in node.targets:
-                attr = _self_attr(tgt)
-                if attr is None:
-                    continue
-                v = node.value
-                if isinstance(v, ast.Call):
-                    if isinstance(v.func, ast.Name) \
-                            and v.func.id[:1].isupper():
-                        types[attr] = v.func.id
-                    elif isinstance(v.func, ast.Attribute) \
-                            and _self_attr(v.func) is not None \
-                            and v.func.attr in maker_returns:
-                        types[attr] = maker_returns[v.func.attr]
-        return types
-
-    def _held_from_docstring(self, doc: str, cl: _ClassLocks) -> list[str]:
-        """For a "Caller holds ..." helper, which class locks its body
-        runs under: the lock attrs named in the docstring, else all.
-        Whole-token match only — ``_lock`` must not match inside
-        ``_flush_lock`` or the graph grows phantom held-edges."""
-        named = [a for a in sorted(cl.attrs | set(cl.aliases))
-                 if re.search(rf"(?<![A-Za-z0-9]){re.escape(a)}"
-                              rf"(?![A-Za-z0-9_])", doc)]
-        attrs = named or sorted(cl.attrs)
-        out = []
-        for a in attrs:
-            canon = cl.canonical(a)
-            if canon:
-                out.append(cl.node_id(canon))
-        return out
-
     def facts(self, ctx: FileContext):
         module_locks: dict[str, str] = {}   # local name -> node id
         for node in ctx.tree.body:
@@ -289,7 +150,7 @@ class LockDisciplineChecker(Checker):
         classes = {node.name: _ClassLocks(node, ctx.path)
                    for node in ctx.tree.body
                    if isinstance(node, ast.ClassDef)}
-        attr_types = {name: self._attr_types(cl.cls)
+        attr_types = {name: class_attr_types(cl.cls)
                       for name, cl in classes.items()}
 
         edges: list[list] = []        # [holder, inner, line]
@@ -337,7 +198,7 @@ class LockDisciplineChecker(Checker):
                 doc = ast.get_docstring(node) or ""
                 seed: list[str] = []
                 if cl is not None and CALLER_HOLDS_RE.search(doc):
-                    seed = self._held_from_docstring(doc, cl)
+                    seed = held_from_docstring(doc, cl)
                 for child in node.body:
                     visit(child, seed, cl, node.name)
                 return
@@ -386,7 +247,9 @@ class LockDisciplineChecker(Checker):
 
     # -- cross-module pass ----------------------------------------------------
 
-    def finalize(self, facts: dict[str, dict]) -> list[Violation]:
+    def finalize(self, facts: dict[str, dict],
+                 program: ProgramIndex | None = None
+                 ) -> list[Violation]:
         # 1. merge acquirer indexes: class -> method -> locks, plus a
         #    name-only view for untyped receivers (resolved only when
         #    globally unambiguous and not a generic stdlib name)

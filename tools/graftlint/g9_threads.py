@@ -1,0 +1,159 @@
+"""G9 thread-discipline: role-aware reachability over the ProgramIndex.
+
+The serving path is a multi-threaded machine with per-role contracts
+that no per-file checker can see:
+
+1. **Transfer drain-thread callbacks must never sync.** The whole point
+   of ``TransferPipeline`` is that the drain thread performs THE one
+   blocking D2H per batch; a callback that itself calls
+   ``block_until_ready`` / ``.result()`` / ``jax.device_get`` — directly
+   or through any helper it reaches — serializes a second device wait
+   into the drain and re-creates the sync stall the pipeline exists to
+   remove (the PR 8 round-2 bug class). Seeds are the callbacks passed
+   to ``TransferPipeline.submit`` (receivers resolved through static
+   types or a ``transfer``-named receiver); the walk covers everything
+   reachable through the call graph, so the violation can live three
+   helpers away in another module.
+
+2. **No rpc/fsync while a db/- or engine/-class lock is held.** A
+   ``transport.rpc`` (seconds under retry) or ``fsutil`` fsync
+   (milliseconds of disk) inside a ``with self._lock:`` on a
+   ``weaviate_tpu/db/`` or ``weaviate_tpu/engine/`` class stalls every
+   reader of that shard/store for the duration — the join-under-lock
+   family from PR 5, now joined with the call graph so the blocking
+   call can hide behind a method boundary.
+
+Violations are reported at the offending call site in the reachable
+function (with the seed and witness chain in the message), so inline
+suppressions and the baseline work exactly like every other checker.
+``weaviate_tpu/runtime/transfer.py`` and ``tracing.py`` are exempt from
+rule 1: they ARE the sanctioned sync boundary the rule points hot code
+at.
+"""
+
+from __future__ import annotations
+
+import re
+
+from tools.graftlint.core import (SYNC_EFFECTS, Checker, ProgramIndex,
+                                  Violation)
+
+#: the sanctioned sync boundaries — the drain itself lives here
+DRAIN_EXEMPT = ("weaviate_tpu/runtime/transfer.py",
+                "weaviate_tpu/runtime/tracing.py")
+
+#: lock ids whose critical sections must stay io-free
+_HOT_LOCK_RE = re.compile(r"^weaviate_tpu/(db|engine)/")
+
+
+class ThreadDisciplineChecker(Checker):
+    id = "G9"
+    name = "thread-discipline"
+
+    def applies_to(self, path: str) -> bool:
+        return (path.endswith(".py")
+                and path.startswith("weaviate_tpu/")
+                and "test" not in path.rsplit("/", 1)[-1])
+
+    def finalize(self, facts: dict[str, dict],
+                 program: ProgramIndex | None = None) -> list[Violation]:
+        if program is None:
+            return []
+        out: list[Violation] = []
+        out.extend(self._drain_sync(program))
+        out.extend(self._lock_io(program))
+        return out
+
+    # -- rule 1: no device sync reachable from a drain callback ---------------
+
+    def _drain_sync(self, program: ProgramIndex) -> list[Violation]:
+        out: list[Violation] = []
+        reported: set[tuple] = set()
+        for role in program.roles():
+            if role["role"] != "drain" or role["target"] is None:
+                continue
+            seed = role["target"]
+            if program.path_of(seed) in DRAIN_EXEMPT:
+                continue
+            reached = program.reachable(seed)
+            for fid in reached:
+                path = program.path_of(fid)
+                if path in DRAIN_EXEMPT:
+                    continue
+                for kind, line, col, _held in \
+                        program.fn[fid].get("effects", ()):
+                    if kind not in SYNC_EFFECTS:
+                        continue
+                    key = (path, line, kind)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    via = ""
+                    if fid != seed:
+                        via = (" (reached via "
+                               f"{program.chain(reached, fid)})")
+                    out.append(Violation(
+                        self.id, path, line, col,
+                        f"[thread-discipline] {kind} runs on the "
+                        "transfer drain thread: reachable from drain "
+                        f"callback {program.qual_of(seed)} (submitted "
+                        f"in {role['path']}){via} — a "
+                        "second device wait inside the drain serializes "
+                        "the D2H overlap away; return the value and "
+                        "post-process off-thread"))
+        return out
+
+    # -- rule 2: no rpc/fsync under a db/engine-class lock --------------------
+
+    def _lock_io(self, program: ProgramIndex) -> list[Violation]:
+        out: list[Violation] = []
+        reported: set[tuple] = set()
+
+        def hot(held) -> list[str]:
+            return [h for h in held if _HOT_LOCK_RE.match(h)]
+
+        for fid, fact in program.fn.items():
+            path = program.path_of(fid)
+            for kind, line, col, held in fact.get("effects", ()):
+                locks = hot(held)
+                if kind not in ("rpc", "fsync") or not locks:
+                    continue
+                key = (path, line)
+                if key not in reported:
+                    reported.add(key)
+                    out.append(Violation(
+                        self.id, path, line, col,
+                        f"[thread-discipline] {kind} while holding "
+                        f"{self._short(locks[0])} — blocking io under "
+                        "a db/engine-class lock stalls every reader "
+                        "for the io's duration; move it outside the "
+                        "critical section"))
+            for ref, line, held in fact.get("calls", ()):
+                locks = hot(held)
+                if not locks:
+                    continue
+                callee = program.resolve_in(fid, ref)
+                if callee is None:
+                    continue
+                kinds = program.reaches(callee) & {"rpc", "fsync"}
+                if not kinds:
+                    continue
+                key = (path, line)
+                if key in reported:
+                    continue
+                reported.add(key)
+                kind = sorted(kinds)[0]
+                out.append(Violation(
+                    self.id, path, line, col=0,
+                    message=(
+                        f"[thread-discipline] call reaches {kind} "
+                        f"({program.witness(callee, kind)}) while "
+                        f"holding {self._short(locks[0])} — blocking "
+                        "io under a db/engine-class lock stalls every "
+                        "reader; move the io outside the critical "
+                        "section")))
+        return out
+
+    @staticmethod
+    def _short(lock_id: str) -> str:
+        return lock_id.split("/")[-1]

@@ -102,6 +102,11 @@ class HNSWIndex:
         self._native = None
         self._native_dirty = False
 
+        # WAL appends (and the wal_sync-gated fsync) run inside ``_lock``
+        # so the log order matches mutation order — graftlint G9 baselines
+        # this cluster with a reason; decoupling needs the sequenced WAL
+        # queue sketched in ROADMAP item 6 (enqueue under the lock, append
+        # and fsync on a writer thread outside it, replay in sequence)
         self._log: WriteAheadLog | None = None
         self._log_dir = commit_log_dir
         self._condense_above = condense_above_bytes

@@ -289,7 +289,8 @@ class QuantizedVectorStore:
             if self.codebook is None:
                 raise RuntimeError("PQ store not trained; call train() first")
             return pq_ops.pq_encode(self.codebook, vectors)
-        return np.asarray(bq_ops.bq_encode(jnp.asarray(vectors)))
+        (codes,) = tracing.d2h(bq_ops.bq_encode(jnp.asarray(vectors)))
+        return codes
 
     def _maybe_norm(self, vectors: np.ndarray) -> np.ndarray:
         if self.normalize_on_add:
@@ -383,16 +384,16 @@ class QuantizedVectorStore:
 
     def _write_codes(self, slots: np.ndarray, codes: np.ndarray | None,
                      rows: np.ndarray | None, pref: np.ndarray | None = None):
+        """Scatter codes (and bf16 rescore rows) into the device arrays,
+        donated in place; padding to pow2 buckets bounds compiled variants."""
         if (pref is None and rows is not None and self.prefix_words
                 and self.quantization == "pq" and codes is not None):
             # PQ prefix comes from the raw vectors' sign bits, not the
             # codes (the BQ store slices its own codes instead); derived
             # here so every write path — add, re-encode after train,
             # restore-from-vectors — carries it
-            pref = np.asarray(bq_ops.bq_encode(
+            (pref,) = tracing.d2h(bq_ops.bq_encode(
                 jnp.asarray(np.asarray(rows)[:, :self.prefix_words * 32])))
-        """Scatter codes (and bf16 rescore rows) into the device arrays,
-        donated in place; padding to pow2 buckets bounds compiled variants."""
         m = len(slots)
         if m == 0:
             return

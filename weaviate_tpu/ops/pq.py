@@ -136,13 +136,14 @@ def pq_fit(
 
 def pq_encode(codebook: PQCodebook, vectors: np.ndarray, batch: int = 65536) -> np.ndarray:
     """Encode vectors -> codes [N, m] uint8 (reference Encode :420)."""
+    from weaviate_tpu.runtime import tracing  # lazy: ops must not pull runtime at import
+
     vectors = np.asarray(vectors, dtype=np.float32)
     out = np.empty((len(vectors), codebook.m), dtype=np.uint8)
     for s in range(0, len(vectors), batch):
         chunk = jnp.asarray(vectors[s : s + batch])
-        out[s : s + batch] = np.asarray(
-            _assign(chunk, codebook.centroids, codebook.m)
-        ).astype(np.uint8)
+        (codes,) = tracing.d2h(_assign(chunk, codebook.centroids, codebook.m))
+        out[s : s + batch] = codes.astype(np.uint8)
     return out
 
 

@@ -31,6 +31,10 @@ This module is the fix's substrate (ISSUE 7 tentpole):
 This file is deliberately OUTSIDE graftlint G1's hot-path scope: it IS
 the API boundary the checker tells hot paths to move their transfers to
 (the same standing tracing.py has for its sampled ``device_sync``).
+G9's drain rule carries the same exemption (``DRAIN_EXEMPT``): the
+drain thread's ONE blocking wait lives here by design, and the
+whole-program walk flags any submitted callback that reaches a second
+sync — keep callbacks host-only and post-process off-thread.
 """
 
 from __future__ import annotations

@@ -860,7 +860,13 @@ class Bucket:
         """Writer-side valve, called WITHOUT ``_lock``: when sealed
         memtables back up past MAX_SEALED, the writer pays for one flush
         instead of RAM growing without bound (reference: memtable flush
-        blocks the put when the flushing queue backs up)."""
+        blocks the put when the flushing queue backs up).
+
+        Deliberately lock-free HERE, but db-layer callers wrap whole
+        batches in shard/collection locks, so the flush's fsync still
+        lands inside THEIR critical sections — graftlint G9 baselines
+        that cluster; the fix shape (stage under the lock, pay
+        backpressure after release) is ROADMAP item 6."""
         if len(self._sealed) > self.MAX_SEALED:
             self.flush_pending(max_tables=1)
 
